@@ -1,0 +1,33 @@
+"""clustering-driven-replication-strategy-tpu — TPU-native rebuild of
+Harounnn/Clustering-Driven-Replication-Strategy.
+
+A framework that synthesizes an HDFS-like file population and access workload,
+extracts per-file access features, clusters files with KMeans++, and classifies
+each cluster into a replication category (Hot/Shared/Moderate/Archival) —
+re-designed for TPU: JAX/XLA kernels, jax.sharding meshes, Pallas distance
+kernels, with a NumPy reference backend for behavioural parity.
+
+Package map (SURVEY.md §7):
+  config    — typed configuration for every stage
+  sim       — population generator + Poisson access simulator (L1)
+  features  — feature extraction backends (L2): numpy golden model, jax segment ops
+  ops       — numerical kernels (L3): kmeans, scoring, distance, segment, quantile
+  parallel  — mesh construction, shard_map kernels, collectives (multi-chip)
+  models    — the flagship ReplicationPolicyModel + streaming variant (L4)
+  io        — on-disk contracts (metadata.csv / access.log / features CSV)
+  compat    — drop-in reference API (kmeans(), ClusterClassifier)
+  runtime   — native C++ runtime bindings (event generation, log parsing)
+  cli       — the single `cdrs` CLI (L5)
+"""
+
+__version__ = "0.1.0"
+
+from .config import (  # noqa: F401
+    CATEGORIES,
+    CLUSTERING_FEATURES,
+    GeneratorConfig,
+    KMeansConfig,
+    PipelineConfig,
+    ScoringConfig,
+    SimulatorConfig,
+)

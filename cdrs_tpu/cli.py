@@ -1,0 +1,214 @@
+"""One coherent CLI for the whole framework.
+
+The reference scatters its entry points across four argparse scripts plus a
+bash pipeline and a Makefile (SURVEY.md §1 L5).  Here every stage is a
+subcommand of ``python -m cdrs_tpu`` (or the ``cdrs`` console script):
+
+  gen       synthetic population -> metadata.csv       (reference: generator.py)
+  simulate  Poisson access events -> access.log        (reference: access_simulator.py)
+  features  manifest+log -> features CSV               (reference: compute_features.py)
+  cluster   features CSV -> final_categories.csv       (reference: main.py)
+  pipeline  all of the above end-to-end                (reference: run_pipeline.sh + main.py)
+  bench     benchmark harness                          (new; BASELINE.md configs)
+
+``--backend {numpy,jax}`` selects the execution backend per the BASELINE.json
+north star; the numpy path preserves reference behaviour (minus crash bugs),
+the jax path scales.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from .config import (
+    CLUSTERING_FEATURES,
+    GeneratorConfig,
+    KMeansConfig,
+    PipelineConfig,
+    ScoringConfig,
+    SimulatorConfig,
+)
+from .utils.logging import StageTimer
+
+__all__ = ["main"]
+
+
+def _add_backend_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--backend", choices=["numpy", "jax"], default="numpy")
+
+
+def _cmd_gen(args) -> int:
+    from .sim.generator import generate_population
+
+    cfg = GeneratorConfig(
+        n_files=args.n, base_dir=args.hdfs_dir, min_size=args.min_size,
+        max_size=args.max_size, nodes=tuple(args.nodes.split(",")),
+        age_days_max=args.age_days_max, seed=args.seed,
+        write_payloads=args.write_payloads,
+    )
+    with StageTimer("gen") as t:
+        manifest = generate_population(cfg)
+        manifest.write_csv(args.out_manifest)
+    print(f"Wrote {args.out_manifest} ({len(manifest)} files) in {t.elapsed:.2f}s")
+    return 0
+
+
+def _cmd_simulate(args) -> int:
+    from .io.events import Manifest
+    from .sim.access import simulate_access
+
+    cfg = SimulatorConfig(
+        duration_seconds=args.duration_seconds,
+        clients=tuple(args.clients.split(",")),
+        seed=args.seed,
+    )
+    with StageTimer("simulate") as t:
+        manifest = Manifest.read_csv(args.manifest)
+        events = simulate_access(manifest, cfg)
+        events.write_csv(args.out, manifest)
+    print(f"Wrote {args.out} with {len(events)} entries in {t.elapsed:.2f}s")
+    return 0
+
+
+def _cmd_features(args) -> int:
+    from .io.events import EventLog, Manifest
+
+    with StageTimer("features") as t:
+        manifest = Manifest.read_csv(args.manifest)
+        events = EventLog.read_csv(args.access_log, manifest)
+        if args.backend == "jax":
+            from .features.jax_backend import compute_features_jax as compute
+        else:
+            from .features.numpy_backend import compute_features as compute
+        table = compute(manifest, events)
+        out = args.out
+        if os.path.isdir(out) or out.endswith(os.sep):
+            os.makedirs(out, exist_ok=True)
+            out = os.path.join(out, "part-00000-features.csv")
+        else:
+            parent = os.path.dirname(out)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+        table.write_csv(out)
+    print(f"Wrote features to {out} in {t.elapsed:.2f}s")
+    return 0
+
+
+def _cmd_cluster(args) -> int:
+    from .io.features import load_feature_matrix
+    from .models.replication import ReplicationPolicyModel
+
+    scoring = ScoringConfig(
+        compute_global_medians_from_data=args.medians_from_data)
+    model = ReplicationPolicyModel(
+        kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed),
+        scoring_cfg=scoring,
+        backend=args.backend,
+    )
+    with StageTimer("cluster") as t:
+        X, paths = load_feature_matrix(args.input_path)
+        decision = model.run(X)
+        decision.write_csv(args.output_csv)
+        if args.assignments_csv:
+            decision.write_assignments_csv(args.assignments_csv, paths)
+    print(f"Cluster centroid assignments ({args.k} clusters) saved to: "
+          f"{args.output_csv} in {t.elapsed:.2f}s")
+    return 0
+
+
+def _cmd_pipeline(args) -> int:
+    from .pipeline import run_pipeline
+
+    cfg = PipelineConfig(
+        backend=args.backend,
+        generator=GeneratorConfig(n_files=args.n, seed=args.seed),
+        simulator=SimulatorConfig(duration_seconds=args.duration_seconds,
+                                  seed=None if args.seed is None else args.seed + 1),
+        kmeans=KMeansConfig(k=args.k, seed=args.seed),
+        scoring=ScoringConfig(compute_global_medians_from_data=args.medians_from_data),
+    )
+    result = run_pipeline(cfg, outdir=args.outdir)
+    print(json.dumps(result.summary(), indent=2))
+    return 0
+
+
+def _cmd_bench(args) -> int:
+    try:
+        from .benchmarks.harness import run_bench
+    except ImportError as e:
+        print(f"benchmark harness not available: {e}", file=sys.stderr)
+        return 1
+    out = run_bench(config=args.config, backend=args.backend)
+    print(json.dumps(out))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cdrs", description="Clustering-driven replication strategy (TPU-native)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("gen", help="generate synthetic file population")
+    p.add_argument("--n", type=int, default=200)
+    p.add_argument("--hdfs_dir", default="/user/root/synth")
+    p.add_argument("--min_size", type=int, default=1024)
+    p.add_argument("--max_size", type=int, default=1024 * 1024)
+    p.add_argument("--nodes", default="dn1,dn2,dn3")
+    p.add_argument("--age_days_max", type=float, default=365.0)
+    p.add_argument("--out_manifest", default="metadata.csv")
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--write_payloads", action="store_true")
+    p.set_defaults(fn=_cmd_gen)
+
+    p = sub.add_parser("simulate", help="simulate Poisson access events")
+    p.add_argument("--manifest", required=True)
+    p.add_argument("--out", default="access.log")
+    p.add_argument("--duration_seconds", type=float, default=300.0)
+    p.add_argument("--clients", default="dn1,dn2,dn3,dn4")
+    p.add_argument("--seed", type=int, default=None)
+    p.set_defaults(fn=_cmd_simulate)
+
+    p = sub.add_parser("features", help="extract the 5 per-file features")
+    p.add_argument("--manifest", required=True)
+    p.add_argument("--access_log", required=True)
+    p.add_argument("--out", default="features_out/")
+    _add_backend_arg(p)
+    p.set_defaults(fn=_cmd_features)
+
+    p = sub.add_parser("cluster", help="KMeans++ clustering + category scoring")
+    p.add_argument("--input_path", required=True)
+    p.add_argument("--k", type=int, default=4)
+    p.add_argument("--output_csv", default="final_categories.csv")
+    p.add_argument("--assignments_csv", default=None)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--medians_from_data", action="store_true")
+    _add_backend_arg(p)
+    p.set_defaults(fn=_cmd_cluster)
+
+    p = sub.add_parser("pipeline", help="end-to-end: gen -> sim -> features -> cluster")
+    p.add_argument("--n", type=int, default=200)
+    p.add_argument("--duration_seconds", type=float, default=600.0)
+    p.add_argument("--k", type=int, default=4)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--outdir", default="output")
+    p.add_argument("--medians_from_data", action="store_true")
+    _add_backend_arg(p)
+    p.set_defaults(fn=_cmd_pipeline)
+
+    p = sub.add_parser("bench", help="benchmark harness (BASELINE.md configs)")
+    p.add_argument("--config", type=int, default=1)
+    _add_backend_arg(p)
+    p.set_defaults(fn=_cmd_bench)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Drop-in reference API shims.
+
+Users of the reference import ``kmeans`` from ``kmeans_plusplus`` and
+``ClusterClassifier`` from ``scoring`` (reference: src/main.py:12-13).  This
+module exposes the same call signatures backed by the new framework, so a
+reference user can switch with an import change:
+
+    from cdrs_tpu.compat.reference_api import kmeans, ClusterClassifier
+
+Differences from the reference, by design (SURVEY.md §6.1):
+* no crash for n > 10,000 (integer max_iter);
+* empty-cluster reseeding respects ``random_state``;
+* importing this module does NOT run a demo at import time (the reference's
+  scoring.py executes a hardcoded example on import, scoring.py:133-175 —
+  that example lives on as tests/test_scoring.py::test_reference_inline_example).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import ScoringConfig
+from ..ops.kmeans_np import kmeans  # noqa: F401  (re-export, reference signature)
+from ..ops.scoring_np import classify_medians
+
+__all__ = ["kmeans", "ClusterClassifier"]
+
+
+class ClusterClassifier:
+    """Dict-in/dict-out classifier matching reference src/scoring.py:13-130."""
+
+    def __init__(self, global_medians, weights, directions, replication_factors):
+        self.global_medians = dict(global_medians)
+        self.weights = {c: dict(w) for c, w in weights.items()}
+        self.directions = {c: dict(d) for c, d in directions.items()}
+        self.replication_factors = dict(replication_factors)
+        self.features = tuple(global_medians.keys())
+        self.categories = tuple(weights.keys())
+
+    def _config(self) -> ScoringConfig:
+        return ScoringConfig(
+            features=self.features,
+            global_medians=self.global_medians,
+            weights=self.weights,
+            directions=self.directions,
+            replication_factors=self.replication_factors,
+            categories=self.categories,
+        )
+
+    def f(self, x):
+        return x ** 2  # reference: src/scoring.py:28-38
+
+    def compute_cluster_medians(self, clusters):
+        # reference: src/scoring.py:40-55
+        return {
+            name: {p: float(np.median(v)) for p, v in feats.items()}
+            for name, feats in clusters.items()
+        }
+
+    def score_category(self, cluster_medians, category):
+        # reference: src/scoring.py:57-84 — kept scalar for API parity.
+        score = 0.0
+        for p, m in cluster_medians.items():
+            delta = m - self.global_medians[p]
+            d = self.directions[category][p]
+            if category == "Moderate":
+                if abs(delta) < 0.1:
+                    score += self.weights[category][p] * self.f(1 - abs(delta))
+            elif d == 0 or np.sign(delta) == d:
+                score += self.weights[category][p] * self.f(abs(delta))
+        return score
+
+    def classify_cluster(self, cluster_medians):
+        # reference: src/scoring.py:86-109
+        medians = np.asarray(
+            [[cluster_medians[f] for f in self.features]], dtype=np.float64)
+        winner, _ = classify_medians(medians, self._config())
+        return self.categories[int(winner[0])]
+
+    def classify(self, clusters):
+        # reference: src/scoring.py:111-130
+        medians = self.compute_cluster_medians(clusters)
+        return {name: self.classify_cluster(m) for name, m in medians.items()}

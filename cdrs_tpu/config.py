@@ -1,0 +1,222 @@
+"""Configuration system for the clustering-driven replication strategy framework.
+
+The reference scatters its configuration across argparse flags and hard-coded module
+constants (reference: src/main.py:23-62, src/generator.py:17-25,
+src/access_simulator.py:42-47, 67-72).  Here every knob is promoted into typed
+dataclasses with the reference's defaults, so any stage can be driven
+programmatically or from the single CLI (cdrs_tpu/cli.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Mapping, Sequence
+
+# ---------------------------------------------------------------------------
+# Canonical category/feature vocabulary
+# ---------------------------------------------------------------------------
+
+#: Category order is load-bearing: scoring iterates in this order and the
+#: replication-factor tie-break must match the reference (src/scoring.py:99-107).
+CATEGORIES: tuple[str, ...] = ("Hot", "Shared", "Moderate", "Archival")
+
+#: The five clustering features (reference: src/main.py:23-29).
+CLUSTERING_FEATURES: tuple[str, ...] = (
+    "access_freq_norm",
+    "age_norm",
+    "write_ratio_norm",
+    "locality_norm",
+    "concurrency_norm",
+)
+
+#: Raw (pre-normalization) feature names in the same order.
+RAW_FEATURES: tuple[str, ...] = (
+    "access_freq",
+    "age_seconds",
+    "write_ratio",
+    "locality",
+    "concurrency",
+)
+
+#: Ground-truth categories planted by the generator (lowercase, reference:
+#: src/generator.py:45) mapped to scoring categories.
+PLANTED_TO_CATEGORY: Mapping[str, str] = {
+    "hot": "Hot",
+    "shared": "Shared",
+    "moderate": "Moderate",
+    "archival": "Archival",
+}
+
+
+# ---------------------------------------------------------------------------
+# Scoring configuration (reference: src/main.py:23-62)
+# ---------------------------------------------------------------------------
+
+def _default_global_medians() -> dict[str, float]:
+    # Reference placeholders (src/main.py:32-38), flagged there as "MUST be
+    # replaced".  We keep them as the default for behavioural parity but the
+    # pipeline can compute real medians from data (compute_from_data=True).
+    return {f: 0.5 for f in CLUSTERING_FEATURES}
+
+
+def _default_weights() -> dict[str, dict[str, float]]:
+    # Reference: src/main.py:41-46.
+    return {
+        "Hot": {"access_freq_norm": 1.0, "age_norm": 0.8, "write_ratio_norm": 0.5,
+                "locality_norm": 0.5, "concurrency_norm": 1.0},
+        "Shared": {"access_freq_norm": 0.7, "age_norm": 0.2, "write_ratio_norm": 1.0,
+                   "locality_norm": 0.2, "concurrency_norm": 0.5},
+        "Moderate": {"access_freq_norm": 0.5, "age_norm": 0.5, "write_ratio_norm": 0.5,
+                     "locality_norm": 0.5, "concurrency_norm": 0.5},
+        "Archival": {"access_freq_norm": 0.1, "age_norm": 1.0, "write_ratio_norm": 0.1,
+                     "locality_norm": 0.5, "concurrency_norm": 0.1},
+    }
+
+
+def _default_directions() -> dict[str, dict[str, int]]:
+    # Reference: src/main.py:49-54.
+    return {
+        "Hot": {"access_freq_norm": +1, "age_norm": -1, "write_ratio_norm": +1,
+                "locality_norm": +1, "concurrency_norm": +1},
+        "Shared": {"access_freq_norm": +1, "age_norm": +1, "write_ratio_norm": +1,
+                   "locality_norm": +1, "concurrency_norm": +1},
+        "Moderate": {"access_freq_norm": 0, "age_norm": 0, "write_ratio_norm": 0,
+                     "locality_norm": 0, "concurrency_norm": 0},
+        "Archival": {"access_freq_norm": -1, "age_norm": +1, "write_ratio_norm": -1,
+                     "locality_norm": -1, "concurrency_norm": -1},
+    }
+
+
+def _default_replication_factors() -> dict[str, int]:
+    # Reference: src/main.py:57-62.  Archival's rf=4 makes it the winner of
+    # all-zero-score ties (SURVEY.md §2.3).
+    return {"Hot": 3, "Shared": 2, "Moderate": 1, "Archival": 4}
+
+
+@dataclass
+class ScoringConfig:
+    """Weighted directional-deviation scoring rules (reference: src/scoring.py:57-109)."""
+
+    features: tuple[str, ...] = CLUSTERING_FEATURES
+    global_medians: dict[str, float] = field(default_factory=_default_global_medians)
+    weights: dict[str, dict[str, float]] = field(default_factory=_default_weights)
+    directions: dict[str, dict[str, int]] = field(default_factory=_default_directions)
+    replication_factors: dict[str, int] = field(default_factory=_default_replication_factors)
+    #: Moderate's "minimal deviation" band (reference: src/scoring.py:78 |delta| < 0.1).
+    moderate_band: float = 0.1
+    #: When True the pipeline replaces ``global_medians`` with medians computed
+    #: from the dataset (fixing reference quirk SURVEY.md §6.1.5).
+    compute_global_medians_from_data: bool = False
+
+    categories: tuple[str, ...] = CATEGORIES
+
+    def weight_matrix(self):
+        """(n_categories, n_features) weights as a nested list (row per category)."""
+        return [[self.weights[c][f] for f in self.features] for c in self.categories]
+
+    def direction_matrix(self):
+        return [[self.directions[c][f] for f in self.features] for c in self.categories]
+
+    def rf_vector(self):
+        return [self.replication_factors[c] for c in self.categories]
+
+
+# ---------------------------------------------------------------------------
+# KMeans configuration (reference: src/kmeans_plusplus.py)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class KMeansConfig:
+    """KMeans++ init + Lloyd loop knobs.
+
+    The reference caps iterations at ``max(100, n/100)`` — a float that crashes
+    ``range`` for n > 10,000 (reference: src/kmeans_plusplus.py:29-31, SURVEY.md
+    §6.1.1).  We fix it to the integer ``max(100, n // 100)`` unless an explicit
+    ``max_iter`` is given.
+    """
+
+    k: int = 4
+    tol: float = 1e-4
+    max_iter: int | None = None  # None -> max(100, n // 100)
+    seed: int | None = 42        # reference: src/main.py:91 random_state=42
+    #: Mini-batch size for the streaming backend; None = full batch.
+    batch_size: int | None = None
+
+    def resolve_max_iter(self, n: int) -> int:
+        if self.max_iter is not None:
+            return int(self.max_iter)
+        return max(100, n // 100)
+
+
+# ---------------------------------------------------------------------------
+# Workload configuration (reference: src/generator.py, src/access_simulator.py)
+# ---------------------------------------------------------------------------
+
+def _default_category_mix() -> dict[str, float]:
+    # Reference: src/generator.py:45 weights [0.10, 0.20, 0.50, 0.20].
+    return {"hot": 0.10, "shared": 0.20, "moderate": 0.50, "archival": 0.20}
+
+
+def _default_rate_profiles() -> dict[str, dict[str, float]]:
+    # Reference: src/access_simulator.py:42-47.
+    return {
+        "hot": {"read_rate": 0.8, "write_rate": 0.2, "locality_bias": 0.7},
+        "shared": {"read_rate": 0.6, "write_rate": 0.02, "locality_bias": 0.3},
+        "moderate": {"read_rate": 0.1, "write_rate": 0.01, "locality_bias": 0.5},
+        "archival": {"read_rate": 0.005, "write_rate": 0.001, "locality_bias": 0.9},
+    }
+
+
+@dataclass
+class GeneratorConfig:
+    """Synthetic file-population generator knobs (reference: src/generator.py:17-25)."""
+
+    n_files: int = 200
+    base_dir: str = "/user/root/synth"
+    min_size: int = 1024
+    max_size: int = 1024 * 1024
+    nodes: tuple[str, ...] = ("dn1", "dn2", "dn3")
+    age_days_max: float = 365.0
+    category_mix: dict[str, float] = field(default_factory=_default_category_mix)
+    seed: int | None = None
+    #: When True, also materialize random-content files (the reference writes
+    #: os.urandom files into HDFS, src/generator.py:33-39).  The manifest alone
+    #: is enough for the analytics pipeline.
+    write_payloads: bool = False
+
+
+@dataclass
+class SimulatorConfig:
+    """Poisson access-pattern simulator knobs (reference: src/access_simulator.py:16-76)."""
+
+    duration_seconds: float = 300.0
+    clients: tuple[str, ...] = ("dn1", "dn2", "dn3", "dn4")
+    rate_profiles: dict[str, dict[str, float]] = field(default_factory=_default_rate_profiles)
+    #: Per-file Gaussian jitter of the rates (reference: src/access_simulator.py:55-57):
+    #: read_rate  ~ N(mu, max(1e-4, 0.2*mu)), write_rate ~ N(mu, max(1e-4, 0.5*mu)),
+    #: locality_bias ~ N(mu, 0.2) clipped to [0, 1].
+    read_rate_jitter: float = 0.2
+    write_rate_jitter: float = 0.5
+    locality_jitter_std: float = 0.2
+    seed: int | None = None
+
+
+# ---------------------------------------------------------------------------
+# Pipeline configuration
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PipelineConfig:
+    """End-to-end pipeline: generator -> simulator -> features -> kmeans -> scoring."""
+
+    backend: str = "numpy"  # {"numpy", "jax"}
+    generator: GeneratorConfig = field(default_factory=GeneratorConfig)
+    simulator: SimulatorConfig = field(default_factory=SimulatorConfig)
+    kmeans: KMeansConfig = field(default_factory=KMeansConfig)
+    scoring: ScoringConfig = field(default_factory=ScoringConfig)
+    #: Mesh shape for the jax backend, e.g. {"data": 8} or {"data": 4, "model": 2}.
+    mesh_shape: dict[str, int] | None = None
+
+    def replace(self, **kwargs) -> "PipelineConfig":
+        return dataclasses.replace(self, **kwargs)

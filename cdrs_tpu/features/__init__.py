@@ -1,0 +1,11 @@
+"""Feature-extraction backends (L2): numpy golden model + jax segment kernels."""
+
+
+def get_jax_backend():
+    try:
+        from .jax_backend import compute_features_jax
+    except ImportError as e:  # pragma: no cover
+        raise NotImplementedError(
+            "jax feature backend unavailable (is jax installed?)"
+        ) from e
+    return compute_features_jax

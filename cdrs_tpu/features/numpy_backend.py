@@ -1,0 +1,126 @@
+"""NumPy feature-extraction backend — replaces the reference's PySpark job.
+
+Computes the five per-file features with the exact formulas of
+reference src/compute_features.py (SURVEY.md §2.2):
+
+* ``access_freq`` — events per path (compute_features.py:31-32)
+* ``writes`` / ``reads`` — per-op counts (l.33-34)
+* ``locality`` — local/total accesses, where local means the event's client
+  equals the file's primary node; **1.0 for files with zero accesses**
+  (l.37-42, 68)
+* ``concurrency`` — max events-per-second bucket (``floor(ts)``) per path
+  (l.44-46)
+* ``age_seconds`` — observation_end − creation_ts, observation_end = max event
+  ts over the whole log (fallback ``time.time()`` on an empty log) (l.48-54)
+* ``write_ratio`` — writes / mean(writes over all files); mean forced to 1.0
+  when 0.  NOT a read/write ratio (l.62-66, SURVEY.md §6.1.10).
+* ``*_norm`` — global min-max per column, **0.0 for every row when
+  max == min** (l.85-94)
+
+Files present in the manifest but never accessed get zero counters and
+locality 1.0 (``na.fill(0)`` + ``otherwise(1.0)``, l.60, 68).  Events whose
+path is not in the manifest are dropped by the joins (l.56-59) but still count
+toward ``observation_end`` (the max is taken on the raw access frame, l.48).
+
+The Spark groupBy/join machinery becomes ``np.bincount`` segment reductions —
+the same shape as the JAX backend's ``segment_sum`` (features/jax_backend.py),
+which this module is the golden model for.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..config import CLUSTERING_FEATURES, RAW_FEATURES
+from ..io.events import EventLog, Manifest
+
+__all__ = ["FeatureTable", "compute_features", "minmax_normalize"]
+
+
+@dataclass
+class FeatureTable:
+    """Raw + normalized per-file features, (n, 5) each, column order RAW_FEATURES."""
+
+    paths: list[str]
+    raw: np.ndarray          # (n, 5) float64
+    norm: np.ndarray         # (n, 5) float64 in [0, 1]
+    writes: np.ndarray       # (n,) kept for parity checks/debugging
+    reads: np.ndarray
+
+    raw_names: tuple[str, ...] = RAW_FEATURES
+    norm_names: tuple[str, ...] = CLUSTERING_FEATURES
+
+    def write_csv(self, path: str) -> None:
+        """Emit the Spark job's CSV schema: path, 5 raw, 5 *_norm columns
+        (reference: src/compute_features.py:70-75, 90-96)."""
+        header = ["path", *self.raw_names, *self.norm_names]
+        with open(path, "w") as f:
+            f.write(",".join(header) + "\n")
+            for i, p in enumerate(self.paths):
+                vals = [*(repr(float(v)) for v in self.raw[i]),
+                        *(repr(float(v)) for v in self.norm[i])]
+                f.write(p + "," + ",".join(vals) + "\n")
+
+
+def minmax_normalize(col: np.ndarray) -> np.ndarray:
+    """Global min-max; all-zeros when the column is constant
+    (reference: src/compute_features.py:85-88)."""
+    lo, hi = float(col.min()), float(col.max())
+    if hi == lo:
+        return np.zeros_like(col, dtype=np.float64)
+    return (col - lo) / (hi - lo)
+
+
+def compute_features(
+    manifest: Manifest,
+    events: EventLog,
+    observation_end: float | None = None,
+) -> FeatureTable:
+    n = len(manifest)
+
+    # observation_end from the raw log (reference: compute_features.py:48-51).
+    if observation_end is None:
+        observation_end = float(events.ts.max()) if len(events) else time.time()
+
+    # Drop events not anchored to a manifest file (left-join semantics).
+    keep = events.path_id >= 0
+    pid = events.path_id[keep].astype(np.int64)
+    ts = events.ts[keep]
+    op = events.op[keep]
+    client = events.client_id[keep]
+
+    access_freq = np.bincount(pid, minlength=n).astype(np.float64)
+    writes = np.bincount(pid, weights=(op == 1), minlength=n)
+    reads = access_freq - writes
+
+    is_local = (client == manifest.primary_node_id[pid]).astype(np.float64)
+    local_accesses = np.bincount(pid, weights=is_local, minlength=n)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        locality = np.where(access_freq > 0, local_accesses / np.maximum(access_freq, 1), 1.0)
+
+    # Two-level concurrency: count per (path, second) then max per path
+    # (reference: compute_features.py:44-46).  Composite key over the observed
+    # second range keeps bincount dense and small (range ~ duration).
+    concurrency = np.zeros(n, dtype=np.float64)
+    if len(ts):
+        sec = np.floor(ts).astype(np.int64)
+        sec -= sec.min()
+        n_sec = int(sec.max()) + 1
+        key = pid * n_sec + sec
+        uniq, counts = np.unique(key, return_counts=True)
+        np.maximum.at(concurrency, uniq // n_sec, counts.astype(np.float64))
+
+    age_seconds = observation_end - manifest.creation_ts
+
+    mean_writes = float(writes.mean()) if n else 0.0
+    if mean_writes == 0:
+        mean_writes = 1.0  # reference: compute_features.py:64-65
+    write_ratio = writes / mean_writes
+
+    raw = np.stack([access_freq, age_seconds, write_ratio, locality, concurrency], axis=1)
+    norm = np.stack([minmax_normalize(raw[:, j]) for j in range(raw.shape[1])], axis=1)
+    return FeatureTable(paths=list(manifest.paths), raw=raw, norm=norm,
+                        writes=writes, reads=reads)

@@ -1,0 +1,167 @@
+"""Struct-of-arrays event/manifest representation and CSV IO.
+
+The reference's layer boundaries are files on disk: ``metadata.csv`` (manifest,
+reference: src/generator.py:60-64) and ``access.log`` (CSV rows
+``ts_iso,path,op,client,pid``, reference: src/access_simulator.py:61-63).
+This module keeps those on-disk contracts but converts everything to dense
+integer/float arrays at ingest — paths and client nodes are interned to int32
+ids, timestamps become float64 epoch seconds — because that is the only
+representation a TPU kernel can consume (SURVEY.md §7.2 "data representation").
+"""
+
+from __future__ import annotations
+
+import csv
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+
+import numpy as np
+
+__all__ = ["Manifest", "EventLog", "parse_iso_ts", "OP_READ", "OP_WRITE"]
+
+OP_READ = np.int8(0)
+OP_WRITE = np.int8(1)
+
+
+def parse_iso_ts(s: str) -> float:
+    """ISO-8601 (optionally ``Z``-suffixed, ms precision) -> epoch seconds (UTC).
+
+    The reference emits ``%Y-%m-%dT%H:%M:%S.%f`` truncated to ms plus ``Z``
+    (src/access_simulator.py:5-6) and parses with Spark ``to_timestamp``
+    (src/compute_features.py:28-29).  We parse in pure Python, treating naive
+    stamps as UTC.
+    """
+    s = s.strip()
+    if s.endswith("Z"):
+        s = s[:-1]
+    dt = datetime.fromisoformat(s)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.timestamp()
+
+
+@dataclass
+class Manifest:
+    """Interned file population.
+
+    Columns mirror metadata.csv (path, creation_ts, primary_node, size_bytes,
+    category — reference: src/generator.py:47-53).
+    """
+
+    paths: list[str]
+    creation_ts: np.ndarray          # (n,) float64 epoch seconds
+    primary_node_id: np.ndarray      # (n,) int32, index into ``nodes``
+    size_bytes: np.ndarray           # (n,) int64
+    category: list[str]              # planted ground-truth, lowercase
+    nodes: list[str]                 # node-id vocabulary
+    path_to_id: dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.path_to_id:
+            self.path_to_id = {p: i for i, p in enumerate(self.paths)}
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    @classmethod
+    def read_csv(cls, path: str) -> "Manifest":
+        paths, creation, nodes_col, sizes, cats = [], [], [], [], []
+        with open(path, newline="") as f:
+            for row in csv.DictReader(f):
+                paths.append(row["path"])
+                creation.append(parse_iso_ts(row["creation_ts"]))
+                nodes_col.append(row["primary_node"])
+                sizes.append(int(row.get("size_bytes", 0) or 0))
+                cats.append(row.get("category", "moderate"))
+        node_vocab: dict[str, int] = {}
+        node_ids = np.empty(len(nodes_col), dtype=np.int32)
+        for i, nm in enumerate(nodes_col):
+            node_ids[i] = node_vocab.setdefault(nm, len(node_vocab))
+        return cls(
+            paths=paths,
+            # The reference truncates creation timestamps to whole seconds via
+            # Spark unix_timestamp (src/compute_features.py:16-17).
+            creation_ts=np.floor(np.asarray(creation, dtype=np.float64)),
+            primary_node_id=node_ids,
+            size_bytes=np.asarray(sizes, dtype=np.int64),
+            category=cats,
+            nodes=list(node_vocab),
+        )
+
+    def write_csv(self, path: str) -> None:
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["path", "creation_ts", "primary_node", "size_bytes", "category"])
+            for i, p in enumerate(self.paths):
+                ts = datetime.fromtimestamp(float(self.creation_ts[i]), tz=timezone.utc)
+                w.writerow([
+                    p,
+                    ts.strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z",
+                    self.nodes[int(self.primary_node_id[i])],
+                    int(self.size_bytes[i]),
+                    self.category[i],
+                ])
+
+
+@dataclass
+class EventLog:
+    """Access events as struct-of-arrays.
+
+    ``path_id`` is -1 for events whose path is absent from the manifest; the
+    feature kernels drop them, matching the reference's manifest-anchored left
+    joins (src/compute_features.py:56-59).
+    """
+
+    ts: np.ndarray          # (e,) float64 epoch seconds (fractional)
+    path_id: np.ndarray     # (e,) int32
+    op: np.ndarray          # (e,) int8, OP_READ/OP_WRITE
+    client_id: np.ndarray   # (e,) int32 into ``clients``
+    clients: list[str]
+
+    def __len__(self) -> int:
+        return len(self.ts)
+
+    @classmethod
+    def read_csv(cls, path: str, manifest: Manifest) -> "EventLog":
+        ts, pid, op, cid = [], [], [], []
+        # Client vocabulary must share ids with manifest primary nodes so the
+        # locality comparison client_node == primary_node works on ids.
+        client_vocab: dict[str, int] = {nm: i for i, nm in enumerate(manifest.nodes)}
+        clients = list(manifest.nodes)
+        with open(path, newline="") as f:
+            for row in csv.reader(f):
+                if not row:
+                    continue
+                ts.append(parse_iso_ts(row[0]))
+                pid.append(manifest.path_to_id.get(row[1], -1))
+                op.append(1 if row[2] == "WRITE" else 0)
+                c = row[3]
+                if c not in client_vocab:
+                    client_vocab[c] = len(clients)
+                    clients.append(c)
+                cid.append(client_vocab[c])
+        return cls(
+            ts=np.asarray(ts, dtype=np.float64),
+            path_id=np.asarray(pid, dtype=np.int32),
+            op=np.asarray(op, dtype=np.int8),
+            client_id=np.asarray(cid, dtype=np.int32),
+            clients=clients,
+        )
+
+    def write_csv(self, path: str, manifest: Manifest) -> None:
+        """Emit the reference's access.log format (ts,path,op,client,pid).
+
+        Events with ``path_id == -1`` (path unknown to the manifest) are
+        skipped — their original path string was not retained at ingest.
+        """
+        with open(path, "w") as f:
+            for i in range(len(self.ts)):
+                if self.path_id[i] < 0:
+                    continue
+                dt = datetime.fromtimestamp(float(self.ts[i]), tz=timezone.utc)
+                iso = dt.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+                op = "WRITE" if self.op[i] else "READ"
+                f.write(
+                    f"{iso},{manifest.paths[int(self.path_id[i])]},{op},"
+                    f"{self.clients[int(self.client_id[i])]},{1000 + i % 9000}\n"
+                )

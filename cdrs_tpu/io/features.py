@@ -1,0 +1,54 @@
+"""Feature-CSV ingestion for the decision layer.
+
+Replicates the reference's input resolution (src/main.py:155-168): a directory
+resolves to ``part-00000*.csv`` inside it (the Spark output convention), a glob
+is expanded, and the first match is used.  Unlike the reference we warn when
+extra matches are silently ignored (SURVEY.md §6.1.12).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+
+from ..config import CLUSTERING_FEATURES
+
+__all__ = ["resolve_features_path", "load_feature_matrix"]
+
+
+def resolve_features_path(input_path: str) -> str:
+    if os.path.isdir(input_path):
+        pattern = os.path.join(input_path, "part-00000*.csv")
+        matches = sorted(glob.glob(pattern))
+        if not matches:
+            # Our own pipeline writes features.csv; accept any csv in the dir.
+            matches = sorted(glob.glob(os.path.join(input_path, "*.csv")))
+    else:
+        matches = sorted(glob.glob(input_path))
+    if not matches:
+        raise FileNotFoundError(f"no features CSV matching {input_path!r}")
+    if len(matches) > 1:
+        print(f"warning: {len(matches)} feature files matched; using {matches[0]}",
+              file=sys.stderr)
+    return matches[0]
+
+
+def load_feature_matrix(
+    input_path: str,
+    features: tuple[str, ...] = CLUSTERING_FEATURES,
+    dtype=np.float64,
+) -> tuple[np.ndarray, list[str]]:
+    """(n, 5) matrix of the normalized clustering features + the path column
+    (reference: src/main.py:75-81)."""
+    path = resolve_features_path(input_path)
+    df = pd.read_csv(path)
+    missing = [f for f in features if f not in df.columns]
+    if missing:
+        raise ValueError(f"features CSV {path} missing columns: {missing}")
+    X = df[list(features)].to_numpy(dtype=dtype)
+    paths = df["path"].tolist() if "path" in df.columns else [str(i) for i in range(len(df))]
+    return X, paths

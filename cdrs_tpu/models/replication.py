@@ -1,0 +1,143 @@
+"""ReplicationPolicyModel — the flagship end-to-end pipeline.
+
+Mirrors the reference's decision layer (src/main.py:66-144): features CSV (or
+in-memory FeatureTable) -> KMeans++ clustering -> per-cluster median scoring ->
+category per cluster -> ``final_categories.csv`` with centroid-string IDs
+(``CENTROID_<v1>_<v2>_...``, main.py:131-136) — plus the per-file assignment
+table the reference only keeps in memory (main.py:92).
+
+Backend selection (``--backend {numpy,jax}``) happens here; both backends share
+this orchestration and the IO contracts.
+"""
+
+from __future__ import annotations
+
+import csv
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..config import CATEGORIES, KMeansConfig, ScoringConfig
+
+__all__ = ["ClusterDecision", "ReplicationPolicyModel", "centroid_id"]
+
+
+def centroid_id(centroid: np.ndarray) -> str:
+    """String centroid ID, 4-decimal per component (reference: src/main.py:131-136)."""
+    return "CENTROID_" + "_".join(f"{float(v):.4f}" for v in centroid)
+
+
+@dataclass
+class ClusterDecision:
+    """Output of one pipeline run."""
+
+    centroids: np.ndarray         # (k, d)
+    labels: np.ndarray            # (n,) cluster index per file
+    category_idx: np.ndarray      # (k,) index into CATEGORIES
+    scores: np.ndarray            # (k, n_categories)
+    cluster_medians: np.ndarray   # (k, d)
+    feature_names: tuple[str, ...]
+
+    @property
+    def categories(self) -> list[str]:
+        return [CATEGORIES[int(i)] for i in self.category_idx]
+
+    def replication_factor_per_file(self, cfg: ScoringConfig) -> np.ndarray:
+        rf = np.asarray(cfg.rf_vector())
+        return rf[self.category_idx[self.labels]]
+
+    def write_csv(self, path: str) -> None:
+        """``final_categories.csv``: centroid_id, category, then the feature
+        columns (reference: src/main.py:139-142)."""
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["centroid_id", "category", *self.feature_names])
+            for j in range(self.centroids.shape[0]):
+                w.writerow([
+                    centroid_id(self.centroids[j]),
+                    CATEGORIES[int(self.category_idx[j])],
+                    *[repr(float(v)) for v in self.centroids[j]],
+                ])
+
+    def write_assignments_csv(self, path: str, paths: list[str]) -> None:
+        """Per-file table: path, cluster, category — the reference computes
+        this (main.py:92) but never writes it; we do."""
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["path", "cluster", "category"])
+            for i, p in enumerate(paths):
+                c = int(self.labels[i])
+                w.writerow([p, c, CATEGORIES[int(self.category_idx[c])]])
+
+
+class ReplicationPolicyModel:
+    """KMeans++ clustering + directional-deviation scoring, backend-switchable."""
+
+    def __init__(
+        self,
+        kmeans_cfg: KMeansConfig | None = None,
+        scoring_cfg: ScoringConfig | None = None,
+        backend: str = "numpy",
+        mesh_shape: dict[str, int] | None = None,
+    ):
+        self.kmeans_cfg = kmeans_cfg or KMeansConfig()
+        self.scoring_cfg = scoring_cfg or ScoringConfig()
+        if backend not in ("numpy", "jax"):
+            raise ValueError(f"unknown backend {backend!r}; expected 'numpy' or 'jax'")
+        self.backend = backend
+        self.mesh_shape = mesh_shape
+
+    # -- clustering -------------------------------------------------------
+    def cluster(self, X: np.ndarray, init_centroids: np.ndarray | None = None):
+        cfg = self.kmeans_cfg
+        n = X.shape[0]
+        if n < cfg.k:
+            raise ValueError(
+                f"{n} samples found, but K={cfg.k} requested; cannot cluster"
+            )  # reference guard: src/main.py:84-86
+        if self.backend == "numpy":
+            from ..ops.kmeans_np import kmeans
+
+            return kmeans(
+                X, cfg.k, number_of_files=n, tol=cfg.tol,
+                random_state=cfg.seed, max_iter=cfg.max_iter,
+                init_centroids=init_centroids,
+            )
+        from ..ops.kmeans_jax import kmeans_jax
+
+        centroids, labels = kmeans_jax(
+            X, cfg.k, tol=cfg.tol, seed=cfg.seed,
+            max_iter=cfg.resolve_max_iter(n),
+            init_centroids=init_centroids,
+            mesh_shape=self.mesh_shape,
+        )
+        return np.asarray(centroids), np.asarray(labels)
+
+    # -- scoring ----------------------------------------------------------
+    def score(self, X: np.ndarray, labels: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if self.backend == "numpy":
+            from ..ops.scoring_np import classify
+
+            return classify(X, labels, self.kmeans_cfg.k, self.scoring_cfg)
+        from ..ops.scoring_jax import classify_jax
+
+        winner, scores, medians = classify_jax(X, labels, self.kmeans_cfg.k, self.scoring_cfg)
+        return np.asarray(winner), np.asarray(scores), np.asarray(medians)
+
+    # -- end to end -------------------------------------------------------
+    def run(
+        self,
+        X: np.ndarray,
+        feature_names: tuple[str, ...] | None = None,
+        init_centroids: np.ndarray | None = None,
+    ) -> ClusterDecision:
+        centroids, labels = self.cluster(X, init_centroids=init_centroids)
+        winner, scores, medians = self.score(X, labels)
+        return ClusterDecision(
+            centroids=np.asarray(centroids),
+            labels=np.asarray(labels),
+            category_idx=np.asarray(winner),
+            scores=np.asarray(scores),
+            cluster_medians=np.asarray(medians),
+            feature_names=tuple(feature_names or self.scoring_cfg.features),
+        )

@@ -1,0 +1,191 @@
+"""NumPy KMeans++ reference backend.
+
+Reproduces the behaviour of the reference's hand-rolled KMeans
+(reference: src/kmeans_plusplus.py:3-50) with the documented fixes
+(SURVEY.md §6.1):
+
+* ``max_iter = max(100, n/100)`` was a float and crashed ``range`` for
+  n > 10,000 (kmeans_plusplus.py:29) — fixed to ``max(100, n // 100)``.
+* Empty-cluster reseeding used the global ``np.random`` state, ignoring the
+  seeded generator (kmeans_plusplus.py:43) — fixed to draw from the same
+  seeded ``Generator`` so runs are reproducible.
+
+Semantics kept bit-for-bit where sane:
+
+* D² init: first centroid uniform, each next sampled with probability
+  proportional to the min squared distance to already-chosen centroids
+  (kmeans_plusplus.py:9-20).
+* Lloyd: assignment by argmin Euclidean distance; update by per-cluster mean;
+  convergence when the Frobenius norm of the centroid shift < tol
+  (kmeans_plusplus.py:31-48).
+* The returned ``labels`` are the assignment computed against the centroids
+  *before* the final update — exactly the reference's loop order
+  (kmeans_plusplus.py:33-48 computes labels, then updates, then breaks).
+
+The O(n·k·d) dense distance broadcast of the reference is replaced by the
+``‖x‖² − 2·x·Cᵀ + ‖c‖²`` matmul expansion computed in tiles, so this backend
+also stays usable at the 1M–10M scale without materializing (n, k, d).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "pairwise_sq_dists",
+    "assign_labels",
+    "kmeans_plusplus_init",
+    "lloyd_step",
+    "kmeans",
+]
+
+# Rows of points per distance tile: bounds temp memory at tile * k floats.
+_TILE = 65536
+
+
+def pairwise_sq_dists(X: np.ndarray, C: np.ndarray, tile: int = _TILE) -> np.ndarray:
+    """Squared Euclidean distances (n, k) via the matmul expansion, tiled over rows.
+
+    Equivalent to ``np.linalg.norm(X[:, None, :] - C[None, :, :], axis=2) ** 2``
+    (reference: src/kmeans_plusplus.py:14-17, 33) without the (n, k, d) temp.
+    Clamped at 0 to absorb the expansion's negative rounding residue.
+    """
+    n = X.shape[0]
+    c_sq = np.einsum("kd,kd->k", C, C)
+    out = np.empty((n, C.shape[0]), dtype=np.result_type(X.dtype, np.float64))
+    for start in range(0, n, tile):
+        xs = X[start:start + tile]
+        x_sq = np.einsum("nd,nd->n", xs, xs)
+        d = x_sq[:, None] - 2.0 * (xs @ C.T) + c_sq[None, :]
+        np.maximum(d, 0.0, out=d)
+        out[start:start + tile] = d
+    return out
+
+
+def kmeans_plusplus_init(
+    X: np.ndarray,
+    k: int,
+    random_state: int | np.random.Generator | None = None,
+) -> np.ndarray:
+    """D² (KMeans++) initialization (reference: src/kmeans_plusplus.py:3-22).
+
+    Uses the incremental min-distance formulation: after adding centroid i we
+    only compute distances to that one centroid and take an elementwise min,
+    O(n·d) per round instead of the reference's O(n·i·d) full recompute.
+    The sampled sequence is distribution-identical (the min over all chosen
+    centroids is the same quantity).
+    """
+    rng = np.random.default_rng(random_state)
+    n, d = X.shape
+    if k > n:
+        raise ValueError(f"k={k} exceeds number of samples n={n}")
+    centroids = np.empty((k, d), dtype=X.dtype)
+
+    first = int(rng.integers(0, n))
+    centroids[0] = X[first]
+
+    min_sq = pairwise_sq_dists(X, centroids[0:1])[:, 0]
+    for i in range(1, k):
+        total = min_sq.sum()
+        if total <= 0:
+            # Degenerate data (all points identical to chosen centroids):
+            # fall back to a uniform draw.
+            idx = int(rng.integers(0, n))
+        else:
+            idx = int(rng.choice(n, p=min_sq / total))
+        centroids[i] = X[idx]
+        np.minimum(min_sq, pairwise_sq_dists(X, centroids[i:i + 1])[:, 0], out=min_sq)
+    return centroids
+
+
+def assign_labels(X: np.ndarray, centroids: np.ndarray, tile: int = _TILE) -> np.ndarray:
+    """Nearest-centroid assignment, computed tile-by-tile so the (n, k)
+    distance matrix is never materialized (peak temp = tile × k)."""
+    n = X.shape[0]
+    labels = np.empty(n, dtype=np.int64)
+    c_sq = np.einsum("kd,kd->k", centroids, centroids)
+    for start in range(0, n, tile):
+        xs = X[start:start + tile]
+        # ‖x‖² is constant per row — argmin doesn't need it.
+        d = c_sq[None, :] - 2.0 * (xs @ centroids.T)
+        labels[start:start + tile] = np.argmin(d, axis=1)
+    return labels
+
+
+def lloyd_step(
+    X: np.ndarray,
+    centroids: np.ndarray,
+    rng: np.random.Generator,
+) -> tuple[np.ndarray, np.ndarray, float]:
+    """One Lloyd iteration: assign, update, measure shift.
+
+    Returns ``(new_centroids, labels, shift)`` where ``labels`` is the
+    assignment against the *input* centroids and ``shift`` the Frobenius norm
+    of the centroid movement (reference: src/kmeans_plusplus.py:33-45).
+    Empty clusters are reseeded to a random data point drawn from ``rng``
+    (reference behaviour at kmeans_plusplus.py:42-43, but seeded).
+    """
+    n = X.shape[0]
+    k = centroids.shape[0]
+    labels = assign_labels(X, centroids)
+
+    # Per-cluster sums and counts in one pass (replaces the reference's k
+    # masked means, kmeans_plusplus.py:38-43).
+    sums = np.stack(
+        [np.bincount(labels, weights=X[:, j], minlength=k) for j in range(X.shape[1])],
+        axis=1,
+    )
+    counts = np.bincount(labels, minlength=k).astype(np.float64)
+
+    new_centroids = np.empty_like(centroids)
+    nonempty = counts > 0
+    new_centroids[nonempty] = (sums[nonempty] / counts[nonempty, None]).astype(centroids.dtype)
+    for j in np.flatnonzero(~nonempty):
+        new_centroids[j] = X[int(rng.integers(0, n))]
+
+    shift = float(np.linalg.norm(new_centroids - centroids))
+    return new_centroids, labels, shift
+
+
+def kmeans(
+    X: np.ndarray,
+    k: int,
+    number_of_files: int | None = None,
+    tol: float = 1e-4,
+    random_state: int | None = None,
+    max_iter: int | None = None,
+    init_centroids: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Full KMeans++ + Lloyd, reference signature preserved
+    (reference: src/kmeans_plusplus.py:24).
+
+    ``init_centroids`` overrides the D² init — used by the numpy-vs-jax parity
+    tests so both backends iterate from identical starting points.
+
+    Returns ``(centroids, labels)``; see module docstring for the exact label
+    semantics.
+    """
+    X = np.asarray(X)
+    if not np.issubdtype(X.dtype, np.floating):
+        X = X.astype(np.float64)  # integer input would truncate centroid means
+    n = X.shape[0]
+    if number_of_files is None:
+        number_of_files = n
+    rng = np.random.default_rng(random_state)
+
+    if init_centroids is not None:
+        centroids = np.array(init_centroids, dtype=X.dtype)
+    else:
+        centroids = kmeans_plusplus_init(X, k, random_state=rng)
+
+    if max_iter is None:
+        from ..config import KMeansConfig
+
+        max_iter = KMeansConfig(k=k).resolve_max_iter(int(number_of_files))
+
+    labels = np.zeros(n, dtype=np.int64)
+    for _ in range(max_iter):
+        centroids, labels, shift = lloyd_step(X, centroids, rng)
+        if shift < tol:
+            break
+    return centroids, labels

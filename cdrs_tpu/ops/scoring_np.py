@@ -1,0 +1,143 @@
+"""NumPy cluster scoring/classification backend.
+
+Vectorized re-implementation of the reference's ``ClusterClassifier``
+(reference: src/scoring.py:3-130) with identical semantics:
+
+For cluster c with per-feature medians m and global medians g, and category
+weights w >= 0, directions dir in {-1, 0, +1}:
+
+* delta = m - g                                       (scoring.py:74)
+* Moderate: score += w * (1 - |delta|)**2  iff |delta| < 0.1   (scoring.py:77-79)
+* Others:   score += w * delta**2          iff dir == 0 or sign(delta) == dir
+                                                       (scoring.py:81-82)
+* winner = argmax score; exact-equality ties broken by the highest
+  replication factor (scoring.py:102-107) — so an all-zero-score cluster
+  classifies as Archival (rf 4 > Hot 3 > Shared 2 > Moderate 1,
+  reference: src/main.py:57-62).
+
+Note ``np.sign(0) == 0`` means a zero delta only scores when dir == 0 —
+preserved (SURVEY.md §2.3, §6.1.9).
+
+Instead of the reference's dict-of-lists clusters we operate on arrays:
+``cluster_medians`` is (k, n_features) and the whole score table is one
+(k, n_categories) computation, which is also the shape the JAX kernel uses.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import ScoringConfig
+
+__all__ = [
+    "compute_cluster_medians",
+    "score_table",
+    "classify_medians",
+    "classify",
+]
+
+
+def compute_cluster_medians(
+    X: np.ndarray, labels: np.ndarray, k: int
+) -> np.ndarray:
+    """Per-cluster per-feature medians, (k, d).
+
+    Reference: src/scoring.py:40-55 (np.median per cluster/feature).  Empty
+    clusters get NaN medians — the reference can't produce empty clusters at
+    this stage because main.py groups by observed labels; NaN rows score 0 for
+    every category and therefore tie-break to Archival, which matches the
+    "no evidence" default of SURVEY.md §2.3.
+    """
+    k_eff = int(k)
+    d = X.shape[1]
+    out = np.full((k_eff, d), np.nan, dtype=np.float64)
+    order = np.argsort(labels, kind="stable")
+    sorted_labels = labels[order]
+    boundaries = np.searchsorted(sorted_labels, np.arange(k_eff + 1))
+    for j in range(k_eff):
+        lo, hi = boundaries[j], boundaries[j + 1]
+        if hi > lo:
+            out[j] = np.median(X[order[lo:hi]], axis=0)
+    return out
+
+
+def score_table(
+    cluster_medians: np.ndarray,
+    cfg: ScoringConfig,
+    global_medians: np.ndarray | None = None,
+) -> np.ndarray:
+    """(k, n_categories) score matrix.
+
+    Vectorizes reference src/scoring.py:57-84 over all clusters and categories
+    at once.  NaN medians (empty clusters) contribute 0.
+    """
+    W = np.asarray(cfg.weight_matrix(), dtype=np.float64)        # (C, d)
+    D = np.asarray(cfg.direction_matrix(), dtype=np.float64)     # (C, d)
+    if global_medians is None:
+        global_medians = np.asarray(
+            [cfg.global_medians[f] for f in cfg.features], dtype=np.float64
+        )
+    delta = cluster_medians - global_medians[None, :]            # (k, d)
+    valid = ~np.isnan(delta)
+    delta = np.where(valid, delta, 0.0)
+    abs_d = np.abs(delta)
+
+    # (k, C, d) broadcast of the per-feature terms.
+    delta_b = delta[:, None, :]
+    absd_b = abs_d[:, None, :]
+    valid_b = valid[:, None, :]
+
+    is_moderate = np.array([c == "Moderate" for c in cfg.categories])  # (C,)
+
+    # Non-Moderate gate: dir == 0 or sign(delta) == dir (scoring.py:81).
+    gate_dir = (D[None, :, :] == 0) | (np.sign(delta_b) == D[None, :, :])
+    term_dir = W[None, :, :] * absd_b**2
+
+    # Moderate gate: |delta| < band, reward (1 - |delta|)^2 (scoring.py:77-79).
+    gate_mod = absd_b < cfg.moderate_band
+    term_mod = W[None, :, :] * (1.0 - absd_b) ** 2
+
+    gate = np.where(is_moderate[None, :, None], gate_mod, gate_dir) & valid_b
+    term = np.where(is_moderate[None, :, None], term_mod, term_dir)
+    return np.where(gate, term, 0.0).sum(axis=2)  # (k, C)
+
+
+def classify_medians(
+    cluster_medians: np.ndarray,
+    cfg: ScoringConfig,
+    global_medians: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Category index per cluster + the score table.
+
+    Tie-break on exact score equality by the highest replication factor
+    (reference: src/scoring.py:102-107).
+    """
+    scores = score_table(cluster_medians, cfg, global_medians)   # (k, C)
+    rf = np.asarray(cfg.rf_vector(), dtype=np.float64)           # (C,)
+    max_score = scores.max(axis=1, keepdims=True)
+    tied = scores == max_score
+    # Among tied categories pick the one with the largest rf; np.argmax picks
+    # the first maximum, matching the reference's sort(reverse=True)[0] for
+    # distinct rf values (all rf values are distinct: 3,2,1,4).
+    winner = np.argmax(np.where(tied, rf[None, :], -np.inf), axis=1)
+    return winner, scores
+
+
+def classify(
+    X: np.ndarray,
+    labels: np.ndarray,
+    k: int,
+    cfg: ScoringConfig | None = None,
+    global_medians: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Full classification: medians -> scores -> categories.
+
+    Returns ``(category_idx (k,), scores (k, C), cluster_medians (k, d))``.
+    Reference call stack: src/scoring.py:111-130.
+    """
+    cfg = cfg or ScoringConfig()
+    medians = compute_cluster_medians(X, labels, k)
+    if global_medians is None and cfg.compute_global_medians_from_data:
+        global_medians = np.median(X, axis=0)
+    winner, scores = classify_medians(medians, cfg, global_medians)
+    return winner, scores, medians

@@ -1,0 +1,45 @@
+"""Structured per-stage timing and metrics.
+
+The reference's only observability is bare ``print()`` calls (SURVEY.md §5
+"Metrics/logging").  Here every pipeline stage runs under a ``StageTimer`` and
+metrics accumulate into a ``MetricsLog`` that serializes to JSON — the same
+records the benchmark harness emits.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+
+__all__ = ["StageTimer", "MetricsLog"]
+
+
+class StageTimer:
+    def __init__(self, name: str, metrics: "MetricsLog | None" = None):
+        self.name = name
+        self.metrics = metrics
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "StageTimer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.perf_counter() - self._t0
+        if self.metrics is not None:
+            self.metrics.record(f"{self.name}.seconds", self.elapsed)
+
+
+@dataclass
+class MetricsLog:
+    records: dict[str, float] = field(default_factory=dict)
+
+    def record(self, key: str, value: float) -> None:
+        self.records[key] = float(value)
+
+    def timer(self, name: str) -> StageTimer:
+        return StageTimer(name, metrics=self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.records, sort_keys=True)

@@ -1,0 +1,16 @@
+"""Test configuration: force JAX onto an 8-device virtual CPU mesh.
+
+The JAX analogue of the reference's docker-compose fake cluster (SURVEY.md §4):
+multi-chip sharding is exercised on host CPU with
+``--xla_force_host_platform_device_count=8``.  Must be set before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

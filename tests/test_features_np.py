@@ -1,0 +1,123 @@
+"""Golden tests for the NumPy feature backend against hand-computed fixtures.
+
+Exercises every formula and edge case of SURVEY.md §2.2: per-op counts,
+locality (incl. the zero-access 1.0 rule), two-level concurrency, age from
+observation_end, write_ratio = writes/mean(writes), and the degenerate
+min-max normalization.
+"""
+
+import numpy as np
+
+from cdrs_tpu.features.numpy_backend import compute_features, minmax_normalize
+from cdrs_tpu.io.events import EventLog, Manifest
+
+
+def make_manifest(n=3, nodes=("dn1", "dn2")):
+    return Manifest(
+        paths=[f"/f{i}" for i in range(n)],
+        creation_ts=np.array([0.0, 100.0, 200.0][:n]),
+        primary_node_id=np.array([0, 1, 0][:n], dtype=np.int32),
+        size_bytes=np.array([10, 20, 30][:n], dtype=np.int64),
+        category=["hot", "moderate", "archival"][:n],
+        nodes=list(nodes),
+    )
+
+
+def make_events(rows, manifest):
+    """rows: list of (ts, path_idx, op(0/1), client_idx)."""
+    return EventLog(
+        ts=np.array([r[0] for r in rows], dtype=np.float64),
+        path_id=np.array([r[1] for r in rows], dtype=np.int32),
+        op=np.array([r[2] for r in rows], dtype=np.int8),
+        client_id=np.array([r[3] for r in rows], dtype=np.int32),
+        clients=list(manifest.nodes),
+    )
+
+
+def test_counts_locality_concurrency_age():
+    m = make_manifest()
+    # file 0 (primary dn1=0): 4 events, 1 write; 3 local.
+    #   seconds 10: two events -> concurrency 2
+    # file 1 (primary dn2=1): 2 events, both writes, 0 local
+    # file 2: no events -> zero counters, locality 1.0
+    rows = [
+        (10.1, 0, 0, 0),
+        (10.9, 0, 0, 0),
+        (11.5, 0, 1, 0),
+        (20.0, 0, 0, 1),
+        (15.0, 1, 1, 0),
+        (30.0, 1, 1, 0),
+    ]
+    ev = make_events(rows, m)
+    t = compute_features(m, ev)
+
+    af, age, wr, loc, conc = t.raw.T
+    np.testing.assert_allclose(af, [4, 2, 0])
+    np.testing.assert_allclose(t.writes, [1, 2, 0])
+    np.testing.assert_allclose(t.reads, [3, 0, 0])
+    np.testing.assert_allclose(loc, [3 / 4, 0.0, 1.0])
+    np.testing.assert_allclose(conc, [2, 1, 0])
+    # observation_end = max ts = 30.0; creation 0/100/200
+    np.testing.assert_allclose(age, [30.0, -70.0, -170.0])
+    # mean writes = (1+2+0)/3 = 1.0 -> write_ratio = writes
+    np.testing.assert_allclose(wr, [1.0, 2.0, 0.0])
+
+
+def test_write_ratio_zero_mean_guard():
+    m = make_manifest()
+    ev = make_events([(5.0, 0, 0, 0)], m)  # one READ, zero writes anywhere
+    t = compute_features(m, ev)
+    # mean(writes)=0 -> forced to 1.0 (compute_features.py:64-65)
+    np.testing.assert_allclose(t.raw[:, 2], [0.0, 0.0, 0.0])
+
+
+def test_unknown_paths_dropped_but_extend_observation_end():
+    m = make_manifest(n=1)
+    ev = EventLog(
+        ts=np.array([10.0, 99.0]),
+        path_id=np.array([0, -1], dtype=np.int32),  # second event: unknown path
+        op=np.array([0, 0], dtype=np.int8),
+        client_id=np.array([0, 0], dtype=np.int32),
+        clients=list(m.nodes),
+    )
+    t = compute_features(m, ev)
+    np.testing.assert_allclose(t.raw[0, 0], 1.0)       # only 1 counted access
+    np.testing.assert_allclose(t.raw[0, 1], 99.0)      # age uses max over raw log
+
+
+def test_empty_log_uses_wallclock_and_locality_one():
+    m = make_manifest()
+    ev = make_events([], m)
+    t = compute_features(m, ev, observation_end=1000.0)
+    np.testing.assert_allclose(t.raw[:, 0], 0)          # access_freq
+    np.testing.assert_allclose(t.raw[:, 3], 1.0)        # locality rule
+    np.testing.assert_allclose(t.raw[:, 1], [1000.0, 900.0, 800.0])
+    # constant columns normalize to all-zeros (compute_features.py:86-88)
+    np.testing.assert_allclose(t.norm[:, 0], 0.0)
+    np.testing.assert_allclose(t.norm[:, 3], 0.0)
+
+
+def test_minmax_normalize():
+    col = np.array([1.0, 3.0, 2.0])
+    np.testing.assert_allclose(minmax_normalize(col), [0.0, 1.0, 0.5])
+    np.testing.assert_allclose(minmax_normalize(np.full(4, 7.0)), 0.0)
+
+
+def test_norm_columns_in_unit_interval():
+    rng = np.random.default_rng(0)
+    m = make_manifest()
+    rows = [(float(rng.random() * 50), int(rng.integers(0, 3)),
+             int(rng.integers(0, 2)), int(rng.integers(0, 2))) for _ in range(200)]
+    t = compute_features(m, make_events(rows, m))
+    assert t.norm.min() >= 0.0 and t.norm.max() <= 1.0
+    # non-degenerate columns hit both 0 and 1
+    af = t.norm[:, 0]
+    assert af.min() == 0.0 and af.max() == 1.0
+
+
+def test_concurrency_bucket_edges():
+    m = make_manifest(n=1)
+    # 10.99 and 11.01 are different floor-buckets; 11.01/11.99 share one.
+    ev = make_events([(10.99, 0, 0, 0), (11.01, 0, 0, 0), (11.99, 0, 0, 0)], m)
+    t = compute_features(m, ev)
+    np.testing.assert_allclose(t.raw[0, 4], 2.0)

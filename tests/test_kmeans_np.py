@@ -1,0 +1,125 @@
+"""Unit tests for the NumPy KMeans++ backend (ops/kmeans_np.py).
+
+Covers the reference contract (src/kmeans_plusplus.py) plus the documented
+fixes: integer max_iter (no crash for n > 10,000) and seeded empty-cluster
+reseeding (SURVEY.md §6.1.1-2).
+"""
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.ops.kmeans_np import (
+    kmeans,
+    kmeans_plusplus_init,
+    lloyd_step,
+    pairwise_sq_dists,
+)
+
+
+def test_pairwise_matches_broadcast():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(57, 5))
+    C = rng.normal(size=(7, 5))
+    expected = np.linalg.norm(X[:, None, :] - C[None, :, :], axis=2) ** 2
+    got = pairwise_sq_dists(X, C, tile=16)
+    np.testing.assert_allclose(got, expected, atol=1e-9)
+
+
+def test_init_shapes_and_membership():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(200, 3))
+    C = kmeans_plusplus_init(X, 5, random_state=42)
+    assert C.shape == (5, 3)
+    # every centroid must be an actual data point
+    for c in C:
+        assert np.any(np.all(np.isclose(X, c), axis=1))
+
+
+def test_init_reproducible():
+    X = np.random.default_rng(2).normal(size=(100, 4))
+    a = kmeans_plusplus_init(X, 6, random_state=7)
+    b = kmeans_plusplus_init(X, 6, random_state=7)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_init_spreads_on_separated_clusters():
+    # With 3 well-separated blobs and k=3, D^2 sampling must pick one point
+    # from each blob (probability of failure is astronomically small).
+    rng = np.random.default_rng(3)
+    blobs = [rng.normal(loc=c, scale=0.01, size=(50, 2)) for c in ((0, 0), (50, 0), (0, 50))]
+    X = np.concatenate(blobs)
+    C = kmeans_plusplus_init(X, 3, random_state=0)
+    owners = {int(np.argmin([np.linalg.norm(c - b.mean(0)) for b in blobs])) for c in C}
+    assert owners == {0, 1, 2}
+
+
+def test_kmeans_recovers_blobs():
+    rng = np.random.default_rng(4)
+    centers = np.array([[0.0, 0.0], [10.0, 0.0], [0.0, 10.0], [10.0, 10.0]])
+    X = np.concatenate([rng.normal(loc=c, scale=0.3, size=(100, 2)) for c in centers])
+    centroids, labels = kmeans(X, 4, number_of_files=len(X), random_state=42)
+    assert centroids.shape == (4, 2)
+    assert labels.shape == (400,)
+    # each found centroid is close to a true center, all 4 matched
+    d = np.linalg.norm(centroids[:, None, :] - centers[None, :, :], axis=2)
+    assert set(np.argmin(d, axis=1).tolist()) == {0, 1, 2, 3}
+    assert d.min(axis=1).max() < 0.5
+    # labels are consistent: points in the same blob share a label
+    for b in range(4):
+        blob_labels = labels[b * 100:(b + 1) * 100]
+        assert len(set(blob_labels.tolist())) == 1
+
+
+def test_kmeans_reproducible_with_seed():
+    X = np.random.default_rng(5).normal(size=(300, 6))
+    c1, l1 = kmeans(X, 5, random_state=9)
+    c2, l2 = kmeans(X, 5, random_state=9)
+    np.testing.assert_array_equal(c1, c2)
+    np.testing.assert_array_equal(l1, l2)
+
+
+def test_no_crash_above_10k_files():
+    # Reference crashes: max(100, n/100) is a float for n > 10,000 and
+    # range(float) raises TypeError (kmeans_plusplus.py:29-31, SURVEY.md §6.1.1).
+    X = np.random.default_rng(6).normal(size=(10_050, 2))
+    centroids, labels = kmeans(X, 3, number_of_files=len(X), random_state=0, max_iter=5)
+    assert centroids.shape == (3, 2)
+
+
+def test_k_greater_than_n_raises():
+    X = np.zeros((3, 2))
+    with pytest.raises(ValueError):
+        kmeans_plusplus_init(X, 5, random_state=0)
+
+
+def test_empty_cluster_reseeded_deterministically():
+    # Force an empty cluster: a far-away initial centroid owns no points.
+    X = np.random.default_rng(7).normal(size=(50, 2))
+    init = np.array([[0.0, 0.0], [1000.0, 1000.0]])
+    rng_a = np.random.default_rng(11)
+    rng_b = np.random.default_rng(11)
+    ca, la, _ = lloyd_step(X, init, rng_a)
+    cb, lb, _ = lloyd_step(X, init, rng_b)
+    np.testing.assert_array_equal(ca, cb)
+    assert np.all(la == 0)  # nobody assigned to the far centroid
+    # the empty cluster was reseeded to a real data point
+    assert np.any(np.all(np.isclose(X, ca[1]), axis=1))
+
+
+def test_labels_match_pre_update_centroids():
+    # Reference loop order: labels computed against the centroids *before*
+    # the final update (kmeans_plusplus.py:33-48).
+    X = np.array([[0.0], [1.0], [10.0], [11.0]])
+    init = np.array([[0.0], [10.0]])
+    centroids, labels = kmeans(X, 2, init_centroids=init, random_state=0, max_iter=1)
+    np.testing.assert_array_equal(labels, [0, 0, 1, 1])
+    np.testing.assert_allclose(centroids, [[0.5], [10.5]])
+
+
+def test_convergence_tolerance():
+    # tol larger than any possible shift -> stops after first iteration.
+    X = np.random.default_rng(8).normal(size=(100, 2))
+    init = X[:3].copy()
+    c_one, _ = kmeans(X, 3, init_centroids=init, random_state=0, max_iter=1)
+    c_tol, _ = kmeans(X, 3, init_centroids=init, random_state=0, tol=1e12)
+    np.testing.assert_allclose(c_one, c_tol)

@@ -1,0 +1,174 @@
+"""Unit tests for the scoring backend (ops/scoring_np.py, compat API).
+
+The reference's only executable verification of the scoring math is a demo
+that runs at import time (src/scoring.py:133-175, SURVEY.md §4.1) — here it
+becomes a real test with the oracle output captured from running the
+reference: C1→Hot, C2→Archival, C3→Archival, C4→Hot.
+"""
+
+import numpy as np
+
+from cdrs_tpu.compat.reference_api import ClusterClassifier
+from cdrs_tpu.config import CATEGORIES, ScoringConfig
+from cdrs_tpu.ops.scoring_np import (
+    classify,
+    classify_medians,
+    compute_cluster_medians,
+    score_table,
+)
+
+# ---------------------------------------------------------------------------
+# The reference's inline example (src/scoring.py:137-165) as a fixture.
+# ---------------------------------------------------------------------------
+
+INLINE_CLUSTERS = {
+    "C1": {"IOPS": [100, 110, 105], "Latency": [2, 3, 2.5]},
+    "C2": {"IOPS": [50, 55, 60], "Latency": [5, 6, 5.5]},
+    "C3": {"IOPS": [10, 12, 11], "Latency": [8, 9, 7]},
+    "C4": {"IOPS": [200, 210, 220], "Latency": [1, 1.5, 1.2]},
+}
+INLINE_GLOBAL_MEDIANS = {"IOPS": 60, "Latency": 4}
+INLINE_WEIGHTS = {
+    "Hot": {"IOPS": 1.0, "Latency": 0.8},
+    "Shared": {"IOPS": 0.7, "Latency": 0.7},
+    "Moderate": {"IOPS": 0.5, "Latency": 0.5},
+    "Archival": {"IOPS": 0.9, "Latency": 1.0},
+}
+INLINE_DIRECTIONS = {
+    "Hot": {"IOPS": +1, "Latency": -1},
+    "Shared": {"IOPS": +1, "Latency": +1},
+    "Moderate": {"IOPS": 0, "Latency": 0},
+    "Archival": {"IOPS": -1, "Latency": +1},
+}
+INLINE_RF = {"Hot": 3, "Shared": 2, "Moderate": 1, "Archival": 4}
+
+
+def test_reference_inline_example():
+    clf = ClusterClassifier(INLINE_GLOBAL_MEDIANS, INLINE_WEIGHTS,
+                            INLINE_DIRECTIONS, INLINE_RF)
+    results = clf.classify(INLINE_CLUSTERS)
+    assert results == {"C1": "Hot", "C2": "Archival", "C3": "Archival", "C4": "Hot"}
+
+
+def test_inline_example_scores_hand_computed():
+    # C1 medians: IOPS 105, Latency 2.5 -> delta (45, -1.5)
+    # Hot: 1.0*45^2 + 0.8*1.5^2 = 2026.8 ; Shared: 0.7*45^2 = 1417.5
+    cfg = ScoringConfig(
+        features=("IOPS", "Latency"),
+        global_medians=INLINE_GLOBAL_MEDIANS,
+        weights=INLINE_WEIGHTS,
+        directions=INLINE_DIRECTIONS,
+        replication_factors=INLINE_RF,
+    )
+    scores = score_table(np.array([[105.0, 2.5]]), cfg)
+    np.testing.assert_allclose(scores[0], [2026.8, 1417.5, 0.0, 0.0])
+
+
+def test_vectorized_matches_compat_api():
+    cfg = ScoringConfig(
+        features=("IOPS", "Latency"),
+        global_medians=INLINE_GLOBAL_MEDIANS,
+        weights=INLINE_WEIGHTS,
+        directions=INLINE_DIRECTIONS,
+        replication_factors=INLINE_RF,
+    )
+    medians = np.array([
+        [105.0, 2.5], [55.0, 5.5], [11.0, 8.0], [210.0, 1.2],
+    ])
+    winner, scores = classify_medians(medians, cfg)
+    assert [cfg.categories[int(w)] for w in winner] == \
+        ["Hot", "Archival", "Archival", "Hot"]
+
+    clf = ClusterClassifier(INLINE_GLOBAL_MEDIANS, INLINE_WEIGHTS,
+                            INLINE_DIRECTIONS, INLINE_RF)
+    for row, w in zip(medians, winner):
+        cm = {"IOPS": row[0], "Latency": row[1]}
+        for cat in cfg.categories:
+            expected = clf.score_category(cm, cat)
+            got = scores[list(medians.tolist()).index(row.tolist()),
+                         cfg.categories.index(cat)]
+            np.testing.assert_allclose(got, expected)
+
+
+def test_all_zero_scores_tie_break_to_archival():
+    # delta exactly 0 everywhere: non-Moderate categories score only where
+    # dir == 0 (np.sign(0) == 0, scoring.py:81); Moderate scores w*(1-0)^2.
+    # With the production config Moderate has all dirs 0 but is handled by the
+    # Moderate branch; others have nonzero dirs -> 0.  Moderate wins outright.
+    cfg = ScoringConfig()
+    medians = np.full((1, 5), 0.5)  # equals placeholder global medians
+    winner, scores = classify_medians(medians, cfg)
+    assert CATEGORIES[int(winner[0])] == "Moderate"
+
+    # NaN medians (empty cluster) -> all scores 0 -> rf tie-break -> Archival
+    # (rf 4 > 3 > 2 > 1; SURVEY.md §2.3).
+    winner2, scores2 = classify_medians(np.full((1, 5), np.nan), cfg)
+    assert np.all(scores2 == 0)
+    assert CATEGORIES[int(winner2[0])] == "Archival"
+
+
+def test_moderate_band_boundary():
+    cfg = ScoringConfig(
+        features=("f",),
+        global_medians={"f": 0.5},
+        weights={c: {"f": 1.0} for c in CATEGORIES},
+        directions={"Hot": {"f": 1}, "Shared": {"f": 1},
+                    "Moderate": {"f": 0}, "Archival": {"f": -1}},
+        replication_factors={"Hot": 3, "Shared": 2, "Moderate": 1, "Archival": 4},
+    )
+    mod = list(CATEGORIES).index("Moderate")
+    # binary-exact deltas: 0.0625 < 0.1 -> Moderate scores (1-0.0625)^2
+    s = score_table(np.array([[0.5625]]), cfg)
+    np.testing.assert_allclose(s[0, mod], (1 - 0.0625) ** 2, rtol=1e-12)
+    # |delta| = 0.125 >= 0.1 -> outside the band: no Moderate score
+    s = score_table(np.array([[0.625]]), cfg)
+    assert s[0, mod] == 0.0
+
+
+def test_direction_gating():
+    cfg = ScoringConfig(
+        features=("f",),
+        global_medians={"f": 0.0},
+        weights={c: {"f": 1.0} for c in CATEGORIES},
+        directions={"Hot": {"f": 1}, "Shared": {"f": -1},
+                    "Moderate": {"f": 0}, "Archival": {"f": 0}},
+        replication_factors={"Hot": 3, "Shared": 2, "Moderate": 1, "Archival": 4},
+    )
+    s = score_table(np.array([[0.4]]), cfg)
+    cats = list(CATEGORIES)
+    assert s[0, cats.index("Hot")] > 0          # sign matches +1
+    assert s[0, cats.index("Shared")] == 0.0    # sign mismatch
+    # dir == 0 scores regardless of delta (scoring.py:81, SURVEY.md §6.1.9)
+    np.testing.assert_allclose(s[0, cats.index("Archival")], 0.16)
+    # delta == 0 scores only when dir == 0 (np.sign(0) == 0)
+    s0 = score_table(np.array([[0.0]]), cfg)
+    assert s0[0, cats.index("Hot")] == 0.0
+    assert s0[0, cats.index("Archival")] == 0.0  # 1.0 * 0^2
+
+
+def test_cluster_medians_and_full_classify():
+    rng = np.random.default_rng(0)
+    X = rng.random((40, 5))
+    labels = np.repeat(np.arange(4), 10)
+    medians = compute_cluster_medians(X, labels, 4)
+    for j in range(4):
+        np.testing.assert_allclose(medians[j], np.median(X[labels == j], axis=0))
+    # empty cluster -> NaN row
+    medians5 = compute_cluster_medians(X, labels, 5)
+    assert np.all(np.isnan(medians5[4]))
+
+    winner, scores, med = classify(X, labels, 4, ScoringConfig())
+    assert winner.shape == (4,)
+    assert scores.shape == (4, 4)
+    np.testing.assert_allclose(med, medians)
+
+
+def test_compute_global_medians_from_data():
+    cfg = ScoringConfig(compute_global_medians_from_data=True)
+    rng = np.random.default_rng(1)
+    X = rng.random((100, 5))
+    labels = np.zeros(100, dtype=np.int64)
+    winner, scores, medians = classify(X, labels, 1, cfg)
+    # one cluster whose medians equal the global medians -> all deltas 0
+    # -> Moderate wins (its band rewards zero deviation).
+    assert CATEGORIES[int(winner[0])] == "Moderate"
