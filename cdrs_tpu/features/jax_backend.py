@@ -1,0 +1,148 @@
+"""JAX feature-extraction backend — segment reductions replace Spark groupBys.
+
+Computes the five per-file features of reference src/compute_features.py
+(exact formulas in SURVEY.md §2.2) as one jit-compiled kernel over the
+struct-of-arrays event log:
+
+* ``access_freq``/``writes``/``reads`` — ``segment_sum`` keyed by path id
+  (replaces the Spark groupBy shuffles, compute_features.py:31-34).
+* ``locality`` — segment_sum of (client == primary_node) matches; 1.0 for
+  never-accessed files (compute_features.py:37-42, 68).
+* ``concurrency`` — max events-per-second per path (compute_features.py:44-46):
+  lexsort events by (path, second), run-length count the equal-(path, second)
+  runs with a cumsum over run boundaries, then ``segment_max`` the run counts
+  by path.  Static shapes throughout — no ``np.unique`` dynamic sizing.
+* ``age_seconds``/``write_ratio``/min-max ``*_norm`` — full-array reductions
+  (compute_features.py:48-54, 62-66, 77-94), including the degenerate guards
+  (mean writes 0 -> 1.0; constant column -> all-zero norm).
+
+Events with paths missing from the manifest are masked out of every counter
+but still counted toward ``observation_end`` (left-join semantics,
+compute_features.py:48, 56-60) — the mask happens in-kernel so event arrays
+never need host-side filtering.
+
+The numpy backend (features/numpy_backend.py) is the golden model; parity is
+enforced by tests/test_features_jax.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..io.events import EventLog, Manifest
+from .numpy_backend import FeatureTable
+
+__all__ = ["compute_features_jax", "features_kernel"]
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def features_kernel(
+    pid: jnp.ndarray,          # (e,) int32, -1 = not in manifest
+    ts: jnp.ndarray,           # (e,) float64 epoch seconds
+    op: jnp.ndarray,           # (e,) int8, 1 = WRITE
+    client: jnp.ndarray,       # (e,) int32
+    primary_node_id: jnp.ndarray,  # (n,) int32
+    creation_ts: jnp.ndarray,  # (n,) float64
+    observation_end: jnp.ndarray,  # scalar
+    n: int,
+):
+    """Returns (raw (n,5), norm (n,5), writes (n,), reads (n,))."""
+    ftype = creation_ts.dtype
+    valid = pid >= 0
+    w = valid.astype(ftype)
+    pid_c = jnp.where(valid, pid, 0).astype(jnp.int32)
+
+    access_freq = jax.ops.segment_sum(w, pid_c, num_segments=n)
+    writes = jax.ops.segment_sum(w * (op == 1), pid_c, num_segments=n)
+    reads = access_freq - writes
+
+    is_local = (client == primary_node_id[pid_c]).astype(ftype) * w
+    local_acc = jax.ops.segment_sum(is_local, pid_c, num_segments=n)
+    locality = jnp.where(
+        access_freq > 0, local_acc / jnp.maximum(access_freq, 1.0), 1.0
+    )
+
+    # Two-level concurrency: runs of equal (path, second) after a lexsort.
+    # Buckets are floor(ts) rebased to the earliest bucket so the int32 cast
+    # never overflows (epoch seconds exceed int32 after 2038; offsets are
+    # bounded by the observation window).
+    e = pid.shape[0]
+    sec_f = jnp.floor(ts)
+    sec = (sec_f - sec_f.min()).astype(jnp.int32)
+    sort_pid = jnp.where(valid, pid, n).astype(jnp.int32)  # invalid sorts last
+    order = jnp.lexsort((sec, sort_pid))
+    s_pid = sort_pid[order]
+    s_sec = sec[order]
+    s_w = w[order]
+    new_run = jnp.concatenate([
+        jnp.ones((1,), jnp.int32),
+        ((s_pid[1:] != s_pid[:-1]) | (s_sec[1:] != s_sec[:-1])).astype(jnp.int32),
+    ])
+    run_id = jnp.cumsum(new_run) - 1                     # (e,) run index
+    run_counts = jax.ops.segment_sum(s_w, run_id, num_segments=e)
+    per_event_count = run_counts[run_id] * s_w
+    conc = jax.ops.segment_max(
+        per_event_count, jnp.where(s_pid < n, s_pid, 0), num_segments=n
+    )
+    concurrency = jnp.maximum(conc, 0.0)  # -inf identity -> 0 for no-event files
+
+    age_seconds = observation_end - creation_ts
+
+    mean_writes = jnp.mean(writes)
+    mean_writes = jnp.where(mean_writes == 0, 1.0, mean_writes)
+    write_ratio = writes / mean_writes
+
+    raw = jnp.stack(
+        [access_freq, age_seconds, write_ratio, locality, concurrency], axis=1
+    )
+    lo = raw.min(axis=0)
+    hi = raw.max(axis=0)
+    norm = jnp.where(hi > lo, (raw - lo) / jnp.where(hi > lo, hi - lo, 1.0), 0.0)
+    return raw, norm, writes, reads
+
+
+def compute_features_jax(
+    manifest: Manifest,
+    events: EventLog,
+    observation_end: float | None = None,
+) -> FeatureTable:
+    """Drop-in replacement for features/numpy_backend.compute_features."""
+    n = len(manifest)
+
+    if observation_end is None:
+        observation_end = float(events.ts.max()) if len(events) else time.time()
+
+    if len(events) == 0:
+        # Degenerate log: all counters zero, locality 1.0 (compute_features.py:60,68).
+        raw = np.zeros((n, 5), dtype=np.float64)
+        raw[:, 1] = observation_end - manifest.creation_ts
+        raw[:, 3] = 1.0
+        lo, hi = raw.min(axis=0), raw.max(axis=0)
+        norm = np.where(hi > lo, (raw - lo) / np.where(hi > lo, hi - lo, 1.0), 0.0)
+        zeros = np.zeros(n, dtype=np.float64)
+        return FeatureTable(paths=list(manifest.paths), raw=raw, norm=norm,
+                            writes=zeros, reads=zeros.copy())
+
+    raw, norm, writes, reads = features_kernel(
+        jnp.asarray(events.path_id, dtype=jnp.int32),
+        jnp.asarray(events.ts),
+        jnp.asarray(events.op),
+        jnp.asarray(events.client_id, dtype=jnp.int32),
+        jnp.asarray(manifest.primary_node_id, dtype=jnp.int32),
+        jnp.asarray(manifest.creation_ts),
+        jnp.asarray(observation_end, dtype=jnp.asarray(manifest.creation_ts).dtype),
+        n,
+    )
+    return FeatureTable(
+        paths=list(manifest.paths),
+        raw=np.asarray(raw, dtype=np.float64),
+        norm=np.asarray(norm, dtype=np.float64),
+        writes=np.asarray(writes, dtype=np.float64),
+        reads=np.asarray(reads, dtype=np.float64),
+    )

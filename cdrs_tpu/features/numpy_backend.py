@@ -56,13 +56,16 @@ class FeatureTable:
     def write_csv(self, path: str) -> None:
         """Emit the Spark job's CSV schema: path, 5 raw, 5 *_norm columns
         (reference: src/compute_features.py:70-75, 90-96)."""
+        import csv
+
         header = ["path", *self.raw_names, *self.norm_names]
-        with open(path, "w") as f:
-            f.write(",".join(header) + "\n")
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(header)
             for i, p in enumerate(self.paths):
-                vals = [*(repr(float(v)) for v in self.raw[i]),
-                        *(repr(float(v)) for v in self.norm[i])]
-                f.write(p + "," + ",".join(vals) + "\n")
+                w.writerow([p,
+                            *(repr(float(v)) for v in self.raw[i]),
+                            *(repr(float(v)) for v in self.norm[i])])
 
 
 def minmax_normalize(col: np.ndarray) -> np.ndarray:

@@ -154,14 +154,15 @@ class EventLog:
         Events with ``path_id == -1`` (path unknown to the manifest) are
         skipped — their original path string was not retained at ingest.
         """
-        with open(path, "w") as f:
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
             for i in range(len(self.ts)):
                 if self.path_id[i] < 0:
                     continue
                 dt = datetime.fromtimestamp(float(self.ts[i]), tz=timezone.utc)
                 iso = dt.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
                 op = "WRITE" if self.op[i] else "READ"
-                f.write(
-                    f"{iso},{manifest.paths[int(self.path_id[i])]},{op},"
-                    f"{self.clients[int(self.client_id[i])]},{1000 + i % 9000}\n"
-                )
+                w.writerow([
+                    iso, manifest.paths[int(self.path_id[i])], op,
+                    self.clients[int(self.client_id[i])], 1000 + i % 9000,
+                ])

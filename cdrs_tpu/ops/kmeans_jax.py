@@ -1,0 +1,285 @@
+"""TPU-native KMeans++ — sharded JAX kernels.
+
+Re-designs the reference's single-threaded NumPy KMeans
+(reference: src/kmeans_plusplus.py:3-50) as XLA-compiled SPMD kernels over a
+``jax.sharding.Mesh``:
+
+* **Assignment** — the reference's dense ``(n, k, d)`` broadcast
+  (kmeans_plusplus.py:33) becomes the matmul expansion
+  ``argmin_k(‖c‖² − 2·x·Cᵀ)`` — one MXU matmul per step, never materializing
+  the (n, k, d) temporary.  Points are sharded along the ``data`` mesh axis,
+  centroids replicated.
+* **Update** — the reference's k masked means (kmeans_plusplus.py:38-43)
+  become one ``segment_sum`` of (weighted x, weight) per shard followed by a
+  single ``lax.psum`` over ICI — the TPU equivalent of Spark's shuffle /
+  an NCCL allreduce (SURVEY.md §2.5).
+* **D² init** — the reference recomputes all pairwise distances each round
+  (kmeans_plusplus.py:13-17, O(n·k²·d)); here the min-distance state is
+  incremental (O(n·d) per round) and the categorical draw runs **on device**
+  via the Gumbel-max trick with a cross-shard argmax, so the k-round loop is
+  a single ``lax.fori_loop`` with zero host syncs.
+* **Convergence** — ``lax.while_loop`` on the Frobenius centroid shift
+  (reference tol semantics, kmeans_plusplus.py:45-48); labels returned are
+  the assignment against the pre-update centroids, exactly the reference's
+  loop order.
+* **Empty clusters** — reseeded to a uniformly drawn data point from the
+  threaded PRNG key (the reference used the *unseeded* global RNG,
+  kmeans_plusplus.py:43 — fixed per SURVEY.md §6.1.2).
+
+Padded rows (for even sharding) carry weight 0 and are excluded from sums,
+counts, and sampling.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, make_mesh, pad_rows
+
+__all__ = [
+    "pairwise_sq_dists_jax",
+    "assign_labels_jax",
+    "kmeans_jax",
+    "kmeans_jax_full",
+]
+
+
+def pairwise_sq_dists_jax(x: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+    """Squared Euclidean distances (n, k) via ‖x‖² − 2·x·Cᵀ + ‖c‖².
+
+    Matches ops/kmeans_np.pairwise_sq_dists (the golden model); the matmul is
+    the MXU-friendly form of reference kmeans_plusplus.py:14-17.
+    """
+    x_sq = jnp.sum(x * x, axis=1, keepdims=True)
+    c_sq = jnp.sum(c * c, axis=1)
+    return jnp.maximum(x_sq - 2.0 * (x @ c.T) + c_sq[None, :], 0.0)
+
+
+def assign_labels_jax(x: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+    """Nearest-centroid labels; drops the per-row-constant ‖x‖² term
+    (same trick as ops/kmeans_np.assign_labels)."""
+    c_sq = jnp.sum(c * c, axis=1)
+    d = c_sq[None, :] - 2.0 * (x @ c.T)
+    return jnp.argmin(d, axis=1).astype(jnp.int32)
+
+
+def _sq_dist_to_row(x: jnp.ndarray, x_sq: jnp.ndarray, row: jnp.ndarray) -> jnp.ndarray:
+    """(n,) squared distances of every x row to one centroid row."""
+    return jnp.maximum(x_sq - 2.0 * (x @ row) + jnp.dot(row, row), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Shard-local kernel bodies (run inside shard_map; axis name DATA_AXIS)
+# ---------------------------------------------------------------------------
+
+
+def _pick_row_global(x: jnp.ndarray, scores: jnp.ndarray) -> jnp.ndarray:
+    """Row of the global argmax of ``scores`` across all shards.
+
+    Cross-shard argmax: pmax of the local max, deterministic tie-break by the
+    lowest device rank, then a psum-select of the winning row — communicates
+    O(d), never gathers points.
+    """
+    rank = lax.axis_index(DATA_AXIS)
+    ndev = lax.axis_size(DATA_AXIS)
+    local_max = jnp.max(scores)
+    local_arg = jnp.argmax(scores)
+    gmax = lax.pmax(local_max, DATA_AXIS)
+    owner = jnp.where(local_max == gmax, rank, ndev)
+    sel = rank == lax.pmin(owner, DATA_AXIS)
+    row = jnp.where(sel, x[local_arg], jnp.zeros((x.shape[1],), x.dtype))
+    return lax.psum(row, DATA_AXIS)
+
+
+def _d2_init_local(x, w, key, *, k):
+    """KMeans++ D² sampling, shard-local view (x: (n_loc, d) shard).
+
+    Gumbel-max: argmax(log p_i + G_i) is a categorical draw ∝ p_i, and argmax
+    distributes across shards (see _pick_row_global) — so each of the k rounds
+    is pure on-device compute + two scalar collectives + one O(d) psum.
+    Degenerate rounds (all residual distances 0) fall back to a uniform draw
+    (reference: kmeans_np.kmeans_plusplus_init fallback).
+    """
+    rank = lax.axis_index(DATA_AXIS)
+    d = x.shape[1]
+    x_sq = jnp.sum(x * x, axis=1)
+    neg_inf = jnp.array(-jnp.inf, x.dtype)
+
+    def sample(round_idx, logits):
+        noise_key = jax.random.fold_in(jax.random.fold_in(key, round_idx), rank)
+        g = jax.random.gumbel(noise_key, logits.shape, x.dtype)
+        return _pick_row_global(x, jnp.where(w > 0, logits + g, neg_inf))
+
+    # Round 0: uniform over valid points (reference kmeans_plusplus.py:9-10).
+    c0 = sample(0, jnp.zeros_like(x_sq))
+    centroids = jnp.zeros((k, d), x.dtype).at[0].set(c0)
+    min_sq = _sq_dist_to_row(x, x_sq, c0)
+
+    def round_body(i, carry):
+        centroids, min_sq = carry
+        total = lax.psum(jnp.sum(min_sq * w), DATA_AXIS)
+        # p_i ∝ min_sq_i  ⇒  logits = log(min_sq); log(0) = -inf is exactly
+        # "probability zero".  All-zero residuals ⇒ uniform fallback.
+        logits = jnp.where(total > 0, jnp.log(min_sq), jnp.zeros_like(min_sq))
+        ci = sample(i, logits)
+        centroids = centroids.at[i].set(ci)
+        min_sq = jnp.minimum(min_sq, _sq_dist_to_row(x, x_sq, ci))
+        return centroids, min_sq
+
+    centroids, _ = lax.fori_loop(1, k, round_body, (centroids, min_sq))
+    return centroids
+
+
+def _lloyd_local(x, w, centroids, key, *, k, n_valid, tol, max_iter):
+    """Lloyd loop, shard-local view.  Returns (centroids, labels, iters, shift).
+
+    Labels are the assignment against the centroids *before* the final update
+    (reference loop order, kmeans_plusplus.py:33-48).
+    """
+    n_loc = x.shape[0]
+    rank = lax.axis_index(DATA_AXIS)
+    offset = rank * n_loc
+
+    def cond(carry):
+        _, _, _, it, shift = carry
+        return (it < max_iter) & ((it == 0) | (shift >= tol))
+
+    def body(carry):
+        c, _, key, it, _ = carry
+        labels = assign_labels_jax(x, c)
+        sums = jax.ops.segment_sum(x * w[:, None], labels, num_segments=k)
+        counts = jax.ops.segment_sum(w, labels, num_segments=k)
+        sums = lax.psum(sums, DATA_AXIS)
+        counts = lax.psum(counts, DATA_AXIS)
+
+        # Seeded empty-cluster reseed: one uniform global index per cluster,
+        # fetched without a gather (each shard contributes its owned rows).
+        key, sub = jax.random.split(key)
+        reseed_idx = jax.random.randint(sub, (k,), 0, n_valid)
+        rel = reseed_idx - offset
+        owned = (rel >= 0) & (rel < n_loc)
+        cand = lax.psum(
+            jnp.where(owned[:, None], x[jnp.clip(rel, 0, n_loc - 1)], 0.0),
+            DATA_AXIS,
+        )
+
+        new_c = jnp.where(
+            counts[:, None] > 0,
+            sums / jnp.maximum(counts, 1.0)[:, None],
+            cand,
+        )
+        shift = jnp.sqrt(jnp.sum((new_c - c) ** 2))
+        return new_c, labels, key, it + 1, shift
+
+    init = (
+        centroids,
+        jnp.zeros((n_loc,), jnp.int32),
+        key,
+        jnp.array(0, jnp.int32),
+        jnp.array(jnp.inf, x.dtype),
+    )
+    c, labels, _, it, shift = lax.while_loop(cond, body, init)
+    return c, labels, it, shift
+
+
+# ---------------------------------------------------------------------------
+# Compiled entry points
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=32)
+def _build_kmeans(n_valid, d, k, ndev, max_iter, tol, with_init, dtype_name):
+    """Compile the full sharded kmeans for one (shape, mesh, config) point."""
+    mesh = make_mesh(n_data=ndev)
+
+    def local_fn(x, w, c0, key):
+        if with_init:
+            centroids = c0
+        else:
+            centroids = _d2_init_local(x, w, key, k=k)
+        lloyd_key = jax.random.fold_in(key, 0x10D)  # distinct stream from init
+        return _lloyd_local(
+            x, w, centroids, lloyd_key,
+            k=k, n_valid=n_valid, tol=tol, max_iter=max_iter,
+        )
+
+    sharded = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P()),
+        out_specs=(P(), P(DATA_AXIS), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def kmeans_jax_full(
+    X,
+    k: int,
+    tol: float = 1e-4,
+    seed: int | None = None,
+    max_iter: int = 100,
+    init_centroids=None,
+    mesh_shape: dict[str, int] | None = None,
+    dtype=None,
+):
+    """Sharded KMeans++ + Lloyd.  Returns (centroids, labels, n_iter, shift).
+
+    Reference entry point: src/kmeans_plusplus.py:24 ``kmeans(X, k, ...)``.
+    ``init_centroids`` overrides the D² init (used by the numpy-parity tests so
+    both backends iterate from identical starting points).
+    ``mesh_shape={"data": N}`` shards rows over N devices; default 1.
+    """
+    X = np.asarray(X)
+    if dtype is None:
+        dtype = X.dtype if np.issubdtype(X.dtype, np.floating) else np.float32
+    n, d = X.shape
+    if k > n:
+        raise ValueError(f"k={k} exceeds number of samples n={n}")
+    ndev = int((mesh_shape or {}).get(DATA_AXIS, 1))
+
+    Xp, n_valid = pad_rows(X.astype(dtype, copy=False), ndev)
+    # Padded rows carry weight 0 and reseed draws are bounded by n_valid, so
+    # padding never leaks into sums, counts, or sampling.
+    w = np.zeros(Xp.shape[0], dtype=dtype)
+    w[:n] = 1.0
+
+    with_init = init_centroids is not None
+    c0 = (
+        np.asarray(init_centroids, dtype=dtype)
+        if with_init
+        else np.zeros((k, d), dtype=dtype)
+    )
+    key = jax.random.PRNGKey(0 if seed is None else int(seed))
+
+    fn = _build_kmeans(
+        n_valid, d, int(k), ndev, int(max_iter), float(tol),
+        with_init, np.dtype(dtype).name,
+    )
+    centroids, labels, it, shift = fn(Xp, w, c0, key)
+    return centroids, labels[:n], int(it), float(shift)
+
+
+def kmeans_jax(
+    X,
+    k: int,
+    tol: float = 1e-4,
+    seed: int | None = None,
+    max_iter: int = 100,
+    init_centroids=None,
+    mesh_shape: dict[str, int] | None = None,
+    dtype=None,
+):
+    """Reference-shaped API: returns (centroids, labels)."""
+    centroids, labels, _, _ = kmeans_jax_full(
+        X, k, tol=tol, seed=seed, max_iter=max_iter,
+        init_centroids=init_centroids, mesh_shape=mesh_shape, dtype=dtype,
+    )
+    return centroids, labels

@@ -1,0 +1,131 @@
+"""JAX cluster scoring/classification — jit-compiled, mesh-friendly.
+
+Same semantics as ops/scoring_np (the golden model; reference:
+src/scoring.py:3-130), re-shaped for XLA:
+
+* **Per-cluster medians** — the reference's per-cluster ``np.median`` calls
+  (scoring.py:50-55) need ragged groups; under jit we instead lexsort each
+  feature column by (label, value) so every cluster's values are a contiguous
+  sorted run, then gather the two middle elements per run from computed
+  offsets.  Static shapes, one sort per feature, no host round-trips.
+* **Score table** — one (k, C, d) masked broadcast: direction gate
+  ``dir == 0 | sign(delta) == dir`` for non-Moderate, ``|delta| < band`` with
+  reward ``(1 - |delta|)²`` for Moderate (scoring.py:77-82).
+* **Tie-break** — argmax of replication factor among score-tied categories
+  (scoring.py:102-107): all-zero clusters classify Archival.
+
+Empty clusters get NaN medians which score 0 everywhere (same contract as the
+numpy backend).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ScoringConfig
+
+__all__ = [
+    "compute_cluster_medians_jax",
+    "score_table_jax",
+    "classify_jax",
+]
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def compute_cluster_medians_jax(x: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:
+    """(k, d) per-cluster per-feature medians; NaN rows for empty clusters."""
+    n = x.shape[0]
+    ones = jnp.ones((n,), x.dtype)
+    counts = jax.ops.segment_sum(ones, labels, num_segments=k).astype(jnp.int32)
+    starts = jnp.cumsum(counts) - counts  # exclusive prefix sum
+
+    def median_one_feature(col):
+        order = jnp.lexsort((col, labels))
+        vals = col[order]
+        lo = starts + (counts - 1) // 2
+        hi = starts + counts // 2
+        med = (vals[jnp.clip(lo, 0, n - 1)] + vals[jnp.clip(hi, 0, n - 1)]) * 0.5
+        return jnp.where(counts > 0, med, jnp.nan)
+
+    return jax.vmap(median_one_feature, in_axes=1, out_axes=1)(x)
+
+
+@jax.jit
+def score_table_jax(
+    cluster_medians: jnp.ndarray,   # (k, d)
+    global_medians: jnp.ndarray,    # (d,)
+    W: jnp.ndarray,                 # (C, d) weights
+    D: jnp.ndarray,                 # (C, d) directions in {-1, 0, +1}
+    is_moderate: jnp.ndarray,       # (C,) bool
+    moderate_band: jnp.ndarray,     # scalar
+) -> jnp.ndarray:
+    """(k, C) score matrix (reference: src/scoring.py:57-84, vectorized)."""
+    delta = cluster_medians - global_medians[None, :]
+    valid = ~jnp.isnan(delta)
+    delta = jnp.where(valid, delta, 0.0)
+    abs_d = jnp.abs(delta)
+
+    delta_b = delta[:, None, :]
+    absd_b = abs_d[:, None, :]
+    valid_b = valid[:, None, :]
+
+    gate_dir = (D[None, :, :] == 0) | (jnp.sign(delta_b) == D[None, :, :])
+    term_dir = W[None, :, :] * absd_b**2
+    gate_mod = absd_b < moderate_band
+    term_mod = W[None, :, :] * (1.0 - absd_b) ** 2
+
+    mod = is_moderate[None, :, None]
+    gate = jnp.where(mod, gate_mod, gate_dir) & valid_b
+    term = jnp.where(mod, term_mod, term_dir)
+    return jnp.where(gate, term, 0.0).sum(axis=2)
+
+
+@jax.jit
+def _pick_winner(scores: jnp.ndarray, rf: jnp.ndarray) -> jnp.ndarray:
+    """Argmax score with replication-factor tie-break (scoring.py:102-107)."""
+    tied = scores == scores.max(axis=1, keepdims=True)
+    return jnp.argmax(jnp.where(tied, rf[None, :], -jnp.inf), axis=1)
+
+
+def classify_jax(
+    X,
+    labels,
+    k: int,
+    cfg: ScoringConfig | None = None,
+    global_medians=None,
+):
+    """Full classification: medians -> scores -> categories.
+
+    Returns ``(category_idx (k,), scores (k, C), cluster_medians (k, d))`` as
+    jax arrays.  Mirrors ops/scoring_np.classify (reference: scoring.py:111-130).
+    """
+    cfg = cfg or ScoringConfig()
+    x = jnp.asarray(X)
+    labels = jnp.asarray(labels).astype(jnp.int32)
+
+    medians = compute_cluster_medians_jax(x, labels, int(k))
+    if global_medians is None:
+        if cfg.compute_global_medians_from_data:
+            global_medians = jnp.median(x, axis=0)
+        else:
+            global_medians = jnp.asarray(
+                [cfg.global_medians[f] for f in cfg.features], dtype=x.dtype
+            )
+    else:
+        global_medians = jnp.asarray(global_medians, dtype=x.dtype)
+
+    W = jnp.asarray(np.array(cfg.weight_matrix(), dtype=np.float64), dtype=x.dtype)
+    D = jnp.asarray(np.array(cfg.direction_matrix(), dtype=np.float64), dtype=x.dtype)
+    is_mod = jnp.asarray(np.array([c == "Moderate" for c in cfg.categories]))
+    rf = jnp.asarray(np.array(cfg.rf_vector(), dtype=np.float64), dtype=x.dtype)
+
+    scores = score_table_jax(
+        medians, global_medians, W, D, is_mod, jnp.asarray(cfg.moderate_band, x.dtype)
+    )
+    winner = _pick_winner(scores, rf)
+    return winner, scores, medians

@@ -1,0 +1,69 @@
+"""Device-mesh utilities — the framework's one communication layer.
+
+The reference's "cluster" is a docker-compose file of Hadoop/Spark containers
+communicating over TCP shuffles and HDFS RPC (reference: docker/docker-compose.yml:4-79,
+SURVEY.md §2.5).  The TPU-native equivalent is a ``jax.sharding.Mesh`` over
+chips with XLA collectives (``psum``/``pmax``/``all_gather``) riding ICI/DCN —
+every distributed operation in this framework goes through a mesh built here.
+
+Mesh axes:
+
+* ``data`` — file/event rows are sharded along it (the reference's Spark
+  row-partitioning axis).
+* ``model`` — optional second axis sharding the centroid table for very large
+  k (tensor parallelism of the (n, k) distance matrix).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "mesh_from_shape", "pad_rows", "DATA_AXIS", "MODEL_AXIS"]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_data: int = 1, n_model: int = 1, devices=None) -> Mesh:
+    """Build a mesh from the first n_data*n_model devices.
+
+    1D ``(data,)`` when n_model == 1 (the common case — keeps specs simple for
+    purely data-parallel kernels), 2D ``(data, model)`` otherwise.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = n_data * n_model
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_model} needs {need} devices, have {len(devices)}"
+        )
+    if n_model == 1:
+        return Mesh(np.array(devices[:n_data]), (DATA_AXIS,))
+    arr = np.array(devices[:need]).reshape(n_data, n_model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def mesh_from_shape(mesh_shape: dict[str, int] | None, devices=None) -> Mesh:
+    """Mesh from a ``{"data": N, "model": M}`` dict (missing axes default 1).
+
+    ``mesh_shape=None`` means a single-device mesh — the uniform code path:
+    collectives over a 1-element axis are identity ops and XLA elides them.
+    """
+    shape = dict(mesh_shape or {})
+    return make_mesh(shape.get(DATA_AXIS, 1), shape.get(MODEL_AXIS, 1), devices)
+
+
+def pad_rows(x: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
+    """Pad axis 0 up to a multiple (for even sharding); returns (padded, n_valid).
+
+    Padded rows carry weight 0 in every kernel (see kmeans_jax), so they never
+    influence sums, counts, or sampling.
+    """
+    n = x.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    pad_width = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
+    return np.pad(x, pad_width), n

@@ -39,13 +39,17 @@ class PipelineResult:
         }
 
 
-def recovery_accuracy(decision: ClusterDecision, planted: list[str]) -> float:
+def recovery_accuracy(decision: ClusterDecision, planted: list[str]) -> float | None:
     """Fraction of files whose recovered category matches the planted one.
 
     The reference plants ground truth (generator.py:45) and drives the
     simulator from it (access_simulator.py:42-47) but never closes the loop
     (SURVEY.md §4.2); this makes the implicit validation executable.
+    Returns None when the manifest plants categories outside the canonical
+    four (custom category mixes have no ground-truth mapping).
     """
+    if any(c not in PLANTED_TO_CATEGORY for c in planted):
+        return None
     predicted = np.asarray(decision.category_idx)[np.asarray(decision.labels)]
     truth = np.asarray(
         [CATEGORIES.index(PLANTED_TO_CATEGORY[c]) for c in planted], dtype=np.int64)

@@ -2,15 +2,51 @@
 
 The JAX analogue of the reference's docker-compose fake cluster (SURVEY.md §4):
 multi-chip sharding is exercised on host CPU with
-``--xla_force_host_platform_device_count=8``.  Must be set before jax imports.
+``--xla_force_host_platform_device_count=8``.
+
+The ambient environment may register a real TPU backend at interpreter startup
+(a sitecustomize driven by PALLAS_AXON_POOL_IPS sets jax_platforms to the TPU
+plugin) — env vars alone are therefore too late here.  We override the config
+directly and clear any initialized backends so tests always run on the virtual
+CPU mesh; only bench.py uses the real chip.
+
+jax is an optional dependency (the ``tpu`` extra): with no jax installed the
+numpy-backend tests still run, and jax-dependent test modules are skipped at
+collection via their own imports.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+try:
+    import jax
+except ImportError:  # pragma: no cover - base install without the tpu extra
+    jax = None
+
+if jax is not None:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    try:  # private API; best-effort cleanup of site-hook-initialized backends
+        from jax._src.xla_bridge import backends_are_initialized
+        if backends_are_initialized():  # pragma: no cover - site-hook dependent
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+    except ImportError:  # pragma: no cover
+        pass
+    n_dev = len(jax.devices())
+    if n_dev < 8:  # pragma: no cover - foreign XLA_FLAGS already set a count
+        import pytest
+
+        pytest.exit(
+            f"tests need 8 virtual CPU devices, got {n_dev} "
+            f"(XLA_FLAGS={os.environ.get('XLA_FLAGS')!r})", returncode=3,
+        )

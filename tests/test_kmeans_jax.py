@@ -1,0 +1,98 @@
+"""Parity + sharding tests for the JAX KMeans backend.
+
+Strategy per SURVEY.md §4: numerical parity NumPy-vs-JAX on identical inputs
+(shared init via ``init_centroids``), plus multi-chip correctness on the
+8-device virtual CPU mesh (conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from cdrs_tpu.ops.kmeans_np import kmeans, kmeans_plusplus_init, pairwise_sq_dists
+from cdrs_tpu.ops.kmeans_jax import (
+    kmeans_jax,
+    kmeans_jax_full,
+    pairwise_sq_dists_jax,
+)
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    rng = np.random.default_rng(7)
+    centers = rng.normal(size=(4, 5)) * 4.0
+    X = np.concatenate([rng.normal(size=(250, 5)) * 0.5 + c for c in centers])
+    return X
+
+
+def test_pairwise_sq_dists_matches_numpy(blobs):
+    C = blobs[:6]
+    got = np.asarray(pairwise_sq_dists_jax(blobs, C))
+    want = pairwise_sq_dists(blobs, C)
+    np.testing.assert_allclose(got, want, atol=1e-8)
+
+
+def test_lloyd_parity_with_numpy_same_init(blobs):
+    init = kmeans_plusplus_init(blobs, 4, random_state=42)
+    cn, ln = kmeans(blobs, 4, random_state=42, init_centroids=init)
+    cj, lj = kmeans_jax(blobs, 4, seed=42, max_iter=100, init_centroids=init)
+    np.testing.assert_allclose(np.asarray(cj), cn, atol=1e-10)
+    assert (np.asarray(lj) == ln).all()
+
+
+@pytest.mark.parametrize("ndev", [1, 2, 8])
+def test_sharded_matches_single_device(blobs, ndev):
+    init = kmeans_plusplus_init(blobs, 4, random_state=0)
+    c1, l1 = kmeans_jax(blobs, 4, seed=0, max_iter=100, init_centroids=init)
+    cn, ln = kmeans_jax(
+        blobs, 4, seed=0, max_iter=100, init_centroids=init,
+        mesh_shape={"data": ndev},
+    )
+    np.testing.assert_allclose(np.asarray(cn), np.asarray(c1), atol=1e-8)
+    assert (np.asarray(ln) == np.asarray(l1)).all()
+
+
+def test_uneven_shard_padding(blobs):
+    X = blobs[:997]  # not divisible by 8
+    init = kmeans_plusplus_init(X, 4, random_state=0)
+    c1, l1 = kmeans_jax(X, 4, seed=0, max_iter=100, init_centroids=init)
+    c8, l8 = kmeans_jax(
+        X, 4, seed=0, max_iter=100, init_centroids=init, mesh_shape={"data": 8}
+    )
+    assert np.asarray(l8).shape == (997,)
+    np.testing.assert_allclose(np.asarray(c8), np.asarray(c1), atol=1e-8)
+    assert (np.asarray(l8) == np.asarray(l1)).all()
+
+
+def test_d2_init_quality(blobs):
+    """On-device D² init should land one centroid near each planted blob."""
+    centroids, labels, it, shift = kmeans_jax_full(
+        blobs, 4, seed=3, max_iter=100, mesh_shape={"data": 8}
+    )
+    centroids = np.asarray(centroids)
+    # Every point should be close to its centroid (tight blobs, sigma=.5).
+    d = pairwise_sq_dists(blobs, centroids)
+    inertia = d[np.arange(len(blobs)), np.asarray(labels)].mean()
+    assert inertia < 3.0  # ~ d * sigma^2 = 5 * 0.25; generous bound
+    assert len(np.unique(np.asarray(labels))) == 4
+    assert shift < 1e-4
+
+
+def test_empty_cluster_reseed_deterministic():
+    """k=4 on 4 distinct points with a far-away init forces reseeds; results
+    must be reproducible from the seed (fixes reference quirk §6.1.2)."""
+    X = np.array([[0.0, 0], [10, 0], [0, 10], [10, 10]])
+    init = np.full((4, 2), 100.0) + np.arange(4)[:, None]  # all points -> cluster argmin ties
+    r1 = kmeans_jax(X, 4, seed=5, max_iter=50, init_centroids=init)
+    r2 = kmeans_jax(X, 4, seed=5, max_iter=50, init_centroids=init)
+    np.testing.assert_array_equal(np.asarray(r1[0]), np.asarray(r2[0]))
+    # converged solution must cover all 4 points as singleton clusters
+    assert sorted(np.asarray(r1[1]).tolist()) == sorted(
+        np.unique(np.asarray(r1[1])).tolist()
+    )
+
+
+def test_k_exceeds_n_raises():
+    with pytest.raises(ValueError):
+        kmeans_jax(np.zeros((3, 2)), 5)

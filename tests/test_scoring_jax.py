@@ -1,0 +1,63 @@
+"""Parity tests: JAX scoring backend vs the NumPy golden model."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from cdrs_tpu.config import ScoringConfig
+from cdrs_tpu.ops import scoring_np
+from cdrs_tpu.ops.scoring_jax import (
+    classify_jax,
+    compute_cluster_medians_jax,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    X = rng.uniform(size=(500, 5))
+    labels = rng.integers(0, 4, size=500)
+    return X, labels
+
+
+def test_cluster_medians_parity(data):
+    X, labels = data
+    got = np.asarray(compute_cluster_medians_jax(X, labels.astype(np.int32), 4))
+    want = scoring_np.compute_cluster_medians(X, labels, 4)
+    np.testing.assert_allclose(got, want, atol=1e-12)
+
+
+def test_cluster_medians_empty_cluster_nan(data):
+    X, labels = data
+    got = np.asarray(compute_cluster_medians_jax(X, labels.astype(np.int32), 6))
+    assert np.isnan(got[4]).all() and np.isnan(got[5]).all()
+    want = scoring_np.compute_cluster_medians(X, labels, 6)
+    np.testing.assert_allclose(got[:4], want[:4], atol=1e-12)
+
+
+@pytest.mark.parametrize("from_data", [False, True])
+def test_classify_parity(data, from_data):
+    X, labels = data
+    cfg = ScoringConfig(compute_global_medians_from_data=from_data)
+    wj, sj, mj = classify_jax(X, labels, 4, cfg)
+    wn, sn, mn = scoring_np.classify(X, labels, 4, cfg)
+    np.testing.assert_allclose(np.asarray(sj), sn, atol=1e-10)
+    np.testing.assert_array_equal(np.asarray(wj), wn)
+    np.testing.assert_allclose(np.asarray(mj), mn, atol=1e-12)
+
+
+def test_all_zero_scores_tiebreak_archival():
+    """Empty-evidence clusters must fall to Archival via the rf tie-break
+    (reference: scoring.py:102-107, SURVEY.md §2.3)."""
+    X = np.full((8, 5), 0.5)  # deltas all zero vs default 0.5 global medians
+    labels = np.zeros(8, dtype=np.int64)
+    cfg = ScoringConfig()
+    # delta == 0: non-Moderate categories need sign match (sign(0)=0 != ±1) so
+    # they score 0; Moderate scores w*(1-0)^2 = 2.5 > 0 -> Moderate wins here.
+    wj, sj, _ = classify_jax(X, labels, 1, cfg)
+    assert cfg.categories[int(np.asarray(wj)[0])] == "Moderate"
+    # A fully empty cluster (NaN medians) scores 0 everywhere -> Archival.
+    wj2, sj2, _ = classify_jax(X, labels, 2, cfg)
+    assert cfg.categories[int(np.asarray(wj2)[1])] == "Archival"
+    assert np.allclose(np.asarray(sj2)[1], 0.0)
