@@ -1,0 +1,42 @@
+#!/usr/bin/env python3
+"""Driver benchmark entry point.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Runs BASELINE.md config 2 (1M files x 32 features, k=128) by default on
+whatever accelerator JAX finds (the real TPU chip when available, CPU
+otherwise): Lloyd iterations/sec, jax vs the reference-style numpy path on the
+identical workload.  ``--config N`` selects another BASELINE config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", type=int, default=2)
+    p.add_argument("--backend", default=None)
+    args = p.parse_args()
+
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from cdrs_tpu.benchmarks.harness import run_bench
+
+    out = run_bench(config=args.config, backend=args.backend)
+    line = {
+        "metric": out["metric"],
+        "value": out["value"],
+        "unit": out["unit"],
+        "vs_baseline": out["vs_baseline"],
+    }
+    print(json.dumps(line))
+    # Full detail to stderr so the one-line stdout contract stays clean.
+    print(json.dumps(out), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

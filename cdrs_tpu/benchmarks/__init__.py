@@ -1,0 +1,1 @@
+"""Benchmark harness for the BASELINE.md configs."""

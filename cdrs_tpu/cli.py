@@ -39,8 +39,21 @@ from .utils.logging import StageTimer
 __all__ = ["main"]
 
 
-def _add_backend_arg(p: argparse.ArgumentParser) -> None:
+def _add_backend_arg(p: argparse.ArgumentParser, mesh: bool = True) -> None:
     p.add_argument("--backend", choices=["numpy", "jax"], default="numpy")
+    if mesh:
+        p.add_argument(
+            "--mesh", default=None, metavar="SPEC",
+            help="device mesh for the jax backend: '8' or 'data=4,model=2'",
+        )
+
+
+def _parse_mesh(spec: str | None) -> dict[str, int] | None:
+    if not spec:
+        return None
+    if "=" not in spec:
+        return {"data": int(spec)}
+    return {k: int(v) for k, v in (part.split("=") for part in spec.split(","))}
 
 
 def _cmd_gen(args) -> int:
@@ -110,6 +123,7 @@ def _cmd_cluster(args) -> int:
         kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed),
         scoring_cfg=scoring,
         backend=args.backend,
+        mesh_shape=_parse_mesh(args.mesh),
     )
     with StageTimer("cluster") as t:
         X, paths = load_feature_matrix(args.input_path)
@@ -132,6 +146,7 @@ def _cmd_pipeline(args) -> int:
                                   seed=None if args.seed is None else args.seed + 1),
         kmeans=KMeansConfig(k=args.k, seed=args.seed),
         scoring=ScoringConfig(compute_global_medians_from_data=args.medians_from_data),
+        mesh_shape=_parse_mesh(args.mesh),
     )
     result = run_pipeline(cfg, outdir=args.outdir)
     print(json.dumps(result.summary(), indent=2))
@@ -144,7 +159,8 @@ def _cmd_bench(args) -> int:
     except ImportError as e:
         print(f"benchmark harness not available: {e}", file=sys.stderr)
         return 1
-    out = run_bench(config=args.config, backend=args.backend)
+    out = run_bench(config=args.config, backend=args.backend,
+                    mesh_shape=_parse_mesh(args.mesh))
     print(json.dumps(out))
     return 0
 
@@ -178,7 +194,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--manifest", required=True)
     p.add_argument("--access_log", required=True)
     p.add_argument("--out", default="features_out/")
-    _add_backend_arg(p)
+    _add_backend_arg(p, mesh=False)  # feature kernel is single-device for now
     p.set_defaults(fn=_cmd_features)
 
     p = sub.add_parser("cluster", help="KMeans++ clustering + category scoring")

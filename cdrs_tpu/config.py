@@ -146,7 +146,9 @@ class KMeansConfig:
     def resolve_max_iter(self, n: int) -> int:
         if self.max_iter is not None:
             return int(self.max_iter)
-        return max(100, n // 100)
+        from .utils.params import default_max_iter
+
+        return default_max_iter(n)
 
 
 # ---------------------------------------------------------------------------

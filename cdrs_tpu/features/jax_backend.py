@@ -44,16 +44,22 @@ __all__ = ["compute_features_jax", "features_kernel"]
 @functools.partial(jax.jit, static_argnames=("n",))
 def features_kernel(
     pid: jnp.ndarray,          # (e,) int32, -1 = not in manifest
-    ts: jnp.ndarray,           # (e,) float64 epoch seconds
+    sec: jnp.ndarray,          # (e,) int32 second bucket, rebased to min=0
     op: jnp.ndarray,           # (e,) int8, 1 = WRITE
     client: jnp.ndarray,       # (e,) int32
     primary_node_id: jnp.ndarray,  # (n,) int32
-    creation_ts: jnp.ndarray,  # (n,) float64
-    observation_end: jnp.ndarray,  # scalar
+    age_seconds: jnp.ndarray,  # (n,) observation_end - creation_ts
     n: int,
 ):
-    """Returns (raw (n,5), norm (n,5), writes (n,), reads (n,))."""
-    ftype = creation_ts.dtype
+    """Returns (raw (n,5), norm (n,5), writes (n,), reads (n,)).
+
+    Timestamps never enter the kernel as raw epoch floats: the second buckets
+    (``floor(ts)`` rebased to the window start) and ``age_seconds`` are
+    pre-reduced on host in float64, because float32 — the accelerator default
+    when x64 is off — has ~256 s resolution at epoch magnitude (~1.75e9),
+    which would merge every event into one concurrency bucket.
+    """
+    ftype = age_seconds.dtype
     valid = pid >= 0
     w = valid.astype(ftype)
     pid_c = jnp.where(valid, pid, 0).astype(jnp.int32)
@@ -69,12 +75,7 @@ def features_kernel(
     )
 
     # Two-level concurrency: runs of equal (path, second) after a lexsort.
-    # Buckets are floor(ts) rebased to the earliest bucket so the int32 cast
-    # never overflows (epoch seconds exceed int32 after 2038; offsets are
-    # bounded by the observation window).
     e = pid.shape[0]
-    sec_f = jnp.floor(ts)
-    sec = (sec_f - sec_f.min()).astype(jnp.int32)
     sort_pid = jnp.where(valid, pid, n).astype(jnp.int32)  # invalid sorts last
     order = jnp.lexsort((sec, sort_pid))
     s_pid = sort_pid[order]
@@ -91,8 +92,6 @@ def features_kernel(
         per_event_count, jnp.where(s_pid < n, s_pid, 0), num_segments=n
     )
     concurrency = jnp.maximum(conc, 0.0)  # -inf identity -> 0 for no-event files
-
-    age_seconds = observation_end - creation_ts
 
     mean_writes = jnp.mean(writes)
     mean_writes = jnp.where(mean_writes == 0, 1.0, mean_writes)
@@ -129,14 +128,18 @@ def compute_features_jax(
         return FeatureTable(paths=list(manifest.paths), raw=raw, norm=norm,
                             writes=zeros, reads=zeros.copy())
 
+    # Host-side float64 time reductions (see features_kernel docstring).
+    sec_f = np.floor(events.ts)
+    sec = (sec_f - sec_f.min()).astype(np.int32)
+    age = np.asarray(observation_end - manifest.creation_ts, dtype=np.float64)
+
     raw, norm, writes, reads = features_kernel(
         jnp.asarray(events.path_id, dtype=jnp.int32),
-        jnp.asarray(events.ts),
+        jnp.asarray(sec),
         jnp.asarray(events.op),
         jnp.asarray(events.client_id, dtype=jnp.int32),
         jnp.asarray(manifest.primary_node_id, dtype=jnp.int32),
-        jnp.asarray(manifest.creation_ts),
-        jnp.asarray(observation_end, dtype=jnp.asarray(manifest.creation_ts).dtype),
+        jnp.asarray(age),
         n,
     )
     return FeatureTable(

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXIS, make_mesh, pad_rows
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, pad_rows
 
 __all__ = [
     "pairwise_sq_dists_jax",
@@ -137,11 +137,84 @@ def _d2_init_local(x, w, key, *, k):
     return centroids
 
 
-def _lloyd_local(x, w, centroids, key, *, k, n_valid, tol, max_iter):
+def _weighted_cluster_stats(xc, wc, lab, k, update):
+    """Per-cluster (sum, count) for one row block.
+
+    ``matmul`` builds the weighted one-hot assignment matrix and reduces with
+    a (k, n)x(n, d) matmul — MXU work, ~3x faster than scatter on TPU.
+    ``scatter`` uses ``segment_sum`` — less memory (no (n, k) one-hot), and
+    bit-identical to numpy's bincount ordering.
+    """
+    if update == "matmul":
+        oh = jax.nn.one_hot(lab, k, dtype=xc.dtype) * wc[:, None]  # (n, k)
+        return oh.T @ xc, oh.sum(axis=0)
+    sums = jax.ops.segment_sum(xc * wc[:, None], lab, num_segments=k)
+    counts = jax.ops.segment_sum(wc, lab, num_segments=k)
+    return sums, counts
+
+
+def _assign_reduce(x, w, c, k, chunk_rows, update="matmul"):
+    """Fused assignment + per-cluster (sum, count) reduction for one shard.
+
+    ``chunk_rows=None`` materializes the full (n_loc, k) distance block — fast
+    when it fits.  Otherwise a ``lax.scan`` over row tiles keeps peak memory at
+    (chunk_rows × k) while accumulating the (k, d) sums in-place — the tiling
+    the reference's dense (n, k, d) broadcast lacks (SURVEY.md §3.2 hot loop #4,
+    §7.4 "memory at 100M×128").
+    """
+    if chunk_rows is None:
+        labels = assign_labels_jax(x, c)
+        sums, counts = _weighted_cluster_stats(x, w, labels, k, update)
+        return labels, sums, counts
+
+    n_loc, d = x.shape
+    nch = n_loc // chunk_rows
+    xr = x.reshape(nch, chunk_rows, d)
+    wr = w.reshape(nch, chunk_rows)
+    c_sq = jnp.sum(c * c, axis=1)
+
+    def step(carry, xw):
+        sums, counts = carry
+        xc, wc = xw
+        dist = c_sq[None, :] - 2.0 * (xc @ c.T)
+        lab = jnp.argmin(dist, axis=1).astype(jnp.int32)
+        s, cnt = _weighted_cluster_stats(xc, wc, lab, k, update)
+        return (sums + s, counts + cnt), lab
+
+    (sums, counts), labels = lax.scan(
+        step,
+        (jnp.zeros((k, d), x.dtype), jnp.zeros((k,), x.dtype)),
+        (xr, wr),
+    )
+    return labels.reshape(n_loc), sums, counts
+
+
+def _assign_only(x, c, chunk_rows):
+    """Labels for one shard without the stats reduction (post-loop pass)."""
+    if chunk_rows is None:
+        return assign_labels_jax(x, c)
+    n_loc, d = x.shape
+    xr = x.reshape(n_loc // chunk_rows, chunk_rows, d)
+    c_sq = jnp.sum(c * c, axis=1)
+
+    def step(_, xc):
+        dist = c_sq[None, :] - 2.0 * (xc @ c.T)
+        return None, jnp.argmin(dist, axis=1).astype(jnp.int32)
+
+    _, labels = lax.scan(step, None, xr)
+    return labels.reshape(n_loc)
+
+
+def _lloyd_local(x, w, centroids, key, *, k, n_valid, tol, max_iter,
+                 chunk_rows=None, update="matmul"):
     """Lloyd loop, shard-local view.  Returns (centroids, labels, iters, shift).
 
     Labels are the assignment against the centroids *before* the final update
-    (reference loop order, kmeans_plusplus.py:33-48).
+    (reference loop order, kmeans_plusplus.py:33-48) — computed in one extra
+    assignment pass after the loop rather than carried through it: an (n,)
+    buffer in the while_loop carry blocks XLA from fusing the
+    argmin/one-hot/matmul chain and costs ~3x per iteration (measured on
+    v5e: 24 ms vs 7 ms per iteration at n=1M, k=128).
     """
     n_loc = x.shape[0]
     rank = lax.axis_index(DATA_AXIS)
@@ -153,9 +226,7 @@ def _lloyd_local(x, w, centroids, key, *, k, n_valid, tol, max_iter):
 
     def body(carry):
         c, _, key, it, _ = carry
-        labels = assign_labels_jax(x, c)
-        sums = jax.ops.segment_sum(x * w[:, None], labels, num_segments=k)
-        counts = jax.ops.segment_sum(w, labels, num_segments=k)
+        _, sums, counts = _assign_reduce(x, w, c, k, chunk_rows, update)
         sums = lax.psum(sums, DATA_AXIS)
         counts = lax.psum(counts, DATA_AXIS)
 
@@ -176,17 +247,125 @@ def _lloyd_local(x, w, centroids, key, *, k, n_valid, tol, max_iter):
             cand,
         )
         shift = jnp.sqrt(jnp.sum((new_c - c) ** 2))
-        return new_c, labels, key, it + 1, shift
+        return new_c, c, key, it + 1, shift
 
     init = (
         centroids,
-        jnp.zeros((n_loc,), jnp.int32),
+        centroids,
         key,
         jnp.array(0, jnp.int32),
         jnp.array(jnp.inf, x.dtype),
     )
-    c, labels, _, it, shift = lax.while_loop(cond, body, init)
+    c, c_prev, _, it, shift = lax.while_loop(cond, body, init)
+    labels = _assign_only(x, c_prev, chunk_rows)
     return c, labels, it, shift
+
+
+def _lloyd_local_2d(x, w, c_loc, key, *, k, n_valid, tol, max_iter,
+                    chunk_rows=None, update="matmul"):
+    """Lloyd loop on a 2D (data, model) mesh — tensor-parallel centroids.
+
+    Points are sharded over ``data`` (as in _lloyd_local); the centroid table
+    is additionally sharded over ``model``: each shard holds k_loc = k/M rows,
+    computes distances only to those (an (n_loc, k_loc) matmul), and the
+    global argmin is recovered with two tiny ``model``-axis collectives
+    (pmin of the best distance, then pmin of the candidate global index,
+    which also reproduces NumPy's first-minimum tie-break).  This keeps both
+    the FLOPs and the O(n·k) distance buffer partitioned when k is large
+    (the 100M x 128, k=1024 BASELINE config).
+    """
+    n_loc = x.shape[0]
+    k_loc = c_loc.shape[0]
+    d_rank = lax.axis_index(DATA_AXIS)
+    m_rank = lax.axis_index(MODEL_AXIS)
+    offset = d_rank * n_loc
+    k_off = m_rank * k_loc
+
+    def assign_block(c_loc, xc):
+        """Global labels for one row block (two tiny model-axis collectives)."""
+        c_sq = jnp.sum(c_loc * c_loc, axis=1)
+        d_loc = c_sq[None, :] - 2.0 * (xc @ c_loc.T)         # (rows, k_loc)
+        lmin = d_loc.min(axis=1)
+        larg = (jnp.argmin(d_loc, axis=1) + k_off).astype(jnp.int32)
+        gmin = lax.pmin(lmin, MODEL_AXIS)
+        return lax.pmin(jnp.where(lmin == gmin, larg, k), MODEL_AXIS)
+
+    def assign_2d(c_loc):
+        if chunk_rows is None:
+            return assign_block(c_loc, x)
+        xr = x.reshape(n_loc // chunk_rows, chunk_rows, -1)
+        _, labels = lax.scan(lambda _, xc: (None, assign_block(c_loc, xc)), None, xr)
+        return labels.reshape(n_loc)
+
+    def assign_reduce_2d(c_loc):
+        """Labels + full-(k,) stats, tiled over row chunks when requested."""
+        if chunk_rows is None:
+            labels = assign_block(c_loc, x)
+            sums, counts = _weighted_cluster_stats(x, w, labels, k, update)
+            return labels, sums, counts
+        nch = n_loc // chunk_rows
+        xr = x.reshape(nch, chunk_rows, -1)
+        wr = w.reshape(nch, chunk_rows)
+
+        def step(carry, xw):
+            sums, counts = carry
+            xc, wc = xw
+            lab = assign_block(c_loc, xc)
+            s, cnt = _weighted_cluster_stats(xc, wc, lab, k, update)
+            return (sums + s, counts + cnt), lab
+
+        (sums, counts), labels = lax.scan(
+            step,
+            (jnp.zeros((k, x.shape[1]), x.dtype), jnp.zeros((k,), x.dtype)),
+            (xr, wr),
+        )
+        return labels.reshape(n_loc), sums, counts
+
+    def cond(carry):
+        _, _, _, it, shift = carry
+        return (it < max_iter) & ((it == 0) | (shift >= tol))
+
+    def body(carry):
+        c_loc, _, key, it, _ = carry
+        # Full (k,) stats computed redundantly per model shard (cheap), then
+        # each shard keeps its own block — replaces an all-gather of labels.
+        _, sums, counts = assign_reduce_2d(c_loc)
+        sums = lax.psum(sums, DATA_AXIS)
+        counts = lax.psum(counts, DATA_AXIS)
+        sums_loc = lax.dynamic_slice_in_dim(sums, k_off, k_loc)
+        counts_loc = lax.dynamic_slice_in_dim(counts, k_off, k_loc)
+
+        key, sub = jax.random.split(key)
+        reseed_idx = lax.dynamic_slice_in_dim(
+            jax.random.randint(sub, (k,), 0, n_valid), k_off, k_loc
+        )
+        rel = reseed_idx - offset
+        owned = (rel >= 0) & (rel < n_loc)
+        cand = lax.psum(
+            jnp.where(owned[:, None], x[jnp.clip(rel, 0, n_loc - 1)], 0.0),
+            DATA_AXIS,
+        )
+
+        new_c = jnp.where(
+            counts_loc[:, None] > 0,
+            sums_loc / jnp.maximum(counts_loc, 1.0)[:, None],
+            cand,
+        )
+        shift = jnp.sqrt(
+            lax.psum(jnp.sum((new_c - c_loc) ** 2), MODEL_AXIS)
+        )
+        return new_c, c_loc, key, it + 1, shift
+
+    init = (
+        c_loc,
+        c_loc,
+        key,
+        jnp.array(0, jnp.int32),
+        jnp.array(jnp.inf, x.dtype),
+    )
+    c_loc, c_prev, _, it, shift = lax.while_loop(cond, body, init)
+    labels = assign_2d(c_prev)
+    return c_loc, labels, it, shift
 
 
 # ---------------------------------------------------------------------------
@@ -195,9 +374,11 @@ def _lloyd_local(x, w, centroids, key, *, k, n_valid, tol, max_iter):
 
 
 @functools.lru_cache(maxsize=32)
-def _build_kmeans(n_valid, d, k, ndev, max_iter, tol, with_init, dtype_name):
+def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
+                  dtype_name, chunk_rows=None, update="matmul"):
     """Compile the full sharded kmeans for one (shape, mesh, config) point."""
-    mesh = make_mesh(n_data=ndev)
+    mesh = make_mesh(n_data=ndata, n_model=nmodel)
+    k_loc = k // nmodel
 
     def local_fn(x, w, c0, key):
         if with_init:
@@ -205,16 +386,30 @@ def _build_kmeans(n_valid, d, k, ndev, max_iter, tol, with_init, dtype_name):
         else:
             centroids = _d2_init_local(x, w, key, k=k)
         lloyd_key = jax.random.fold_in(key, 0x10D)  # distinct stream from init
-        return _lloyd_local(
-            x, w, centroids, lloyd_key,
+        if nmodel == 1:
+            return _lloyd_local(
+                x, w, centroids, lloyd_key,
+                k=k, n_valid=n_valid, tol=tol, max_iter=max_iter,
+                chunk_rows=chunk_rows, update=update,
+            )
+        c_loc = lax.dynamic_slice_in_dim(
+            centroids, lax.axis_index(MODEL_AXIS) * k_loc, k_loc
+        )
+        return _lloyd_local_2d(
+            x, w, c_loc, lloyd_key,
             k=k, n_valid=n_valid, tol=tol, max_iter=max_iter,
+            chunk_rows=chunk_rows, update=update,
         )
 
+    if nmodel == 1:
+        c_spec = P()
+    else:
+        c_spec = P(MODEL_AXIS, None)
     sharded = jax.shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P()),
-        out_specs=(P(), P(DATA_AXIS), P(), P()),
+        out_specs=(c_spec, P(DATA_AXIS), P(), P()),
         check_vma=False,
     )
     return jax.jit(sharded)
@@ -229,27 +424,57 @@ def kmeans_jax_full(
     init_centroids=None,
     mesh_shape: dict[str, int] | None = None,
     dtype=None,
+    chunk_rows: int | None = None,
+    update: str = "matmul",
+    n_valid: int | None = None,
 ):
     """Sharded KMeans++ + Lloyd.  Returns (centroids, labels, n_iter, shift).
 
     Reference entry point: src/kmeans_plusplus.py:24 ``kmeans(X, k, ...)``.
     ``init_centroids`` overrides the D² init (used by the numpy-parity tests so
     both backends iterate from identical starting points).
-    ``mesh_shape={"data": N}`` shards rows over N devices; default 1.
+    ``mesh_shape={"data": N}`` shards rows over N devices (data parallel);
+    adding ``"model": M`` also shards the centroid table over M devices
+    (tensor parallel, k divisible by M).  Default: single device.
     """
-    X = np.asarray(X)
+    is_device_array = isinstance(X, jax.Array)
+    if not is_device_array:
+        X = np.asarray(X)
     if dtype is None:
-        dtype = X.dtype if np.issubdtype(X.dtype, np.floating) else np.float32
+        dtype = X.dtype if np.issubdtype(np.dtype(X.dtype), np.floating) else np.float32
     n, d = X.shape
     if k > n:
         raise ValueError(f"k={k} exceeds number of samples n={n}")
-    ndev = int((mesh_shape or {}).get(DATA_AXIS, 1))
+    ndata = int((mesh_shape or {}).get(DATA_AXIS, 1))
+    nmodel = int((mesh_shape or {}).get(MODEL_AXIS, 1))
+    if k % nmodel != 0:
+        raise ValueError(f"k={k} must be divisible by the model axis size {nmodel}")
 
-    Xp, n_valid = pad_rows(X.astype(dtype, copy=False), ndev)
-    # Padded rows carry weight 0 and reseed draws are bounded by n_valid, so
-    # padding never leaks into sums, counts, or sampling.
-    w = np.zeros(Xp.shape[0], dtype=dtype)
-    w[:n] = 1.0
+    multiple = ndata * (chunk_rows or 1)
+    if is_device_array:
+        # Device-resident input (benchmark / streaming path): never copy to
+        # host.  The caller must pre-size rows, passing ``n_valid`` when the
+        # trailing rows are padding; those rows get weight 0 and are excluded
+        # from reseed draws, exactly like the host padding path.
+        if X.shape[0] % multiple:
+            raise ValueError(
+                f"device-array input rows ({X.shape[0]}) must be a multiple "
+                f"of data_axis*chunk_rows ({multiple}); pad on device first "
+                f"and pass n_valid=<true row count>"
+            )
+        Xp = X.astype(dtype)
+        n_valid = n if n_valid is None else int(n_valid)
+        if n_valid > n:
+            raise ValueError(f"n_valid={n_valid} exceeds rows {n}")
+        w = (jnp.arange(Xp.shape[0]) < n_valid).astype(dtype)
+    else:
+        if n_valid is not None and n_valid != n:
+            raise ValueError("n_valid is only for pre-padded device arrays")
+        Xp, n_valid = pad_rows(X.astype(dtype, copy=False), multiple)
+        # Padded rows carry weight 0 and reseed draws are bounded by n_valid,
+        # so padding never leaks into sums, counts, or sampling.
+        w = np.zeros(Xp.shape[0], dtype=dtype)
+        w[:n] = 1.0
 
     with_init = init_centroids is not None
     c0 = (
@@ -259,27 +484,23 @@ def kmeans_jax_full(
     )
     key = jax.random.PRNGKey(0 if seed is None else int(seed))
 
+    if update not in ("matmul", "scatter"):
+        raise ValueError(f"unknown update strategy {update!r}")
     fn = _build_kmeans(
-        n_valid, d, int(k), ndev, int(max_iter), float(tol),
-        with_init, np.dtype(dtype).name,
+        n_valid, d, int(k), ndata, nmodel, int(max_iter), float(tol),
+        with_init, np.dtype(dtype).name, chunk_rows, update,
     )
+    if k > n_valid:
+        raise ValueError(f"k={k} exceeds number of valid samples {n_valid}")
     centroids, labels, it, shift = fn(Xp, w, c0, key)
-    return centroids, labels[:n], int(it), float(shift)
+    return centroids, labels[:n_valid], int(it), float(shift)
 
 
-def kmeans_jax(
-    X,
-    k: int,
-    tol: float = 1e-4,
-    seed: int | None = None,
-    max_iter: int = 100,
-    init_centroids=None,
-    mesh_shape: dict[str, int] | None = None,
-    dtype=None,
-):
-    """Reference-shaped API: returns (centroids, labels)."""
-    centroids, labels, _, _ = kmeans_jax_full(
-        X, k, tol=tol, seed=seed, max_iter=max_iter,
-        init_centroids=init_centroids, mesh_shape=mesh_shape, dtype=dtype,
-    )
+def kmeans_jax(X, k: int, **kwargs):
+    """Reference-shaped API: returns (centroids, labels).
+
+    Accepts every ``kmeans_jax_full`` knob (tol, seed, max_iter,
+    init_centroids, mesh_shape, dtype, chunk_rows, update, n_valid).
+    """
+    centroids, labels, _, _ = kmeans_jax_full(X, k, **kwargs)
     return centroids, labels

@@ -179,9 +179,9 @@ def kmeans(
         centroids = kmeans_plusplus_init(X, k, random_state=rng)
 
     if max_iter is None:
-        # Reference formula with the float->int fix (SURVEY.md §6.1.1); kept in
-        # sync with config.KMeansConfig.resolve_max_iter.
-        max_iter = max(100, int(number_of_files) // 100)
+        from ..utils.params import default_max_iter
+
+        max_iter = default_max_iter(number_of_files)
 
     labels = np.zeros(n, dtype=np.int64)
     for _ in range(max_iter):

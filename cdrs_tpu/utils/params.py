@@ -1,0 +1,12 @@
+"""Shared numeric defaults used by both the config layer and the kernels."""
+
+from __future__ import annotations
+
+__all__ = ["default_max_iter"]
+
+
+def default_max_iter(n: int) -> int:
+    """Reference iteration cap ``max(100, n/100)`` with the float->int fix
+    (reference: src/kmeans_plusplus.py:29 crashed ``range`` for n > 10,000 —
+    SURVEY.md §6.1.1).  Single source for every backend."""
+    return max(100, int(n) // 100)

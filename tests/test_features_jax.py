@@ -63,3 +63,36 @@ def test_empty_log(workload):
     np.testing.assert_allclose(got.raw, want.raw)
     np.testing.assert_allclose(got.norm, want.norm)
     assert (got.raw[:, 3] == 1.0).all()  # locality 1.0 for never-accessed files
+
+
+def test_kernel_float32_inputs_match_numpy(workload):
+    """Production (x32) shape of the kernel: float32 age + int32 second buckets
+    must still reproduce the numpy concurrency/age features (the raw epoch
+    floats never enter the kernel — they are reduced on host in float64)."""
+    import jax.numpy as jnp
+
+    from cdrs_tpu.features.jax_backend import features_kernel
+
+    manifest, events = workload
+    want = compute_features(manifest, events)
+
+    obs_end = float(events.ts.max())
+    sec_f = np.floor(events.ts)
+    sec = (sec_f - sec_f.min()).astype(np.int32)
+    age = (obs_end - manifest.creation_ts).astype(np.float32)
+
+    raw, norm, writes, reads = features_kernel(
+        jnp.asarray(events.path_id, dtype=jnp.int32),
+        jnp.asarray(sec),
+        jnp.asarray(events.op),
+        jnp.asarray(events.client_id, dtype=jnp.int32),
+        jnp.asarray(manifest.primary_node_id, dtype=jnp.int32),
+        jnp.asarray(age),  # float32: the accelerator default without x64
+        len(manifest),
+    )
+    got = np.asarray(raw)
+    # concurrency (col 4) and counters are exact in f32; age (col 1) is
+    # magnitude ~3e7 so f32 keeps ~1e-7 relative accuracy.
+    np.testing.assert_allclose(got[:, 4], want.raw[:, 4], rtol=0, atol=0)
+    np.testing.assert_allclose(got[:, 0], want.raw[:, 0], rtol=0, atol=0)
+    np.testing.assert_allclose(got[:, 1], want.raw[:, 1], rtol=1e-6)

@@ -96,3 +96,34 @@ def test_empty_cluster_reseed_deterministic():
 def test_k_exceeds_n_raises():
     with pytest.raises(ValueError):
         kmeans_jax(np.zeros((3, 2)), 5)
+
+
+def test_2d_mesh_with_chunking(blobs):
+    """chunk_rows must be honored on the (data, model) mesh (tiled distances)."""
+    init = kmeans_plusplus_init(blobs, 4, random_state=0)
+    c1, l1 = kmeans_jax(blobs, 4, seed=0, max_iter=100, init_centroids=init)
+    c2, l2 = kmeans_jax(
+        blobs, 4, seed=0, max_iter=100, init_centroids=init,
+        mesh_shape={"data": 2, "model": 2}, chunk_rows=64,
+    )
+    np.testing.assert_allclose(np.asarray(c2), np.asarray(c1), atol=1e-8)
+    assert (np.asarray(l2) == np.asarray(l1)).all()
+
+
+def test_device_array_n_valid(blobs):
+    """Pre-padded device arrays: padding rows excluded via n_valid."""
+    import jax.numpy as jnp
+
+    n = 997
+    X = blobs[:n]
+    pad = np.zeros((3, X.shape[1]))
+    Xd = jnp.asarray(np.concatenate([X, pad]))  # 1000 rows, 3 padding
+    init = kmeans_plusplus_init(X, 4, random_state=0)
+    c1, l1 = kmeans_jax(X, 4, seed=0, max_iter=100, init_centroids=init)
+    c2, l2, it2, _ = kmeans_jax_full(
+        Xd, 4, seed=0, max_iter=100, init_centroids=init,
+        mesh_shape={"data": 4}, n_valid=n,
+    )
+    assert np.asarray(l2).shape == (n,)
+    np.testing.assert_allclose(np.asarray(c2), np.asarray(c1), atol=1e-8)
+    assert (np.asarray(l2) == np.asarray(l1)).all()
