@@ -22,7 +22,9 @@ def main() -> int:
     p.add_argument("--backend", default=None)
     args = p.parse_args()
 
-    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from cdrs_tpu.benchmarks.harness import run_bench
 
     out = run_bench(config=args.config, backend=args.backend)

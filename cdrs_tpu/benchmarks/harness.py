@@ -52,8 +52,89 @@ CONFIGS: dict[int, BenchConfig] = {
                    chunk_rows=131_072),
     4: BenchConfig(n=104_857_600, d=128, k=1024, backend="jax", iters=5,
                    chunk_rows=131_072, mesh_shape=(("data", 8),)),
-    5: BenchConfig(n=1_048_576, d=32, k=128, backend="jax", iters=20),  # streaming: see bench_streaming
+    # 5 = streaming: n is the file population, iters the number of event
+    # batches; see _bench_streaming (events/sec is the metric).
+    5: BenchConfig(n=1_048_576, d=32, k=128, backend="jax", iters=10),
 }
+
+STREAM_BATCH_EVENTS = 1_048_576
+
+
+def _synth_event_batch(rng, n_files, e, t0):
+    """Vectorized synthetic event batch (time-ordered), numpy struct-of-arrays."""
+    ts = t0 + np.sort(rng.random(e)) * 60.0
+    return {
+        "pid": rng.integers(0, n_files, size=e, dtype=np.int32),
+        "ts": ts,
+        "op": (rng.random(e) < 0.2).astype(np.int8),
+        "client": rng.integers(0, 4, size=e, dtype=np.int32),
+    }
+
+
+def _numpy_stream_fold(batch, n_files, counters):
+    """Numpy equivalent of the device stream fold (baseline timing)."""
+    pid, ts, op, client = batch["pid"], batch["ts"], batch["op"], batch["client"]
+    counters["freq"] += np.bincount(pid, minlength=n_files)
+    counters["writes"] += np.bincount(pid, weights=(op == 1), minlength=n_files)
+    sec = np.floor(ts).astype(np.int64)
+    sec -= sec.min()
+    key = pid.astype(np.int64) * (int(sec.max()) + 1) + sec
+    uniq, cnt = np.unique(key, return_counts=True)
+    np.maximum.at(counters["conc"], uniq // (int(sec.max()) + 1),
+                  cnt.astype(np.float64))
+
+
+def _bench_streaming(cfg: BenchConfig, seed: int) -> dict:
+    """Events/sec through the device stream fold vs the numpy fold."""
+    import jax.numpy as jnp
+
+    from ..features.streaming import _build_update
+
+    n, e = cfg.n, STREAM_BATCH_EVENTS
+    rng = np.random.default_rng(seed)
+    primary = jnp.asarray(rng.integers(0, 4, size=n, dtype=np.int32))
+    fn = _build_update(e, n, "float32")
+
+    def dev_state():
+        z = jnp.zeros((n,), jnp.float32)
+        return [z, z, z, z, jnp.full((n,), -1, jnp.int32), z]
+
+    batches = [_synth_event_batch(rng, n, e, 1.7e9 + 60.0 * i)
+               for i in range(cfg.iters)]
+    dev_batches = [
+        (jnp.asarray(b["pid"]),
+         jnp.asarray((np.floor(b["ts"]) - 1.7e9).astype(np.int32)),
+         jnp.asarray(b["op"]), jnp.asarray(b["client"]))
+        for b in batches
+    ]
+
+    # warmup + timed pass
+    st = dev_state()
+    st = list(fn(*dev_batches[0], primary, *st))
+    np.asarray(st[0])
+    st = dev_state()
+    t0 = time.perf_counter()
+    for db in dev_batches:
+        st = list(fn(*db, primary, *st))
+    np.asarray(st[0])  # sync
+    dev_eps = (cfg.iters * e) / (time.perf_counter() - t0)
+
+    counters = {"freq": np.zeros(n), "writes": np.zeros(n), "conc": np.zeros(n)}
+    t0 = time.perf_counter()
+    for b in batches[: max(2, cfg.iters // 4)]:
+        _numpy_stream_fold(b, n, counters)
+    np_eps = (max(2, cfg.iters // 4) * e) / (time.perf_counter() - t0)
+
+    return {
+        "config": 5, "n": n, "d": cfg.d, "k": cfg.k,
+        "batch_events": e, "batches": cfg.iters,
+        "metric": f"stream_events_per_sec_n{n}_batch{e}",
+        "value": dev_eps,
+        "unit": "event/s",
+        "vs_baseline": dev_eps / np_eps,
+        "numpy_events_per_sec": np_eps,
+        "backend": "jax",
+    }
 
 
 def synth_blobs_np(n: int, d: int, k_true: int, seed: int = 0) -> np.ndarray:
@@ -151,6 +232,8 @@ def run_bench(config: int = 2, backend: str | None = None,
     """
     cfg = CONFIGS[int(config)]
     backend = backend or cfg.backend
+    if int(config) == 5:
+        return _bench_streaming(cfg, seed)
     np_iters = max(2, min(3, cfg.iters))
 
     # The subsample guard applies regardless of backend — a direct numpy
@@ -167,7 +250,7 @@ def run_bench(config: int = 2, backend: str | None = None,
         np_scale = cfg.n / n_sub
         numpy_estimated = True
 
-    init_np = _init_from_rows(np_sub, cfg.k, seed) if np_sub is not None else None
+    init_np = _init_from_rows(np_sub, cfg.k, seed)
     np_sec = _time_numpy_lloyd(np_sub, cfg.k, init_np, np_iters) * np_scale
     np_ips = 1.0 / np_sec
 
@@ -220,7 +303,7 @@ def run_bench(config: int = 2, backend: str | None = None,
             X = jax.block_until_ready(X)
         else:
             X = X_np
-        init = _init_from_rows(X_np, cfg.k, seed)
+        init = init_np  # numpy and jax timings start from identical centroids
     else:
         X = _synth_blobs_device(cfg.n, cfg.d, min(cfg.k, 64), seed, cfg.dtype,
                                 mesh_shape)

@@ -153,6 +153,48 @@ def _cmd_pipeline(args) -> int:
     return 0
 
 
+def _cmd_stream(args) -> int:
+    """Streaming mode: fold the access log in fixed-size batches, then cluster.
+
+    The batch pipeline's result on the same log is identical (the stream fold
+    is exact — features/streaming.py); this path exists for logs too large to
+    hold in memory and for continuous operation.
+    """
+    try:
+        from .features.streaming import stream_finalize, stream_init, stream_update
+    except ImportError as e:
+        print(f"streaming requires jax (the 'tpu' extra): {e}", file=sys.stderr)
+        return 1
+    from .io.events import EventLog, Manifest
+    from .models.replication import ReplicationPolicyModel
+
+    with StageTimer("stream") as t:
+        manifest = Manifest.read_csv(args.manifest)
+        state = stream_init(len(manifest))
+        n_batches = 0
+        for batch in EventLog.read_csv_batches(args.access_log, manifest,
+                                               batch_size=args.batch_size):
+            state = stream_update(state, batch, manifest)
+            n_batches += 1
+        table = stream_finalize(state, manifest)
+    print(f"Streamed {state.n_events} events in {n_batches} batches "
+          f"({t.elapsed:.2f}s)")
+
+    model = ReplicationPolicyModel(
+        kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed),
+        scoring_cfg=ScoringConfig(
+            compute_global_medians_from_data=args.medians_from_data),
+        backend=args.backend,
+        mesh_shape=_parse_mesh(args.mesh),
+    )
+    with StageTimer("cluster") as t:
+        decision = model.run(np.asarray(table.norm))
+        decision.write_csv(args.output_csv)
+    print(f"Cluster centroid assignments ({args.k} clusters) saved to: "
+          f"{args.output_csv} in {t.elapsed:.2f}s")
+    return 0
+
+
 def _cmd_bench(args) -> int:
     try:
         from .benchmarks.harness import run_bench
@@ -216,6 +258,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--medians_from_data", action="store_true")
     _add_backend_arg(p)
     p.set_defaults(fn=_cmd_pipeline)
+
+    p = sub.add_parser("stream", help="stream the access log in batches, then cluster")
+    p.add_argument("--manifest", required=True)
+    p.add_argument("--access_log", required=True)
+    p.add_argument("--batch_size", type=int, default=1_000_000)
+    p.add_argument("--k", type=int, default=4)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--output_csv", default="final_categories.csv")
+    p.add_argument("--medians_from_data", action="store_true")
+    _add_backend_arg(p)
+    p.set_defaults(fn=_cmd_stream)
 
     p = sub.add_parser("bench", help="benchmark harness (BASELINE.md configs)")
     p.add_argument("--config", type=int, default=1)

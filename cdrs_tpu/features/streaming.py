@@ -1,0 +1,196 @@
+"""Streaming feature extraction — incremental segment reductions over event batches.
+
+The reference computes features in one Spark job over the complete log
+(src/compute_features.py); the BASELINE config-5 scenario instead feeds 1B
+events as a stream.  This module keeps per-file running counters on device and
+folds in fixed-size event batches with the same segment kernels as the batch
+backend (features/jax_backend.py):
+
+* ``access_freq`` / ``writes`` / ``local_accesses`` — additive segment sums.
+* ``concurrency`` (max events-per-second per file) — per-batch run-length
+  counts over lexsorted (path, second) plus an exact cross-batch merge: the
+  state carries each file's last-seen second and that second's partial count,
+  so a second split across batch boundaries is re-joined before the max.
+  Requires the stream to be time-ordered per file (the reference sorts its
+  log globally, src/access_simulator.py:60).
+* ``age_seconds`` / ``write_ratio`` / min-max norm — computed at finalize
+  from the accumulated counters (exact formulas of SURVEY.md §2.2).
+
+``stream_features`` over any batch split of a log is bit-equal to the batch
+backends — enforced by tests/test_streaming.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..io.events import EventLog, Manifest
+from .numpy_backend import FeatureTable, minmax_normalize
+
+__all__ = ["StreamFeatureState", "stream_init", "stream_update", "stream_finalize"]
+
+
+@dataclass
+class StreamFeatureState:
+    """Per-file running counters (device arrays) + host scalars."""
+
+    access_freq: jax.Array   # (n,)
+    writes: jax.Array        # (n,)
+    local_acc: jax.Array     # (n,)
+    conc_max: jax.Array      # (n,)
+    last_sec: jax.Array      # (n,) int32, -1 = never seen
+    last_count: jax.Array    # (n,)
+    sec_base: float | None = None   # host: epoch floor of the first event seen
+    observation_end: float | None = None  # host: max raw ts seen
+    n_events: int = 0
+
+
+def stream_init(n_files: int, dtype=np.float64) -> StreamFeatureState:
+    z = jnp.zeros((n_files,), np.dtype(dtype))
+    return StreamFeatureState(
+        access_freq=z, writes=z, local_acc=z, conc_max=z,
+        last_sec=jnp.full((n_files,), -1, jnp.int32),
+        last_count=z,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _build_update(e, n, dtype_name):
+    ftype = np.dtype(dtype_name)
+
+    @jax.jit
+    def update(pid, sec, op, client, primary_node_id,
+               access_freq, writes, local_acc, conc_max, last_sec, last_count):
+        valid = pid >= 0
+        w = valid.astype(ftype)
+        pid_c = jnp.where(valid, pid, 0).astype(jnp.int32)
+
+        access_freq = access_freq + jax.ops.segment_sum(w, pid_c, num_segments=n)
+        writes = writes + jax.ops.segment_sum(w * (op == 1), pid_c, num_segments=n)
+        is_local = (client == primary_node_id[pid_c]).astype(ftype) * w
+        local_acc = local_acc + jax.ops.segment_sum(is_local, pid_c, num_segments=n)
+
+        # --- concurrency with cross-batch merge ---
+        sort_pid = jnp.where(valid, pid, n).astype(jnp.int32)
+        order = jnp.lexsort((sec, sort_pid))
+        s_pid = sort_pid[order]
+        s_sec = sec[order]
+        s_w = w[order]
+
+        first_of_pid = jnp.concatenate([
+            jnp.ones((1,), bool), s_pid[1:] != s_pid[:-1]])
+        last_of_pid = jnp.concatenate([
+            s_pid[1:] != s_pid[:-1], jnp.ones((1,), bool)])
+        new_run = first_of_pid | jnp.concatenate([
+            jnp.ones((1,), bool), s_sec[1:] != s_sec[:-1]])
+        run_id = jnp.cumsum(new_run.astype(jnp.int32)) - 1
+        run_count = jax.ops.segment_sum(s_w, run_id, num_segments=e)  # (e,) run-level
+
+        s_pid_safe = jnp.where(s_pid < n, s_pid, 0)
+        # Carry merge: a run that starts a file's presence in this batch and
+        # continues the file's last-seen second absorbs that second's partial
+        # count from the previous batch.
+        carry = jnp.where(
+            first_of_pid & (last_sec[s_pid_safe] == s_sec) & (s_pid < n),
+            last_count[s_pid_safe],
+            0.0,
+        )
+        # run-level effective counts, viewed at run-start events
+        eff = run_count[run_id] + carry  # carry only nonzero at run starts
+        eff_at_start = jnp.where(new_run & (s_pid < n), eff, 0.0)
+        conc_max = jnp.maximum(
+            conc_max,
+            jax.ops.segment_max(eff_at_start, s_pid_safe, num_segments=n),
+        )
+
+        # Store each file's trailing (second, count) for the next batch.  The
+        # trailing run's effective count includes the carry when the file has
+        # a single run in this batch.  ``eff`` lives at run-start events;
+        # propagate it to every event of the run via each run's start index.
+        start_idx = jax.ops.segment_max(
+            jnp.where(new_run, jnp.arange(e), 0), run_id, num_segments=e)
+        eff_run = eff_at_start[start_idx[run_id]]
+
+        sel = last_of_pid & (s_pid < n)
+        tgt = jnp.where(sel, s_pid, n)  # n = drop
+        last_sec = last_sec.at[tgt].set(s_sec, mode="drop")
+        last_count = last_count.at[tgt].set(eff_run, mode="drop")
+        return access_freq, writes, local_acc, conc_max, last_sec, last_count
+
+    return update
+
+
+def stream_update(state: StreamFeatureState, events: EventLog,
+                  manifest: Manifest) -> StreamFeatureState:
+    """Fold one event batch into the state (batch must be time-ordered)."""
+    e = len(events)
+    if e == 0:
+        return state
+    n = len(manifest)
+
+    batch_max = float(events.ts.max())
+    obs = batch_max if state.observation_end is None else max(
+        state.observation_end, batch_max)
+
+    sec_base = state.sec_base
+    if sec_base is None:
+        sec_base = float(np.floor(events.ts.min()))
+    sec = (np.floor(events.ts) - sec_base).astype(np.int32)
+
+    dtype_name = np.dtype(state.access_freq.dtype).name
+    fn = _build_update(e, n, dtype_name)
+    af, wr, la, cm, ls, lc = fn(
+        jnp.asarray(events.path_id, dtype=jnp.int32),
+        jnp.asarray(sec),
+        jnp.asarray(events.op),
+        jnp.asarray(events.client_id, dtype=jnp.int32),
+        jnp.asarray(manifest.primary_node_id, dtype=jnp.int32),
+        state.access_freq, state.writes, state.local_acc,
+        state.conc_max, state.last_sec, state.last_count,
+    )
+    return replace(
+        state,
+        access_freq=af, writes=wr, local_acc=la, conc_max=cm,
+        last_sec=ls, last_count=lc,
+        sec_base=sec_base, observation_end=obs,
+        n_events=state.n_events + e,
+    )
+
+
+def stream_finalize(state: StreamFeatureState, manifest: Manifest,
+                    observation_end: float | None = None) -> FeatureTable:
+    """Assemble the five features + norms from the accumulated counters."""
+    import time
+
+    n = len(manifest)
+    if observation_end is None:
+        observation_end = (
+            state.observation_end if state.observation_end is not None else time.time()
+        )
+
+    access_freq = np.asarray(state.access_freq, dtype=np.float64)
+    writes = np.asarray(state.writes, dtype=np.float64)
+    local_acc = np.asarray(state.local_acc, dtype=np.float64)
+    concurrency = np.asarray(state.conc_max, dtype=np.float64)
+    reads = access_freq - writes
+
+    locality = np.where(access_freq > 0,
+                        local_acc / np.maximum(access_freq, 1.0), 1.0)
+    age_seconds = observation_end - manifest.creation_ts
+    mean_writes = float(writes.mean()) if n else 0.0
+    if mean_writes == 0:
+        mean_writes = 1.0  # reference: compute_features.py:64-65
+    write_ratio = writes / mean_writes
+
+    raw = np.stack([access_freq, age_seconds, write_ratio, locality, concurrency],
+                   axis=1)
+    norm = np.stack([minmax_normalize(raw[:, j]) for j in range(raw.shape[1])],
+                    axis=1)
+    return FeatureTable(paths=list(manifest.paths), raw=raw, norm=norm,
+                        writes=writes, reads=reads)

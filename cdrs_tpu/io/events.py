@@ -123,11 +123,41 @@ class EventLog:
 
     @classmethod
     def read_csv(cls, path: str, manifest: Manifest) -> "EventLog":
-        ts, pid, op, cid = [], [], [], []
-        # Client vocabulary must share ids with manifest primary nodes so the
-        # locality comparison client_node == primary_node works on ids.
+        """Read the whole log as one EventLog (= one unbounded batch)."""
+        batches = list(cls.read_csv_batches(path, manifest, batch_size=None))
+        if not batches:
+            return cls(
+                ts=np.zeros(0), path_id=np.zeros(0, dtype=np.int32),
+                op=np.zeros(0, dtype=np.int8),
+                client_id=np.zeros(0, dtype=np.int32),
+                clients=list(manifest.nodes),
+            )
+        return batches[0]
+
+    @classmethod
+    def read_csv_batches(cls, path: str, manifest: Manifest,
+                         batch_size: int | None = 1_000_000):
+        """Yield EventLog batches of up to ``batch_size`` rows (streaming IO;
+        ``None`` = everything in one batch).
+
+        The client vocabulary is threaded across batches (ids shared with the
+        manifest's node vocabulary so the locality comparison
+        client_node == primary_node works on ids); the whole log is never
+        resident when a batch size is given.
+        """
         client_vocab: dict[str, int] = {nm: i for i, nm in enumerate(manifest.nodes)}
         clients = list(manifest.nodes)
+
+        def flush(ts, pid, op, cid):
+            return cls(
+                ts=np.asarray(ts, dtype=np.float64),
+                path_id=np.asarray(pid, dtype=np.int32),
+                op=np.asarray(op, dtype=np.int8),
+                client_id=np.asarray(cid, dtype=np.int32),
+                clients=list(clients),
+            )
+
+        ts, pid, op, cid = [], [], [], []
         with open(path, newline="") as f:
             for row in csv.reader(f):
                 if not row:
@@ -140,13 +170,11 @@ class EventLog:
                     client_vocab[c] = len(clients)
                     clients.append(c)
                 cid.append(client_vocab[c])
-        return cls(
-            ts=np.asarray(ts, dtype=np.float64),
-            path_id=np.asarray(pid, dtype=np.int32),
-            op=np.asarray(op, dtype=np.int8),
-            client_id=np.asarray(cid, dtype=np.int32),
-            clients=clients,
-        )
+                if batch_size is not None and len(ts) >= batch_size:
+                    yield flush(ts, pid, op, cid)
+                    ts, pid, op, cid = [], [], [], []
+        if ts:
+            yield flush(ts, pid, op, cid)
 
     def write_csv(self, path: str, manifest: Manifest) -> None:
         """Emit the reference's access.log format (ts,path,op,client,pid).

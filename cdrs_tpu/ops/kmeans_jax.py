@@ -380,7 +380,13 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
     mesh = make_mesh(n_data=ndata, n_model=nmodel)
     k_loc = k // nmodel
 
-    def local_fn(x, w, c0, key):
+    def local_fn(x, c0, key):
+        # Per-shard weight mask from the static n_valid (valid rows are always
+        # a prefix): built inside the program so no O(n) mask array is ever
+        # materialized on (or transferred through) a single device.
+        n_loc = x.shape[0]
+        row0 = lax.axis_index(DATA_AXIS) * n_loc
+        w = ((row0 + jnp.arange(n_loc)) < n_valid).astype(x.dtype)
         if with_init:
             centroids = c0
         else:
@@ -408,7 +414,7 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
     sharded = jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(), P()),
+        in_specs=(P(DATA_AXIS, None), P(), P()),
         out_specs=(c_spec, P(DATA_AXIS), P(), P()),
         check_vma=False,
     )
@@ -466,15 +472,13 @@ def kmeans_jax_full(
         n_valid = n if n_valid is None else int(n_valid)
         if n_valid > n:
             raise ValueError(f"n_valid={n_valid} exceeds rows {n}")
-        w = (jnp.arange(Xp.shape[0]) < n_valid).astype(dtype)
     else:
         if n_valid is not None and n_valid != n:
             raise ValueError("n_valid is only for pre-padded device arrays")
         Xp, n_valid = pad_rows(X.astype(dtype, copy=False), multiple)
-        # Padded rows carry weight 0 and reseed draws are bounded by n_valid,
-        # so padding never leaks into sums, counts, or sampling.
-        w = np.zeros(Xp.shape[0], dtype=dtype)
-        w[:n] = 1.0
+    # Padded rows get weight 0 inside the kernel (mask derived from n_valid)
+    # and reseed draws are bounded by n_valid, so padding never leaks into
+    # sums, counts, or sampling.
 
     with_init = init_centroids is not None
     c0 = (
@@ -492,7 +496,7 @@ def kmeans_jax_full(
     )
     if k > n_valid:
         raise ValueError(f"k={k} exceeds number of valid samples {n_valid}")
-    centroids, labels, it, shift = fn(Xp, w, c0, key)
+    centroids, labels, it, shift = fn(Xp, c0, key)
     return centroids, labels[:n_valid], int(it), float(shift)
 
 

@@ -1,0 +1,183 @@
+"""Streaming mini-batch KMeans — the BASELINE config-5 path.
+
+The reference has no streaming mode (its KMeans is one in-memory NumPy call,
+src/kmeans_plusplus.py:24); BASELINE.json's north star adds a 1B-event
+streaming scenario.  This implements web-scale mini-batch KMeans (Sculley,
+WWW'10 — public algorithm) as a jit-compiled sharded update:
+
+* state = (centroids (k, d), per-center counts (k,)) resident on device
+* per batch: assign (matmul expansion argmin) -> per-center batch sums/counts
+  (one-hot matmul, psum over the data mesh axis) -> per-center learning rate
+  eta_j = batch_count_j / total_count_j -> convex update
+  ``c_j <- (1 - eta_j) c_j + eta_j batch_mean_j``
+* the first batch can seed centroids with the same on-device D² init used by
+  the full-batch kernel (ops/kmeans_jax._d2_init_local)
+
+The update is a pure function of (state, batch): restartable mid-stream by
+checkpointing two small arrays (SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, make_mesh, pad_rows
+from .kmeans_jax import _d2_init_local, _weighted_cluster_stats, assign_labels_jax
+
+__all__ = ["MiniBatchState", "minibatch_init", "minibatch_update", "MiniBatchKMeans"]
+
+
+@dataclass
+class MiniBatchState:
+    centroids: jax.Array   # (k, d)
+    counts: jax.Array      # (k,) float — total points ever assigned per center
+    n_batches: int = 0
+
+
+def _prefix_mask(x, n_valid):
+    """Per-shard weight mask from the static valid-row count (built in-program
+    so no O(n) mask array crosses the host boundary)."""
+    n_loc = x.shape[0]
+    row0 = lax.axis_index(DATA_AXIS) * n_loc
+    return ((row0 + jnp.arange(n_loc)) < n_valid).astype(x.dtype)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_init(n_rows, n_valid, d, k, ndata, dtype_name):
+    mesh = make_mesh(n_data=ndata)
+
+    def local_fn(x, key):
+        return _d2_init_local(x, _prefix_mask(x, n_valid), key, k=k)
+
+    return jax.jit(jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P()),
+        out_specs=P(),
+        check_vma=False,
+    ))
+
+
+@functools.lru_cache(maxsize=32)
+def _build_update(n_rows, n_valid, d, k, ndata, dtype_name, update):
+    mesh = make_mesh(n_data=ndata)
+
+    def local_fn(x, centroids, counts):
+        w = _prefix_mask(x, n_valid)
+        labels = assign_labels_jax(x, centroids)
+        sums, bcounts = _weighted_cluster_stats(x, w, labels, k, update)
+        sums = lax.psum(sums, DATA_AXIS)
+        bcounts = lax.psum(bcounts, DATA_AXIS)
+
+        new_counts = counts + bcounts
+        eta = jnp.where(bcounts > 0, bcounts / jnp.maximum(new_counts, 1.0), 0.0)
+        bmean = sums / jnp.maximum(bcounts, 1.0)[:, None]
+        new_c = centroids + eta[:, None] * (bmean - centroids)
+        return new_c, new_counts, labels
+
+    return jax.jit(jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(), P()),
+        out_specs=(P(), P(), P(DATA_AXIS)),
+        check_vma=False,
+    ))
+
+
+def _prep_batch(xb, ndata, dtype):
+    """Pad one batch for even sharding; returns (rows, n_valid)."""
+    if isinstance(xb, jax.Array):
+        if xb.shape[0] % ndata:
+            raise ValueError(
+                f"device batch rows ({xb.shape[0]}) must divide data axis {ndata}"
+            )
+        return xb.astype(dtype), xb.shape[0]
+    xb = np.asarray(xb)
+    return pad_rows(xb.astype(dtype, copy=False), ndata)
+
+
+def minibatch_init(
+    first_batch,
+    k: int,
+    seed: int | None = None,
+    mesh_shape: dict[str, int] | None = None,
+    dtype=np.float32,
+) -> MiniBatchState:
+    """Seed centroids via the on-device D² init over the first batch."""
+    ndata = int((mesh_shape or {}).get(DATA_AXIS, 1))
+    xp, n_valid = _prep_batch(first_batch, ndata, np.dtype(dtype))
+    fn = _build_init(xp.shape[0], n_valid, xp.shape[1], int(k), ndata,
+                     np.dtype(dtype).name)
+    key = jax.random.PRNGKey(0 if seed is None else int(seed))
+    centroids = fn(xp, key)
+    return MiniBatchState(
+        centroids=centroids,
+        counts=jnp.zeros((k,), np.dtype(dtype)),
+        n_batches=0,
+    )
+
+
+def minibatch_update(
+    state: MiniBatchState,
+    batch,
+    mesh_shape: dict[str, int] | None = None,
+    update: str = "matmul",
+):
+    """One mini-batch step.  Returns (new_state, labels_for_batch)."""
+    ndata = int((mesh_shape or {}).get(DATA_AXIS, 1))
+    dtype = np.dtype(state.centroids.dtype)
+    xp, n_valid = _prep_batch(batch, ndata, dtype)
+    k = state.centroids.shape[0]
+    fn = _build_update(xp.shape[0], n_valid, xp.shape[1], int(k), ndata,
+                       dtype.name, update)
+    new_c, new_counts, labels = fn(xp, state.centroids, state.counts)
+    return (
+        MiniBatchState(new_c, new_counts, state.n_batches + 1),
+        labels[:n_valid],
+    )
+
+
+class MiniBatchKMeans:
+    """Convenience wrapper: feed batches, read centroids/labels.
+
+    >>> mb = MiniBatchKMeans(k=128, seed=0, mesh_shape={"data": 8})
+    >>> for xb in batches: mb.partial_fit(xb)
+    >>> mb.centroids  # (k, d)
+    """
+
+    def __init__(self, k: int, seed: int | None = None,
+                 mesh_shape: dict[str, int] | None = None, dtype=np.float32):
+        self.k = int(k)
+        self.seed = seed
+        self.mesh_shape = mesh_shape
+        self.dtype = dtype
+        self.state: MiniBatchState | None = None
+
+    def partial_fit(self, batch):
+        if self.state is None:
+            self.state = minibatch_init(
+                batch, self.k, seed=self.seed,
+                mesh_shape=self.mesh_shape, dtype=self.dtype,
+            )
+        self.state, labels = minibatch_update(
+            self.state, batch, mesh_shape=self.mesh_shape
+        )
+        return labels
+
+    @property
+    def centroids(self) -> np.ndarray:
+        if self.state is None:
+            raise ValueError("no batches seen yet")
+        return np.asarray(self.state.centroids)
+
+    def predict(self, X) -> np.ndarray:
+        import jax.numpy as jnp
+
+        return np.asarray(assign_labels_jax(jnp.asarray(np.asarray(X), dtype=self.dtype),
+                                            self.state.centroids))

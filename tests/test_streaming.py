@@ -1,0 +1,118 @@
+"""Streaming subsystem tests.
+
+Invariant: streaming any batch split of a time-ordered log must reproduce the
+full-log features exactly (including seconds split across batch boundaries),
+and mini-batch KMeans must recover planted blob structure.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
+from cdrs_tpu.features.numpy_backend import compute_features
+from cdrs_tpu.features.streaming import stream_finalize, stream_init, stream_update
+from cdrs_tpu.io.events import EventLog
+from cdrs_tpu.ops.kmeans_stream import MiniBatchKMeans
+from cdrs_tpu.sim.access import simulate_access
+from cdrs_tpu.sim.generator import generate_population
+
+
+@pytest.fixture(scope="module")
+def workload():
+    manifest = generate_population(GeneratorConfig(n_files=100, seed=3))
+    events = simulate_access(manifest, SimulatorConfig(duration_seconds=90.0, seed=3))
+    return manifest, events
+
+
+def _slice_events(events, lo, hi):
+    return EventLog(
+        ts=events.ts[lo:hi], path_id=events.path_id[lo:hi],
+        op=events.op[lo:hi], client_id=events.client_id[lo:hi],
+        clients=events.clients,
+    )
+
+
+@pytest.mark.parametrize("n_batches", [1, 3, 7])
+def test_stream_matches_batch_features(workload, n_batches):
+    manifest, events = workload
+    want = compute_features(manifest, events)
+
+    state = stream_init(len(manifest))
+    # Deliberately uneven splits (prime-ish offsets) to cut inside seconds.
+    cuts = np.linspace(0, len(events), n_batches + 1).astype(int)
+    cuts[1:-1] += 13  # shift interior cuts off any natural boundary
+    cuts = np.clip(cuts, 0, len(events))
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        state = stream_update(state, _slice_events(events, int(lo), int(hi)), manifest)
+    got = stream_finalize(state, manifest)
+
+    np.testing.assert_allclose(got.raw, want.raw, rtol=1e-12, atol=1e-9)
+    np.testing.assert_allclose(got.norm, want.norm, rtol=1e-12, atol=1e-12)
+
+
+def test_stream_concurrency_boundary_merge(workload):
+    """A (path, second) run split across batches must count as one run."""
+    manifest, _ = workload
+    n = len(manifest)
+    base = 1_700_000_000.0
+    # 6 events for file 0 in the same second, split 2/4 across batches.
+    ts = np.array([base + 0.1, base + 0.2, base + 0.3, base + 0.4,
+                   base + 0.5, base + 0.6])
+    mk = lambda lo, hi: EventLog(
+        ts=ts[lo:hi],
+        path_id=np.zeros(hi - lo, dtype=np.int32),
+        op=np.zeros(hi - lo, dtype=np.int8),
+        client_id=np.zeros(hi - lo, dtype=np.int32),
+        clients=["dn1"],
+    )
+    state = stream_init(n)
+    state = stream_update(state, mk(0, 2), manifest)
+    state = stream_update(state, mk(2, 6), manifest)
+    got = stream_finalize(state, manifest)
+    assert got.raw[0, 4] == 6.0  # concurrency: all six in one second
+
+
+def test_minibatch_kmeans_recovers_blobs():
+    rng = np.random.default_rng(5)
+    centers = rng.normal(size=(8, 16)) * 5.0
+    mb = MiniBatchKMeans(k=8, seed=1, mesh_shape={"data": 4})
+    for _ in range(30):
+        lab = rng.integers(0, 8, size=512)
+        batch = centers[lab] + rng.normal(size=(512, 16)) * 0.3
+        mb.partial_fit(batch.astype(np.float32))
+    got = mb.centroids
+    # Every true center must have a learned centroid within a small distance.
+    d = np.linalg.norm(centers[:, None, :] - got[None, :, :], axis=2)
+    assert d.min(axis=1).max() < 1.0
+    # predict() assigns a fresh blob sample to the matching centroid
+    lab = rng.integers(0, 8, size=256)
+    X = centers[lab] + rng.normal(size=(256, 16)) * 0.3
+    pred = mb.predict(X)
+    # consistency: points from the same true blob map to the same centroid
+    for j in range(8):
+        p = pred[lab == j]
+        assert (p == p[0]).mean() > 0.95
+
+
+def test_minibatch_state_is_checkpointable():
+    """State round-trips through host numpy (checkpoint/resume, SURVEY.md §5)."""
+    import jax.numpy as jnp
+
+    from cdrs_tpu.ops.kmeans_stream import MiniBatchState, minibatch_update
+
+    rng = np.random.default_rng(0)
+    mb = MiniBatchKMeans(k=4, seed=0)
+    b1 = rng.normal(size=(128, 8)).astype(np.float32)
+    b2 = rng.normal(size=(128, 8)).astype(np.float32)
+    mb.partial_fit(b1)
+
+    # checkpoint -> restore -> continue
+    ckpt = (np.asarray(mb.state.centroids), np.asarray(mb.state.counts))
+    restored = MiniBatchState(jnp.asarray(ckpt[0]), jnp.asarray(ckpt[1]),
+                              n_batches=1)
+    s2, _ = minibatch_update(restored, b2)
+    mb.partial_fit(b2)
+    np.testing.assert_allclose(np.asarray(mb.state.centroids),
+                               np.asarray(s2.centroids), atol=1e-6)
