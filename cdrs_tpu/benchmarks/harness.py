@@ -93,10 +93,10 @@ def _bench_streaming(cfg: BenchConfig, seed: int) -> dict:
     n, e = cfg.n, STREAM_BATCH_EVENTS
     rng = np.random.default_rng(seed)
     primary = jnp.asarray(rng.integers(0, 4, size=n, dtype=np.int32))
-    fn = _build_update(e, n, "float32")
+    fn = _build_update(e, n)
 
     def dev_state():
-        z = jnp.zeros((n,), jnp.float32)
+        z = jnp.zeros((n,), jnp.int32)
         return [z, z, z, z, jnp.full((n,), -1, jnp.int32), z]
 
     batches = [_synth_event_batch(rng, n, e, 1.7e9 + 60.0 * i)
@@ -233,6 +233,10 @@ def run_bench(config: int = 2, backend: str | None = None,
     cfg = CONFIGS[int(config)]
     backend = backend or cfg.backend
     if int(config) == 5:
+        if backend != "jax" or mesh_shape:
+            raise ValueError(
+                "config 5 (streaming) runs the jax fold on a single device; "
+                "--backend/--mesh overrides are not supported")
         return _bench_streaming(cfg, seed)
     np_iters = max(2, min(3, cfg.iters))
 

@@ -38,21 +38,26 @@ __all__ = ["StreamFeatureState", "stream_init", "stream_update", "stream_finaliz
 
 @dataclass
 class StreamFeatureState:
-    """Per-file running counters (device arrays) + host scalars."""
+    """Per-file running counters (device arrays) + host scalars.
 
-    access_freq: jax.Array   # (n,)
-    writes: jax.Array        # (n,)
-    local_acc: jax.Array     # (n,)
-    conc_max: jax.Array      # (n,)
+    Counters are int32: exact accumulation with no dependence on x64 mode
+    (float32 counters would silently saturate at 2**24 events per file —
+    reachable at the 1B-event target scale).
+    """
+
+    access_freq: jax.Array   # (n,) int32
+    writes: jax.Array        # (n,) int32
+    local_acc: jax.Array     # (n,) int32
+    conc_max: jax.Array      # (n,) int32
     last_sec: jax.Array      # (n,) int32, -1 = never seen
-    last_count: jax.Array    # (n,)
+    last_count: jax.Array    # (n,) int32
     sec_base: float | None = None   # host: epoch floor of the first event seen
     observation_end: float | None = None  # host: max raw ts seen
     n_events: int = 0
 
 
-def stream_init(n_files: int, dtype=np.float64) -> StreamFeatureState:
-    z = jnp.zeros((n_files,), np.dtype(dtype))
+def stream_init(n_files: int) -> StreamFeatureState:
+    z = jnp.zeros((n_files,), jnp.int32)
     return StreamFeatureState(
         access_freq=z, writes=z, local_acc=z, conc_max=z,
         last_sec=jnp.full((n_files,), -1, jnp.int32),
@@ -61,19 +66,17 @@ def stream_init(n_files: int, dtype=np.float64) -> StreamFeatureState:
 
 
 @functools.lru_cache(maxsize=32)
-def _build_update(e, n, dtype_name):
-    ftype = np.dtype(dtype_name)
-
+def _build_update(e, n):
     @jax.jit
     def update(pid, sec, op, client, primary_node_id,
                access_freq, writes, local_acc, conc_max, last_sec, last_count):
         valid = pid >= 0
-        w = valid.astype(ftype)
+        w = valid.astype(jnp.int32)
         pid_c = jnp.where(valid, pid, 0).astype(jnp.int32)
 
         access_freq = access_freq + jax.ops.segment_sum(w, pid_c, num_segments=n)
         writes = writes + jax.ops.segment_sum(w * (op == 1), pid_c, num_segments=n)
-        is_local = (client == primary_node_id[pid_c]).astype(ftype) * w
+        is_local = (client == primary_node_id[pid_c]).astype(jnp.int32) * w
         local_acc = local_acc + jax.ops.segment_sum(is_local, pid_c, num_segments=n)
 
         # --- concurrency with cross-batch merge ---
@@ -99,11 +102,11 @@ def _build_update(e, n, dtype_name):
         carry = jnp.where(
             first_of_pid & (last_sec[s_pid_safe] == s_sec) & (s_pid < n),
             last_count[s_pid_safe],
-            0.0,
+            0,
         )
         # run-level effective counts, viewed at run-start events
         eff = run_count[run_id] + carry  # carry only nonzero at run starts
-        eff_at_start = jnp.where(new_run & (s_pid < n), eff, 0.0)
+        eff_at_start = jnp.where(new_run & (s_pid < n), eff, 0)
         conc_max = jnp.maximum(
             conc_max,
             jax.ops.segment_max(eff_at_start, s_pid_safe, num_segments=n),
@@ -143,8 +146,7 @@ def stream_update(state: StreamFeatureState, events: EventLog,
         sec_base = float(np.floor(events.ts.min()))
     sec = (np.floor(events.ts) - sec_base).astype(np.int32)
 
-    dtype_name = np.dtype(state.access_freq.dtype).name
-    fn = _build_update(e, n, dtype_name)
+    fn = _build_update(e, n)
     af, wr, la, cm, ls, lc = fn(
         jnp.asarray(events.path_id, dtype=jnp.int32),
         jnp.asarray(sec),

@@ -41,7 +41,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, pad_rows
+from ..parallel.mesh import (DATA_AXIS, MODEL_AXIS, make_mesh, pad_rows,
+                             prefix_mask)
 
 __all__ = [
     "pairwise_sq_dists_jax",
@@ -153,7 +154,7 @@ def _weighted_cluster_stats(xc, wc, lab, k, update):
     return sums, counts
 
 
-def _assign_reduce(x, w, c, k, chunk_rows, update="matmul"):
+def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None):
     """Fused assignment + per-cluster (sum, count) reduction for one shard.
 
     ``chunk_rows=None`` materializes the full (n_loc, k) distance block — fast
@@ -162,6 +163,19 @@ def _assign_reduce(x, w, c, k, chunk_rows, update="matmul"):
     the reference's dense (n, k, d) broadcast lacks (SURVEY.md §3.2 hot loop #4,
     §7.4 "memory at 100M×128").
     """
+    if update == "pallas":
+        # Fused VMEM-resident kernel (ops/pallas_kernels.py).  The shard-local
+        # valid count is derived exactly from the static global n_valid (a
+        # float mask sum would saturate at 2**24 rows in f32).
+        from .pallas_kernels import lloyd_assign_reduce_pallas
+
+        n_loc = x.shape[0]
+        nv = jnp.clip(n_valid - lax.axis_index(DATA_AXIS) * n_loc, 0, n_loc
+                      ).astype(jnp.int32)
+        labels, sums, counts = lloyd_assign_reduce_pallas(
+            x, c, nv, tile_rows=chunk_rows or 1024)
+        return labels, sums.astype(x.dtype), counts.astype(x.dtype)
+
     if chunk_rows is None:
         labels = assign_labels_jax(x, c)
         sums, counts = _weighted_cluster_stats(x, w, labels, k, update)
@@ -226,26 +240,36 @@ def _lloyd_local(x, w, centroids, key, *, k, n_valid, tol, max_iter,
 
     def body(carry):
         c, _, key, it, _ = carry
-        _, sums, counts = _assign_reduce(x, w, c, k, chunk_rows, update)
+        _, sums, counts = _assign_reduce(x, w, c, k, chunk_rows, update,
+                                         n_valid=n_valid)
         sums = lax.psum(sums, DATA_AXIS)
         counts = lax.psum(counts, DATA_AXIS)
-
-        # Seeded empty-cluster reseed: one uniform global index per cluster,
-        # fetched without a gather (each shard contributes its owned rows).
         key, sub = jax.random.split(key)
-        reseed_idx = jax.random.randint(sub, (k,), 0, n_valid)
-        rel = reseed_idx - offset
-        owned = (rel >= 0) & (rel < n_loc)
-        cand = lax.psum(
-            jnp.where(owned[:, None], x[jnp.clip(rel, 0, n_loc - 1)], 0.0),
-            DATA_AXIS,
-        )
 
-        new_c = jnp.where(
-            counts[:, None] > 0,
-            sums / jnp.maximum(counts, 1.0)[:, None],
-            cand,
-        )
+        def with_reseed(_):
+            # Seeded empty-cluster reseed: one uniform global index per
+            # cluster, fetched without a gather (each shard contributes its
+            # owned rows).  Behind lax.cond because empty clusters are rare
+            # and per-kernel launch overhead dominates small ops on TPU;
+            # the predicate is psum-replicated so every shard takes the same
+            # branch (collectives inside stay aligned).
+            reseed_idx = jax.random.randint(sub, (k,), 0, n_valid)
+            rel = reseed_idx - offset
+            owned = (rel >= 0) & (rel < n_loc)
+            cand = lax.psum(
+                jnp.where(owned[:, None], x[jnp.clip(rel, 0, n_loc - 1)], 0.0),
+                DATA_AXIS,
+            )
+            return jnp.where(
+                counts[:, None] > 0,
+                sums / jnp.maximum(counts, 1.0)[:, None],
+                cand,
+            )
+
+        def no_empty(_):
+            return sums / jnp.maximum(counts, 1.0)[:, None]
+
+        new_c = lax.cond(jnp.any(counts == 0), with_reseed, no_empty, None)
         shift = jnp.sqrt(jnp.sum((new_c - c) ** 2))
         return new_c, c, key, it + 1, shift
 
@@ -334,23 +358,31 @@ def _lloyd_local_2d(x, w, c_loc, key, *, k, n_valid, tol, max_iter,
         counts = lax.psum(counts, DATA_AXIS)
         sums_loc = lax.dynamic_slice_in_dim(sums, k_off, k_loc)
         counts_loc = lax.dynamic_slice_in_dim(counts, k_off, k_loc)
-
         key, sub = jax.random.split(key)
-        reseed_idx = lax.dynamic_slice_in_dim(
-            jax.random.randint(sub, (k,), 0, n_valid), k_off, k_loc
-        )
-        rel = reseed_idx - offset
-        owned = (rel >= 0) & (rel < n_loc)
-        cand = lax.psum(
-            jnp.where(owned[:, None], x[jnp.clip(rel, 0, n_loc - 1)], 0.0),
-            DATA_AXIS,
-        )
 
-        new_c = jnp.where(
-            counts_loc[:, None] > 0,
-            sums_loc / jnp.maximum(counts_loc, 1.0)[:, None],
-            cand,
-        )
+        def with_reseed(_):
+            # Rare path behind lax.cond (see _lloyd_local); the predicate is
+            # computed from the full psum-replicated counts so all shards —
+            # across both mesh axes — branch identically.
+            reseed_idx = lax.dynamic_slice_in_dim(
+                jax.random.randint(sub, (k,), 0, n_valid), k_off, k_loc
+            )
+            rel = reseed_idx - offset
+            owned = (rel >= 0) & (rel < n_loc)
+            cand = lax.psum(
+                jnp.where(owned[:, None], x[jnp.clip(rel, 0, n_loc - 1)], 0.0),
+                DATA_AXIS,
+            )
+            return jnp.where(
+                counts_loc[:, None] > 0,
+                sums_loc / jnp.maximum(counts_loc, 1.0)[:, None],
+                cand,
+            )
+
+        def no_empty(_):
+            return sums_loc / jnp.maximum(counts_loc, 1.0)[:, None]
+
+        new_c = lax.cond(jnp.any(counts == 0), with_reseed, no_empty, None)
         shift = jnp.sqrt(
             lax.psum(jnp.sum((new_c - c_loc) ** 2), MODEL_AXIS)
         )
@@ -381,12 +413,7 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
     k_loc = k // nmodel
 
     def local_fn(x, c0, key):
-        # Per-shard weight mask from the static n_valid (valid rows are always
-        # a prefix): built inside the program so no O(n) mask array is ever
-        # materialized on (or transferred through) a single device.
-        n_loc = x.shape[0]
-        row0 = lax.axis_index(DATA_AXIS) * n_loc
-        w = ((row0 + jnp.arange(n_loc)) < n_valid).astype(x.dtype)
+        w = prefix_mask(x, n_valid)
         if with_init:
             centroids = c0
         else:
@@ -456,7 +483,8 @@ def kmeans_jax_full(
     if k % nmodel != 0:
         raise ValueError(f"k={k} must be divisible by the model axis size {nmodel}")
 
-    multiple = ndata * (chunk_rows or 1)
+    # pallas tiles rows internally (default 1024), so shards must divide it.
+    multiple = ndata * (chunk_rows or (1024 if update == "pallas" else 1))
     if is_device_array:
         # Device-resident input (benchmark / streaming path): never copy to
         # host.  The caller must pre-size rows, passing ``n_valid`` when the
@@ -488,8 +516,10 @@ def kmeans_jax_full(
     )
     key = jax.random.PRNGKey(0 if seed is None else int(seed))
 
-    if update not in ("matmul", "scatter"):
+    if update not in ("matmul", "scatter", "pallas"):
         raise ValueError(f"unknown update strategy {update!r}")
+    if update == "pallas" and nmodel > 1:
+        raise ValueError("pallas update not supported on a model-sharded mesh")
     fn = _build_kmeans(
         n_valid, d, int(k), ndata, nmodel, int(max_iter), float(tol),
         with_init, np.dtype(dtype).name, chunk_rows, update,

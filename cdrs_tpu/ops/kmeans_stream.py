@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXIS, make_mesh, pad_rows
+from ..parallel.mesh import DATA_AXIS, make_mesh, pad_rows, prefix_mask
 from .kmeans_jax import _d2_init_local, _weighted_cluster_stats, assign_labels_jax
 
 __all__ = ["MiniBatchState", "minibatch_init", "minibatch_update", "MiniBatchKMeans"]
@@ -42,20 +42,12 @@ class MiniBatchState:
     n_batches: int = 0
 
 
-def _prefix_mask(x, n_valid):
-    """Per-shard weight mask from the static valid-row count (built in-program
-    so no O(n) mask array crosses the host boundary)."""
-    n_loc = x.shape[0]
-    row0 = lax.axis_index(DATA_AXIS) * n_loc
-    return ((row0 + jnp.arange(n_loc)) < n_valid).astype(x.dtype)
-
-
 @functools.lru_cache(maxsize=32)
 def _build_init(n_rows, n_valid, d, k, ndata, dtype_name):
     mesh = make_mesh(n_data=ndata)
 
     def local_fn(x, key):
-        return _d2_init_local(x, _prefix_mask(x, n_valid), key, k=k)
+        return _d2_init_local(x, prefix_mask(x, n_valid), key, k=k)
 
     return jax.jit(jax.shard_map(
         local_fn, mesh=mesh,
@@ -70,7 +62,7 @@ def _build_update(n_rows, n_valid, d, k, ndata, dtype_name, update):
     mesh = make_mesh(n_data=ndata)
 
     def local_fn(x, centroids, counts):
-        w = _prefix_mask(x, n_valid)
+        w = prefix_mask(x, n_valid)
         labels = assign_labels_jax(x, centroids)
         sums, bcounts = _weighted_cluster_stats(x, w, labels, k, update)
         sums = lax.psum(sums, DATA_AXIS)

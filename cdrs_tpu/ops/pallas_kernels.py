@@ -1,0 +1,173 @@
+"""Pallas TPU kernels — fused Lloyd assignment + cluster-stats reduction.
+
+The XLA path (ops/kmeans_jax._assign_reduce) round-trips two (n, k) blocks
+through HBM per iteration: the distance matrix (argmin input) and the one-hot
+assignment (update-matmul input).  At n=1M, k=128, f32 that is ~2 GB of HBM
+traffic per Lloyd iteration versus ~130 MB of actual input.  This kernel fuses
+the whole step per row tile inside VMEM:
+
+    for each tile of TILE_N rows (sequential TPU grid):
+        dist   = c_sq - 2 x_tile @ C^T          (MXU, VMEM-resident)
+        labels = argmin(dist)                    (VPU)
+        onehot = labels == iota                  (VPU, VMEM-resident)
+        sums  += onehot^T @ x_tile               (MXU accumulation)
+        counts+= colsum(onehot)
+
+so HBM sees x once plus the tiny (k, d) outputs — the memory-bound limit.
+
+Feature count d and cluster count k are padded to the 128-lane boundary in the
+wrapper (zero feature columns leave distances unchanged; padded centroid rows
+are pushed to +inf distance so argmin never selects them).
+
+Reference hot loop being replaced: the (n, k, d) broadcast at
+src/kmeans_plusplus.py:33 (SURVEY.md §3.2 hot loop #4).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["lloyd_assign_reduce_pallas", "pallas_available"]
+
+_LANE = 128
+
+
+def pallas_available() -> bool:
+    """True when running on a real TPU backend (otherwise use interpret)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _pad_to(v: int, m: int) -> int:
+    return ((v + m - 1) // m) * m
+
+
+def _kernel(nv_ref, x_ref, c_ref, csq_ref, sums_ref, counts_ref, labels_ref, *,
+            k_pad, tile_rows):
+    i = pl.program_id(0)
+    n_valid = nv_ref[0, 0]  # runtime scalar: shard-local valid row count
+    x = x_ref[:]                      # (T, d_pad)
+    c = c_ref[:]                      # (k_pad, d_pad)
+
+    dist = csq_ref[:] - 2.0 * jax.lax.dot_general(
+        x, c,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                  # (T, k_pad); csq row-broadcasts
+
+    # argmin via min + first-match (Mosaic lacks a direct argmin lowering);
+    # all iota/compares stay 2D (1D->2D i1 reshapes are rejected).
+    cols2 = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, k_pad), 1)
+    dmin = jnp.min(dist, axis=1, keepdims=True)           # (T, 1)
+    lab2 = jnp.min(jnp.where(dist == dmin, cols2, k_pad), axis=1,
+                   keepdims=True)                          # (T, 1) first min
+    labels_ref[:] = lab2[:, 0].astype(jnp.int32)
+
+    row0 = i * tile_rows
+    rows2 = jax.lax.broadcasted_iota(jnp.int32, (tile_rows, k_pad), 0)
+    oh = ((lab2 == cols2) & ((row0 + rows2) < n_valid)).astype(x.dtype)
+
+    s = jax.lax.dot_general(
+        oh, x,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                  # (k_pad, d_pad)
+    cnt = jnp.sum(oh, axis=0)          # (k_pad,)
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[:] = s
+        counts_ref[:] = cnt[None, :]
+
+    @pl.when(i > 0)
+    def _acc():
+        sums_ref[:] += s
+        counts_ref[:] += cnt[None, :]
+
+
+@functools.lru_cache(maxsize=32)
+def _build(n_rows, d, k, tile_rows, dtype_name, interpret):
+    # Feature dim is used as-is (Mosaic lane-pads minor dims internally; an
+    # explicit zero-pad to 128 would 4x the matmul FLOPs at d=32 and
+    # materialize a padded copy of x in HBM).  k is padded so the argmin /
+    # one-hot lanes are full; padded centroids sit at +inf distance.
+    d_pad = d
+    k_pad = _pad_to(max(k, 8), _LANE)
+    grid = n_rows // tile_rows
+
+    kern = functools.partial(_kernel, k_pad=k_pad, tile_rows=tile_rows)
+
+    call = pl.pallas_call(
+        kern,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((tile_rows, d_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((k_pad, d_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_rows,), lambda i: (i,),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k_pad, d_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((n_rows,), jnp.int32),
+        ],
+        interpret=bool(interpret),
+    )
+
+    dtype = jnp.dtype(dtype_name)
+
+    def fn(x, c, n_valid):
+        # Pad centroids to k_pad rows pushed to +inf distance (via c_sq) so
+        # the argmin never selects them.
+        big = jnp.asarray(1e30, dtype)
+        c_p = jnp.zeros((k_pad, d_pad), dtype).at[:k].set(c)
+        c_sq = jnp.sum(c_p * c_p, axis=1)
+        c_sq = jnp.where(jax.lax.iota(jnp.int32, k_pad) < k, c_sq, big)
+        nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
+        sums, counts, labels = call(nv, x, c_p, c_sq[None, :])
+        return labels, sums[:k], counts[0, :k]
+
+    return fn
+
+
+def lloyd_assign_reduce_pallas(x, c, n_valid, tile_rows: int = 1024,
+                               interpret: bool | None = None):
+    """Fused assignment + (sums, counts) for one device's rows.
+
+    ``x``: (n_rows, d) with n_rows % tile_rows == 0 (caller pads rows;
+    tile_rows must be a multiple of 1024 to match XLA's 1D layout tiling);
+    ``c``: (k, d).  ``n_valid`` may be a traced scalar (shard-local count) —
+    rows >= n_valid get zero weight (their labels are still produced but
+    meaningless).  Returns (labels (n_rows,) int32, sums (k, d) f32,
+    counts (k,) f32).  Call from inside jit for fusion with neighbors.
+    """
+    if interpret is None:
+        interpret = not pallas_available()
+    n_rows, d = x.shape
+    k = c.shape[0]
+    if n_rows % tile_rows:
+        raise ValueError(f"rows {n_rows} not a multiple of tile_rows {tile_rows}")
+    fn = _build(n_rows, d, k, int(tile_rows),
+                jnp.dtype(x.dtype).name, bool(interpret))
+    return fn(x, c, n_valid)

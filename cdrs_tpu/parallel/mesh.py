@@ -21,7 +21,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-__all__ = ["make_mesh", "mesh_from_shape", "pad_rows", "DATA_AXIS", "MODEL_AXIS"]
+__all__ = ["make_mesh", "mesh_from_shape", "pad_rows", "prefix_mask",
+           "DATA_AXIS", "MODEL_AXIS"]
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -67,3 +68,17 @@ def pad_rows(x: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
         return x, n
     pad_width = [(0, rem)] + [(0, 0)] * (x.ndim - 1)
     return np.pad(x, pad_width), n
+
+
+def prefix_mask(x, n_valid: int):
+    """Shard-local validity mask (valid rows are a global prefix).
+
+    Built in-program from the static count so no O(n) mask array crosses the
+    host boundary.  For use inside ``shard_map`` bodies sharded over DATA_AXIS.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_loc = x.shape[0]
+    row0 = lax.axis_index(DATA_AXIS) * n_loc
+    return ((row0 + jnp.arange(n_loc)) < n_valid).astype(x.dtype)
