@@ -83,7 +83,7 @@ def _cmd_simulate(args) -> int:
     )
     with StageTimer("simulate") as t:
         manifest = Manifest.read_csv(args.manifest)
-        events = simulate_access(manifest, cfg)
+        events = simulate_access(manifest, cfg, engine=args.engine)
         events.write_csv(args.out, manifest)
     print(f"Wrote {args.out} with {len(events)} entries in {t.elapsed:.2f}s")
     return 0
@@ -230,6 +230,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--duration_seconds", type=float, default=300.0)
     p.add_argument("--clients", default="dn1,dn2,dn3,dn4")
     p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--engine", choices=["numpy", "native"], default="numpy",
+                   help="'native' = threaded C++ generator (runtime/native.py)")
     p.set_defaults(fn=_cmd_simulate)
 
     p = sub.add_parser("features", help="extract the 5 per-file features")

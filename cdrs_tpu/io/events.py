@@ -17,10 +17,24 @@ from datetime import datetime, timezone
 
 import numpy as np
 
-__all__ = ["Manifest", "EventLog", "parse_iso_ts", "OP_READ", "OP_WRITE"]
+__all__ = ["Manifest", "EventLog", "parse_iso_ts", "client_vocabulary",
+           "OP_READ", "OP_WRITE"]
 
 OP_READ = np.int8(0)
 OP_WRITE = np.int8(1)
+
+
+def client_vocabulary(manifest: "Manifest", extra_clients=()):
+    """Shared client-id vocabulary: manifest nodes first (so ids align with
+    ``primary_node_id`` — the locality comparison is id-based), then any extra
+    simulator clients.  Returns (clients list, pool int32 array of the ids of
+    ``extra_clients``)."""
+    clients = list(manifest.nodes)
+    for c in extra_clients:
+        if c not in clients:
+            clients.append(c)
+    pool = np.asarray([clients.index(c) for c in extra_clients], dtype=np.int32)
+    return clients, pool
 
 
 def parse_iso_ts(s: str) -> float:
@@ -122,8 +136,42 @@ class EventLog:
         return len(self.ts)
 
     @classmethod
-    def read_csv(cls, path: str, manifest: Manifest) -> "EventLog":
-        """Read the whole log as one EventLog (= one unbounded batch)."""
+    def read_csv(cls, path: str, manifest: Manifest,
+                 native: bool | None = None) -> "EventLog":
+        """Read the whole log as one EventLog (= one unbounded batch).
+
+        Uses the C++ parser (runtime/native.py) when available — byte-exact
+        with the Python path, ~10x faster on large logs; ``native=False``
+        forces pure Python, ``None`` auto-detects.  Quoted CSVs fall back
+        automatically.
+        """
+        if native is not False:
+            from ..runtime.native import native_available, parse_access_log_native
+
+            if native is True and not native_available():
+                raise RuntimeError(
+                    "native log parser unavailable (library not built; "
+                    "needs g++/make)")
+            parsed = parse_access_log_native(path)
+            if parsed is not None:
+                ts, op, paths, client_names = parsed
+                pid = np.asarray(
+                    [manifest.path_to_id.get(p, -1) for p in paths],
+                    dtype=np.int32)
+                client_vocab = {nm: i for i, nm in enumerate(manifest.nodes)}
+                clients = list(manifest.nodes)
+                cid = np.empty(len(client_names), dtype=np.int32)
+                for i, c in enumerate(client_names):
+                    if c not in client_vocab:
+                        client_vocab[c] = len(clients)
+                        clients.append(c)
+                    cid[i] = client_vocab[c]
+                return cls(ts=np.asarray(ts), path_id=pid,
+                           op=np.asarray(op, dtype=np.int8),
+                           client_id=cid, clients=clients)
+            # parsed is None: the file needs the python csv path (quoting,
+            # malformed rows, exotic timestamps) — fall through even under
+            # native=True so diagnostics come from one place.
         batches = list(cls.read_csv_batches(path, manifest, batch_size=None))
         if not batches:
             return cls(

@@ -1,0 +1,164 @@
+"""ctypes bindings for the native runtime library (native/cdrs_native.cpp).
+
+The compute path of this framework is JAX/XLA/Pallas; the *runtime* around it
+(event generation, log ingest) has native C++ implementations here, mirroring
+how the reference leans on the JVM/Spark for its data plane (SURVEY.md §2.4).
+
+Everything degrades gracefully: ``load()`` returns None when the library is
+absent and cannot be built (no g++), and every caller falls back to the
+NumPy/pure-Python path.  The library is built lazily with ``make -C native``
+on first use.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["load", "native_available", "simulate_events_native",
+           "parse_access_log_native"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libcdrs_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_attempted = False
+
+_i64 = ctypes.c_int64
+_u64 = ctypes.c_uint64
+_f64 = ctypes.c_double
+_p_f64 = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_p_i64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_p_i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_p_i8 = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")
+_p_char = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _try_build() -> bool:
+    if not os.path.isdir(_NATIVE_DIR) or shutil.which("make") is None:
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True, capture_output=True, timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def load():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_attempted
+    with _lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        if not os.path.exists(_LIB_PATH) and not _try_build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+
+        lib.sim_counts.restype = _i64
+        lib.sim_counts.argtypes = [_i64, _p_f64, _p_f64, _f64, _u64, _p_i64]
+        lib.sim_fill.restype = None
+        lib.sim_fill.argtypes = [
+            _i64, _p_i64, _p_f64, _p_f64, _p_f64, _p_i32, _p_i32, _i64,
+            _f64, _f64, _u64, _i64, _p_f64, _p_i32, _p_i8, _p_i32,
+        ]
+        lib.log_scan.restype = _i64
+        lib.log_scan.argtypes = [ctypes.c_char_p,
+                                 ctypes.POINTER(_i64), ctypes.POINTER(_i64)]
+        lib.log_fill.restype = _i64
+        lib.log_fill.argtypes = [
+            ctypes.c_char_p, _i64, _i64, _i64, _p_f64, _p_i8,
+            _p_char, _p_i64, _p_char, _p_i64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load() is not None
+
+
+def simulate_events_native(
+    read_rate: np.ndarray,
+    write_rate: np.ndarray,
+    locality: np.ndarray,
+    primary_node: np.ndarray,
+    client_pool: np.ndarray,
+    duration: float,
+    sim_start: float,
+    seed: int,
+    n_threads: int = 0,
+):
+    """Threaded Poisson event generation.  Returns (ts, pid, op, client),
+    globally time-sorted.  Raises RuntimeError when the library is missing."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable (no g++/make?)")
+    if len(client_pool) == 0:
+        raise ValueError("client_pool must be non-empty")
+    n = len(read_rate)
+    read_rate = np.ascontiguousarray(read_rate, dtype=np.float64)
+    write_rate = np.ascontiguousarray(write_rate, dtype=np.float64)
+    locality = np.ascontiguousarray(locality, dtype=np.float64)
+    primary_node = np.ascontiguousarray(primary_node, dtype=np.int32)
+    client_pool = np.ascontiguousarray(client_pool, dtype=np.int32)
+
+    counts = np.empty(n, dtype=np.int64)
+    total = int(lib.sim_counts(n, read_rate, write_rate, float(duration),
+                               int(seed) & (2**64 - 1), counts))
+    ts = np.empty(total, dtype=np.float64)
+    pid = np.empty(total, dtype=np.int32)
+    op = np.empty(total, dtype=np.int8)
+    client = np.empty(total, dtype=np.int32)
+    lib.sim_fill(n, counts, read_rate, write_rate, locality, primary_node,
+                 client_pool, len(client_pool), float(duration),
+                 float(sim_start), int(seed) & (2**64 - 1), int(n_threads),
+                 ts, pid, op, client)
+    return ts, pid, op, client
+
+
+def parse_access_log_native(path: str):
+    """Fast access.log parse.  Returns (ts, op, path_strs, client_strs) with
+    paths/clients as Python string lists, or None when the native parser
+    cannot handle the file (quoted CSV) or the library is unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    pb = _i64(0)
+    cb = _i64(0)
+    rows = int(lib.log_scan(path.encode(), ctypes.byref(pb), ctypes.byref(cb)))
+    if rows < 0:
+        return None  # IO error or quoted CSV -> python fallback
+    ts = np.empty(rows, dtype=np.float64)
+    op = np.empty(rows, dtype=np.int8)
+    path_blob = np.empty(max(pb.value, 1), dtype=np.uint8)
+    client_blob = np.empty(max(cb.value, 1), dtype=np.uint8)
+    path_off = np.empty(rows + 1, dtype=np.int64)
+    client_off = np.empty(rows + 1, dtype=np.int64)
+    got = int(lib.log_fill(path.encode(), rows, int(pb.value), int(cb.value),
+                           ts, op, path_blob, path_off,
+                           client_blob, client_off))
+    if got != rows or np.isnan(ts).any():
+        # Re-read mismatch or a timestamp the native grammar rejects: let the
+        # python csv path handle (and properly diagnose) the file.
+        return None
+    pbytes = path_blob.tobytes()
+    cbytes = client_blob.tobytes()
+    paths = [pbytes[path_off[i]:path_off[i + 1]].decode("utf-8", "replace")
+             for i in range(rows)]
+    clients = [cbytes[client_off[i]:client_off[i + 1]].decode("utf-8", "replace")
+               for i in range(rows)]
+    return ts, op, paths, clients

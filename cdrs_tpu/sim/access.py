@@ -61,7 +61,11 @@ def simulate_access(
     manifest: Manifest,
     cfg: SimulatorConfig,
     sim_start: float | None = None,
+    engine: str = "numpy",
 ) -> EventLog:
+    """``engine='native'`` runs the threaded C++ generator (runtime/native.py)
+    — same distributional semantics, its own deterministic RNG stream; for
+    the 1B-event scale where even vectorized NumPy becomes the bottleneck."""
     rng = np.random.default_rng(cfg.seed)
     n = len(manifest)
     if sim_start is None:
@@ -69,6 +73,24 @@ def simulate_access(
         sim_start = time.time()
 
     read, write, loc = jittered_rates(manifest, cfg, rng)
+
+    if engine == "native":
+        from ..io.events import client_vocabulary
+        from ..runtime.native import simulate_events_native
+
+        clients, pool = client_vocabulary(manifest, cfg.clients)
+        # Unseeded runs must stay independent: derive a fresh 64-bit seed from
+        # the (entropy-seeded) numpy generator instead of pinning 0.
+        seed = int(cfg.seed) if cfg.seed is not None else int(
+            rng.integers(0, 2**63 - 1))
+        ts, pid, op, client = simulate_events_native(
+            read, write, loc, manifest.primary_node_id, pool,
+            cfg.duration_seconds, sim_start, seed=seed,
+        )
+        return EventLog(ts=ts, path_id=pid, op=op, client_id=client,
+                        clients=clients)
+    if engine != "numpy":
+        raise ValueError(f"unknown simulator engine {engine!r}")
     lam = read + write
     counts = rng.poisson(lam * cfg.duration_seconds)
     total = int(counts.sum())
@@ -80,14 +102,10 @@ def simulate_access(
     p_read = read / (lam + 1e-12)
     op = (rng.random(total) >= p_read[path_id]).astype(np.int8)  # 1 = WRITE
 
-    # Client vocabulary: manifest nodes first (ids align with primary_node_id),
-    # then any extra simulator clients.
-    clients = list(manifest.nodes)
-    for c in cfg.clients:
-        if c not in clients:
-            clients.append(c)
+    from ..io.events import client_vocabulary
+
+    clients, client_pool = client_vocabulary(manifest, cfg.clients)
     n_clients = len(cfg.clients)
-    client_pool = np.asarray([clients.index(c) for c in cfg.clients], dtype=np.int32)
 
     use_primary = rng.random(total) < loc[path_id]
     random_client = client_pool[rng.integers(0, n_clients, size=total)]

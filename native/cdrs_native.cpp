@@ -1,0 +1,325 @@
+// cdrs_native — native runtime components for the TPU framework.
+//
+// The reference implements its host-side data plane in interpreted Python:
+// a per-event Poisson loop (reference: src/access_simulator.py:16-38) and
+// csv-module log parsing (consumed by Spark).  This library provides the
+// native equivalents used by cdrs_tpu/runtime/native.py via ctypes:
+//
+//   * simulate_events — threaded Poisson access-event generation, sorted by
+//     timestamp, deterministic per (seed, file) regardless of thread count.
+//   * parse_access_log — access.log CSV reader emitting columnar arrays
+//     (epoch seconds, op, and offset-indexed path/client byte ranges that
+//     Python interns against the manifest).
+//
+// Exact distributional semantics match cdrs_tpu/sim/access.py (order-
+// statistics Poisson: count ~ Poisson(lambda*T), times uniform on [0, T)),
+// with a C++ RNG stream (std::mt19937_64) — deterministic but distinct from
+// NumPy's Philox; tests compare distributions, not bitstreams.
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <thread>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <parallel/algorithm>
+#define CDRS_SORT __gnu_parallel::stable_sort
+#else
+#define CDRS_SORT std::stable_sort
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Event simulation
+// ---------------------------------------------------------------------------
+
+// Phase 1: per-file Poisson event counts.  Returns total events.
+// counts_out: int64[n_files]
+int64_t sim_counts(int64_t n_files, const double* read_rate,
+                   const double* write_rate, double duration, uint64_t seed,
+                   int64_t* counts_out) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < n_files; ++i) {
+    std::mt19937_64 rng(seed * 0x9E3779B97F4A7C15ULL + (uint64_t)i);
+    double lam = (read_rate[i] + write_rate[i]) * duration;
+    int64_t c = 0;
+    if (lam > 0) {
+      std::poisson_distribution<int64_t> pois(lam);
+      c = pois(rng);
+    }
+    counts_out[i] = c;
+    total += c;
+  }
+  return total;
+}
+
+// Phase 2: fill event arrays (ts, pid, op, client), then sort by timestamp.
+// Deterministic per (seed, file): each file's events come from an RNG seeded
+// by (seed, i), independent of thread scheduling.  Arrays are caller-
+// allocated with the total from sim_counts.
+void sim_fill(int64_t n_files, const int64_t* counts, const double* read_rate,
+              const double* write_rate, const double* locality,
+              const int32_t* primary_node, const int32_t* client_pool,
+              int64_t n_pool, double duration, double sim_start, uint64_t seed,
+              int64_t n_threads, double* ts_out, int32_t* pid_out,
+              int8_t* op_out, int32_t* client_out) {
+  std::vector<int64_t> offsets(n_files + 1, 0);
+  for (int64_t i = 0; i < n_files; ++i) offsets[i + 1] = offsets[i] + counts[i];
+  const int64_t total = offsets[n_files];
+
+  if (n_threads <= 0) {
+    n_threads = (int64_t)std::thread::hardware_concurrency();
+    if (n_threads <= 0) n_threads = 1;
+  }
+
+  std::atomic<int64_t> next_file(0);
+  auto worker = [&]() {
+    for (;;) {
+      int64_t i = next_file.fetch_add(1);
+      if (i >= n_files) return;
+      // Re-seed as in sim_counts and discard the count draw (same
+      // distribution + same engine state consume the same variates), so the
+      // fill stream continues deterministically after it.
+      std::mt19937_64 rng(seed * 0x9E3779B97F4A7C15ULL + (uint64_t)i);
+      double lam = (read_rate[i] + write_rate[i]) * duration;
+      if (lam > 0) {
+        std::poisson_distribution<int64_t> pois(lam);
+        (void)pois(rng);
+      }
+      std::uniform_real_distribution<double> uni(0.0, 1.0);
+      const double p_read =
+          read_rate[i] / (read_rate[i] + write_rate[i] + 1e-12);
+      const double loc = locality[i];
+      const int32_t prim = primary_node[i];
+      for (int64_t j = offsets[i]; j < offsets[i + 1]; ++j) {
+        ts_out[j] = sim_start + uni(rng) * duration;
+        pid_out[j] = (int32_t)i;
+        op_out[j] = uni(rng) >= p_read ? 1 : 0;  // 1 = WRITE
+        if (n_pool <= 0 || uni(rng) < loc) {
+          client_out[j] = prim;
+        } else {
+          client_out[j] = client_pool[(int64_t)(uni(rng) * (double)n_pool) %
+                                      n_pool];
+        }
+      }
+    }
+  };
+  std::vector<std::thread> threads;
+  for (int64_t t = 0; t < n_threads; ++t) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+
+  // Global time sort (reference: access_simulator.py:60).  Sort an index
+  // permutation, then apply it column-by-column out of place.
+  std::vector<int64_t> idx(total);
+  for (int64_t i = 0; i < total; ++i) idx[i] = i;
+  CDRS_SORT(idx.begin(), idx.end(), [&](int64_t a, int64_t b) {
+    return ts_out[a] < ts_out[b];
+  });
+  std::vector<double> ts2(total);
+  std::vector<int32_t> i2(total);
+  for (int64_t i = 0; i < total; ++i) ts2[i] = ts_out[idx[i]];
+  std::memcpy(ts_out, ts2.data(), sizeof(double) * total);
+  for (int64_t i = 0; i < total; ++i) i2[i] = pid_out[idx[i]];
+  std::memcpy(pid_out, i2.data(), sizeof(int32_t) * total);
+  for (int64_t i = 0; i < total; ++i) i2[i] = client_out[idx[i]];
+  std::memcpy(client_out, i2.data(), sizeof(int32_t) * total);
+  std::vector<int8_t> o2(total);
+  for (int64_t i = 0; i < total; ++i) o2[i] = op_out[idx[i]];
+  std::memcpy(op_out, o2.data(), sizeof(int8_t) * total);
+}
+
+// ---------------------------------------------------------------------------
+// access.log CSV parsing
+// ---------------------------------------------------------------------------
+
+// days-from-civil (Howard Hinnant's public-domain algorithm shape): epoch days
+// for a proleptic Gregorian date.
+static int64_t days_from_civil(int64_t y, int64_t m, int64_t d) {
+  y -= m <= 2;
+  const int64_t era = (y >= 0 ? y : y - 399) / 400;
+  const int64_t yoe = y - era * 400;
+  const int64_t doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  const int64_t doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return era * 146097 + doe - 719468;
+}
+
+// Parse "YYYY-MM-DDTHH:MM:SS[.frac][Z|+HH:MM|-HH:MM]" -> epoch seconds.
+// Returns NaN on malformed input (matching Python parse_iso_ts's accepted
+// grammar; naive stamps are treated as UTC).
+static double parse_iso(const char* s, int64_t len) {
+  if (len < 19) return __builtin_nan("");
+  auto num = [&](int64_t off, int64_t n) {
+    int64_t v = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      char c = s[off + i];
+      if (c < '0' || c > '9') return (int64_t)-1;
+      v = v * 10 + (c - '0');
+    }
+    return v;
+  };
+  int64_t Y = num(0, 4), M = num(5, 2), D = num(8, 2);
+  int64_t h = num(11, 2), m = num(14, 2), sec = num(17, 2);
+  if (Y < 0 || M < 0 || D < 0 || h < 0 || m < 0 || sec < 0)
+    return __builtin_nan("");
+  double frac = 0.0;
+  int64_t i = 19;
+  if (i < len && s[i] == '.') {
+    double scale = 0.1;
+    for (++i; i < len && s[i] >= '0' && s[i] <= '9'; ++i) {
+      frac += (s[i] - '0') * scale;
+      scale *= 0.1;
+    }
+  }
+  double tz_off = 0.0;
+  if (i < len) {
+    if (s[i] == 'Z' && i + 1 == len) {
+      // UTC marker
+    } else if ((s[i] == '+' || s[i] == '-') && len - i >= 6 && s[i + 3] == ':') {
+      int64_t oh = num(i + 1, 2), om = num(i + 4, 2);
+      if (oh < 0 || om < 0 || len - i != 6) return __builtin_nan("");
+      tz_off = (double)(oh * 3600 + om * 60) * (s[i] == '+' ? 1.0 : -1.0);
+    } else {
+      return __builtin_nan("");  // trailing junk -> python fallback
+    }
+  }
+  return (double)(days_from_civil(Y, M, D) * 86400 + h * 3600 + m * 60 + sec) +
+         frac - tz_off;
+}
+
+// Phase 1: count data rows and total path/client byte lengths.
+// Returns row count, or -1 on IO error, -2 if the file uses CSV quoting,
+// -3 if a non-empty row has fewer than 4 fields (caller falls back to the
+// Python csv parser, which raises a proper diagnostic).
+int64_t log_scan(const char* path, int64_t* path_bytes, int64_t* client_bytes) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t rows = 0, pb = 0, cb = 0;
+  bool quoted = false, malformed = false;
+  std::vector<char> buf(1 << 20);
+  std::string line;
+  line.reserve(512);
+  size_t got;
+  std::string carry;
+  while ((got = std::fread(buf.data(), 1, buf.size(), f)) > 0) {
+    size_t start = 0;
+    for (size_t i = 0; i < got; ++i) {
+      if (buf[i] == '"') quoted = true;
+      if (buf[i] == '\n') {
+        std::string full = carry + std::string(buf.data() + start, i - start);
+        carry.clear();
+        start = i + 1;
+        if (full.empty()) continue;
+        // fields: ts,path,op,client,pid
+        size_t c1 = full.find(',');
+        size_t c2 = c1 == std::string::npos ? std::string::npos
+                                            : full.find(',', c1 + 1);
+        size_t c3 = c2 == std::string::npos ? std::string::npos
+                                            : full.find(',', c2 + 1);
+        if (c3 == std::string::npos) { malformed = true; continue; }
+        size_t c4 = full.find(',', c3 + 1);
+        if (c4 == std::string::npos) c4 = full.size();
+        pb += (int64_t)(c2 - c1 - 1);
+        cb += (int64_t)(c4 - c3 - 1);
+        ++rows;
+      }
+    }
+    carry.append(buf.data() + start, got - start);
+  }
+  std::fclose(f);
+  if (!carry.empty()) {
+    size_t c1 = carry.find(',');
+    size_t c2 = c1 == std::string::npos ? std::string::npos
+                                        : carry.find(',', c1 + 1);
+    size_t c3 = c2 == std::string::npos ? std::string::npos
+                                        : carry.find(',', c2 + 1);
+    if (c3 != std::string::npos) {
+      size_t c4 = carry.find(',', c3 + 1);
+      if (c4 == std::string::npos) c4 = carry.size();
+      pb += (int64_t)(c2 - c1 - 1);
+      cb += (int64_t)(c4 - c3 - 1);
+      ++rows;
+    } else {
+      malformed = true;
+    }
+  }
+  if (quoted) return -2;
+  if (malformed) return -3;
+  *path_bytes = pb;
+  *client_bytes = cb;
+  return rows;
+}
+
+// Phase 2: fill columnar output.  Path/client strings are concatenated into
+// byte blobs with (rows+1) offset arrays; Python slices + interns them.
+// Returns rows parsed, or -1 on IO error.
+int64_t log_fill(const char* path, int64_t max_rows, int64_t path_cap,
+                 int64_t client_cap, double* ts_out,
+                 int8_t* op_out, char* path_blob, int64_t* path_off,
+                 char* client_blob, int64_t* client_off) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t row = 0, ppos = 0, cpos = 0;
+  bool overflow = false;
+  path_off[0] = 0;
+  client_off[0] = 0;
+  std::vector<char> buf(1 << 20);
+  std::string carry;
+  size_t got;
+  auto handle = [&](const char* s, size_t len) {
+    if (len == 0 || row >= max_rows) return;
+    const char* c1 = (const char*)memchr(s, ',', len);
+    if (!c1) return;
+    const char* c2 = (const char*)memchr(c1 + 1, ',', len - (c1 + 1 - s));
+    if (!c2) return;
+    const char* c3 = (const char*)memchr(c2 + 1, ',', len - (c2 + 1 - s));
+    if (!c3) return;
+    const char* c4 = (const char*)memchr(c3 + 1, ',', len - (c3 + 1 - s));
+    const char* end4 = c4 ? c4 : s + len;
+    size_t plen = c2 - c1 - 1;
+    size_t clen = end4 - c3 - 1;
+    // Bounds vs the scan-pass sizing: a file rewritten between the two
+    // passes must not overflow the caller's numpy buffers.
+    if (ppos + (int64_t)plen > path_cap || cpos + (int64_t)clen > client_cap) {
+      overflow = true;
+      return;
+    }
+    ts_out[row] = parse_iso(s, c1 - s);
+    std::memcpy(path_blob + ppos, c1 + 1, plen);
+    ppos += (int64_t)plen;
+    // op field: "WRITE" -> 1 else 0
+    op_out[row] = (c3 - c2 - 1 == 5 && std::memcmp(c2 + 1, "WRITE", 5) == 0)
+                      ? 1 : 0;
+    std::memcpy(client_blob + cpos, c3 + 1, clen);
+    cpos += (int64_t)clen;
+    ++row;
+    path_off[row] = ppos;
+    client_off[row] = cpos;
+  };
+  while ((got = std::fread(buf.data(), 1, buf.size(), f)) > 0) {
+    size_t start = 0;
+    for (size_t i = 0; i < got; ++i) {
+      if (buf[i] == '\n') {
+        if (!carry.empty()) {
+          carry.append(buf.data() + start, i - start);
+          handle(carry.data(), carry.size());
+          carry.clear();
+        } else {
+          handle(buf.data() + start, i - start);
+        }
+        start = i + 1;
+      }
+    }
+    carry.append(buf.data() + start, got - start);
+  }
+  if (!carry.empty()) handle(carry.data(), carry.size());
+  std::fclose(f);
+  if (overflow) return -1;
+  return row;
+}
+
+}  // extern "C"

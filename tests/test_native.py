@@ -1,0 +1,138 @@
+"""Native C++ runtime components (native/cdrs_native.cpp via ctypes).
+
+Tests skip when the library cannot be built (no g++/make on the host).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.runtime.native import (
+    native_available,
+    parse_access_log_native,
+    simulate_events_native,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native library unavailable (g++/make?)")
+
+
+def test_simulator_schema_and_determinism():
+    n = 200
+    rng = np.random.default_rng(0)
+    read = rng.uniform(0.1, 1.0, n)
+    write = rng.uniform(0.0, 0.3, n)
+    loc = rng.uniform(0.0, 1.0, n)
+    prim = rng.integers(0, 3, n).astype(np.int32)
+    pool = np.arange(4, dtype=np.int32)
+
+    ts, pid, op, cl = simulate_events_native(read, write, loc, prim, pool,
+                                             duration=120.0, sim_start=1.7e9,
+                                             seed=7)
+    assert (np.diff(ts) >= 0).all()           # globally time-sorted
+    assert ts.min() >= 1.7e9 and ts.max() < 1.7e9 + 120.0
+    assert set(np.unique(op)) <= {0, 1}
+    assert pid.min() >= 0 and pid.max() < n
+    assert cl.min() >= 0 and cl.max() < 4
+
+    # Deterministic across thread counts (per-file seeded RNG).
+    ts2, pid2, op2, cl2 = simulate_events_native(
+        read, write, loc, prim, pool, 120.0, 1.7e9, seed=7, n_threads=3)
+    assert (ts == ts2).all() and (pid == pid2).all()
+    assert (op == op2).all() and (cl == cl2).all()
+
+
+def test_simulator_rate_statistics():
+    """Event counts and op mix must track the Poisson parameters."""
+    n = 500
+    read = np.full(n, 0.8)
+    write = np.full(n, 0.2)
+    loc = np.full(n, 1.0)   # always primary
+    prim = np.full(n, 2, dtype=np.int32)
+    pool = np.arange(4, dtype=np.int32)
+    T = 200.0
+    ts, pid, op, cl = simulate_events_native(read, write, loc, prim, pool,
+                                             T, 0.0, seed=1)
+    expected = n * 1.0 * T
+    assert abs(len(ts) - expected) < 5 * np.sqrt(expected)
+    assert abs(float((op == 1).mean()) - 0.2) < 0.01
+    assert (cl == 2).all()  # locality 1.0 -> always the primary node
+
+
+def test_log_parser_matches_python_reader():
+    from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
+    from cdrs_tpu.io.events import EventLog
+    from cdrs_tpu.sim.access import simulate_access
+    from cdrs_tpu.sim.generator import generate_population
+
+    m = generate_population(GeneratorConfig(n_files=60, seed=5))
+    ev = simulate_access(m, SimulatorConfig(duration_seconds=45.0, seed=6))
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "access.log")
+        ev.write_csv(p, m)
+        py = EventLog.read_csv(p, m, native=False)
+        nat = EventLog.read_csv(p, m, native=True)
+    np.testing.assert_allclose(nat.ts, py.ts, atol=1e-9)
+    assert (nat.path_id == py.path_id).all()
+    assert (nat.op == py.op).all()
+    assert [nat.clients[i] for i in nat.client_id] == \
+           [py.clients[i] for i in py.client_id]
+
+
+def test_log_parser_quoted_csv_falls_back():
+    """Quoted rows (comma in path) must not silently mis-parse: the native
+    scanner bails and the python csv reader handles them."""
+    from cdrs_tpu.io.events import EventLog, Manifest
+
+    m = Manifest(paths=["/a,b.bin"], creation_ts=np.array([0.0]),
+                 primary_node_id=np.array([0], dtype=np.int32),
+                 size_bytes=np.array([1], dtype=np.int64),
+                 category=["hot"], nodes=["dn1"])
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "access.log")
+        with open(p, "w") as f:
+            f.write('2026-01-01T00:00:00.000Z,"/a,b.bin",READ,dn1,1000\n')
+        assert parse_access_log_native(p) is None  # refuses quoted csv
+        ev = EventLog.read_csv(p, m)  # auto-falls back to python
+    assert len(ev) == 1 and ev.path_id[0] == 0
+
+
+def test_native_engine_via_simulate_access():
+    from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
+    from cdrs_tpu.sim.access import simulate_access
+    from cdrs_tpu.sim.generator import generate_population
+
+    m = generate_population(GeneratorConfig(n_files=50, seed=2))
+    ev = simulate_access(m, SimulatorConfig(duration_seconds=30.0, seed=3),
+                         engine="native")
+    assert len(ev) > 0
+    assert (np.diff(ev.ts) >= 0).all()
+    # client ids must be valid indices into the shared vocabulary
+    assert ev.client_id.max() < len(ev.clients)
+
+
+def test_parse_iso_timezone_offsets(tmp_path):
+    """Offset-bearing timestamps must match Python's fromisoformat epoch."""
+    from cdrs_tpu.io.events import parse_iso_ts
+
+    rows = [
+        "2026-01-01T05:30:00.000+05:30,/f,READ,dn1,1",
+        "2026-01-01T00:00:00.250Z,/f,WRITE,dn1,2",
+        "2025-12-31T19:00:00-05:00,/f,READ,dn1,3",
+    ]
+    p = tmp_path / "tz.log"
+    p.write_text("\n".join(rows) + "\n")
+    parsed = parse_access_log_native(str(p))
+    assert parsed is not None
+    ts, op, paths, clients = parsed
+    want = [parse_iso_ts(r.split(",")[0]) for r in rows]
+    np.testing.assert_allclose(ts, want, atol=1e-9)
+
+
+def test_malformed_rows_fall_back(tmp_path):
+    """Short/garbled rows make the native scanner bail (python path raises)."""
+    p = tmp_path / "bad.log"
+    p.write_text("2026-01-01T00:00:00.000Z,/f,READ\n")  # only 3 fields
+    assert parse_access_log_native(str(p)) is None
