@@ -147,9 +147,49 @@ def _cmd_pipeline(args) -> int:
         kmeans=KMeansConfig(k=args.k, seed=args.seed),
         scoring=ScoringConfig(compute_global_medians_from_data=args.medians_from_data),
         mesh_shape=_parse_mesh(args.mesh),
+        evaluate=args.evaluate,
     )
     result = run_pipeline(cfg, outdir=args.outdir)
     print(json.dumps(result.summary(), indent=2))
+    return 0
+
+
+def _cmd_evaluate(args) -> int:
+    """Apply decided replication factors on the simulated cluster and report
+    locality/load/storage vs uniform baselines (the reference decides factors
+    but never applies them — SURVEY.md §6)."""
+    import csv as _csv
+
+    from .cluster import ClusterTopology, compare_policies
+    from .io.events import EventLog, Manifest
+
+    manifest = Manifest.read_csv(args.manifest)
+    events = EventLog.read_csv(args.access_log, manifest)
+
+    scoring = ScoringConfig()
+    rf = np.full(len(manifest), args.default_rf, dtype=np.int32)
+    rows = matched = 0
+    with open(args.assignments_csv, newline="") as f:
+        for row in _csv.DictReader(f):
+            rows += 1
+            i = manifest.path_to_id.get(row["path"])
+            r = scoring.replication_factors.get(row.get("category"))
+            if i is not None and r is not None:
+                rf[i] = r
+                matched += 1
+    if rows and matched == 0:
+        print(f"error: no row of {args.assignments_csv} matched a manifest "
+              f"path with a known category — is this the cluster "
+              f"--assignments_csv output?", file=sys.stderr)
+        return 1
+    if matched < rows:
+        print(f"warning: {rows - matched}/{rows} assignment rows ignored "
+              f"(unknown path or category)", file=sys.stderr)
+
+    nodes = tuple(args.nodes.split(",")) if args.nodes else tuple(manifest.nodes)
+    out = compare_policies(manifest, events, rf,
+                           topology=ClusterTopology(nodes=nodes))
+    print(json.dumps(out, indent=2))
     return 0
 
 
@@ -258,8 +298,23 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--outdir", default="output")
     p.add_argument("--medians_from_data", action="store_true")
+    p.add_argument("--evaluate", action="store_true",
+                   help="apply decided rf on the simulated cluster and report "
+                        "locality/load/storage vs uniform baselines")
     _add_backend_arg(p)
     p.set_defaults(fn=_cmd_pipeline)
+
+    p = sub.add_parser("evaluate", help="apply replication factors on the "
+                       "simulated cluster; report locality/load/storage")
+    p.add_argument("--manifest", required=True)
+    p.add_argument("--access_log", required=True)
+    p.add_argument("--assignments_csv", required=True,
+                   help="per-file path,cluster,category table "
+                        "(cluster --assignments_csv output)")
+    p.add_argument("--nodes", default=None,
+                   help="datanode names (default: manifest nodes)")
+    p.add_argument("--default_rf", type=int, default=1)
+    p.set_defaults(fn=_cmd_evaluate)
 
     p = sub.add_parser("stream", help="stream the access log in batches, then cluster")
     p.add_argument("--manifest", required=True)

@@ -1,0 +1,19 @@
+"""Simulated distributed file system — the reference's L0 substrate, closed-loop.
+
+The reference stands up a 6-container Hadoop/Spark cluster purely as a place
+for files to live (docker/docker-compose.yml:4-79) and *decides* replication
+factors without ever applying them (hadoop.env pins dfs.replication=1 —
+SURVEY.md §6 "no actual replication performed").  This package replaces that
+role analytically and goes one step further: it applies the decided factors
+(block placement over simulated datanodes) and replays the access log against
+the placement to measure what the policy actually buys — read locality,
+load balance, and storage cost (SURVEY.md §4.2's missing validation loop).
+"""
+
+from .placement import ClusterTopology, PlacementResult, place_replicas
+from .evaluate import PolicyMetrics, evaluate_placement, compare_policies
+
+__all__ = [
+    "ClusterTopology", "PlacementResult", "place_replicas",
+    "PolicyMetrics", "evaluate_placement", "compare_policies",
+]

@@ -1,0 +1,146 @@
+"""Policy evaluation: replay the access log against a replica placement.
+
+The reference plants ground truth and decides replication factors but never
+measures what they achieve (SURVEY.md §4.2, §6 "pipeline decides factors but
+never applies them").  This module replays the simulated access log against a
+placement and reports:
+
+* **read locality** — fraction of reads whose client holds a replica
+  (the quantity the paper's Hot/Shared categories exist to improve);
+* **load balance** — reads served per node (local reads served locally,
+  remote reads by a seeded-random replica holder), writes fanned out to every
+  replica (the HDFS write pipeline); balance = max/mean;
+* **storage** — bytes per node including replicas.
+
+``compare_policies`` puts the clustering-driven factors side by side with
+uniform baselines (dfs.replication=1, the reference's sim-cluster setting,
+and uniform 3, the HDFS default).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..io.events import EventLog, Manifest
+from .placement import ClusterTopology, PlacementResult, place_replicas
+
+__all__ = ["PolicyMetrics", "evaluate_placement", "compare_policies"]
+
+
+@dataclass
+class PolicyMetrics:
+    read_locality: float          # local reads / total reads
+    reads_per_node: np.ndarray    # (#nodes,)
+    writes_per_node: np.ndarray   # (#nodes,) replica write amplification incl.
+    load_balance: float           # max/mean of total ops per node (1.0 = even)
+    storage_per_node: np.ndarray  # (#nodes,) bytes
+    total_storage: int
+    n_reads: int
+    n_writes: int
+
+    def summary(self) -> dict:
+        return {
+            "read_locality": self.read_locality,
+            "load_balance": self.load_balance,
+            "total_storage_bytes": int(self.total_storage),
+            "reads_per_node": self.reads_per_node.tolist(),
+            "writes_per_node": self.writes_per_node.tolist(),
+            "storage_per_node": self.storage_per_node.tolist(),
+            "n_reads": self.n_reads,
+            "n_writes": self.n_writes,
+        }
+
+
+def _client_to_topology(events: EventLog, topology: ClusterTopology) -> np.ndarray:
+    node_by_name = {nm: i for i, nm in enumerate(topology.nodes)}
+    lut = np.asarray([
+        node_by_name.get(c, -1) for c in events.clients
+    ], dtype=np.int32)
+    return lut[events.client_id]
+
+
+def evaluate_placement(
+    manifest: Manifest,
+    events: EventLog,
+    placement: PlacementResult,
+    seed: int | None = 0,
+) -> PolicyMetrics:
+    topology = placement.topology
+    n_nodes = len(topology)
+
+    keep = events.path_id >= 0
+    pid = events.path_id[keep]
+    op = events.op[keep]
+    client = _client_to_topology(events, topology)[keep]
+
+    reads = op == 0
+    writes = ~reads
+
+    rmap = placement.replica_map[pid]                    # (e, max_rf)
+    # A client outside the topology (client == -1) must never count as local —
+    # it would otherwise match the -1 padding slots of mixed-rf placements.
+    holds = (rmap == client[:, None]).any(axis=1) & (client >= 0)
+
+    # Reads: local if the client holds a replica; otherwise served by a
+    # seeded-random replica of the file.
+    rng = np.random.default_rng(seed)
+    rf = placement.rf[pid]
+    pick = (rng.random(len(pid)) * rf).astype(np.int32)
+    remote_server = rmap[np.arange(len(pid)), pick]
+    server = np.where(holds, client, remote_server)
+
+    read_server = server[reads]
+    reads_per_node = np.bincount(read_server[read_server >= 0],
+                                 minlength=n_nodes).astype(np.int64)
+    n_reads = int(reads.sum())
+    read_locality = float(holds[reads].mean()) if n_reads else 1.0
+
+    # Writes: every replica receives the write (HDFS pipeline).
+    wmap = rmap[writes]
+    writes_per_node = np.bincount(
+        wmap[wmap >= 0].ravel(), minlength=n_nodes).astype(np.int64)
+    n_writes = int(writes.sum())
+
+    total_ops = reads_per_node + writes_per_node
+    mean_ops = total_ops.mean() if total_ops.sum() else 1.0
+    load_balance = float(total_ops.max() / max(mean_ops, 1e-12))
+
+    return PolicyMetrics(
+        read_locality=read_locality,
+        reads_per_node=reads_per_node,
+        writes_per_node=writes_per_node,
+        load_balance=load_balance,
+        storage_per_node=placement.storage_per_node,
+        total_storage=int(placement.storage_per_node.sum()),
+        n_reads=n_reads,
+        n_writes=n_writes,
+    )
+
+
+def compare_policies(
+    manifest: Manifest,
+    events: EventLog,
+    policy_rf: np.ndarray,
+    topology: ClusterTopology | None = None,
+    baselines: dict[str, int] | None = None,
+    seed: int | None = 0,
+) -> dict:
+    """Side-by-side metrics: clustering-driven rf vs uniform baselines.
+
+    Default baselines: uniform 1 (the reference sim cluster's
+    dfs.replication=1, docker/hadoop.env:2) and uniform 3 (HDFS default).
+    """
+    topology = topology or ClusterTopology()
+    baselines = baselines if baselines is not None else {"uniform_1": 1,
+                                                         "uniform_3": 3}
+    out = {}
+    for name, rf in baselines.items():
+        placement = place_replicas(
+            manifest, np.full(len(manifest), rf, dtype=np.int32),
+            topology, seed)
+        out[name] = evaluate_placement(manifest, events, placement, seed).summary()
+    placement = place_replicas(manifest, policy_rf, topology, seed)
+    out["policy"] = evaluate_placement(manifest, events, placement, seed).summary()
+    return out

@@ -1,0 +1,96 @@
+"""Replica placement over simulated datanodes.
+
+HDFS-like policy, fully vectorized: replica 0 lives on the file's primary
+node (the reference manifest's ``primary_node`` column, generator.py:44);
+additional replicas go to distinct other nodes chosen by a seeded random
+permutation per file (the statistical shape of HDFS's random target chooser,
+minus rack topology).  Deterministic given (manifest, rf, seed).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..io.events import Manifest
+
+__all__ = ["ClusterTopology", "PlacementResult", "place_replicas"]
+
+
+@dataclass
+class ClusterTopology:
+    """Datanode set.  The reference's compose file runs one real datanode and
+    imagines three (SURVEY.md §5 note); here the node set is explicit."""
+
+    nodes: tuple[str, ...] = ("dn1", "dn2", "dn3")
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+
+@dataclass
+class PlacementResult:
+    """Replica assignment: (n, max_rf) node ids, -1 where rf < max_rf."""
+
+    replica_map: np.ndarray          # (n, max_rf) int32
+    rf: np.ndarray                   # (n,) int32 effective rf (capped at #nodes)
+    topology: ClusterTopology
+    storage_per_node: np.ndarray = field(default=None)  # (#nodes,) bytes
+
+    def holds(self, pid: np.ndarray, node: np.ndarray) -> np.ndarray:
+        """Bool per event: does ``node`` hold a replica of file ``pid``?"""
+        return (self.replica_map[pid] == node[:, None]).any(axis=1)
+
+
+def place_replicas(
+    manifest: Manifest,
+    rf_per_file: np.ndarray,
+    topology: ClusterTopology | None = None,
+    seed: int | None = 0,
+) -> PlacementResult:
+    """Place ``rf_per_file`` replicas of each file onto the topology.
+
+    ``rf`` is capped at the node count (HDFS behaviour for small clusters).
+    Replica 0 is the primary node; the remaining ``rf-1`` are drawn without
+    replacement from the other nodes via per-file random priority sort.
+    """
+    topology = topology or ClusterTopology()
+    n = len(manifest)
+    n_nodes = len(topology)
+    node_by_name = {nm: i for i, nm in enumerate(topology.nodes)}
+
+    # Manifest primary ids index manifest.nodes; remap onto the topology.
+    # Unknown nodes spread over the topology via a *stable* hash (Python's
+    # str hash is salted per process and would break run-to-run determinism).
+    import zlib
+
+    primary = np.asarray([
+        node_by_name.get(manifest.nodes[i],
+                         zlib.crc32(manifest.nodes[i].encode()) % n_nodes)
+        for i in manifest.primary_node_id
+    ], dtype=np.int32)
+
+    rf = np.minimum(np.asarray(rf_per_file, dtype=np.int32), n_nodes)
+    rf = np.maximum(rf, 1)
+    max_rf = int(rf.max())
+
+    rng = np.random.default_rng(seed)
+    # Random priorities per (file, node); primary forced to the front.
+    prio = rng.random((n, n_nodes))
+    prio[np.arange(n), primary] = -1.0          # sorts first
+    order = np.argsort(prio, axis=1).astype(np.int32)  # (n, n_nodes)
+
+    replica_map = order[:, :max_rf].copy()
+    mask = np.arange(max_rf)[None, :] < rf[:, None]
+    replica_map[~mask] = -1
+
+    storage = np.zeros(n_nodes, dtype=np.int64)
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+    for j in range(max_rf):
+        col = replica_map[:, j]
+        sel = col >= 0
+        np.add.at(storage, col[sel], sizes[sel])
+
+    return PlacementResult(replica_map=replica_map, rf=rf, topology=topology,
+                           storage_per_node=storage)

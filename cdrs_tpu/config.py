@@ -219,6 +219,10 @@ class PipelineConfig:
     scoring: ScoringConfig = field(default_factory=ScoringConfig)
     #: Mesh shape for the jax backend, e.g. {"data": 8} or {"data": 4, "model": 2}.
     mesh_shape: dict[str, int] | None = None
+    #: When True, apply the decided replication factors on the simulated
+    #: cluster and report locality/load/storage vs uniform baselines
+    #: (cdrs_tpu/cluster — the loop the reference never closes).
+    evaluate: bool = False
 
     def replace(self, **kwargs) -> "PipelineConfig":
         return dataclasses.replace(self, **kwargs)

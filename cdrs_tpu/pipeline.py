@@ -28,15 +28,19 @@ class PipelineResult:
     n_files: int
     n_events: int
     planted_accuracy: float | None
+    evaluation: dict | None = None
 
     def summary(self) -> dict:
-        return {
+        out = {
             "n_files": self.n_files,
             "n_events": self.n_events,
             "categories": {f"C{j}": c for j, c in enumerate(self.decision.categories)},
             "planted_accuracy": self.planted_accuracy,
             **self.metrics.records,
         }
+        if self.evaluation is not None:
+            out["evaluation"] = self.evaluation
+        return out
 
 
 def recovery_accuracy(decision: ClusterDecision, planted: list[str]) -> float | None:
@@ -88,6 +92,17 @@ def run_pipeline(cfg: PipelineConfig, outdir: str | None = None) -> PipelineResu
     accuracy = recovery_accuracy(decision, manifest.category)
     metrics.record("planted_accuracy", accuracy)
 
+    evaluation = None
+    if cfg.evaluate:
+        from .cluster import ClusterTopology, compare_policies
+
+        with metrics.timer("evaluate"):
+            rf = decision.replication_factor_per_file(cfg.scoring)
+            evaluation = compare_policies(
+                manifest, events, rf,
+                topology=ClusterTopology(nodes=tuple(manifest.nodes)),
+            )
+
     if outdir:
         os.makedirs(outdir, exist_ok=True)
         with metrics.timer("io"):
@@ -101,5 +116,5 @@ def run_pipeline(cfg: PipelineConfig, outdir: str | None = None) -> PipelineResu
     return PipelineResult(
         decision=decision, metrics=metrics,
         n_files=len(manifest), n_events=len(events),
-        planted_accuracy=accuracy,
+        planted_accuracy=accuracy, evaluation=evaluation,
     )
