@@ -1,0 +1,67 @@
+# Orchestration entry points — the reference's Makefile role (SURVEY.md §2 #11)
+# mapped onto the TPU-native framework.  Where the reference drives a Docker
+# Hadoop/Spark cluster (make up/down/gen/sim/spark/pipeline/output), here every
+# stage is a `cdrs` CLI subcommand and the "cluster" is a jax.sharding.Mesh —
+# `make up` just verifies the device mesh is reachable.
+#
+# Knobs (reference: run_pipeline.sh NUM_FILES/DURATION, Makefile:36,41):
+NUM_FILES ?= 200
+DURATION ?= 600
+K ?= 4
+OUTDIR ?= output
+BACKEND ?= numpy
+PY ?= python
+
+CDRS := $(PY) -m cdrs_tpu
+
+.PHONY: up gen sim features cluster pipeline evaluate stream bench test native clean
+
+up:  ## show the device mesh (replaces docker-compose up)
+	$(PY) -c "import jax; print('devices:', jax.devices())"
+
+gen:  ## synthetic population -> $(OUTDIR)/metadata.csv (reference: make gen)
+	mkdir -p $(OUTDIR)
+	$(CDRS) gen --n $(NUM_FILES) --out_manifest $(OUTDIR)/metadata.csv
+
+sim: ## Poisson access log -> $(OUTDIR)/access.log (reference: make sim)
+	$(CDRS) simulate --manifest $(OUTDIR)/metadata.csv \
+	  --out $(OUTDIR)/access.log --duration_seconds $(DURATION)
+
+features: ## five features -> $(OUTDIR)/features_out (reference: make spark)
+	$(CDRS) features --manifest $(OUTDIR)/metadata.csv \
+	  --access_log $(OUTDIR)/access.log --out $(OUTDIR)/features_out/ \
+	  --backend $(BACKEND)
+
+cluster: ## KMeans++ + scoring -> final_categories.csv (reference: main.py)
+	$(CDRS) cluster --input_path $(OUTDIR)/features_out/ --k $(K) \
+	  --output_csv $(OUTDIR)/final_categories.csv \
+	  --assignments_csv $(OUTDIR)/assignments.csv \
+	  --medians_from_data --backend $(BACKEND)
+
+evaluate: ## apply rf on the simulated cluster, report locality/load/storage
+	$(CDRS) evaluate --manifest $(OUTDIR)/metadata.csv \
+	  --access_log $(OUTDIR)/access.log \
+	  --assignments_csv $(OUTDIR)/assignments.csv
+
+pipeline: ## end-to-end in one process (reference: make pipeline)
+	$(CDRS) pipeline --n $(NUM_FILES) --duration_seconds $(DURATION) \
+	  --k $(K) --outdir $(OUTDIR) --medians_from_data --evaluate \
+	  --backend $(BACKEND)
+
+stream: ## streaming variant over $(OUTDIR)/access.log
+	$(CDRS) stream --manifest $(OUTDIR)/metadata.csv \
+	  --access_log $(OUTDIR)/access.log --k $(K) \
+	  --output_csv $(OUTDIR)/final_categories.csv --medians_from_data
+
+bench: ## one-line benchmark JSON (BASELINE.md configs)
+	$(PY) bench.py
+
+test:
+	$(PY) -m pytest tests/ -q
+
+native: ## build the C++ runtime library
+	$(MAKE) -C native
+
+clean:
+	rm -rf $(OUTDIR)
+	$(MAKE) -C native clean

@@ -79,9 +79,7 @@ def evaluate_placement(
     writes = ~reads
 
     rmap = placement.replica_map[pid]                    # (e, max_rf)
-    # A client outside the topology (client == -1) must never count as local —
-    # it would otherwise match the -1 padding slots of mixed-rf placements.
-    holds = (rmap == client[:, None]).any(axis=1) & (client >= 0)
+    holds = placement.holds(pid, client)
 
     # Reads: local if the client holds a replica; otherwise served by a
     # seeded-random replica of the file.

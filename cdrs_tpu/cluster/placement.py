@@ -39,8 +39,12 @@ class PlacementResult:
     storage_per_node: np.ndarray = field(default=None)  # (#nodes,) bytes
 
     def holds(self, pid: np.ndarray, node: np.ndarray) -> np.ndarray:
-        """Bool per event: does ``node`` hold a replica of file ``pid``?"""
-        return (self.replica_map[pid] == node[:, None]).any(axis=1)
+        """Bool per event: does ``node`` hold a replica of file ``pid``?
+
+        ``node < 0`` (a client outside the topology) is never a holder — it
+        must not match the -1 padding slots of mixed-rf rows.
+        """
+        return (self.replica_map[pid] == node[:, None]).any(axis=1) & (node >= 0)
 
 
 def place_replicas(
@@ -60,16 +64,17 @@ def place_replicas(
     n_nodes = len(topology)
     node_by_name = {nm: i for i, nm in enumerate(topology.nodes)}
 
-    # Manifest primary ids index manifest.nodes; remap onto the topology.
-    # Unknown nodes spread over the topology via a *stable* hash (Python's
-    # str hash is salted per process and would break run-to-run determinism).
+    # Manifest primary ids index manifest.nodes; remap onto the topology via
+    # a per-name LUT (O(vocabulary), not O(files)).  Unknown nodes spread over
+    # the topology via a *stable* hash (Python's str hash is salted per
+    # process and would break run-to-run determinism).
     import zlib
 
-    primary = np.asarray([
-        node_by_name.get(manifest.nodes[i],
-                         zlib.crc32(manifest.nodes[i].encode()) % n_nodes)
-        for i in manifest.primary_node_id
+    lut = np.asarray([
+        node_by_name.get(nm, zlib.crc32(nm.encode()) % n_nodes)
+        for nm in manifest.nodes
     ], dtype=np.int32)
+    primary = lut[manifest.primary_node_id]
 
     rf = np.minimum(np.asarray(rf_per_file, dtype=np.int32), n_nodes)
     rf = np.maximum(rf, 1)

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# End-to-end pipeline driver — the reference's run_pipeline.sh role
+# (SURVEY.md §3.1) without the Docker/Hadoop machinery: every stage is a
+# `cdrs` CLI call over durable file boundaries (metadata.csv -> access.log ->
+# features_out -> final_categories.csv), and — unlike the reference, which
+# stops at features — it runs clustering AND applies the decided replication
+# factors on the simulated cluster.
+#
+# Usage: ./run_pipeline.sh [NUM_FILES] [DURATION_SECONDS]
+set -euo pipefail
+
+NUM_FILES="${1:-200}"
+DURATION="${2:-600}"
+K="${K:-4}"
+OUTDIR="${OUTDIR:-output}"
+BACKEND="${BACKEND:-numpy}"
+PY="${PY:-python}"
+
+cd "$(dirname "$0")"
+mkdir -p "$OUTDIR"
+
+info() { echo "[run_pipeline] $*"; }
+
+info "1/5 generating $NUM_FILES files -> $OUTDIR/metadata.csv"
+$PY -m cdrs_tpu gen --n "$NUM_FILES" --out_manifest "$OUTDIR/metadata.csv"
+
+info "2/5 simulating $DURATION s of access events -> $OUTDIR/access.log"
+$PY -m cdrs_tpu simulate --manifest "$OUTDIR/metadata.csv" \
+  --out "$OUTDIR/access.log" --duration_seconds "$DURATION"
+
+info "3/5 extracting features -> $OUTDIR/features_out/"
+$PY -m cdrs_tpu features --manifest "$OUTDIR/metadata.csv" \
+  --access_log "$OUTDIR/access.log" --out "$OUTDIR/features_out/" \
+  --backend "$BACKEND"
+
+info "4/5 clustering + scoring -> $OUTDIR/final_categories.csv"
+$PY -m cdrs_tpu cluster --input_path "$OUTDIR/features_out/" --k "$K" \
+  --output_csv "$OUTDIR/final_categories.csv" \
+  --assignments_csv "$OUTDIR/assignments.csv" \
+  --medians_from_data --backend "$BACKEND"
+
+info "5/5 applying replication factors on the simulated cluster"
+$PY -m cdrs_tpu evaluate --manifest "$OUTDIR/metadata.csv" \
+  --access_log "$OUTDIR/access.log" \
+  --assignments_csv "$OUTDIR/assignments.csv"
+
+info "done — outputs in $OUTDIR/"
