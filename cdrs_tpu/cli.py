@@ -149,7 +149,10 @@ def _cmd_pipeline(args) -> int:
         mesh_shape=_parse_mesh(args.mesh),
         evaluate=args.evaluate,
     )
-    result = run_pipeline(cfg, outdir=args.outdir)
+    from .utils.profiling import trace_region
+
+    with trace_region(args.profile):
+        result = run_pipeline(cfg, outdir=args.outdir)
     print(json.dumps(result.summary(), indent=2))
     return 0
 
@@ -301,6 +304,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--evaluate", action="store_true",
                    help="apply decided rf on the simulated cluster and report "
                         "locality/load/storage vs uniform baselines")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace (TensorBoard/Perfetto)")
     _add_backend_arg(p)
     p.set_defaults(fn=_cmd_pipeline)
 

@@ -219,8 +219,8 @@ def _assign_only(x, c, chunk_rows):
     return labels.reshape(n_loc)
 
 
-def _lloyd_local(x, w, centroids, key, *, k, n_valid, tol, max_iter,
-                 chunk_rows=None, update="matmul"):
+def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
+                 max_iter, chunk_rows=None, update="matmul"):
     """Lloyd loop, shard-local view.  Returns (centroids, labels, iters, shift).
 
     Labels are the assignment against the centroids *before* the final update
@@ -235,16 +235,19 @@ def _lloyd_local(x, w, centroids, key, *, k, n_valid, tol, max_iter,
     offset = rank * n_loc
 
     def cond(carry):
-        _, _, _, it, shift = carry
+        _, _, it, shift = carry
         return (it < max_iter) & ((it == 0) | (shift >= tol))
 
     def body(carry):
-        c, _, key, it, _ = carry
+        c, _, it, _ = carry
         _, sums, counts = _assign_reduce(x, w, c, k, chunk_rows, update,
                                          n_valid=n_valid)
         sums = lax.psum(sums, DATA_AXIS)
         counts = lax.psum(counts, DATA_AXIS)
-        key, sub = jax.random.split(key)
+        # Reseed key depends on the GLOBAL iteration index (iter_offset + it),
+        # not on a per-call split chain — blocked/checkpointed runs draw the
+        # same stream as uninterrupted ones (utils/checkpoint.py).
+        sub = jax.random.fold_in(key, iter_offset + it)
 
         def with_reseed(_):
             # Seeded empty-cluster reseed: one uniform global index per
@@ -271,22 +274,21 @@ def _lloyd_local(x, w, centroids, key, *, k, n_valid, tol, max_iter,
 
         new_c = lax.cond(jnp.any(counts == 0), with_reseed, no_empty, None)
         shift = jnp.sqrt(jnp.sum((new_c - c) ** 2))
-        return new_c, c, key, it + 1, shift
+        return new_c, c, it + 1, shift
 
     init = (
         centroids,
         centroids,
-        key,
         jnp.array(0, jnp.int32),
         jnp.array(jnp.inf, x.dtype),
     )
-    c, c_prev, _, it, shift = lax.while_loop(cond, body, init)
+    c, c_prev, it, shift = lax.while_loop(cond, body, init)
     labels = _assign_only(x, c_prev, chunk_rows)
     return c, labels, it, shift
 
 
-def _lloyd_local_2d(x, w, c_loc, key, *, k, n_valid, tol, max_iter,
-                    chunk_rows=None, update="matmul"):
+def _lloyd_local_2d(x, w, c_loc, key, iter_offset, *, k, n_valid, tol,
+                    max_iter, chunk_rows=None, update="matmul"):
     """Lloyd loop on a 2D (data, model) mesh — tensor-parallel centroids.
 
     Points are sharded over ``data`` (as in _lloyd_local); the centroid table
@@ -346,11 +348,11 @@ def _lloyd_local_2d(x, w, c_loc, key, *, k, n_valid, tol, max_iter,
         return labels.reshape(n_loc), sums, counts
 
     def cond(carry):
-        _, _, _, it, shift = carry
+        _, _, it, shift = carry
         return (it < max_iter) & ((it == 0) | (shift >= tol))
 
     def body(carry):
-        c_loc, _, key, it, _ = carry
+        c_loc, _, it, _ = carry
         # Full (k,) stats computed redundantly per model shard (cheap), then
         # each shard keeps its own block — replaces an all-gather of labels.
         _, sums, counts = assign_reduce_2d(c_loc)
@@ -358,7 +360,7 @@ def _lloyd_local_2d(x, w, c_loc, key, *, k, n_valid, tol, max_iter,
         counts = lax.psum(counts, DATA_AXIS)
         sums_loc = lax.dynamic_slice_in_dim(sums, k_off, k_loc)
         counts_loc = lax.dynamic_slice_in_dim(counts, k_off, k_loc)
-        key, sub = jax.random.split(key)
+        sub = jax.random.fold_in(key, iter_offset + it)  # global-iter stream
 
         def with_reseed(_):
             # Rare path behind lax.cond (see _lloyd_local); the predicate is
@@ -386,16 +388,15 @@ def _lloyd_local_2d(x, w, c_loc, key, *, k, n_valid, tol, max_iter,
         shift = jnp.sqrt(
             lax.psum(jnp.sum((new_c - c_loc) ** 2), MODEL_AXIS)
         )
-        return new_c, c_loc, key, it + 1, shift
+        return new_c, c_loc, it + 1, shift
 
     init = (
         c_loc,
         c_loc,
-        key,
         jnp.array(0, jnp.int32),
         jnp.array(jnp.inf, x.dtype),
     )
-    c_loc, c_prev, _, it, shift = lax.while_loop(cond, body, init)
+    c_loc, c_prev, it, shift = lax.while_loop(cond, body, init)
     labels = assign_2d(c_prev)
     return c_loc, labels, it, shift
 
@@ -412,7 +413,7 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
     mesh = make_mesh(n_data=ndata, n_model=nmodel)
     k_loc = k // nmodel
 
-    def local_fn(x, c0, key):
+    def local_fn(x, c0, key, iter_offset):
         w = prefix_mask(x, n_valid)
         if with_init:
             centroids = c0
@@ -421,7 +422,7 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
         lloyd_key = jax.random.fold_in(key, 0x10D)  # distinct stream from init
         if nmodel == 1:
             return _lloyd_local(
-                x, w, centroids, lloyd_key,
+                x, w, centroids, lloyd_key, iter_offset,
                 k=k, n_valid=n_valid, tol=tol, max_iter=max_iter,
                 chunk_rows=chunk_rows, update=update,
             )
@@ -429,7 +430,7 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
             centroids, lax.axis_index(MODEL_AXIS) * k_loc, k_loc
         )
         return _lloyd_local_2d(
-            x, w, c_loc, lloyd_key,
+            x, w, c_loc, lloyd_key, iter_offset,
             k=k, n_valid=n_valid, tol=tol, max_iter=max_iter,
             chunk_rows=chunk_rows, update=update,
         )
@@ -441,7 +442,7 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
     sharded = jax.shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(), P()),
+        in_specs=(P(DATA_AXIS, None), P(), P(), P()),
         out_specs=(c_spec, P(DATA_AXIS), P(), P()),
         check_vma=False,
     )
@@ -460,8 +461,13 @@ def kmeans_jax_full(
     chunk_rows: int | None = None,
     update: str = "matmul",
     n_valid: int | None = None,
+    iter_offset: int = 0,
 ):
     """Sharded KMeans++ + Lloyd.  Returns (centroids, labels, n_iter, shift).
+
+    ``iter_offset`` shifts the global iteration index used for the reseed PRNG
+    stream — a blocked/checkpointed run passing its completed-iteration count
+    draws exactly the stream an uninterrupted run would (utils/checkpoint.py).
 
     Reference entry point: src/kmeans_plusplus.py:24 ``kmeans(X, k, ...)``.
     ``init_centroids`` overrides the D² init (used by the numpy-parity tests so
@@ -526,7 +532,8 @@ def kmeans_jax_full(
     )
     if k > n_valid:
         raise ValueError(f"k={k} exceeds number of valid samples {n_valid}")
-    centroids, labels, it, shift = fn(Xp, c0, key)
+    centroids, labels, it, shift = fn(
+        Xp, c0, key, jnp.asarray(int(iter_offset), jnp.int32))
     return centroids, labels[:n_valid], int(it), float(shift)
 
 

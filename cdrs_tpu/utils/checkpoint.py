@@ -1,0 +1,123 @@
+"""Checkpoint/resume for long-running kernels and streams.
+
+The reference has no checkpointing, but its file-boundary architecture is
+accidentally restartable (SURVEY.md §5).  This module keeps that property for
+the in-memory kernels:
+
+* ``save_state``/``load_state`` — atomic npz snapshots of array pytrees
+  (centroids, counts, streaming counters) + JSON scalars.
+* ``kmeans_jax_checkpointed`` — the Lloyd loop executed in blocks of
+  iterations with a durable centroid snapshot between blocks; a killed run
+  resumes from the last block with identical results to an uninterrupted run
+  (the convergence predicate and PRNG stream are carried in the snapshot).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+import numpy as np
+
+__all__ = ["save_state", "load_state", "kmeans_jax_checkpointed"]
+
+
+def save_state(path: str, arrays: dict, meta: dict | None = None) -> None:
+    """Atomic npz snapshot (write temp + rename) with a JSON meta blob."""
+    payload = {k: np.asarray(v) for k, v in arrays.items()}
+    payload["__meta__"] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_state(path: str) -> tuple[dict, dict]:
+    """Returns (arrays, meta); raises FileNotFoundError when absent."""
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files if k != "__meta__"}
+        meta = json.loads(bytes(z["__meta__"].tobytes()).decode()) \
+            if "__meta__" in z.files else {}
+    return arrays, meta
+
+
+def kmeans_jax_checkpointed(
+    X,
+    k: int,
+    checkpoint_path: str,
+    tol: float = 1e-4,
+    seed: int | None = None,
+    max_iter: int = 100,
+    block_iters: int = 25,
+    mesh_shape: dict[str, int] | None = None,
+    resume: bool = True,
+    init_centroids=None,
+    **kwargs,
+):
+    """Lloyd loop in durable blocks.  Returns (centroids, labels, total_iters).
+
+    Each block runs ``block_iters`` Lloyd iterations on device (one compiled
+    call), then snapshots (centroids, iters_done, converged?).  ``resume=True``
+    picks up from an existing snapshot.  The reseed PRNG stream is keyed by
+    the GLOBAL iteration index (kmeans_jax_full ``iter_offset``), so blocked,
+    resumed, and uninterrupted runs draw identical streams — results match
+    exactly regardless of where the blocks fall, including iterations where
+    empty-cluster reseeds fire.
+
+    Labels are the assignment against the FINAL centroids (one extra pass) —
+    consistent across fresh/resumed/already-complete invocations; note this
+    differs from kmeans_jax_full's reference-parity labels, which are taken
+    against the pre-update centroids of the last iteration.
+    """
+    from ..ops.kmeans_jax import kmeans_jax_full
+
+    X = np.asarray(X) if not hasattr(X, "devices") else X
+    iters_done = 0
+    # ``init_centroids`` seeds only a fresh run; a checkpoint always wins.
+    centroids = None if init_centroids is None else np.asarray(init_centroids)
+
+    converged = False
+    if resume and os.path.exists(checkpoint_path):
+        arrays, meta = load_state(checkpoint_path)
+        centroids = arrays["centroids"]
+        iters_done = int(meta["iters_done"])
+        converged = bool(meta.get("converged", False))
+        if meta.get("k") != int(k):
+            raise ValueError(
+                f"checkpoint k={meta.get('k')} != requested k={k}")
+
+    base_seed = 0 if seed is None else int(seed)
+    while not converged and iters_done < max_iter:
+        block = min(block_iters, max_iter - iters_done)
+        centroids_out, _, it, shift = kmeans_jax_full(
+            X, k, tol=tol,
+            seed=base_seed,
+            max_iter=block,
+            init_centroids=centroids,
+            mesh_shape=mesh_shape,
+            iter_offset=iters_done,
+            **kwargs,
+        )
+        centroids = np.asarray(centroids_out)
+        iters_done += it
+        converged = shift < tol
+        save_state(checkpoint_path, {"centroids": centroids},
+                   {"iters_done": iters_done, "k": int(k),
+                    "shift": shift, "converged": converged})
+
+    import jax.numpy as jnp
+
+    from ..ops.kmeans_jax import assign_labels_jax
+
+    labels = assign_labels_jax(jnp.asarray(np.asarray(X)),
+                               jnp.asarray(centroids))
+    return centroids, np.asarray(labels), iters_done

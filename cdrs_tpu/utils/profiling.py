@@ -1,0 +1,33 @@
+"""Profiling helpers — jax.profiler traces + the per-stage wall-clock timers.
+
+The reference's only observability is Spark's history-server UI and print()
+statements (SURVEY.md §5).  Here every pipeline stage is timed (MetricsLog,
+utils/logging.py) and any region can additionally emit a full XLA trace
+viewable in TensorBoard/Perfetto via ``trace_region``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["trace_region"]
+
+
+@contextlib.contextmanager
+def trace_region(trace_dir: str | None):
+    """Context manager: jax.profiler.trace into ``trace_dir`` (no-op when
+    None or when jax/profiler is unavailable)."""
+    if not trace_dir:
+        yield
+        return
+    try:
+        import jax
+    except ImportError:
+        import sys
+
+        print("warning: --profile requested but jax is not installed; "
+              "no trace will be written", file=sys.stderr)
+        yield
+        return
+    with jax.profiler.trace(trace_dir):
+        yield

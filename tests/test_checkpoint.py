@@ -1,0 +1,103 @@
+"""Checkpoint/resume: blocked Lloyd runs resume identically after a kill."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from cdrs_tpu.ops.kmeans_np import kmeans_plusplus_init
+from cdrs_tpu.utils.checkpoint import (
+    kmeans_jax_checkpointed,
+    load_state,
+    save_state,
+)
+
+
+@pytest.fixture()
+def blobs():
+    rng = np.random.default_rng(3)
+    centers = rng.normal(size=(4, 6)) * 4.0
+    return np.concatenate(
+        [rng.normal(size=(200, 6)) * 0.5 + c for c in centers])
+
+
+def test_save_load_roundtrip(tmp_path):
+    p = str(tmp_path / "s.npz")
+    save_state(p, {"a": np.arange(5), "b": np.ones((2, 2))},
+               {"it": 7, "note": "x"})
+    arrays, meta = load_state(p)
+    np.testing.assert_array_equal(arrays["a"], np.arange(5))
+    assert meta == {"it": 7, "note": "x"}
+
+
+def test_checkpointed_matches_uninterrupted(blobs, tmp_path):
+    init = kmeans_plusplus_init(blobs, 4, random_state=0)
+    p1 = str(tmp_path / "a.npz")
+    c1, l1, it1 = kmeans_jax_checkpointed(
+        blobs, 4, p1, seed=0, max_iter=100, block_iters=100,
+        init_centroids=init)
+    p2 = str(tmp_path / "b.npz")
+    c2, l2, it2 = kmeans_jax_checkpointed(
+        blobs, 4, p2, seed=0, max_iter=100, block_iters=3,
+        init_centroids=init)
+    np.testing.assert_allclose(c1, c2, atol=1e-10)
+    assert (l1 == l2).all()
+
+
+def test_resume_after_kill(blobs, tmp_path):
+    """Simulate a crash after the first block; the resumed run must finish
+    and match an uninterrupted run."""
+    init = kmeans_plusplus_init(blobs, 4, random_state=0)
+    p = str(tmp_path / "c.npz")
+    # "crashed" run: only one block executes
+    kmeans_jax_checkpointed(blobs, 4, p, seed=0, max_iter=2, block_iters=2,
+                            init_centroids=init, tol=0.0)
+    _, meta = load_state(p)
+    assert meta["iters_done"] == 2
+    # resume to completion
+    c2, l2, it2 = kmeans_jax_checkpointed(
+        blobs, 4, p, seed=0, max_iter=100, block_iters=50,
+        init_centroids=init)
+    assert it2 >= 2
+    # uninterrupted reference
+    pref = str(tmp_path / "d.npz")
+    c3, l3, _ = kmeans_jax_checkpointed(
+        blobs, 4, pref, seed=0, max_iter=100, block_iters=2,
+        init_centroids=init)
+    np.testing.assert_allclose(c2, c3, atol=1e-10)
+    assert (l2 == l3).all()
+
+
+def test_resume_from_complete_checkpoint(blobs, tmp_path):
+    p = str(tmp_path / "e.npz")
+    c1, l1, it1 = kmeans_jax_checkpointed(blobs, 4, p, seed=0, max_iter=50)
+    # second invocation: nothing runs (converged flag), identical outputs
+    c2, l2, it2 = kmeans_jax_checkpointed(blobs, 4, p, seed=0, max_iter=it1)
+    np.testing.assert_allclose(c1, c2, atol=0)
+    assert (l2 == l1).all()
+    assert it2 == it1
+
+
+def test_k_mismatch_rejected(blobs, tmp_path):
+    p = str(tmp_path / "f.npz")
+    kmeans_jax_checkpointed(blobs, 4, p, seed=0, max_iter=2, block_iters=2,
+                            tol=0.0)
+    with pytest.raises(ValueError, match="checkpoint k="):
+        kmeans_jax_checkpointed(blobs, 8, p, seed=0, max_iter=4)
+
+
+def test_blocked_equivalence_with_reseeds(tmp_path):
+    """Reseed draws are keyed by global iteration index, so blocked and
+    uninterrupted runs match even when empty-cluster reseeds fire."""
+    X = np.array([[0.0, 0], [10, 0], [0, 10], [10, 10], [5, 5]])
+    init = np.full((4, 2), 100.0) + np.arange(4)[:, None]  # forces reseeds
+    p1 = str(tmp_path / "r1.npz")
+    c1, l1, _ = kmeans_jax_checkpointed(X, 4, p1, seed=9, max_iter=40,
+                                        block_iters=40, init_centroids=init,
+                                        tol=1e-4)
+    p2 = str(tmp_path / "r2.npz")
+    c2, l2, _ = kmeans_jax_checkpointed(X, 4, p2, seed=9, max_iter=40,
+                                        block_iters=1, init_centroids=init,
+                                        tol=1e-4)
+    np.testing.assert_array_equal(c1, c2)
+    assert (l1 == l2).all()
