@@ -48,6 +48,20 @@ def _add_backend_arg(p: argparse.ArgumentParser, mesh: bool = True) -> None:
         )
 
 
+def _load_scoring(args) -> ScoringConfig:
+    """ScoringConfig from --scoring_config JSON (if given) with the
+    --medians_from_data flag applied on top."""
+    if getattr(args, "scoring_config", None):
+        from .config import load_scoring_config
+        import dataclasses
+
+        cfg = load_scoring_config(args.scoring_config)
+        if args.medians_from_data:
+            cfg = dataclasses.replace(cfg, compute_global_medians_from_data=True)
+        return cfg
+    return ScoringConfig(compute_global_medians_from_data=args.medians_from_data)
+
+
 def _parse_mesh(spec: str | None) -> dict[str, int] | None:
     if not spec:
         return None
@@ -117,11 +131,9 @@ def _cmd_cluster(args) -> int:
     from .io.features import load_feature_matrix
     from .models.replication import ReplicationPolicyModel
 
-    scoring = ScoringConfig(
-        compute_global_medians_from_data=args.medians_from_data)
     model = ReplicationPolicyModel(
         kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed),
-        scoring_cfg=scoring,
+        scoring_cfg=_load_scoring(args),
         backend=args.backend,
         mesh_shape=_parse_mesh(args.mesh),
     )
@@ -145,7 +157,7 @@ def _cmd_pipeline(args) -> int:
         simulator=SimulatorConfig(duration_seconds=args.duration_seconds,
                                   seed=None if args.seed is None else args.seed + 1),
         kmeans=KMeansConfig(k=args.k, seed=args.seed),
-        scoring=ScoringConfig(compute_global_medians_from_data=args.medians_from_data),
+        scoring=_load_scoring(args),
         mesh_shape=_parse_mesh(args.mesh),
         evaluate=args.evaluate,
     )
@@ -225,8 +237,7 @@ def _cmd_stream(args) -> int:
 
     model = ReplicationPolicyModel(
         kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed),
-        scoring_cfg=ScoringConfig(
-            compute_global_medians_from_data=args.medians_from_data),
+        scoring_cfg=_load_scoring(args),
         backend=args.backend,
         mesh_shape=_parse_mesh(args.mesh),
     )
@@ -291,6 +302,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--assignments_csv", default=None)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--medians_from_data", action="store_true")
+    p.add_argument("--scoring_config", default=None, metavar="JSON",
+                   help="weights/directions/medians/rf config file")
     _add_backend_arg(p)
     p.set_defaults(fn=_cmd_cluster)
 
@@ -301,6 +314,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--outdir", default="output")
     p.add_argument("--medians_from_data", action="store_true")
+    p.add_argument("--scoring_config", default=None, metavar="JSON")
     p.add_argument("--evaluate", action="store_true",
                    help="apply decided rf on the simulated cluster and report "
                         "locality/load/storage vs uniform baselines")
@@ -329,6 +343,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--output_csv", default="final_categories.csv")
     p.add_argument("--medians_from_data", action="store_true")
+    p.add_argument("--scoring_config", default=None, metavar="JSON")
     _add_backend_arg(p)
     p.set_defaults(fn=_cmd_stream)
 

@@ -226,3 +226,50 @@ class PipelineConfig:
 
     def replace(self, **kwargs) -> "PipelineConfig":
         return dataclasses.replace(self, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Config-file loading (JSON)
+# ---------------------------------------------------------------------------
+
+def scoring_config_from_dict(d: Mapping) -> ScoringConfig:
+    """Build a ScoringConfig from a plain dict (e.g. parsed JSON).
+
+    Unknown keys are rejected — a typo'd weight table must not silently fall
+    back to defaults.  The reference hardcodes all of this in module constants
+    flagged "MUST be replaced" (src/main.py:20-62); here it is user data.
+    """
+    allowed = {f.name for f in dataclasses.fields(ScoringConfig)}
+    unknown = set(d) - allowed
+    if unknown:
+        raise ValueError(f"unknown scoring config keys: {sorted(unknown)}")
+    kwargs = dict(d)
+    for key in ("features", "categories"):
+        if key in kwargs:
+            kwargs[key] = tuple(kwargs[key])
+    cfg = ScoringConfig(**kwargs)
+    # Validate cross-references early (a missing weight/direction entry would
+    # otherwise surface as a KeyError deep inside the score kernel).
+    for c in cfg.categories:
+        for table, name in ((cfg.weights, "weights"),
+                            (cfg.directions, "directions")):
+            if c not in table:
+                raise ValueError(f"{name} missing category {c!r}")
+            missing = set(cfg.features) - set(table[c])
+            if missing:
+                raise ValueError(
+                    f"{name}[{c!r}] missing features {sorted(missing)}")
+        if c not in cfg.replication_factors:
+            raise ValueError(f"replication_factors missing category {c!r}")
+    missing = set(cfg.features) - set(cfg.global_medians)
+    if missing:
+        raise ValueError(f"global_medians missing features {sorted(missing)}")
+    return cfg
+
+
+def load_scoring_config(path: str) -> ScoringConfig:
+    """Load a ScoringConfig from a JSON file."""
+    import json
+
+    with open(path) as f:
+        return scoring_config_from_dict(json.load(f))

@@ -1,0 +1,77 @@
+"""Scoring-config file loading: round-trip, validation, CLI wiring."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.config import (
+    ScoringConfig,
+    load_scoring_config,
+    scoring_config_from_dict,
+)
+
+
+def _as_dict(cfg: ScoringConfig) -> dict:
+    d = dataclasses.asdict(cfg)
+    d["features"] = list(cfg.features)
+    d["categories"] = list(cfg.categories)
+    return d
+
+
+def test_roundtrip_defaults(tmp_path):
+    cfg = ScoringConfig()
+    p = tmp_path / "s.json"
+    p.write_text(json.dumps(_as_dict(cfg)))
+    loaded = load_scoring_config(str(p))
+    assert loaded == cfg
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ValueError, match="unknown scoring config keys"):
+        scoring_config_from_dict({"wieghts": {}})
+
+
+def test_missing_feature_weight_rejected():
+    d = _as_dict(ScoringConfig())
+    del d["weights"]["Hot"]["age_norm"]
+    with pytest.raises(ValueError, match="missing features"):
+        scoring_config_from_dict(d)
+
+
+def test_missing_category_rejected():
+    d = _as_dict(ScoringConfig())
+    del d["replication_factors"]["Archival"]
+    with pytest.raises(ValueError, match="replication_factors missing"):
+        scoring_config_from_dict(d)
+
+
+def test_custom_config_changes_classification(tmp_path):
+    """A config that inflates Hot weights must be able to flip a decision."""
+    from cdrs_tpu.ops.scoring_np import classify_medians
+
+    base = ScoringConfig()
+    d = _as_dict(base)
+    for f in d["weights"]["Hot"]:
+        d["weights"]["Hot"][f] = 100.0
+    boosted = scoring_config_from_dict(d)
+
+    medians = np.array([[0.6, 0.4, 0.6, 0.6, 0.6]])  # mildly hot-ish
+    w1, _ = classify_medians(medians, base)
+    w2, _ = classify_medians(medians, boosted)
+    assert base.categories[int(w2[0])] == "Hot"
+
+
+def test_cli_scoring_config(tmp_path):
+    from cdrs_tpu.cli import main
+
+    cfgp = tmp_path / "s.json"
+    cfgp.write_text(json.dumps(_as_dict(ScoringConfig())))
+    rc = main([
+        "pipeline", "--n", "80", "--duration_seconds", "30", "--k", "4",
+        "--outdir", str(tmp_path / "out"),
+        "--scoring_config", str(cfgp), "--medians_from_data",
+    ])
+    assert rc == 0
+    assert (tmp_path / "out" / "final_categories.csv").exists()
