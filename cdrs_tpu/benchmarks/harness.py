@@ -84,16 +84,27 @@ def _numpy_stream_fold(batch, n_files, counters):
                   cnt.astype(np.float64))
 
 
-def _bench_streaming(cfg: BenchConfig, seed: int) -> dict:
-    """Events/sec through the device stream fold vs the numpy fold."""
+def _bench_streaming(cfg: BenchConfig, seed: int,
+                     mesh_shape: dict[str, int] | None = None) -> dict:
+    """Events/sec through the device stream fold vs the numpy fold.
+
+    ``mesh_shape={"data": N}`` runs the event-sharded fold (the v5e-8
+    BASELINE config-5 scenario; features/streaming.py)."""
+    import jax
     import jax.numpy as jnp
 
     from ..features.streaming import _build_update
 
     n, e = cfg.n, STREAM_BATCH_EVENTS
+    ndata = int((mesh_shape or {}).get("data", 1))
+    requested = ndata
+    if ndata > len(jax.devices()):
+        # Largest available power of two — always divides the 2^20 batch.
+        ndata = 1 << (len(jax.devices()).bit_length() - 1)
     rng = np.random.default_rng(seed)
     primary = jnp.asarray(rng.integers(0, 4, size=n, dtype=np.int32))
-    fn = _build_update(e, n)
+    e_shard = e + ((-e) % ndata)  # padded like stream_update does
+    fn = _build_update(e_shard, n, ndata)
 
     def dev_state():
         z = jnp.zeros((n,), jnp.int32)
@@ -101,10 +112,12 @@ def _bench_streaming(cfg: BenchConfig, seed: int) -> dict:
 
     batches = [_synth_event_batch(rng, n, e, 1.7e9 + 60.0 * i)
                for i in range(cfg.iters)]
+    from ..features.jax_backend import _pad_events
+
     dev_batches = [
-        (jnp.asarray(b["pid"]),
-         jnp.asarray((np.floor(b["ts"]) - 1.7e9).astype(np.int32)),
-         jnp.asarray(b["op"]), jnp.asarray(b["client"]))
+        tuple(jnp.asarray(a) for a in _pad_events(
+            b["pid"], (np.floor(b["ts"]) - 1.7e9).astype(np.int32),
+            b["op"], b["client"], ndata))
         for b in batches
     ]
 
@@ -125,16 +138,21 @@ def _bench_streaming(cfg: BenchConfig, seed: int) -> dict:
         _numpy_stream_fold(b, n, counters)
     np_eps = (max(2, cfg.iters // 4) * e) / (time.perf_counter() - t0)
 
-    return {
+    suffix = f"_mesh{ndata}" if ndata > 1 else ""
+    out = {
         "config": 5, "n": n, "d": cfg.d, "k": cfg.k,
         "batch_events": e, "batches": cfg.iters,
-        "metric": f"stream_events_per_sec_n{n}_batch{e}",
+        "metric": f"stream_events_per_sec_n{n}_batch{e}{suffix}",
         "value": dev_eps,
         "unit": "event/s",
         "vs_baseline": dev_eps / np_eps,
         "numpy_events_per_sec": np_eps,
         "backend": "jax",
+        "mesh_data": ndata,
     }
+    if ndata != requested:
+        out["mesh_downscaled_to"] = {"data": ndata}
+    return out
 
 
 def synth_blobs_np(n: int, d: int, k_true: int, seed: int = 0) -> np.ndarray:
@@ -233,11 +251,10 @@ def run_bench(config: int = 2, backend: str | None = None,
     cfg = CONFIGS[int(config)]
     backend = backend or cfg.backend
     if int(config) == 5:
-        if backend != "jax" or mesh_shape:
-            raise ValueError(
-                "config 5 (streaming) runs the jax fold on a single device; "
-                "--backend/--mesh overrides are not supported")
-        return _bench_streaming(cfg, seed)
+        if backend != "jax":
+            raise ValueError("config 5 (streaming) is a jax fold; "
+                             "--backend numpy is not supported")
+        return _bench_streaming(cfg, seed, mesh_shape=mesh_shape)
     np_iters = max(2, min(3, cfg.iters))
 
     # The subsample guard applies regardless of backend — a direct numpy

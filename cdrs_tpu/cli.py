@@ -39,8 +39,9 @@ from .utils.logging import StageTimer
 __all__ = ["main"]
 
 
-def _add_backend_arg(p: argparse.ArgumentParser, mesh: bool = True) -> None:
-    p.add_argument("--backend", choices=["numpy", "jax"], default="numpy")
+def _add_backend_arg(p: argparse.ArgumentParser, mesh: bool = True,
+                     default: str | None = "numpy") -> None:
+    p.add_argument("--backend", choices=["numpy", "jax"], default=default)
     if mesh:
         p.add_argument(
             "--mesh", default=None, metavar="SPEC",
@@ -66,8 +67,18 @@ def _parse_mesh(spec: str | None) -> dict[str, int] | None:
     if not spec:
         return None
     if "=" not in spec:
+        if int(spec) < 1:
+            raise SystemExit(f"mesh size must be >= 1, got {spec}")
         return {"data": int(spec)}
-    return {k: int(v) for k, v in (part.split("=") for part in spec.split(","))}
+    mesh = {k: int(v) for k, v in (part.split("=") for part in spec.split(","))}
+    unknown = set(mesh) - {"data", "model"}
+    if unknown:
+        raise SystemExit(
+            f"unknown mesh axis {sorted(unknown)}: --mesh takes 'data' and "
+            f"'model' (e.g. 'data=4,model=2')")
+    if any(v < 1 for v in mesh.values()):
+        raise SystemExit(f"mesh axis sizes must be >= 1, got {mesh}")
+    return mesh
 
 
 def _cmd_gen(args) -> int:
@@ -110,8 +121,16 @@ def _cmd_features(args) -> int:
         manifest = Manifest.read_csv(args.manifest)
         events = EventLog.read_csv(args.access_log, manifest)
         if args.backend == "jax":
-            from .features.jax_backend import compute_features_jax as compute
+            import functools
+
+            from .features.jax_backend import compute_features_jax
+
+            compute = functools.partial(
+                compute_features_jax, mesh_shape=_parse_mesh(args.mesh))
         else:
+            if args.mesh:
+                print("warning: --mesh ignored for the numpy backend",
+                      file=sys.stderr)
             from .features.numpy_backend import compute_features as compute
         table = compute(manifest, events)
         out = args.out
@@ -225,11 +244,12 @@ def _cmd_stream(args) -> int:
 
     with StageTimer("stream") as t:
         manifest = Manifest.read_csv(args.manifest)
+        mesh_shape = _parse_mesh(args.mesh)
         state = stream_init(len(manifest))
         n_batches = 0
         for batch in EventLog.read_csv_batches(args.access_log, manifest,
                                                batch_size=args.batch_size):
-            state = stream_update(state, batch, manifest)
+            state = stream_update(state, batch, manifest, mesh_shape=mesh_shape)
             n_batches += 1
         table = stream_finalize(state, manifest)
     print(f"Streamed {state.n_events} events in {n_batches} batches "
@@ -239,7 +259,7 @@ def _cmd_stream(args) -> int:
         kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed),
         scoring_cfg=_load_scoring(args),
         backend=args.backend,
-        mesh_shape=_parse_mesh(args.mesh),
+        mesh_shape=mesh_shape,
     )
     with StageTimer("cluster") as t:
         decision = model.run(np.asarray(table.norm))
@@ -292,7 +312,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--manifest", required=True)
     p.add_argument("--access_log", required=True)
     p.add_argument("--out", default="features_out/")
-    _add_backend_arg(p, mesh=False)  # feature kernel is single-device for now
+    _add_backend_arg(p)  # --mesh shards the event stream over chips
     p.set_defaults(fn=_cmd_features)
 
     p = sub.add_parser("cluster", help="KMeans++ clustering + category scoring")
@@ -349,7 +369,7 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser("bench", help="benchmark harness (BASELINE.md configs)")
     p.add_argument("--config", type=int, default=1)
-    _add_backend_arg(p)
+    _add_backend_arg(p, default=None)  # None = the config's own backend
     p.set_defaults(fn=_cmd_bench)
 
     args = parser.parse_args(argv)

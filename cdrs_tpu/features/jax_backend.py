@@ -16,10 +16,27 @@ struct-of-arrays event log:
   (compute_features.py:48-54, 62-66, 77-94), including the degenerate guards
   (mean writes 0 -> 1.0; constant column -> all-zero norm).
 
+Counters accumulate as **int32 segment sums** (exact regardless of x64 mode —
+float32 accumulators would silently lose counts past 2^24 events per file,
+reachable at the 1B-event target) and are cast to float only for ratios and
+normalization.
+
 Events with paths missing from the manifest are masked out of every counter
 but still counted toward ``observation_end`` (left-join semantics,
 compute_features.py:48, 56-60) — the mask happens in-kernel so event arrays
 never need host-side filtering.
+
+**Multi-chip**: ``mesh_shape={"data": N}`` shards the event stream over the
+mesh's data axis in time-contiguous blocks — the TPU equivalent of the
+reference's Spark executors partitioning the log (compute_features.py:11,
+SURVEY.md §2.5).  Each chip segment-sums its event shard into a replicated
+(n,) stats table and a single cross-chip ``psum`` merges them.  Concurrency
+needs one extra step: a (path, second) pair can straddle a shard boundary, and
+because shards are time-contiguous only the ≤ 2N shard-edge seconds can be
+split — those are ``all_gather``-ed and their counts psum-merged exactly
+(see ``_features_local``).  The result is bit-equal to the single-device
+kernel for any time-sorted log; enforced by tests/test_features_jax.py on the
+8-device CPU mesh.
 
 The numpy backend (features/numpy_backend.py) is the golden model; parity is
 enforced by tests/test_features_jax.py.
@@ -34,53 +51,42 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from ..io.events import EventLog, Manifest
+from ..parallel.mesh import DATA_AXIS, make_mesh
 from .numpy_backend import FeatureTable
 
 __all__ = ["compute_features_jax", "features_kernel"]
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def features_kernel(
-    pid: jnp.ndarray,          # (e,) int32, -1 = not in manifest
-    sec: jnp.ndarray,          # (e,) int32 second bucket, rebased to min=0
-    op: jnp.ndarray,           # (e,) int8, 1 = WRITE
-    client: jnp.ndarray,       # (e,) int32
-    primary_node_id: jnp.ndarray,  # (n,) int32
-    age_seconds: jnp.ndarray,  # (n,) observation_end - creation_ts
-    n: int,
-):
-    """Returns (raw (n,5), norm (n,5), writes (n,), reads (n,)).
+def _pad_events(pid, sec, op, client, multiple):
+    """Pad event columns to an even shard split.  Padded rows are pid=-1
+    (masked in-kernel) with the last real second so they never widen the
+    boundary-second set; mesh.pad_rows would zero-pad, aliasing pid 0."""
+    pad = (-len(pid)) % multiple
+    if pad:
+        pid = np.concatenate([pid, np.full(pad, -1, np.int32)])
+        sec = np.concatenate([sec, np.full(pad, sec[-1], np.int32)])
+        op = np.concatenate([op, np.zeros(pad, op.dtype)])
+        client = np.concatenate([client, np.zeros(pad, client.dtype)])
+    return pid, sec, op, client
 
-    Timestamps never enter the kernel as raw epoch floats: the second buckets
-    (``floor(ts)`` rebased to the window start) and ``age_seconds`` are
-    pre-reduced on host in float64, because float32 — the accelerator default
-    when x64 is off — has ~256 s resolution at epoch magnitude (~1.75e9),
-    which would merge every event into one concurrency bucket.
+
+def _concurrency_local(pid, sec, wi, n):
+    """Shard-local max events-per-second per path (int32, (n,)).
+
+    Lexsort by (path, second), run-length count equal-(path, second) runs via
+    a cumsum over run boundaries, segment_max the run counts by path.  Exact
+    when the shard holds every event of each (path, second) pair it sees;
+    partial counts at shard-edge seconds are corrected by the caller.
     """
-    ftype = age_seconds.dtype
-    valid = pid >= 0
-    w = valid.astype(ftype)
-    pid_c = jnp.where(valid, pid, 0).astype(jnp.int32)
-
-    access_freq = jax.ops.segment_sum(w, pid_c, num_segments=n)
-    writes = jax.ops.segment_sum(w * (op == 1), pid_c, num_segments=n)
-    reads = access_freq - writes
-
-    is_local = (client == primary_node_id[pid_c]).astype(ftype) * w
-    local_acc = jax.ops.segment_sum(is_local, pid_c, num_segments=n)
-    locality = jnp.where(
-        access_freq > 0, local_acc / jnp.maximum(access_freq, 1.0), 1.0
-    )
-
-    # Two-level concurrency: runs of equal (path, second) after a lexsort.
     e = pid.shape[0]
-    sort_pid = jnp.where(valid, pid, n).astype(jnp.int32)  # invalid sorts last
-    order = jnp.lexsort((sec, sort_pid))
-    s_pid = sort_pid[order]
+    order = jnp.lexsort((sec, pid))
+    s_pid = pid[order]
     s_sec = sec[order]
-    s_w = w[order]
+    s_w = wi[order]
     new_run = jnp.concatenate([
         jnp.ones((1,), jnp.int32),
         ((s_pid[1:] != s_pid[:-1]) | (s_sec[1:] != s_sec[:-1])).astype(jnp.int32),
@@ -91,7 +97,60 @@ def features_kernel(
     conc = jax.ops.segment_max(
         per_event_count, jnp.where(s_pid < n, s_pid, 0), num_segments=n
     )
-    concurrency = jnp.maximum(conc, 0.0)  # -inf identity -> 0 for no-event files
+    return jnp.maximum(conc, 0)  # int-min identity -> 0 for no-event files
+
+
+def _features_local(pid, sec, op, client, primary_node_id, age_seconds, *,
+                    n, sharded):
+    """Feature kernel body; runs standalone or inside shard_map over DATA_AXIS.
+
+    Event arrays are the (sharded) stream; ``primary_node_id``/``age_seconds``
+    are replicated (n,) manifest columns.  Returns replicated
+    (raw (n,5), norm (n,5), writes (n,), reads (n,)) in ``age_seconds.dtype``.
+    """
+    ftype = age_seconds.dtype
+    valid = pid >= 0
+    wi = valid.astype(jnp.int32)
+    pid_c = jnp.where(valid, pid, 0).astype(jnp.int32)
+
+    access_i = jax.ops.segment_sum(wi, pid_c, num_segments=n)
+    writes_i = jax.ops.segment_sum(wi * (op == 1), pid_c, num_segments=n)
+    is_local = (client == primary_node_id[pid_c]).astype(jnp.int32) * wi
+    local_i = jax.ops.segment_sum(is_local, pid_c, num_segments=n)
+
+    sort_pid = jnp.where(valid, pid, n).astype(jnp.int32)  # invalid sorts last
+    conc_i = _concurrency_local(sort_pid, sec, wi, n)
+
+    if sharded:
+        access_i = lax.psum(access_i, DATA_AXIS)
+        writes_i = lax.psum(writes_i, DATA_AXIS)
+        local_i = lax.psum(local_i, DATA_AXIS)
+        # Shard-local run counts are exact except at seconds split across a
+        # shard edge.  Shards are time-contiguous, so only each shard's first
+        # and last valid second can be split: gather those ≤ 2N boundary
+        # seconds (identical on every shard) and psum their exact counts.
+        # Partial local counts at boundary seconds are ≤ the exact psum'd
+        # total, so keeping them in the pmax is harmless.
+        conc_i = lax.pmax(conc_i, DATA_AXIS)
+        big = jnp.int32(np.iinfo(np.int32).max)
+        smin = jnp.min(jnp.where(valid, sec, big))
+        smax = jnp.max(jnp.where(valid, sec, -1))
+        bounds = lax.all_gather(jnp.stack([smin, smax]), DATA_AXIS).reshape(-1)
+
+        def edge_count(i, conc):
+            b = bounds[i]
+            cnt = jax.ops.segment_sum(wi * (sec == b), pid_c, num_segments=n)
+            return jnp.maximum(conc, lax.psum(cnt, DATA_AXIS))
+
+        conc_i = lax.fori_loop(0, bounds.shape[0], edge_count, conc_i)
+
+    access_freq = access_i.astype(ftype)
+    writes = writes_i.astype(ftype)
+    reads = access_freq - writes
+    locality = jnp.where(
+        access_i > 0, local_i.astype(ftype) / jnp.maximum(access_freq, 1.0), 1.0
+    )
+    concurrency = conc_i.astype(ftype)
 
     mean_writes = jnp.mean(writes)
     mean_writes = jnp.where(mean_writes == 0, 1.0, mean_writes)
@@ -106,24 +165,79 @@ def features_kernel(
     return raw, norm, writes, reads
 
 
+@functools.partial(jax.jit, static_argnames=("n",))
+def features_kernel(
+    pid: jnp.ndarray,          # (e,) int32, -1 = not in manifest
+    sec: jnp.ndarray,          # (e,) int32 second bucket, rebased to min=0
+    op: jnp.ndarray,           # (e,) int8, 1 = WRITE
+    client: jnp.ndarray,       # (e,) int32
+    primary_node_id: jnp.ndarray,  # (n,) int32
+    age_seconds: jnp.ndarray,  # (n,) observation_end - creation_ts
+    n: int,
+):
+    """Single-device kernel: (raw (n,5), norm (n,5), writes (n,), reads (n,)).
+
+    Timestamps never enter the kernel as raw epoch floats: the second buckets
+    (``floor(ts)`` rebased to the window start) and ``age_seconds`` are
+    pre-reduced on host in float64, because float32 — the accelerator default
+    when x64 is off — has ~256 s resolution at epoch magnitude (~1.75e9),
+    which would merge every event into one concurrency bucket.
+    """
+    return _features_local(pid, sec, op, client, primary_node_id, age_seconds,
+                           n=n, sharded=False)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_features_sharded(n: int, ndata: int):
+    """Compile the event-sharded feature kernel for one (n, mesh) point."""
+    mesh = make_mesh(n_data=ndata)
+
+    def local_fn(pid, sec, op, client, primary_node_id, age_seconds):
+        return _features_local(pid, sec, op, client, primary_node_id,
+                               age_seconds, n=n, sharded=True)
+
+    return jax.jit(jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    ))
+
+
 def compute_features_jax(
     manifest: Manifest,
     events: EventLog,
     observation_end: float | None = None,
+    mesh_shape: dict[str, int] | None = None,
+    check_sorted: bool = True,
 ) -> FeatureTable:
-    """Drop-in replacement for features/numpy_backend.compute_features."""
+    """Drop-in replacement for features/numpy_backend.compute_features.
+
+    ``mesh_shape={"data": N}`` shards the event stream over N chips (see
+    module docstring); it requires a time-sorted log — the reference sorts
+    its log globally (src/access_simulator.py:60) and every producer in this
+    framework emits sorted events.  ``check_sorted=False`` skips the O(e)
+    host-side verification for very large trusted logs.
+    """
     n = len(manifest)
 
     if observation_end is None:
         observation_end = float(events.ts.max()) if len(events) else time.time()
 
-    if len(events) == 0:
+    ndata = int((mesh_shape or {}).get(DATA_AXIS, 1))
+
+    if len(events) == 0 or n == 0:
         # Degenerate log: all counters zero, locality 1.0 (compute_features.py:60,68).
         raw = np.zeros((n, 5), dtype=np.float64)
         raw[:, 1] = observation_end - manifest.creation_ts
         raw[:, 3] = 1.0
-        lo, hi = raw.min(axis=0), raw.max(axis=0)
-        norm = np.where(hi > lo, (raw - lo) / np.where(hi > lo, hi - lo, 1.0), 0.0)
+        if n:
+            lo, hi = raw.min(axis=0), raw.max(axis=0)
+            norm = np.where(hi > lo, (raw - lo) / np.where(hi > lo, hi - lo, 1.0), 0.0)
+        else:
+            norm = raw.copy()
         zeros = np.zeros(n, dtype=np.float64)
         return FeatureTable(paths=list(manifest.paths), raw=raw, norm=norm,
                             writes=zeros, reads=zeros.copy())
@@ -133,15 +247,33 @@ def compute_features_jax(
     sec = (sec_f - sec_f.min()).astype(np.int32)
     age = np.asarray(observation_end - manifest.creation_ts, dtype=np.float64)
 
-    raw, norm, writes, reads = features_kernel(
-        jnp.asarray(events.path_id, dtype=jnp.int32),
-        jnp.asarray(sec),
-        jnp.asarray(events.op),
-        jnp.asarray(events.client_id, dtype=jnp.int32),
-        jnp.asarray(manifest.primary_node_id, dtype=jnp.int32),
-        jnp.asarray(age),
-        n,
-    )
+    pid = np.asarray(events.path_id, dtype=np.int32)
+    op = np.asarray(events.op)
+    client = np.asarray(events.client_id, dtype=np.int32)
+
+    if ndata > 1:
+        if check_sorted and not bool(np.all(np.diff(events.ts) >= 0)):
+            raise ValueError(
+                "sharded feature extraction requires a time-sorted event log "
+                "(shards must be time-contiguous for exact concurrency); "
+                "sort the log or pass check_sorted=False at your own risk"
+            )
+        pid, sec, op, client = _pad_events(pid, sec, op, client, ndata)
+        fn = _build_features_sharded(n, ndata)
+        raw, norm, writes, reads = fn(
+            jnp.asarray(pid), jnp.asarray(sec), jnp.asarray(op),
+            jnp.asarray(client),
+            jnp.asarray(manifest.primary_node_id, dtype=jnp.int32),
+            jnp.asarray(age),
+        )
+    else:
+        raw, norm, writes, reads = features_kernel(
+            jnp.asarray(pid), jnp.asarray(sec), jnp.asarray(op),
+            jnp.asarray(client),
+            jnp.asarray(manifest.primary_node_id, dtype=jnp.int32),
+            jnp.asarray(age),
+            n,
+        )
     return FeatureTable(
         paths=list(manifest.paths),
         raw=np.asarray(raw, dtype=np.float64),

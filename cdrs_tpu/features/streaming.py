@@ -6,18 +6,31 @@ events as a stream.  This module keeps per-file running counters on device and
 folds in fixed-size event batches with the same segment kernels as the batch
 backend (features/jax_backend.py):
 
-* ``access_freq`` / ``writes`` / ``local_accesses`` — additive segment sums.
+* ``access_freq`` / ``writes`` / ``local_accesses`` — additive int32 segment
+  sums (exact regardless of x64 mode; float32 counters would silently
+  saturate at 2**24 events per file — reachable at the 1B-event target).
 * ``concurrency`` (max events-per-second per file) — per-batch run-length
   counts over lexsorted (path, second) plus an exact cross-batch merge: the
-  state carries each file's last-seen second and that second's partial count,
-  so a second split across batch boundaries is re-joined before the max.
-  Requires the stream to be time-ordered per file (the reference sorts its
-  log globally, src/access_simulator.py:60).
+  state carries each file's last-seen second and that second's running count,
+  and a batch whose first second for a file equals the carried second absorbs
+  the carried count before the max.  Requires the stream to be time-ordered
+  per file across batches (the reference sorts its log globally,
+  src/access_simulator.py:60).
 * ``age_seconds`` / ``write_ratio`` / min-max norm — computed at finalize
   from the accumulated counters (exact formulas of SURVEY.md §2.2).
 
+**Multi-chip**: ``mesh_shape={"data": N}`` shards each batch's events over the
+mesh's data axis (time-contiguous shards — requires globally time-sorted
+batches), psum-merging the per-shard counter deltas — the streaming analogue
+of the sharded batch kernel (features/jax_backend.py).  Cross-shard split
+seconds are corrected exactly via the ≤ 2N shard-edge seconds (all_gather +
+psum), and carried counts are folded in per file at its first second of the
+batch.  The single-device path is the same code over a 1-element mesh
+(collectives become identity ops — parallel/mesh.py's uniform-path design).
+
 ``stream_features`` over any batch split of a log is bit-equal to the batch
-backends — enforced by tests/test_streaming.py.
+backends — enforced by tests/test_streaming.py, including on the 8-device
+CPU mesh.
 """
 
 from __future__ import annotations
@@ -29,8 +42,12 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from ..io.events import EventLog, Manifest
+from ..parallel.mesh import DATA_AXIS, make_mesh
+from .jax_backend import _concurrency_local, _pad_events
 from .numpy_backend import FeatureTable, minmax_normalize
 
 __all__ = ["StreamFeatureState", "stream_init", "stream_update", "stream_finalize"]
@@ -38,19 +55,14 @@ __all__ = ["StreamFeatureState", "stream_init", "stream_update", "stream_finaliz
 
 @dataclass
 class StreamFeatureState:
-    """Per-file running counters (device arrays) + host scalars.
-
-    Counters are int32: exact accumulation with no dependence on x64 mode
-    (float32 counters would silently saturate at 2**24 events per file —
-    reachable at the 1B-event target scale).
-    """
+    """Per-file running counters (device arrays, replicated) + host scalars."""
 
     access_freq: jax.Array   # (n,) int32
     writes: jax.Array        # (n,) int32
     local_acc: jax.Array     # (n,) int32
     conc_max: jax.Array      # (n,) int32
     last_sec: jax.Array      # (n,) int32, -1 = never seen
-    last_count: jax.Array    # (n,) int32
+    last_count: jax.Array    # (n,) int32 — running count of last_sec's bucket
     sec_base: float | None = None   # host: epoch floor of the first event seen
     observation_end: float | None = None  # host: max raw ts seen
     n_events: int = 0
@@ -66,76 +78,123 @@ def stream_init(n_files: int) -> StreamFeatureState:
 
 
 @functools.lru_cache(maxsize=32)
-def _build_update(e, n):
-    @jax.jit
-    def update(pid, sec, op, client, primary_node_id,
-               access_freq, writes, local_acc, conc_max, last_sec, last_count):
+def _build_update(e: int, n: int, ndata: int = 1):
+    """Compile the sharded batch fold for one (batch rows, n files, mesh) point.
+
+    The returned function takes the event shard columns plus the replicated
+    state arrays and returns the updated state arrays.
+    """
+    mesh = make_mesh(n_data=ndata)
+    imax = jnp.int32(np.iinfo(np.int32).max)
+
+    def local_fn(pid, sec, op, client, primary_node_id,
+                 access_freq, writes, local_acc, conc_max, last_sec, last_count):
         valid = pid >= 0
-        w = valid.astype(jnp.int32)
+        wi = valid.astype(jnp.int32)
         pid_c = jnp.where(valid, pid, 0).astype(jnp.int32)
 
-        access_freq = access_freq + jax.ops.segment_sum(w, pid_c, num_segments=n)
-        writes = writes + jax.ops.segment_sum(w * (op == 1), pid_c, num_segments=n)
-        is_local = (client == primary_node_id[pid_c]).astype(jnp.int32) * w
-        local_acc = local_acc + jax.ops.segment_sum(is_local, pid_c, num_segments=n)
+        batch_access = lax.psum(
+            jax.ops.segment_sum(wi, pid_c, num_segments=n), DATA_AXIS)
+        access_freq = access_freq + batch_access
+        writes = writes + lax.psum(
+            jax.ops.segment_sum(wi * (op == 1), pid_c, num_segments=n), DATA_AXIS)
+        is_local = (client == primary_node_id[pid_c]).astype(jnp.int32) * wi
+        local_acc = local_acc + lax.psum(
+            jax.ops.segment_sum(is_local, pid_c, num_segments=n), DATA_AXIS)
+        present = batch_access > 0
 
-        # --- concurrency with cross-batch merge ---
+        # --- concurrency ---
         sort_pid = jnp.where(valid, pid, n).astype(jnp.int32)
-        order = jnp.lexsort((sec, sort_pid))
-        s_pid = sort_pid[order]
-        s_sec = sec[order]
-        s_w = w[order]
-
-        first_of_pid = jnp.concatenate([
-            jnp.ones((1,), bool), s_pid[1:] != s_pid[:-1]])
-        last_of_pid = jnp.concatenate([
-            s_pid[1:] != s_pid[:-1], jnp.ones((1,), bool)])
-        new_run = first_of_pid | jnp.concatenate([
-            jnp.ones((1,), bool), s_sec[1:] != s_sec[:-1]])
-        run_id = jnp.cumsum(new_run.astype(jnp.int32)) - 1
-        run_count = jax.ops.segment_sum(s_w, run_id, num_segments=e)  # (e,) run-level
-
-        s_pid_safe = jnp.where(s_pid < n, s_pid, 0)
-        # Carry merge: a run that starts a file's presence in this batch and
-        # continues the file's last-seen second absorbs that second's partial
-        # count from the previous batch.
-        carry = jnp.where(
-            first_of_pid & (last_sec[s_pid_safe] == s_sec) & (s_pid < n),
-            last_count[s_pid_safe],
-            0,
-        )
-        # run-level effective counts, viewed at run-start events
-        eff = run_count[run_id] + carry  # carry only nonzero at run starts
-        eff_at_start = jnp.where(new_run & (s_pid < n), eff, 0)
-        conc_max = jnp.maximum(
+        conc = jnp.maximum(
             conc_max,
-            jax.ops.segment_max(eff_at_start, s_pid_safe, num_segments=n),
+            lax.pmax(_concurrency_local(sort_pid, sec, wi, n), DATA_AXIS),
         )
 
-        # Store each file's trailing (second, count) for the next batch.  The
-        # trailing run's effective count includes the carry when the file has
-        # a single run in this batch.  ``eff`` lives at run-start events;
-        # propagate it to every event of the run via each run's start index.
-        start_idx = jax.ops.segment_max(
-            jnp.where(new_run, jnp.arange(e), 0), run_id, num_segments=e)
-        eff_run = eff_at_start[start_idx[run_id]]
+        # Per-file first/last second of this batch (int-extreme defaults for
+        # absent files; ``present`` gates every use).
+        sec_hi = jnp.where(valid, sec, imax)
+        sec_lo = jnp.where(valid, sec, -1)
+        s_first = lax.pmin(
+            jnp.minimum(jax.ops.segment_min(sec_hi, pid_c, num_segments=n), imax),
+            DATA_AXIS)
+        s_last = lax.pmax(
+            jnp.maximum(jax.ops.segment_max(sec_lo, pid_c, num_segments=n), -1),
+            DATA_AXIS)
 
-        sel = last_of_pid & (s_pid < n)
-        tgt = jnp.where(sel, s_pid, n)  # n = drop
-        last_sec = last_sec.at[tgt].set(s_sec, mode="drop")
-        last_count = last_count.at[tgt].set(eff_run, mode="drop")
-        return access_freq, writes, local_acc, conc_max, last_sec, last_count
+        # Cross-batch carry: the carried (last_sec, last_count) continues into
+        # this batch iff the file's first second here equals the carried one.
+        carry = jnp.where(present & (last_sec == s_first), last_count, 0)
 
-    return update
+        # Exact totals at each file's first second (local counts of events in
+        # that file's first-second bucket, psum-merged, plus the carry).
+        l_first = jax.ops.segment_sum(
+            wi * (sec == s_first[pid_c]), pid_c, num_segments=n)
+        total_first = lax.psum(l_first, DATA_AXIS) + carry
+        conc = jnp.maximum(conc, jnp.where(present, total_first, 0))
+
+        # Shard-edge seconds (time-contiguous shards ⇒ only these can hold a
+        # (file, second) bucket split across shards): psum exact counts, with
+        # the carry folded in where the edge second is a file's first.
+        smin = jnp.min(sec_hi)
+        smax = jnp.max(sec_lo)
+        bounds = lax.all_gather(jnp.stack([smin, smax]), DATA_AXIS).reshape(-1)
+
+        def edge_count(i, conc):
+            b = bounds[i]
+            cnt = lax.psum(
+                jax.ops.segment_sum(wi * (sec == b), pid_c, num_segments=n),
+                DATA_AXIS)
+            cnt = cnt + jnp.where(s_first == b, carry, 0)
+            return jnp.maximum(conc, jnp.where(present, cnt, 0))
+
+        conc = lax.fori_loop(0, bounds.shape[0], edge_count, conc)
+
+        # Trailing (second, running count) for the next batch.  The last
+        # second's total is exact: either all its events sit on one shard
+        # (local count psums right because other shards contribute 0 at that
+        # second for that file... only when split across shards do multiple
+        # shards contribute, and the psum of per-shard partial counts IS the
+        # total), plus the carry when the batch has a single bucket.
+        l_last = jax.ops.segment_sum(
+            wi * (sec == s_last[pid_c]), pid_c, num_segments=n)
+        total_last = lax.psum(l_last, DATA_AXIS) + jnp.where(
+            s_last == s_first, carry, 0)
+        new_last_sec = jnp.where(present, s_last, last_sec)
+        new_last_count = jnp.where(present, total_last, last_count)
+
+        return access_freq, writes, local_acc, conc, new_last_sec, new_last_count
+
+    return jax.jit(jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P()),
+        check_vma=False,
+    ))
 
 
 def stream_update(state: StreamFeatureState, events: EventLog,
-                  manifest: Manifest) -> StreamFeatureState:
-    """Fold one event batch into the state (batch must be time-ordered)."""
+                  manifest: Manifest,
+                  mesh_shape: dict[str, int] | None = None,
+                  check_sorted: bool = True) -> StreamFeatureState:
+    """Fold one event batch into the state.
+
+    Batches must be time-ordered per file across calls; with a multi-device
+    ``mesh_shape`` each batch must additionally be globally time-sorted (the
+    shards must be time-contiguous — see module docstring; verified per batch
+    unless ``check_sorted=False``).
+    """
     e = len(events)
     if e == 0:
         return state
     n = len(manifest)
+    ndata = int((mesh_shape or {}).get(DATA_AXIS, 1))
+    if ndata > 1 and check_sorted and not bool(np.all(np.diff(events.ts) >= 0)):
+        raise ValueError(
+            "sharded stream_update requires each batch to be globally "
+            "time-sorted (shards must be time-contiguous for exact "
+            "concurrency); sort the stream or pass check_sorted=False")
 
     batch_max = float(events.ts.max())
     obs = batch_max if state.observation_end is None else max(
@@ -146,12 +205,15 @@ def stream_update(state: StreamFeatureState, events: EventLog,
         sec_base = float(np.floor(events.ts.min()))
     sec = (np.floor(events.ts) - sec_base).astype(np.int32)
 
-    fn = _build_update(e, n)
+    pid = np.asarray(events.path_id, dtype=np.int32)
+    op = np.asarray(events.op)
+    client = np.asarray(events.client_id, dtype=np.int32)
+    pid, sec, op, client = _pad_events(pid, sec, op, client, ndata)
+
+    fn = _build_update(len(pid), n, ndata)
     af, wr, la, cm, ls, lc = fn(
-        jnp.asarray(events.path_id, dtype=jnp.int32),
-        jnp.asarray(sec),
-        jnp.asarray(events.op),
-        jnp.asarray(events.client_id, dtype=jnp.int32),
+        jnp.asarray(pid), jnp.asarray(sec), jnp.asarray(op),
+        jnp.asarray(client),
         jnp.asarray(manifest.primary_node_id, dtype=jnp.int32),
         state.access_freq, state.writes, state.local_acc,
         state.conc_max, state.last_sec, state.last_count,

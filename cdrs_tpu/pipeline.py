@@ -74,9 +74,13 @@ def run_pipeline(cfg: PipelineConfig, outdir: str | None = None) -> PipelineResu
     metrics.record("n_events", len(events))
 
     if cfg.backend == "jax":
+        import functools
+
         from .features import get_jax_backend
 
-        compute = get_jax_backend()
+        # The feature kernel shards the event stream over the mesh's data
+        # axis (features/jax_backend.py); model-axis entries are ignored.
+        compute = functools.partial(get_jax_backend(), mesh_shape=cfg.mesh_shape)
     else:
         from .features.numpy_backend import compute_features as compute
     with metrics.timer("features"):

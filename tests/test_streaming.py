@@ -74,6 +74,47 @@ def test_stream_concurrency_boundary_merge(workload):
     assert got.raw[0, 4] == 6.0  # concurrency: all six in one second
 
 
+@pytest.mark.parametrize("ndata", [2, 8])
+@pytest.mark.parametrize("n_batches", [1, 4])
+def test_sharded_stream_matches_batch_features(workload, ndata, n_batches):
+    """The mesh-sharded fold reproduces the full-log features exactly."""
+    manifest, events = workload
+    want = compute_features(manifest, events)
+
+    state = stream_init(len(manifest))
+    cuts = np.linspace(0, len(events), n_batches + 1).astype(int)
+    cuts[1:-1] += 7
+    cuts = np.clip(cuts, 0, len(events))
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        state = stream_update(state, _slice_events(events, int(lo), int(hi)),
+                              manifest, mesh_shape={"data": ndata})
+    got = stream_finalize(state, manifest)
+
+    np.testing.assert_allclose(got.raw, want.raw, rtol=1e-12, atol=1e-9)
+    np.testing.assert_allclose(got.norm, want.norm, rtol=1e-12, atol=1e-12)
+
+
+def test_sharded_stream_hot_second_across_batches_and_shards(workload):
+    """One second's events split across batches AND shards counts exactly once
+    with the carry folded in."""
+    manifest, _ = workload
+    n = len(manifest)
+    base = 1_700_000_000.0
+    ts = base + np.linspace(0.0, 0.9, 19)  # 19 events, one second, file 0
+    mk = lambda lo, hi: EventLog(
+        ts=ts[lo:hi],
+        path_id=np.zeros(hi - lo, dtype=np.int32),
+        op=np.zeros(hi - lo, dtype=np.int8),
+        client_id=np.zeros(hi - lo, dtype=np.int32),
+        clients=["dn1"],
+    )
+    state = stream_init(n)
+    state = stream_update(state, mk(0, 5), manifest, mesh_shape={"data": 4})
+    state = stream_update(state, mk(5, 19), manifest, mesh_shape={"data": 4})
+    got = stream_finalize(state, manifest)
+    assert got.raw[0, 4] == 19.0
+
+
 def test_minibatch_kmeans_recovers_blobs():
     rng = np.random.default_rng(5)
     centers = rng.normal(size=(8, 16)) * 5.0
