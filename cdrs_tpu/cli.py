@@ -231,32 +231,61 @@ def _cmd_stream(args) -> int:
     """Streaming mode: fold the access log in fixed-size batches, then cluster.
 
     The batch pipeline's result on the same log is identical (the stream fold
-    is exact — features/streaming.py); this path exists for logs too large to
-    hold in memory and for continuous operation.
+    is exact — features/streaming.py, features/streaming_np.py); this path
+    exists for logs too large to hold in memory and for continuous operation.
+    ``--kmeans_batch`` additionally makes the clustering itself incremental
+    (mini-batch KMeans, ops/kmeans_stream.py — the BASELINE config-5 mode).
     """
-    try:
-        from .features.streaming import stream_finalize, stream_init, stream_update
-    except ImportError as e:
-        print(f"streaming requires jax (the 'tpu' extra): {e}", file=sys.stderr)
-        return 1
     from .io.events import EventLog, Manifest
     from .models.replication import ReplicationPolicyModel
 
+    mesh_shape = _parse_mesh(args.mesh)
+    if args.kmeans_batch is not None:
+        # Validate before the (potentially hours-long) streaming pass.
+        if args.backend != "jax":
+            print("error: --kmeans_batch (mini-batch KMeans) requires "
+                  "--backend jax", file=sys.stderr)
+            return 1
+        if args.kmeans_batch < 1:
+            print(f"error: --kmeans_batch must be >= 1, got "
+                  f"{args.kmeans_batch}", file=sys.stderr)
+            return 1
+    if args.backend == "jax":
+        try:
+            from .features.streaming import (stream_finalize, stream_init,
+                                             stream_update)
+        except ImportError as e:
+            print(f"--backend jax requires jax (the 'tpu' extra): {e}",
+                  file=sys.stderr)
+            return 1
+        import functools
+
+        stream_update = functools.partial(stream_update, mesh_shape=mesh_shape)
+    else:
+        from .features.streaming_np import (
+            stream_finalize_np as stream_finalize,
+            stream_init_np as stream_init,
+            stream_update_np as stream_update,
+        )
+        if args.mesh:
+            print("warning: --mesh ignored for the numpy backend",
+                  file=sys.stderr)
+
     with StageTimer("stream") as t:
         manifest = Manifest.read_csv(args.manifest)
-        mesh_shape = _parse_mesh(args.mesh)
         state = stream_init(len(manifest))
         n_batches = 0
         for batch in EventLog.read_csv_batches(args.access_log, manifest,
                                                batch_size=args.batch_size):
-            state = stream_update(state, batch, manifest, mesh_shape=mesh_shape)
+            state = stream_update(state, batch, manifest)
             n_batches += 1
         table = stream_finalize(state, manifest)
     print(f"Streamed {state.n_events} events in {n_batches} batches "
           f"({t.elapsed:.2f}s)")
 
     model = ReplicationPolicyModel(
-        kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed),
+        kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed,
+                                batch_size=args.kmeans_batch),
         scoring_cfg=_load_scoring(args),
         backend=args.backend,
         mesh_shape=mesh_shape,
@@ -264,8 +293,10 @@ def _cmd_stream(args) -> int:
     with StageTimer("cluster") as t:
         decision = model.run(np.asarray(table.norm))
         decision.write_csv(args.output_csv)
-    print(f"Cluster centroid assignments ({args.k} clusters) saved to: "
-          f"{args.output_csv} in {t.elapsed:.2f}s")
+    mode = (f"mini-batch({args.kmeans_batch})" if args.kmeans_batch
+            else "full-batch")
+    print(f"Cluster centroid assignments ({args.k} clusters, {mode}) saved "
+          f"to: {args.output_csv} in {t.elapsed:.2f}s")
     return 0
 
 
@@ -358,7 +389,11 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("stream", help="stream the access log in batches, then cluster")
     p.add_argument("--manifest", required=True)
     p.add_argument("--access_log", required=True)
-    p.add_argument("--batch_size", type=int, default=1_000_000)
+    p.add_argument("--batch_size", type=int, default=1_000_000,
+                   help="events per feature-fold batch")
+    p.add_argument("--kmeans_batch", type=int, default=None, metavar="ROWS",
+                   help="rows per incremental mini-batch KMeans step "
+                        "(jax backend; default: full-batch Lloyd)")
     p.add_argument("--k", type=int, default=4)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--output_csv", default="final_categories.csv")

@@ -140,8 +140,11 @@ class KMeansConfig:
     tol: float = 1e-4
     max_iter: int | None = None  # None -> max(100, n // 100)
     seed: int | None = 42        # reference: src/main.py:91 random_state=42
-    #: Mini-batch size for the streaming backend; None = full batch.
+    #: Rows per mini-batch for incremental (Sculley) KMeans; None = full-batch
+    #: Lloyd.  jax backend only (ops/kmeans_stream.py).
     batch_size: int | None = None
+    #: Shuffled passes over the data in mini-batch mode.
+    batch_epochs: int = 5
 
     def resolve_max_iter(self, n: int) -> int:
         if self.max_iter is not None:

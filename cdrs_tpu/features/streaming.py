@@ -48,7 +48,7 @@ from jax.sharding import PartitionSpec as P
 from ..io.events import EventLog, Manifest
 from ..parallel.mesh import DATA_AXIS, make_mesh
 from .jax_backend import _concurrency_local, _pad_events
-from .numpy_backend import FeatureTable, minmax_normalize
+from .numpy_backend import FeatureTable
 
 __all__ = ["StreamFeatureState", "stream_init", "stream_update", "stream_finalize"]
 
@@ -230,31 +230,9 @@ def stream_update(state: StreamFeatureState, events: EventLog,
 def stream_finalize(state: StreamFeatureState, manifest: Manifest,
                     observation_end: float | None = None) -> FeatureTable:
     """Assemble the five features + norms from the accumulated counters."""
-    import time
+    from .streaming_np import finalize_counters
 
-    n = len(manifest)
     if observation_end is None:
-        observation_end = (
-            state.observation_end if state.observation_end is not None else time.time()
-        )
-
-    access_freq = np.asarray(state.access_freq, dtype=np.float64)
-    writes = np.asarray(state.writes, dtype=np.float64)
-    local_acc = np.asarray(state.local_acc, dtype=np.float64)
-    concurrency = np.asarray(state.conc_max, dtype=np.float64)
-    reads = access_freq - writes
-
-    locality = np.where(access_freq > 0,
-                        local_acc / np.maximum(access_freq, 1.0), 1.0)
-    age_seconds = observation_end - manifest.creation_ts
-    mean_writes = float(writes.mean()) if n else 0.0
-    if mean_writes == 0:
-        mean_writes = 1.0  # reference: compute_features.py:64-65
-    write_ratio = writes / mean_writes
-
-    raw = np.stack([access_freq, age_seconds, write_ratio, locality, concurrency],
-                   axis=1)
-    norm = np.stack([minmax_normalize(raw[:, j]) for j in range(raw.shape[1])],
-                    axis=1)
-    return FeatureTable(paths=list(manifest.paths), raw=raw, norm=norm,
-                        writes=writes, reads=reads)
+        observation_end = state.observation_end
+    return finalize_counters(state.access_freq, state.writes, state.local_acc,
+                             state.conc_max, manifest, observation_end)

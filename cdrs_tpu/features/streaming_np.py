@@ -1,0 +1,146 @@
+"""NumPy streaming feature fold — jax-free batch-incremental counters.
+
+The golden-model counterpart of features/streaming.py: folds time-ordered
+event batches into per-file running counters with plain ``np.bincount`` /
+``np.unique`` segment reductions, including the exact cross-batch concurrency
+merge (a (path, second) bucket split across batches counts once, with the
+carried partial count absorbed at the file's first second of the next batch).
+
+Exists so ``cdrs stream --backend numpy`` runs on a jax-free install (the
+``tpu`` extra is optional — pyproject.toml) and as the parity reference for
+the sharded device fold.  Semantics mirror reference src/compute_features.py
+(SURVEY.md §2.2); batch-split invariance is enforced by tests/test_streaming.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..io.events import EventLog, Manifest
+from .numpy_backend import FeatureTable, minmax_normalize
+
+__all__ = ["NumpyStreamState", "stream_init_np", "stream_update_np",
+           "stream_finalize_np", "finalize_counters"]
+
+
+@dataclass
+class NumpyStreamState:
+    """Per-file running counters (int64) + host scalars."""
+
+    access_freq: np.ndarray   # (n,) int64
+    writes: np.ndarray        # (n,) int64
+    local_acc: np.ndarray     # (n,) int64
+    conc_max: np.ndarray      # (n,) int64
+    last_sec: np.ndarray      # (n,) int64, -1 = never seen
+    last_count: np.ndarray    # (n,) int64 — running count of last_sec's bucket
+    sec_base: float | None = None
+    observation_end: float | None = None
+    n_events: int = 0
+
+
+def stream_init_np(n_files: int) -> NumpyStreamState:
+    z = lambda: np.zeros(n_files, dtype=np.int64)
+    return NumpyStreamState(
+        access_freq=z(), writes=z(), local_acc=z(), conc_max=z(),
+        last_sec=np.full(n_files, -1, dtype=np.int64), last_count=z(),
+    )
+
+
+def stream_update_np(state: NumpyStreamState, events: EventLog,
+                     manifest: Manifest) -> NumpyStreamState:
+    """Fold one event batch (time-ordered per file across calls) in place."""
+    e = len(events)
+    if e == 0:
+        return state
+    n = len(manifest)
+
+    batch_max = float(events.ts.max())
+    state.observation_end = batch_max if state.observation_end is None else max(
+        state.observation_end, batch_max)
+    if state.sec_base is None:
+        state.sec_base = float(np.floor(events.ts.min()))
+    sec_all = (np.floor(events.ts) - state.sec_base).astype(np.int64)
+    state.n_events += e
+
+    keep = events.path_id >= 0
+    pid = events.path_id[keep].astype(np.int64)
+    sec = sec_all[keep]
+    op = events.op[keep]
+    client = events.client_id[keep]
+    if len(pid) == 0:
+        return state
+
+    state.access_freq += np.bincount(pid, minlength=n)
+    state.writes += np.bincount(pid[op == 1], minlength=n)
+    is_local = client == manifest.primary_node_id[pid]
+    state.local_acc += np.bincount(pid[is_local], minlength=n)
+
+    # Per-(path, second) bucket counts via a dense composite key (second range
+    # is bounded by the batch's time span).
+    smin = sec.min()
+    span = int(sec.max() - smin) + 1
+    key = pid * span + (sec - smin)
+    uniq, cnt = np.unique(key, return_counts=True)
+    upid = uniq // span
+    usec = uniq % span + smin
+    cnt = cnt.astype(np.int64)
+
+    # ``uniq`` is sorted by (path, second): the first occurrence per path is
+    # its earliest bucket (where the cross-batch carry applies), the last its
+    # latest (the next carry).
+    pids_present, fidx = np.unique(upid, return_index=True)
+    carry = state.last_sec[pids_present] == usec[fidx]
+    cnt[fidx[carry]] += state.last_count[pids_present[carry]]
+
+    np.maximum.at(state.conc_max, upid, cnt)
+
+    lidx = len(upid) - 1 - np.unique(upid[::-1], return_index=True)[1]
+    state.last_sec[pids_present] = usec[lidx]
+    state.last_count[pids_present] = cnt[lidx]
+    return state
+
+
+def finalize_counters(access_freq, writes, local_acc, concurrency,
+                      manifest: Manifest,
+                      observation_end: float | None) -> FeatureTable:
+    """Five features + norms from accumulated counters (any array-likes).
+
+    Shared by the numpy and device stream folds; formulas per SURVEY.md §2.2
+    (reference: src/compute_features.py:37-94).
+    """
+    import time
+
+    n = len(manifest)
+    if observation_end is None:
+        observation_end = time.time()
+
+    access_freq = np.asarray(access_freq, dtype=np.float64)
+    writes = np.asarray(writes, dtype=np.float64)
+    local_acc = np.asarray(local_acc, dtype=np.float64)
+    concurrency = np.asarray(concurrency, dtype=np.float64)
+    reads = access_freq - writes
+
+    locality = np.where(access_freq > 0,
+                        local_acc / np.maximum(access_freq, 1.0), 1.0)
+    age_seconds = observation_end - manifest.creation_ts
+    mean_writes = float(writes.mean()) if n else 0.0
+    if mean_writes == 0:
+        mean_writes = 1.0  # reference: compute_features.py:64-65
+    write_ratio = writes / mean_writes
+
+    raw = np.stack([access_freq, age_seconds, write_ratio, locality, concurrency],
+                   axis=1)
+    norm = np.stack([minmax_normalize(raw[:, j]) for j in range(raw.shape[1])],
+                    axis=1)
+    return FeatureTable(paths=list(manifest.paths), raw=raw, norm=norm,
+                        writes=writes, reads=reads)
+
+
+def stream_finalize_np(state: NumpyStreamState, manifest: Manifest,
+                       observation_end: float | None = None) -> FeatureTable:
+    if observation_end is None:
+        observation_end = state.observation_end
+    return finalize_counters(state.access_freq, state.writes, state.local_acc,
+                             state.conc_max, manifest, observation_end)

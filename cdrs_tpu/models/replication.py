@@ -96,6 +96,9 @@ class ReplicationPolicyModel:
                 f"{n} samples found, but K={cfg.k} requested; cannot cluster"
             )  # reference guard: src/main.py:84-86
         if self.backend == "numpy":
+            if cfg.batch_size is not None:
+                raise ValueError(
+                    "mini-batch KMeans (batch_size) requires the jax backend")
             from ..ops.kmeans_np import kmeans
 
             return kmeans(
@@ -103,6 +106,8 @@ class ReplicationPolicyModel:
                 random_state=cfg.seed, max_iter=cfg.max_iter,
                 init_centroids=init_centroids,
             )
+        if cfg.batch_size is not None:
+            return self._cluster_minibatch(X, init_centroids)
         from ..ops.kmeans_jax import kmeans_jax
 
         centroids, labels = kmeans_jax(
@@ -112,6 +117,39 @@ class ReplicationPolicyModel:
             mesh_shape=self.mesh_shape,
         )
         return np.asarray(centroids), np.asarray(labels)
+
+    def _cluster_minibatch(self, X: np.ndarray, init_centroids=None):
+        """Incremental (Sculley) KMeans over shuffled row batches.
+
+        The BASELINE config-5 capability reached through the same model API:
+        ``batch_epochs`` seeded-shuffled passes of ``batch_size`` rows through
+        ops/kmeans_stream.MiniBatchKMeans, then a chunked assignment pass.
+        Bounded device memory — only one batch is resident per step.
+        """
+        import jax.numpy as jnp
+
+        from ..ops.kmeans_stream import MiniBatchKMeans, MiniBatchState
+
+        cfg = self.kmeans_cfg
+        n = X.shape[0]
+        bs = int(cfg.batch_size)
+        if bs < 1:
+            raise ValueError(f"batch_size must be >= 1, got {bs}")
+        mb = MiniBatchKMeans(k=cfg.k, seed=cfg.seed, mesh_shape=self.mesh_shape)
+        if init_centroids is not None:
+            mb.state = MiniBatchState(
+                centroids=jnp.asarray(np.asarray(init_centroids, np.float32)),
+                counts=jnp.zeros((cfg.k,), np.float32),
+            )
+        rng = np.random.default_rng(cfg.seed)
+        for _ in range(max(1, int(cfg.batch_epochs))):
+            order = rng.permutation(n)
+            for lo in range(0, n, bs):
+                mb.partial_fit(np.asarray(X[order[lo:lo + bs]], np.float32))
+        labels = np.empty(n, dtype=np.int32)
+        for lo in range(0, n, bs):
+            labels[lo:lo + bs] = mb.predict(X[lo:lo + bs])
+        return mb.centroids, labels
 
     # -- scoring ----------------------------------------------------------
     def score(self, X: np.ndarray, labels: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:

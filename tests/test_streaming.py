@@ -137,6 +137,79 @@ def test_minibatch_kmeans_recovers_blobs():
         assert (p == p[0]).mean() > 0.95
 
 
+def test_minibatch_model_path_consistent_with_full_batch():
+    """ReplicationPolicyModel with batch_size set must recover the same blob
+    structure (and categories) as the full-batch path on small data."""
+    from cdrs_tpu.config import KMeansConfig
+    from cdrs_tpu.models.replication import ReplicationPolicyModel
+
+    rng = np.random.default_rng(11)
+    centers = rng.random((4, 5))  # feature-space-like [0,1] blobs
+    lab = rng.integers(0, 4, size=2000)
+    X = np.clip(centers[lab] + rng.normal(size=(2000, 5)) * 0.03, 0, 1)
+
+    full = ReplicationPolicyModel(
+        kmeans_cfg=KMeansConfig(k=4, seed=0), backend="jax").run(X)
+    mini = ReplicationPolicyModel(
+        kmeans_cfg=KMeansConfig(k=4, seed=0, batch_size=256),
+        backend="jax").run(X)
+
+    # Same partition up to cluster relabeling: match mini centroids to full
+    # centroids and compare label agreement + categories.
+    d = np.linalg.norm(full.centroids[:, None] - mini.centroids[None], axis=2)
+    perm = d.argmin(axis=1)
+    assert sorted(perm.tolist()) == [0, 1, 2, 3]  # bijective matching
+    agree = (perm[full.labels] == mini.labels).mean()
+    assert agree > 0.98
+    assert [mini.categories[perm[j]] for j in range(4)] == full.categories
+
+
+def test_cli_stream_minibatch_and_numpy_fold(tmp_path, workload):
+    """CLI-level: `cdrs stream --kmeans_batch N` (jax) and `--backend numpy`
+    both produce a final_categories.csv consistent with the batch path."""
+    from cdrs_tpu.cli import main
+
+    manifest, events = workload
+    mpath, apath = tmp_path / "m.csv", tmp_path / "a.log"
+    manifest.write_csv(str(mpath))
+    events.write_csv(str(apath), manifest)
+
+    # batch reference via the pipeline stages
+    out_batch = tmp_path / "batch.csv"
+    rc = main(["features", "--manifest", str(mpath), "--access_log",
+               str(apath), "--out", str(tmp_path / "f.csv")])
+    assert rc == 0
+    rc = main(["cluster", "--input_path", str(tmp_path / "f.csv"),
+               "--k", "4", "--seed", "0", "--output_csv", str(out_batch),
+               "--medians_from_data"])
+    assert rc == 0
+
+    out_mb = tmp_path / "mb.csv"
+    rc = main(["stream", "--manifest", str(mpath), "--access_log", str(apath),
+               "--batch_size", "512", "--k", "4", "--seed", "0",
+               "--backend", "jax", "--kmeans_batch", "64",
+               "--output_csv", str(out_mb), "--medians_from_data"])
+    assert rc == 0
+    out_np = tmp_path / "np.csv"
+    rc = main(["stream", "--manifest", str(mpath), "--access_log", str(apath),
+               "--batch_size", "512", "--k", "4", "--seed", "0",
+               "--backend", "numpy", "--output_csv", str(out_np),
+               "--medians_from_data"])
+    assert rc == 0
+
+    import csv as _csv
+    cats = {}
+    for name, p in (("batch", out_batch), ("mb", out_mb), ("np", out_np)):
+        with open(p) as f:
+            rows = list(_csv.DictReader(f))
+        assert len(rows) == 4
+        cats[name] = sorted(r["category"] for r in rows)
+    # numpy full-batch stream path matches the batch CLI path exactly
+    assert cats["np"] == cats["batch"]
+    # mini-batch recovers the same category multiset on this small workload
+    assert cats["mb"] == cats["batch"]
+
+
 def test_minibatch_state_is_checkpointable():
     """State round-trips through host numpy (checkpoint/resume, SURVEY.md §5)."""
     import jax.numpy as jnp

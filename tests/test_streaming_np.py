@@ -1,0 +1,109 @@
+"""NumPy streaming-fold tests — deliberately NOT gated on jax.
+
+features/streaming_np.py exists so ``cdrs stream --backend numpy`` runs on a
+jax-free install (the optional 'tpu' extra); these tests run on such an
+install and would catch an accidental jax import sneaking into that path.
+"""
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.config import GeneratorConfig, KMeansConfig, SimulatorConfig
+from cdrs_tpu.features.numpy_backend import compute_features
+from cdrs_tpu.features.streaming_np import (
+    stream_finalize_np, stream_init_np, stream_update_np)
+from cdrs_tpu.io.events import EventLog
+from cdrs_tpu.sim.access import simulate_access
+from cdrs_tpu.sim.generator import generate_population
+
+
+@pytest.fixture(scope="module")
+def workload():
+    manifest = generate_population(GeneratorConfig(n_files=100, seed=3))
+    events = simulate_access(manifest, SimulatorConfig(duration_seconds=90.0, seed=3))
+    return manifest, events
+
+
+def _slice_events(events, lo, hi):
+    return EventLog(
+        ts=events.ts[lo:hi], path_id=events.path_id[lo:hi],
+        op=events.op[lo:hi], client_id=events.client_id[lo:hi],
+        clients=events.clients,
+    )
+
+
+@pytest.mark.parametrize("n_batches", [1, 3, 7])
+def test_numpy_stream_fold_matches_batch_features(workload, n_batches):
+    """The jax-free fold is bit-equal to the batch golden model over any
+    batch split of a time-ordered log."""
+    manifest, events = workload
+    want = compute_features(manifest, events)
+
+    state = stream_init_np(len(manifest))
+    cuts = np.linspace(0, len(events), n_batches + 1).astype(int)
+    cuts[1:-1] += 13  # shift interior cuts off any natural boundary
+    cuts = np.clip(cuts, 0, len(events))
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        state = stream_update_np(state, _slice_events(events, int(lo), int(hi)),
+                                 manifest)
+    got = stream_finalize_np(state, manifest)
+
+    np.testing.assert_allclose(got.raw, want.raw, rtol=1e-12, atol=1e-9)
+    np.testing.assert_allclose(got.norm, want.norm, rtol=1e-12, atol=1e-12)
+
+
+def test_numpy_stream_concurrency_boundary_merge(workload):
+    """A (path, second) run split across batches must count as one run."""
+    manifest, _ = workload
+    n = len(manifest)
+    base = 1_700_000_000.0
+    ts = np.array([base + 0.1, base + 0.2, base + 0.3, base + 0.4,
+                   base + 0.5, base + 0.6])
+    mk = lambda lo, hi: EventLog(
+        ts=ts[lo:hi],
+        path_id=np.zeros(hi - lo, dtype=np.int32),
+        op=np.zeros(hi - lo, dtype=np.int8),
+        client_id=np.zeros(hi - lo, dtype=np.int32),
+        clients=["dn1"],
+    )
+    state = stream_init_np(n)
+    state = stream_update_np(state, mk(0, 2), manifest)
+    state = stream_update_np(state, mk(2, 6), manifest)
+    got = stream_finalize_np(state, manifest)
+    assert got.raw[0, 4] == 6.0
+
+
+def test_minibatch_rejected_on_numpy_backend():
+    from cdrs_tpu.models.replication import ReplicationPolicyModel
+
+    X = np.random.default_rng(0).random((64, 5))
+    with pytest.raises(ValueError, match="jax backend"):
+        ReplicationPolicyModel(
+            kmeans_cfg=KMeansConfig(k=4, batch_size=16), backend="numpy"
+        ).run(X)
+
+
+def test_cli_stream_numpy_backend(tmp_path, workload):
+    """`cdrs stream --backend numpy` end-to-end, and early --kmeans_batch
+    validation (before any streaming work happens)."""
+    from cdrs_tpu.cli import main
+
+    manifest, events = workload
+    mpath, apath = tmp_path / "m.csv", tmp_path / "a.log"
+    manifest.write_csv(str(mpath))
+    events.write_csv(str(apath), manifest)
+
+    out = tmp_path / "np.csv"
+    rc = main(["stream", "--manifest", str(mpath), "--access_log", str(apath),
+               "--batch_size", "512", "--k", "4", "--seed", "0",
+               "--backend", "numpy", "--output_csv", str(out),
+               "--medians_from_data"])
+    assert rc == 0
+    assert out.exists()
+
+    # numpy + --kmeans_batch is rejected up front with a clear message
+    rc = main(["stream", "--manifest", str(mpath), "--access_log", str(apath),
+               "--kmeans_batch", "64", "--backend", "numpy",
+               "--output_csv", str(tmp_path / "x.csv")])
+    assert rc == 1
+    assert not (tmp_path / "x.csv").exists()
