@@ -117,21 +117,23 @@ def _cmd_simulate(args) -> int:
 def _cmd_features(args) -> int:
     from .io.events import EventLog, Manifest
 
+    # Validate the mesh spec before the potentially long log parse.
+    mesh_shape = _parse_mesh(args.mesh)
+    if args.backend == "jax":
+        import functools
+
+        from .features.jax_backend import compute_features_jax
+
+        compute = functools.partial(compute_features_jax, mesh_shape=mesh_shape)
+    else:
+        if args.mesh:
+            print("warning: --mesh ignored for the numpy backend",
+                  file=sys.stderr)
+        from .features.numpy_backend import compute_features as compute
+
     with StageTimer("features") as t:
         manifest = Manifest.read_csv(args.manifest)
         events = EventLog.read_csv(args.access_log, manifest)
-        if args.backend == "jax":
-            import functools
-
-            from .features.jax_backend import compute_features_jax
-
-            compute = functools.partial(
-                compute_features_jax, mesh_shape=_parse_mesh(args.mesh))
-        else:
-            if args.mesh:
-                print("warning: --mesh ignored for the numpy backend",
-                      file=sys.stderr)
-            from .features.numpy_backend import compute_features as compute
         table = compute(manifest, events)
         out = args.out
         if os.path.isdir(out) or out.endswith(os.sep):

@@ -212,6 +212,7 @@ def compute_features_jax(
     observation_end: float | None = None,
     mesh_shape: dict[str, int] | None = None,
     check_sorted: bool = True,
+    as_device: bool = False,
 ) -> FeatureTable:
     """Drop-in replacement for features/numpy_backend.compute_features.
 
@@ -220,6 +221,11 @@ def compute_features_jax(
     its log globally (src/access_simulator.py:60) and every producer in this
     framework emits sorted events.  ``check_sorted=False`` skips the O(e)
     host-side verification for very large trusted logs.
+
+    ``as_device=True`` keeps the feature table's arrays on device (kernel
+    dtype — f32 without x64), so a jax pipeline can hand ``table.norm``
+    straight to the clustering kernel without a host round trip (at the
+    100M x 128 target the host copy alone is ~51 GB — SURVEY.md §7.4).
     """
     n = len(manifest)
 
@@ -274,6 +280,9 @@ def compute_features_jax(
             jnp.asarray(age),
             n,
         )
+    if as_device:
+        return FeatureTable(paths=list(manifest.paths), raw=raw, norm=norm,
+                            writes=writes, reads=reads)
     return FeatureTable(
         paths=list(manifest.paths),
         raw=np.asarray(raw, dtype=np.float64),

@@ -58,14 +58,17 @@ class FeatureTable:
         (reference: src/compute_features.py:70-75, 90-96)."""
         import csv
 
+        # One bulk host fetch if the table is device-resident (as_device=True).
+        raw = np.asarray(self.raw)
+        norm = np.asarray(self.norm)
         header = ["path", *self.raw_names, *self.norm_names]
         with open(path, "w", newline="") as f:
             w = csv.writer(f)
             w.writerow(header)
             for i, p in enumerate(self.paths):
                 w.writerow([p,
-                            *(repr(float(v)) for v in self.raw[i]),
-                            *(repr(float(v)) for v in self.norm[i])])
+                            *(repr(float(v)) for v in raw[i]),
+                            *(repr(float(v)) for v in norm[i])])
 
 
 def minmax_normalize(col: np.ndarray) -> np.ndarray:

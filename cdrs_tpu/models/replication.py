@@ -102,7 +102,7 @@ class ReplicationPolicyModel:
             from ..ops.kmeans_np import kmeans
 
             return kmeans(
-                X, cfg.k, number_of_files=n, tol=cfg.tol,
+                np.asarray(X), cfg.k, number_of_files=n, tol=cfg.tol,
                 random_state=cfg.seed, max_iter=cfg.max_iter,
                 init_centroids=init_centroids,
             )
@@ -141,22 +141,29 @@ class ReplicationPolicyModel:
                 centroids=jnp.asarray(np.asarray(init_centroids, np.float32)),
                 counts=jnp.zeros((cfg.k,), np.float32),
             )
+        import jax
+
+        is_dev = isinstance(X, jax.Array)
         rng = np.random.default_rng(cfg.seed)
         for _ in range(max(1, int(cfg.batch_epochs))):
             order = rng.permutation(n)
             for lo in range(0, n, bs):
-                mb.partial_fit(np.asarray(X[order[lo:lo + bs]], np.float32))
+                idx = order[lo:lo + bs]
+                # Device inputs batch via on-device gather — no host round trip.
+                mb.partial_fit(X[idx] if is_dev
+                               else np.asarray(X[idx], np.float32))
         labels = np.empty(n, dtype=np.int32)
         for lo in range(0, n, bs):
             labels[lo:lo + bs] = mb.predict(X[lo:lo + bs])
         return mb.centroids, labels
 
     # -- scoring ----------------------------------------------------------
-    def score(self, X: np.ndarray, labels: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def score(self, X, labels) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         if self.backend == "numpy":
             from ..ops.scoring_np import classify
 
-            return classify(X, labels, self.kmeans_cfg.k, self.scoring_cfg)
+            return classify(np.asarray(X), labels, self.kmeans_cfg.k,
+                            self.scoring_cfg)
         from ..ops.scoring_jax import classify_jax
 
         winner, scores, medians = classify_jax(X, labels, self.kmeans_cfg.k, self.scoring_cfg)
@@ -165,10 +172,14 @@ class ReplicationPolicyModel:
     # -- end to end -------------------------------------------------------
     def run(
         self,
-        X: np.ndarray,
+        X,
         feature_names: tuple[str, ...] | None = None,
         init_centroids: np.ndarray | None = None,
     ) -> ClusterDecision:
+        """``X`` may be a host ndarray or a device array (jax backend):
+        device inputs flow through clustering + scoring without a host
+        round trip — only the k-sized decision tables and the final labels
+        come back to host."""
         centroids, labels = self.cluster(X, init_centroids=init_centroids)
         winner, scores, medians = self.score(X, labels)
         return ClusterDecision(

@@ -492,20 +492,19 @@ def kmeans_jax_full(
     # pallas tiles rows internally (default 1024), so shards must divide it.
     multiple = ndata * (chunk_rows or (1024 if update == "pallas" else 1))
     if is_device_array:
-        # Device-resident input (benchmark / streaming path): never copy to
-        # host.  The caller must pre-size rows, passing ``n_valid`` when the
-        # trailing rows are padding; those rows get weight 0 and are excluded
-        # from reseed draws, exactly like the host padding path.
-        if X.shape[0] % multiple:
-            raise ValueError(
-                f"device-array input rows ({X.shape[0]}) must be a multiple "
-                f"of data_axis*chunk_rows ({multiple}); pad on device first "
-                f"and pass n_valid=<true row count>"
-            )
+        # Device-resident input (pipeline / benchmark / streaming path): never
+        # copy to host.  ``n_valid`` marks the true row count when the caller
+        # pre-padded; any remaining misalignment is padded on device (an HBM
+        # copy — still far cheaper than a host round trip).  Padded rows get
+        # weight 0 and are excluded from reseed draws, exactly like the host
+        # padding path.
         Xp = X.astype(dtype)
         n_valid = n if n_valid is None else int(n_valid)
         if n_valid > n:
             raise ValueError(f"n_valid={n_valid} exceeds rows {n}")
+        rem = (-Xp.shape[0]) % multiple
+        if rem:
+            Xp = jnp.pad(Xp, ((0, rem), (0, 0)))
     else:
         if n_valid is not None and n_valid != n:
             raise ValueError("n_valid is only for pre-padded device arrays")

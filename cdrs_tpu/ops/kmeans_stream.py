@@ -83,13 +83,17 @@ def _build_update(n_rows, n_valid, d, k, ndata, dtype_name, update):
 
 
 def _prep_batch(xb, ndata, dtype):
-    """Pad one batch for even sharding; returns (rows, n_valid)."""
+    """Pad one batch for even sharding; returns (rows, n_valid).
+
+    Device batches are padded on device (weight-0 rows via prefix_mask, same
+    contract as the host path)."""
     if isinstance(xb, jax.Array):
-        if xb.shape[0] % ndata:
-            raise ValueError(
-                f"device batch rows ({xb.shape[0]}) must divide data axis {ndata}"
-            )
-        return xb.astype(dtype), xb.shape[0]
+        n_valid = xb.shape[0]
+        rem = (-n_valid) % ndata
+        xb = xb.astype(dtype)
+        if rem:
+            xb = jnp.pad(xb, ((0, rem), (0, 0)))
+        return xb, n_valid
     xb = np.asarray(xb)
     return pad_rows(xb.astype(dtype, copy=False), ndata)
 
@@ -169,7 +173,7 @@ class MiniBatchKMeans:
         return np.asarray(self.state.centroids)
 
     def predict(self, X) -> np.ndarray:
-        import jax.numpy as jnp
-
-        return np.asarray(assign_labels_jax(jnp.asarray(np.asarray(X), dtype=self.dtype),
+        if self.state is None:
+            raise ValueError("no batches seen yet")
+        return np.asarray(assign_labels_jax(jnp.asarray(X, dtype=self.dtype),
                                             self.state.centroids))

