@@ -299,8 +299,14 @@ def run_bench(config: int = 2, backend: str | None = None,
         need = int(np.prod(list(mesh_shape.values())))
         if need > len(jax.devices()):
             # Scale the mesh down to what the host actually has (e.g. config 4
-            # on a single-chip runner) and note it.
-            mesh_shape = {"data": len(jax.devices())}
+            # on a single-chip runner): the largest power of two <= device
+            # count whose (data * chunk_rows) still divides the row count —
+            # a raw device count like 3 or 6 would fail the sharding check.
+            avail = len(jax.devices())
+            ndata = 1 << (avail.bit_length() - 1)
+            while ndata > 1 and cfg.n % (ndata * (cfg.chunk_rows or 1)):
+                ndata //= 2
+            mesh_shape = {"data": ndata}
             result["mesh_downscaled_to"] = mesh_shape
 
     dtype = np.dtype(cfg.dtype)

@@ -52,15 +52,16 @@ def _add_backend_arg(p: argparse.ArgumentParser, mesh: bool = True,
 def _load_scoring(args) -> ScoringConfig:
     """ScoringConfig from --scoring_config JSON (if given) with the
     --medians_from_data flag applied on top."""
+    medians_from_data = getattr(args, "medians_from_data", False)
     if getattr(args, "scoring_config", None):
         from .config import load_scoring_config
         import dataclasses
 
         cfg = load_scoring_config(args.scoring_config)
-        if args.medians_from_data:
+        if medians_from_data:
             cfg = dataclasses.replace(cfg, compute_global_medians_from_data=True)
         return cfg
-    return ScoringConfig(compute_global_medians_from_data=args.medians_from_data)
+    return ScoringConfig(compute_global_medians_from_data=medians_from_data)
 
 
 def _parse_mesh(spec: str | None) -> dict[str, int] | None:
@@ -202,7 +203,10 @@ def _cmd_evaluate(args) -> int:
     manifest = Manifest.read_csv(args.manifest)
     events = EventLog.read_csv(args.access_log, manifest)
 
-    scoring = ScoringConfig()
+    # Honor a custom scoring config: its category -> rf table must be the one
+    # the cluster stage decided with, or the evaluation silently applies the
+    # wrong factors.
+    scoring = _load_scoring(args)
     rf = np.full(len(manifest), args.default_rf, dtype=np.int32)
     rows = matched = 0
     with open(args.assignments_csv, newline="") as f:
@@ -386,6 +390,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--nodes", default=None,
                    help="datanode names (default: manifest nodes)")
     p.add_argument("--default_rf", type=int, default=1)
+    p.add_argument("--scoring_config", default=None, metavar="JSON",
+                   help="scoring config the assignments were produced with "
+                        "(source of the category -> replication-factor table)")
     p.set_defaults(fn=_cmd_evaluate)
 
     p = sub.add_parser("stream", help="stream the access log in batches, then cluster")

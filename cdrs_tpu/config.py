@@ -108,6 +108,12 @@ class ScoringConfig:
     #: When True the pipeline replaces ``global_medians`` with medians computed
     #: from the dataset (fixing reference quirk SURVEY.md §6.1.5).
     compute_global_medians_from_data: bool = False
+    #: Per-cluster median strategy for the jax backend: "sort" (exact),
+    #: "hist" (O(n) fixed-bin histogram for very large n), or "auto"
+    #: (hist past ops/scoring_jax.HIST_MEDIAN_THRESHOLD rows).
+    median_method: str = "auto"
+    #: Histogram resolution for the "hist" strategy (error <= range/bins).
+    median_bins: int = 2048
 
     categories: tuple[str, ...] = CATEGORIES
 

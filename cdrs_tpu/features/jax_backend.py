@@ -245,6 +245,11 @@ def compute_features_jax(
         else:
             norm = raw.copy()
         zeros = np.zeros(n, dtype=np.float64)
+        if as_device:  # honor the device-residency contract on this path too
+            return FeatureTable(
+                paths=list(manifest.paths), raw=jnp.asarray(raw),
+                norm=jnp.asarray(norm), writes=jnp.asarray(zeros),
+                reads=jnp.asarray(zeros))
         return FeatureTable(paths=list(manifest.paths), raw=raw, norm=norm,
                             writes=zeros, reads=zeros.copy())
 

@@ -415,11 +415,15 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
 
     def local_fn(x, c0, key, iter_offset):
         w = prefix_mask(x, n_valid)
+        # Split once: the init stream folds in round indices [0, k) and the
+        # Lloyd stream folds in global iteration indices — a single fold_in
+        # domain would collide for k > the fold constant (the round-269
+        # correlation ADVICE r1 flagged).  split() keys never overlap.
+        init_key, lloyd_key = jax.random.split(key)
         if with_init:
             centroids = c0
         else:
-            centroids = _d2_init_local(x, w, key, k=k)
-        lloyd_key = jax.random.fold_in(key, 0x10D)  # distinct stream from init
+            centroids = _d2_init_local(x, w, init_key, k=k)
         if nmodel == 1:
             return _lloyd_local(
                 x, w, centroids, lloyd_key, iter_offset,

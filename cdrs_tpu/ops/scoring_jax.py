@@ -8,6 +8,15 @@ src/scoring.py:3-130), re-shaped for XLA:
   feature column by (label, value) so every cluster's values are a contiguous
   sorted run, then gather the two middle elements per run from computed
   offsets.  Static shapes, one sort per feature, no host round-trips.
+* **Histogram medians at scale** — a full per-feature n-sort is the wrong
+  shape for 10M+ rows (SURVEY.md §7.4); ``compute_cluster_medians_hist_jax``
+  instead bins each feature into a fixed ``(k, bins)`` histogram (one
+  ``segment_sum`` per feature — O(n) and TPU-reduction-friendly) and reads
+  both middle-rank values off the cumulative counts with intra-bin linear
+  interpolation.  Error is bounded by the bin width of the feature's value
+  range; category assignments are compared against the exact path in
+  tests/test_scoring_jax.py.  ``classify_jax`` switches automatically past
+  ``HIST_MEDIAN_THRESHOLD`` rows.
 * **Score table** — one (k, C, d) masked broadcast: direction gate
   ``dir == 0 | sign(delta) == dir`` for non-Moderate, ``|delta| < band`` with
   reward ``(1 - |delta|)²`` for Moderate (scoring.py:77-82).
@@ -26,14 +35,21 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..config import ScoringConfig
 
 __all__ = [
     "compute_cluster_medians_jax",
+    "compute_cluster_medians_hist_jax",
     "score_table_jax",
     "classify_jax",
+    "HIST_MEDIAN_THRESHOLD",
 ]
+
+#: Row count past which classify_jax's "auto" mode switches from exact
+#: sort-based medians to histogram medians.
+HIST_MEDIAN_THRESHOLD = 2_000_000
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
@@ -53,6 +69,80 @@ def compute_cluster_medians_jax(x: jnp.ndarray, labels: jnp.ndarray, k: int) -> 
         return jnp.where(counts > 0, med, jnp.nan)
 
     return jax.vmap(median_one_feature, in_axes=1, out_axes=1)(x)
+
+
+def _medians_from_hist(H, counts, lo_f, w_f, bins, ftype):
+    """(k,) medians from a (k, bins) histogram: both middle-rank values off
+    the cumulative counts, linearly interpolated inside the bin."""
+    cum = jnp.cumsum(H, axis=1)
+    r0 = (counts - 1) // 2   # 0-indexed middle ranks (lower/upper)
+    r1 = counts // 2
+
+    def value_at(r):
+        # First bin whose cumulative count exceeds rank r holds it.
+        j = jnp.argmax(cum > r[:, None], axis=1)                 # (k,)
+        cum_before = jnp.where(
+            j > 0,
+            jnp.take_along_axis(cum, jnp.maximum(j - 1, 0)[:, None], 1)[:, 0],
+            0,
+        )
+        h = jnp.take_along_axis(H, j[:, None], 1)[:, 0]
+        frac = (r - cum_before + 0.5) / jnp.maximum(h, 1)
+        return (j.astype(ftype) + frac.astype(ftype)) * (w_f / bins)
+
+    med = lo_f + 0.5 * (value_at(r0) + value_at(r1))
+    return jnp.where(counts > 0, med, jnp.nan)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "bins", "with_global"))
+def _hist_medians(x, labels, k: int, bins: int, with_global: bool):
+    """Per-cluster (k, d) + optionally global (d,) medians in ONE data pass.
+
+    One ``segment_sum`` over composite (label, bin) keys per feature — O(n·d)
+    with (k, bins) working memory per feature (``lax.map`` keeps features
+    sequential, so peak memory is independent of d).  Error <=
+    feature_range / bins; constant columns are exact.  NaN rows for empty
+    clusters (same contract as the exact kernel).  The global medians reuse
+    the already-built histograms (summed over clusters) — no second pass.
+    """
+    n = x.shape[0]
+    ftype = x.dtype
+    ones = jnp.ones((n,), jnp.int32)
+    counts = jax.ops.segment_sum(ones, labels, num_segments=k)   # (k,)
+    n_total = jnp.full((1,), n, counts.dtype)
+
+    lo = x.min(axis=0)
+    hi = x.max(axis=0)
+
+    def one_feature(args):
+        col, lo_f, hi_f = args
+        w_f = jnp.where(hi_f > lo_f, hi_f - lo_f, 1.0)
+        b = jnp.clip(((col - lo_f) / w_f * bins).astype(jnp.int32), 0, bins - 1)
+        H = jax.ops.segment_sum(
+            ones, labels * bins + b, num_segments=k * bins
+        ).reshape(k, bins)
+        exact_const = hi_f <= lo_f  # constant column: the value itself
+        med = jnp.where(
+            exact_const, lo_f,
+            _medians_from_hist(H, counts, lo_f, w_f, bins, ftype))
+        if with_global:
+            gmed = jnp.where(
+                exact_const, lo_f,
+                _medians_from_hist(H.sum(0, keepdims=True), n_total,
+                                   lo_f, w_f, bins, ftype))[0]
+        else:
+            gmed = jnp.zeros((), ftype)
+        return med, gmed
+
+    meds, gmeds = lax.map(one_feature, (x.T, lo, hi))   # (d, k), (d,)
+    return meds.T, gmeds
+
+
+def compute_cluster_medians_hist_jax(
+    x: jnp.ndarray, labels: jnp.ndarray, k: int, bins: int = 2048,
+) -> jnp.ndarray:
+    """(k, d) approximate per-cluster medians via fixed-bin histograms."""
+    return _hist_medians(x, labels, k, bins, False)[0]
 
 
 @jax.jit
@@ -103,15 +193,32 @@ def classify_jax(
 
     Returns ``(category_idx (k,), scores (k, C), cluster_medians (k, d))`` as
     jax arrays.  Mirrors ops/scoring_np.classify (reference: scoring.py:111-130).
+
+    Median strategy follows ``cfg.median_method``: ``"sort"`` (exact),
+    ``"hist"`` (fixed-bin histogram, O(n), for large n), or ``"auto"``
+    (hist past HIST_MEDIAN_THRESHOLD rows).
     """
     cfg = cfg or ScoringConfig()
     x = jnp.asarray(X)
     labels = jnp.asarray(labels).astype(jnp.int32)
 
-    medians = compute_cluster_medians_jax(x, labels, int(k))
+    method = getattr(cfg, "median_method", "auto")
+    if method == "auto":
+        method = "hist" if x.shape[0] > HIST_MEDIAN_THRESHOLD else "sort"
+    if method not in ("sort", "hist"):
+        raise ValueError(f"unknown median_method {method!r}")
+    bins = int(getattr(cfg, "median_bins", 2048))
+
+    want_global = global_medians is None and cfg.compute_global_medians_from_data
+    if method == "hist":
+        # Global medians (when needed) fall out of the same histograms —
+        # one data pass total.
+        medians, gmeds = _hist_medians(x, labels, int(k), bins, want_global)
+    else:
+        medians = compute_cluster_medians_jax(x, labels, int(k))
     if global_medians is None:
         if cfg.compute_global_medians_from_data:
-            global_medians = jnp.median(x, axis=0)
+            global_medians = gmeds if method == "hist" else jnp.median(x, axis=0)
         else:
             global_medians = jnp.asarray(
                 [cfg.global_medians[f] for f in cfg.features], dtype=x.dtype

@@ -63,6 +63,53 @@ def test_custom_config_changes_classification(tmp_path):
     assert base.categories[int(w2[0])] == "Hot"
 
 
+def test_cli_evaluate_honors_scoring_config(tmp_path, capsys):
+    """`cdrs evaluate --scoring_config` must apply the custom category -> rf
+    table when placing replicas (VERDICT r1: it silently used defaults)."""
+    from cdrs_tpu.cli import main
+    from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
+    from cdrs_tpu.sim.access import simulate_access
+    from cdrs_tpu.sim.generator import generate_population
+
+    manifest = generate_population(GeneratorConfig(n_files=40, seed=2))
+    events = simulate_access(manifest, SimulatorConfig(duration_seconds=30, seed=2))
+    mpath, apath = tmp_path / "m.csv", tmp_path / "a.log"
+    manifest.write_csv(str(mpath))
+    events.write_csv(str(apath), manifest)
+
+    # All files assigned to Hot (default rf=3; custom rf=6 below).
+    assign = tmp_path / "assign.csv"
+    with open(assign, "w") as f:
+        f.write("path,cluster,category\n")
+        for p in manifest.paths:
+            f.write(f"{p},0,Hot\n")
+
+    d = _as_dict(ScoringConfig())
+    d["replication_factors"]["Hot"] = 6
+    cfgp = tmp_path / "s.json"
+    cfgp.write_text(json.dumps(d))
+
+    base_args = ["evaluate", "--manifest", str(mpath), "--access_log",
+                 str(apath), "--assignments_csv", str(assign)]
+    # On the manifest's 3-node topology both rf tables cap to 3 replicas:
+    # outputs must be identical (pins the capping behaviour).
+    assert main(base_args) == 0
+    default_capped = json.loads(capsys.readouterr().out)
+    assert main(base_args + ["--scoring_config", str(cfgp)]) == 0
+    custom_capped = json.loads(capsys.readouterr().out)
+    assert custom_capped["policy"]["total_storage_bytes"] == \
+        default_capped["policy"]["total_storage_bytes"]
+
+    # With 8 nodes the custom rf=6 doubles the default rf=3 storage.
+    nodes = "dn1,dn2,dn3,dn4,dn5,dn6,dn7,dn8"
+    assert main(base_args + ["--nodes", nodes]) == 0
+    default_out = json.loads(capsys.readouterr().out)
+    assert main(base_args + ["--nodes", nodes, "--scoring_config", str(cfgp)]) == 0
+    custom_out = json.loads(capsys.readouterr().out)
+    assert custom_out["policy"]["total_storage_bytes"] == \
+        2 * default_out["policy"]["total_storage_bytes"]
+
+
 def test_cli_scoring_config(tmp_path):
     from cdrs_tpu.cli import main
 

@@ -9,6 +9,7 @@ from cdrs_tpu.config import ScoringConfig
 from cdrs_tpu.ops import scoring_np
 from cdrs_tpu.ops.scoring_jax import (
     classify_jax,
+    compute_cluster_medians_hist_jax,
     compute_cluster_medians_jax,
 )
 
@@ -45,6 +46,61 @@ def test_classify_parity(data, from_data):
     np.testing.assert_allclose(np.asarray(sj), sn, atol=1e-10)
     np.testing.assert_array_equal(np.asarray(wj), wn)
     np.testing.assert_allclose(np.asarray(mj), mn, atol=1e-12)
+
+
+def test_hist_medians_close_to_exact():
+    """Histogram medians within a bin width of exact, NaN for empty clusters."""
+    rng = np.random.default_rng(3)
+    X = rng.uniform(size=(40_000, 5))
+    labels = rng.integers(0, 7, size=40_000).astype(np.int32)  # cluster 7 empty
+    got = np.asarray(compute_cluster_medians_hist_jax(X, labels, 8, bins=2048))
+    want = scoring_np.compute_cluster_medians(X, labels, 8)
+    assert np.isnan(got[7]).all()
+    np.testing.assert_allclose(got[:7], want[:7], atol=1.0 / 2048)
+
+
+def test_hist_medians_constant_column_exact():
+    rng = np.random.default_rng(4)
+    X = rng.uniform(size=(1000, 3))
+    X[:, 1] = 0.25  # constant column must come out exactly
+    labels = rng.integers(0, 3, size=1000).astype(np.int32)
+    got = np.asarray(compute_cluster_medians_hist_jax(X, labels, 3))
+    assert (got[:, 1] == 0.25).all()
+
+
+@pytest.mark.parametrize("from_data", [False, True])
+def test_hist_classify_category_parity(from_data):
+    """Categories from histogram medians must match the exact path on a
+    realistic blob workload (SURVEY.md §7.4: parity on categories, not raw
+    scores, at scale)."""
+    rng = np.random.default_rng(7)
+    k = 8
+    centers = rng.uniform(size=(k, 5))
+    lab = rng.integers(0, k, size=100_000)
+    X = np.clip(centers[lab] + rng.normal(size=(100_000, 5)) * 0.05, 0, 1)
+    labels = lab.astype(np.int32)
+
+    exact = ScoringConfig(median_method="sort",
+                          compute_global_medians_from_data=from_data)
+    hist = ScoringConfig(median_method="hist",
+                         compute_global_medians_from_data=from_data)
+    we, se, me = classify_jax(X, labels, k, exact)
+    wh, sh, mh = classify_jax(X, labels, k, hist)
+    np.testing.assert_allclose(np.asarray(mh), np.asarray(me), atol=1e-3)
+    np.testing.assert_array_equal(np.asarray(wh), np.asarray(we))
+
+
+def test_auto_median_threshold_routes():
+    """auto = sort below the threshold (bit-exact vs numpy)."""
+    rng = np.random.default_rng(9)
+    X = rng.uniform(size=(512, 5))
+    labels = rng.integers(0, 4, size=512).astype(np.int32)
+    cfg = ScoringConfig(median_method="auto",
+                        compute_global_medians_from_data=True)
+    wj, sj, mj = classify_jax(X, labels, 4, cfg)
+    wn, sn, mn = scoring_np.classify(X, labels, 4, cfg)
+    np.testing.assert_allclose(np.asarray(mj), mn, atol=1e-12)
+    np.testing.assert_array_equal(np.asarray(wj), wn)
 
 
 def test_all_zero_scores_tiebreak_archival():
