@@ -210,6 +210,36 @@ def _time_numpy_lloyd(X: np.ndarray, k: int, init: np.ndarray, iters: int) -> fl
     return (time.perf_counter() - t0) / iters
 
 
+def _time_init(X, k: int, init: np.ndarray, mesh_shape, chunk_rows, dtype,
+               method: str) -> float | None:
+    """Seconds for one D²/k-means|| init (compile excluded).
+
+    Measured as (init + one assignment pass) minus an assignment-only run
+    with fixed centroids — max_iter=0 skips the Lloyd loop in both.
+    Returns None when the method can't run at this shape (kmeans|| per-round
+    sample exceeding shard rows).
+    """
+    from ..ops.kmeans_jax import kmeans_jax_full
+
+    kwargs = dict(tol=0.0, seed=0, max_iter=0, mesh_shape=mesh_shape,
+                  dtype=dtype, chunk_rows=chunk_rows)
+
+    def timed(**kw):
+        c, _, _, _ = kmeans_jax_full(X, k, **kwargs, **kw)  # compile/warmup
+        np.asarray(c)
+        t0 = time.perf_counter()
+        c, _, _, _ = kmeans_jax_full(X, k, **kwargs, **kw)
+        np.asarray(c)
+        return time.perf_counter() - t0
+
+    try:
+        full = timed(init_method=method)
+    except ValueError:
+        return None
+    base = timed(init_centroids=init)
+    return max(full - base, 0.0)
+
+
 def _time_jax_lloyd(X, k: int, init: np.ndarray, iters: int,
                     mesh_shape, chunk_rows, dtype) -> float:
     """Seconds per Lloyd iteration for the jax backend (compile excluded)."""
@@ -339,6 +369,16 @@ def run_bench(config: int = 2, backend: str | None = None,
     jax_sec = _time_jax_lloyd(X, cfg.k, init, cfg.iters, mesh_shape,
                               cfg.chunk_rows, dtype)
     jax_ips = 1.0 / jax_sec
+
+    # Init cost (SURVEY.md §7.4: the D² loop is k sequential rounds — the
+    # north-star configs need to know whether it dominates, and what the
+    # kmeans|| alternative buys).
+    for method, field in (("d2", "init_seconds_d2"),
+                          ("kmeans||", "init_seconds_kmeans_par")):
+        sec = _time_init(X, cfg.k, init, mesh_shape, cfg.chunk_rows, dtype,
+                         method)
+        if sec is not None:
+            result[field] = sec
 
     result.update({
         "metric": f"lloyd_iters_per_sec_n{cfg.n}_d{cfg.d}_k{cfg.k}",

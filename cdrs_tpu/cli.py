@@ -49,6 +49,15 @@ def _add_backend_arg(p: argparse.ArgumentParser, mesh: bool = True,
         )
 
 
+def _add_init_method_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--init_method", choices=["d2", "kmeans||"], default="d2",
+        help="centroid init (jax backend): 'd2' = reference KMeans++ "
+             "semantics; 'kmeans||' = oversampling init whose cost does "
+             "not grow with k",
+    )
+
+
 def _load_scoring(args) -> ScoringConfig:
     """ScoringConfig from --scoring_config JSON (if given) with the
     --medians_from_data flag applied on top."""
@@ -154,7 +163,8 @@ def _cmd_cluster(args) -> int:
     from .models.replication import ReplicationPolicyModel
 
     model = ReplicationPolicyModel(
-        kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed),
+        kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed,
+                                init_method=getattr(args, 'init_method', 'd2')),
         scoring_cfg=_load_scoring(args),
         backend=args.backend,
         mesh_shape=_parse_mesh(args.mesh),
@@ -178,7 +188,8 @@ def _cmd_pipeline(args) -> int:
         generator=GeneratorConfig(n_files=args.n, seed=args.seed),
         simulator=SimulatorConfig(duration_seconds=args.duration_seconds,
                                   seed=None if args.seed is None else args.seed + 1),
-        kmeans=KMeansConfig(k=args.k, seed=args.seed),
+        kmeans=KMeansConfig(k=args.k, seed=args.seed,
+                            init_method=getattr(args, 'init_method', 'd2')),
         scoring=_load_scoring(args),
         mesh_shape=_parse_mesh(args.mesh),
         evaluate=args.evaluate,
@@ -291,7 +302,8 @@ def _cmd_stream(args) -> int:
 
     model = ReplicationPolicyModel(
         kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed,
-                                batch_size=args.kmeans_batch),
+                                batch_size=args.kmeans_batch,
+                                init_method=getattr(args, 'init_method', 'd2')),
         scoring_cfg=_load_scoring(args),
         backend=args.backend,
         mesh_shape=mesh_shape,
@@ -362,6 +374,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--scoring_config", default=None, metavar="JSON",
                    help="weights/directions/medians/rf config file")
     _add_backend_arg(p)
+    _add_init_method_arg(p)
     p.set_defaults(fn=_cmd_cluster)
 
     p = sub.add_parser("pipeline", help="end-to-end: gen -> sim -> features -> cluster")
@@ -378,6 +391,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace (TensorBoard/Perfetto)")
     _add_backend_arg(p)
+    _add_init_method_arg(p)
     p.set_defaults(fn=_cmd_pipeline)
 
     p = sub.add_parser("evaluate", help="apply replication factors on the "
@@ -409,6 +423,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--medians_from_data", action="store_true")
     p.add_argument("--scoring_config", default=None, metavar="JSON")
     _add_backend_arg(p)
+    _add_init_method_arg(p)
     p.set_defaults(fn=_cmd_stream)
 
     p = sub.add_parser("bench", help="benchmark harness (BASELINE.md configs)")

@@ -151,6 +151,10 @@ class KMeansConfig:
     batch_size: int | None = None
     #: Shuffled passes over the data in mini-batch mode.
     batch_epochs: int = 5
+    #: Centroid init for the jax backend: "d2" (reference KMeans++ semantics)
+    #: or "kmeans||" (oversampling init whose cost does not scale with k —
+    #: ops/kmeans_jax._kmeans_par_init_local, SURVEY.md §7.4 hard part).
+    init_method: str = "d2"
 
     def resolve_max_iter(self, n: int) -> int:
         if self.max_iter is not None:

@@ -99,6 +99,9 @@ class ReplicationPolicyModel:
             if cfg.batch_size is not None:
                 raise ValueError(
                     "mini-batch KMeans (batch_size) requires the jax backend")
+            if cfg.init_method != "d2":
+                raise ValueError(
+                    f"init_method {cfg.init_method!r} requires the jax backend")
             from ..ops.kmeans_np import kmeans
 
             return kmeans(
@@ -115,6 +118,7 @@ class ReplicationPolicyModel:
             max_iter=cfg.resolve_max_iter(n),
             init_centroids=init_centroids,
             mesh_shape=self.mesh_shape,
+            init_method=cfg.init_method,
         )
         return np.asarray(centroids), np.asarray(labels)
 

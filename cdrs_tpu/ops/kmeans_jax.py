@@ -138,6 +138,125 @@ def _d2_init_local(x, w, key, *, k):
     return centroids
 
 
+# ---------------------------------------------------------------------------
+# k-means|| init (Bahmani et al., VLDB'12 — public algorithm), TPU-shaped
+# ---------------------------------------------------------------------------
+
+
+def _weighted_kmeanspp(c, wts, key, k):
+    """Weighted D² reduction of a small candidate set to k centroids.
+
+    Runs replicated (identical on every shard: the PRNG stream does NOT fold
+    in the shard rank), so it needs no collectives.  Zero-weight candidates
+    are never drawn.
+    """
+    n_cand, d = c.shape
+    c_sq = jnp.sum(c * c, axis=1)
+    neg_inf = jnp.array(-jnp.inf, c.dtype)
+    wlog = jnp.where(wts > 0, jnp.log(wts), neg_inf)
+
+    g0 = jax.random.gumbel(jax.random.fold_in(key, 0), (n_cand,), c.dtype)
+    i0 = jnp.argmax(wlog + g0)           # sample ∝ weight
+    cent = jnp.zeros((k, d), c.dtype).at[0].set(c[i0])
+    min_sq = _sq_dist_to_row(c, c_sq, c[i0])
+
+    def body(i, carry):
+        cent, min_sq = carry
+        total = jnp.sum(min_sq * wts)
+        # p ∝ w * D²; all-zero residuals -> weighted-uniform fallback.
+        logits = jnp.where(total > 0,
+                           wlog + jnp.log(jnp.maximum(min_sq, 1e-38)),
+                           wlog)
+        g = jax.random.gumbel(jax.random.fold_in(key, i), (n_cand,), c.dtype)
+        idx = jnp.argmax(logits + g)
+        ci = c[idx]
+        cent = cent.at[i].set(ci)
+        min_sq = jnp.minimum(min_sq, _sq_dist_to_row(c, c_sq, ci))
+        return cent, min_sq
+
+    cent, _ = lax.fori_loop(1, k, body, (cent, min_sq))
+    return cent
+
+
+def _weighted_lloyd_small(c, wts, cent, iters):
+    """A few weighted Lloyd iterations on the candidate set (replicated)."""
+    k = cent.shape[0]
+
+    def body(_, cent):
+        lab = assign_labels_jax(c, cent)
+        sums = jax.ops.segment_sum(c * wts[:, None], lab, num_segments=k)
+        counts = jax.ops.segment_sum(wts, lab, num_segments=k)
+        return jnp.where(counts[:, None] > 0,
+                         sums / jnp.maximum(counts, 1.0)[:, None], cent)
+
+    return lax.fori_loop(0, iters, body, cent)
+
+
+def _kmeans_par_init_local(x, w, key, *, k, rounds, per_round,
+                           cand_lloyd_iters=10):
+    """k-means|| init, shard-local view — O(rounds) passes instead of k.
+
+    The reference's D² init is inherently sequential in k (1024 rounds at the
+    BASELINE configs — SURVEY.md §7.4); k-means|| replaces it with ``rounds``
+    oversampling passes, each drawing ``per_round`` points ∝ D² *without
+    replacement* via distributed Gumbel top-m (each shard takes a local
+    top-m of log(D²)+Gumbel, an ``all_gather`` of (m,) scores + (m, d) rows
+    merges them into the global top-m — O(rounds · m · d) communicated, the
+    points matrix never moves).  This is a documented, statically-shaped
+    stand-in for the paper's Bernoulli sampling (which draws a *random
+    number* of points — impossible under XLA's static shapes).  Candidates
+    are then weighted by an assignment count pass and reduced to k with a
+    replicated weighted D² + a few weighted Lloyd steps (Bahmani §3.3).
+    """
+    rank = lax.axis_index(DATA_AXIS)
+    n_loc, d = x.shape
+    x_sq = jnp.sum(x * x, axis=1)
+    neg_inf = jnp.array(-jnp.inf, x.dtype)
+    n_cand = 1 + rounds * per_round
+
+    key_rounds, key_reduce = jax.random.split(key)
+
+    # Round 0: one uniform draw (same as D² round 0).
+    g0 = jax.random.gumbel(
+        jax.random.fold_in(jax.random.fold_in(key_rounds, 0), rank),
+        (n_loc,), x.dtype)
+    c0 = _pick_row_global(x, jnp.where(w > 0, g0, neg_inf))
+    cands = jnp.zeros((n_cand, d), x.dtype).at[0].set(c0)
+    min_sq = _sq_dist_to_row(x, x_sq, c0)
+
+    def round_body(r, carry):
+        cands, min_sq = carry
+        noise_key = jax.random.fold_in(
+            jax.random.fold_in(key_rounds, r + 1), rank)
+        g = jax.random.gumbel(noise_key, (n_loc,), x.dtype)
+        total = lax.psum(jnp.sum(min_sq * w), DATA_AXIS)
+        logits = jnp.where(total > 0,
+                           jnp.log(jnp.maximum(min_sq, 1e-38)),
+                           jnp.zeros_like(min_sq))
+        scores = jnp.where(w > 0, logits + g, neg_inf)
+        vals, idx = lax.top_k(scores, per_round)          # local top-m
+        rows = x[idx]                                     # (m, d)
+        all_vals = lax.all_gather(vals, DATA_AXIS).reshape(-1)
+        all_rows = lax.all_gather(rows, DATA_AXIS).reshape(-1, d)
+        _, gsel = lax.top_k(all_vals, per_round)          # global top-m
+        new_rows = all_rows[gsel]                         # replicated (m, d)
+        cands = lax.dynamic_update_slice(cands, new_rows,
+                                         (1 + r * per_round, 0))
+        d2new = jnp.maximum(
+            x_sq[:, None] - 2.0 * (x @ new_rows.T)
+            + jnp.sum(new_rows * new_rows, axis=1)[None, :], 0.0)
+        return cands, jnp.minimum(min_sq, d2new.min(axis=1))
+
+    cands, _ = lax.fori_loop(0, rounds, round_body, (cands, min_sq))
+
+    # Weight candidates by how many points they own (one assignment pass).
+    lab = assign_labels_jax(x, cands)
+    wts = lax.psum(jax.ops.segment_sum(w, lab, num_segments=n_cand), DATA_AXIS)
+
+    cent = _weighted_kmeanspp(cands, wts, key_reduce, k)
+    return _weighted_lloyd_small(cands, wts, cent, cand_lloyd_iters)
+
+
 def _weighted_cluster_stats(xc, wc, lab, k, update):
     """Per-cluster (sum, count) for one row block.
 
@@ -408,7 +527,8 @@ def _lloyd_local_2d(x, w, c_loc, key, iter_offset, *, k, n_valid, tol,
 
 @functools.lru_cache(maxsize=32)
 def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
-                  dtype_name, chunk_rows=None, update="matmul"):
+                  dtype_name, chunk_rows=None, update="matmul",
+                  init_method="d2", init_rounds=5, init_per_round=0):
     """Compile the full sharded kmeans for one (shape, mesh, config) point."""
     mesh = make_mesh(n_data=ndata, n_model=nmodel)
     k_loc = k // nmodel
@@ -422,6 +542,10 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
         init_key, lloyd_key = jax.random.split(key)
         if with_init:
             centroids = c0
+        elif init_method == "kmeans||":
+            centroids = _kmeans_par_init_local(
+                x, w, init_key, k=k, rounds=init_rounds,
+                per_round=init_per_round)
         else:
             centroids = _d2_init_local(x, w, init_key, k=k)
         if nmodel == 1:
@@ -466,6 +590,9 @@ def kmeans_jax_full(
     update: str = "matmul",
     n_valid: int | None = None,
     iter_offset: int = 0,
+    init_method: str = "d2",
+    init_oversample: float = 2.0,
+    init_rounds: int = 5,
 ):
     """Sharded KMeans++ + Lloyd.  Returns (centroids, labels, n_iter, shift).
 
@@ -479,6 +606,13 @@ def kmeans_jax_full(
     ``mesh_shape={"data": N}`` shards rows over N devices (data parallel);
     adding ``"model": M`` also shards the centroid table over M devices
     (tensor parallel, k divisible by M).  Default: single device.
+
+    ``init_method="kmeans||"`` swaps the k-round D² init for the documented
+    k-means|| oversampling init (SURVEY.md §7.4): ``init_rounds`` passes each
+    drawing ``ceil(init_oversample * k / init_rounds)`` candidates — the init
+    cost stops scaling with k (D² is 1024 sequential rounds at the BASELINE
+    k=1024 configs).  Different (but comparable-quality) starting centroids
+    than "d2"; not available with ``init_centroids``.
     """
     is_device_array = isinstance(X, jax.Array)
     if not is_device_array:
@@ -529,9 +663,20 @@ def kmeans_jax_full(
         raise ValueError(f"unknown update strategy {update!r}")
     if update == "pallas" and nmodel > 1:
         raise ValueError("pallas update not supported on a model-sharded mesh")
+    if init_method not in ("d2", "kmeans||"):
+        raise ValueError(f"unknown init_method {init_method!r}")
+    init_per_round = 0
+    if init_method == "kmeans||" and not with_init:
+        init_per_round = max(1, int(np.ceil(init_oversample * k / init_rounds)))
+        n_loc = Xp.shape[0] // ndata
+        if init_per_round > n_loc:
+            raise ValueError(
+                f"kmeans|| needs per-round sample {init_per_round} <= shard "
+                f"rows {n_loc}; use init_method='d2' at this scale")
     fn = _build_kmeans(
         n_valid, d, int(k), ndata, nmodel, int(max_iter), float(tol),
         with_init, np.dtype(dtype).name, chunk_rows, update,
+        init_method, int(init_rounds), init_per_round,
     )
     if k > n_valid:
         raise ValueError(f"k={k} exceeds number of valid samples {n_valid}")

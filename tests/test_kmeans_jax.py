@@ -79,6 +79,46 @@ def test_d2_init_quality(blobs):
     assert shift < 1e-4
 
 
+@pytest.mark.parametrize("mesh", [None, {"data": 8}, {"data": 4, "model": 2}])
+def test_kmeans_par_init_quality(blobs, mesh):
+    """kmeans|| init + Lloyd must recover the planted blobs as well as D²
+    (SURVEY.md §7.4: the documented oversampling alternative)."""
+    from cdrs_tpu.ops.kmeans_np import pairwise_sq_dists
+
+    centroids, labels, it, shift = kmeans_jax_full(
+        blobs, 4, seed=3, max_iter=100, mesh_shape=mesh,
+        init_method="kmeans||",
+    )
+    centroids = np.asarray(centroids)
+    d = pairwise_sq_dists(blobs, centroids)
+    inertia = d[np.arange(len(blobs)), np.asarray(labels)].mean()
+    assert inertia < 3.0  # same bound as the D² quality test
+    assert len(np.unique(np.asarray(labels))) == 4
+    assert shift < 1e-4
+
+
+def test_kmeans_par_deterministic(blobs):
+    a = kmeans_jax_full(blobs, 4, seed=9, max_iter=20,
+                        mesh_shape={"data": 8}, init_method="kmeans||")
+    b = kmeans_jax_full(blobs, 4, seed=9, max_iter=20,
+                        mesh_shape={"data": 8}, init_method="kmeans||")
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+
+
+def test_kmeans_par_rejects_tiny_shards():
+    """per-round sample > shard rows must fail with a clear message."""
+    X = np.random.default_rng(0).normal(size=(64, 3))
+    with pytest.raises(ValueError, match="kmeans"):
+        kmeans_jax_full(X, 32, seed=0, max_iter=5, mesh_shape={"data": 8},
+                        init_method="kmeans||")
+
+
+def test_unknown_init_method_raises(blobs):
+    with pytest.raises(ValueError, match="init_method"):
+        kmeans_jax_full(blobs, 4, init_method="magic")
+
+
 def test_empty_cluster_reseed_deterministic():
     """k=4 on 4 distinct points with a far-away init forces reseeds; results
     must be reproducible from the seed (fixes reference quirk §6.1.2)."""
