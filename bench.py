@@ -20,6 +20,9 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--config", type=int, default=2)
     p.add_argument("--backend", default=None)
+    p.add_argument("--update", default=None,
+                   choices=["matmul", "scatter", "pallas"],
+                   help="Lloyd assign+reduce strategy (default: the config's)")
     args = p.parse_args()
 
     import os
@@ -27,7 +30,8 @@ def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from cdrs_tpu.benchmarks.harness import run_bench
 
-    out = run_bench(config=args.config, backend=args.backend)
+    out = run_bench(config=args.config, backend=args.backend,
+                    update=args.update)
     line = {
         "metric": out["metric"],
         "value": out["value"],

@@ -59,14 +59,24 @@ def _add_init_method_arg(p: argparse.ArgumentParser) -> None:
 
 
 def _load_scoring(args) -> ScoringConfig:
-    """ScoringConfig from --scoring_config JSON (if given) with the
-    --medians_from_data flag applied on top."""
+    """ScoringConfig from --scoring_config (if given) with the
+    --medians_from_data flag applied on top.
+
+    ``--scoring_config validated`` selects the built-in tables tuned for the
+    simulator's workload (config.validated_scoring_config); any other value
+    is a JSON file path."""
     medians_from_data = getattr(args, "medians_from_data", False)
     if getattr(args, "scoring_config", None):
-        from .config import load_scoring_config
         import dataclasses
 
-        cfg = load_scoring_config(args.scoring_config)
+        if args.scoring_config == "validated":
+            from .config import validated_scoring_config
+
+            cfg = validated_scoring_config()
+        else:
+            from .config import load_scoring_config
+
+            cfg = load_scoring_config(args.scoring_config)
         if medians_from_data:
             cfg = dataclasses.replace(cfg, compute_global_medians_from_data=True)
         return cfg
@@ -325,7 +335,8 @@ def _cmd_bench(args) -> int:
         print(f"benchmark harness not available: {e}", file=sys.stderr)
         return 1
     out = run_bench(config=args.config, backend=args.backend,
-                    mesh_shape=_parse_mesh(args.mesh))
+                    mesh_shape=_parse_mesh(args.mesh),
+                    update=getattr(args, "update", None))
     print(json.dumps(out))
     return 0
 
@@ -371,8 +382,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--assignments_csv", default=None)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--medians_from_data", action="store_true")
-    p.add_argument("--scoring_config", default=None, metavar="JSON",
-                   help="weights/directions/medians/rf config file")
+    p.add_argument("--scoring_config", default=None, metavar="JSON|validated",
+                   help="weights/directions/medians/rf config file, or "
+                        "'validated' for the built-in workload-tuned tables")
     _add_backend_arg(p)
     _add_init_method_arg(p)
     p.set_defaults(fn=_cmd_cluster)
@@ -384,7 +396,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--outdir", default="output")
     p.add_argument("--medians_from_data", action="store_true")
-    p.add_argument("--scoring_config", default=None, metavar="JSON")
+    p.add_argument("--scoring_config", default=None, metavar="JSON|validated")
     p.add_argument("--evaluate", action="store_true",
                    help="apply decided rf on the simulated cluster and report "
                         "locality/load/storage vs uniform baselines")
@@ -428,6 +440,9 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser("bench", help="benchmark harness (BASELINE.md configs)")
     p.add_argument("--config", type=int, default=1)
+    p.add_argument("--update", choices=["matmul", "scatter", "pallas"],
+                   default=None,
+                   help="Lloyd assign+reduce strategy (default: the config's)")
     _add_backend_arg(p, default=None)  # None = the config's own backend
     p.set_defaults(fn=_cmd_bench)
 

@@ -49,7 +49,23 @@ __all__ = [
     "assign_labels_jax",
     "kmeans_jax",
     "kmeans_jax_full",
+    "padding_multiple",
 ]
+
+#: Row tile the pallas kernel iterates internally (ops/pallas_kernels.py).
+PALLAS_TILE_ROWS = 1024
+
+
+def padding_multiple(ndata: int, chunk_rows: int | None, update: str) -> int:
+    """Row-count multiple the kernel pads/shards to.
+
+    Single source for callers (e.g. the benchmark harness) that pre-stage a
+    sharded device array and must match ``kmeans_jax_full``'s padding rule:
+    each of the ``ndata`` shards must hold a whole number of chunks, and the
+    pallas kernel additionally tiles rows at PALLAS_TILE_ROWS.
+    """
+    return int(ndata) * int(
+        chunk_rows or (PALLAS_TILE_ROWS if update == "pallas" else 1))
 
 
 def pairwise_sq_dists_jax(x: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
@@ -627,8 +643,8 @@ def kmeans_jax_full(
     if k % nmodel != 0:
         raise ValueError(f"k={k} must be divisible by the model axis size {nmodel}")
 
-    # pallas tiles rows internally (default 1024), so shards must divide it.
-    multiple = ndata * (chunk_rows or (1024 if update == "pallas" else 1))
+    # pallas tiles rows internally (PALLAS_TILE_ROWS), so shards must divide it.
+    multiple = padding_multiple(ndata, chunk_rows, update)
     if is_device_array:
         # Device-resident input (pipeline / benchmark / streaming path): never
         # copy to host.  ``n_valid`` marks the true row count when the caller

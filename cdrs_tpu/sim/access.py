@@ -69,8 +69,18 @@ def simulate_access(
     rng = np.random.default_rng(cfg.seed)
     n = len(manifest)
     if sim_start is None:
-        import time
-        sim_start = time.time()
+        # Seeded runs anchor to the *manifest's* timebase (latest creation
+        # timestamp) so the window is deterministic whenever the manifest is
+        # (see utils/params.SEEDED_EPOCH) AND always after every file exists —
+        # a fixed global epoch would put events ~years before wall-clock
+        # manifests, publishing negative age_seconds.  Unseeded runs keep the
+        # reference's wall clock (src/access_simulator.py:21).
+        if cfg.seed is not None:
+            sim_start = float(np.ceil(manifest.creation_ts.max())) + 1.0
+        else:
+            import time
+
+            sim_start = time.time()
 
     read, write, loc = jittered_rates(manifest, cfg, rng)
 

@@ -39,7 +39,12 @@ def generate_population(
     rng = np.random.default_rng(cfg.seed)
     n = cfg.n_files
     if now is None:
-        now = time.time()
+        # Seeded runs anchor to a fixed epoch so the seed fully determines the
+        # workload — wall-clock anchoring would shift the simulator's 1-second
+        # concurrency buckets every run (utils/params.SEEDED_EPOCH rationale).
+        from ..utils.params import SEEDED_EPOCH
+
+        now = SEEDED_EPOCH if cfg.seed is not None else time.time()
 
     sizes = rng.integers(cfg.min_size, cfg.max_size + 1, size=n, dtype=np.int64)
     age_days = rng.random(n) * cfg.age_days_max
