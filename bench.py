@@ -21,8 +21,9 @@ def main() -> int:
     p.add_argument("--config", type=int, default=2)
     p.add_argument("--backend", default=None)
     p.add_argument("--update", default=None,
-                   choices=["matmul", "scatter", "pallas"],
-                   help="Lloyd assign+reduce strategy (default: the config's)")
+                   choices=["auto", "matmul", "scatter", "pallas"],
+                   help="Lloyd assign+reduce strategy (default: the config's; "
+                        "auto = pallas on TPU where it fits, matmul else)")
     args = p.parse_args()
 
     import os

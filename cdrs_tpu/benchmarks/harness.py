@@ -37,9 +37,9 @@ class BenchConfig:
     chunk_rows: int | None = None
     mesh_shape: tuple[tuple[str, int], ...] | None = None  # hashable dict items
     dtype: str = "float32"
-    #: Lloyd assign+reduce strategy: "matmul" | "scatter" | "pallas"
-    #: (ops/kmeans_jax._assign_reduce).
-    update: str = "matmul"
+    #: Lloyd assign+reduce strategy: "auto" | "matmul" | "scatter" | "pallas"
+    #: (ops/kmeans_jax._assign_reduce; "auto" = pallas on TPU, matmul else).
+    update: str = "auto"
     # numpy baseline is measured directly when n <= direct_np_limit, else on a
     # row subsample and extrapolated linearly in n (documented estimate).
     direct_np_limit: int = 2_000_000
@@ -50,7 +50,7 @@ class BenchConfig:
 
 CONFIGS: dict[int, BenchConfig] = {
     1: BenchConfig(n=10_000, d=8, k=10, backend="numpy", iters=10),
-    2: BenchConfig(n=1_048_576, d=32, k=128, backend="jax", iters=50),
+    2: BenchConfig(n=1_048_576, d=32, k=128, backend="jax", iters=100),
     3: BenchConfig(n=10_485_760, d=128, k=1024, backend="jax", iters=5,
                    chunk_rows=131_072),
     4: BenchConfig(n=104_857_600, d=128, k=1024, backend="jax", iters=5,
@@ -230,10 +230,13 @@ def _time_init(X, k: int, init: np.ndarray, mesh_shape, chunk_rows, dtype,
     measurement floor (INIT_TIMING_FLOOR_FRAC of the baseline pass) — a
     near-zero difference is timing noise, not a free init.
     """
+    import jax
+
     from ..ops.kmeans_jax import kmeans_jax_full
 
     kwargs = dict(tol=0.0, seed=0, max_iter=0, mesh_shape=mesh_shape,
                   dtype=dtype, chunk_rows=chunk_rows, update=update)
+    init_dev = jax.block_until_ready(jax.device_put(np.asarray(init, dtype)))
 
     def timed(**kw):
         c, _, _, _ = kmeans_jax_full(X, k, **kwargs, **kw)  # compile/warmup
@@ -247,7 +250,7 @@ def _time_init(X, k: int, init: np.ndarray, mesh_shape, chunk_rows, dtype,
         full = timed(init_method=method)
     except ValueError:
         return None
-    base = timed(init_centroids=init)
+    base = timed(init_centroids=init_dev)
     diff = full - base
     if diff <= INIT_TIMING_FLOOR_FRAC * base:
         return None
@@ -262,10 +265,14 @@ def _time_jax_lloyd(X, k: int, init: np.ndarray, iters: int,
 
     from ..ops.kmeans_jax import kmeans_jax_full
 
+    # Stage the init on device outside the timed region — a numpy array here
+    # costs a per-call host->device upload (fixed ~100+ ms on remote-tunnel
+    # backends, polluting the steady-state iteration metric).
+    init_dev = jax.block_until_ready(jax.device_put(np.asarray(init, dtype)))
     kwargs = dict(
         tol=0.0,  # never converge: run exactly max_iter iterations
         seed=0,
-        init_centroids=init,
+        init_centroids=init_dev,
         mesh_shape=mesh_shape,
         dtype=dtype,
         chunk_rows=chunk_rows,
@@ -328,7 +335,9 @@ def run_bench(config: int = 2, backend: str | None = None,
     row subsample and scaled linearly in n (the Lloyd step is O(n·k·d));
     the result notes this with ``numpy_estimated: true``.
     ``update`` overrides the config's Lloyd assign+reduce strategy
-    ("matmul" | "scatter" | "pallas").
+    ("auto" | "matmul" | "scatter" | "pallas"; "auto" resolves to the fused
+    pallas kernel on TPU when its VMEM blocks fit, else matmul — the
+    recorded ``update`` field is the resolved strategy).
     """
     cfg = CONFIGS[int(config)]
     backend = backend or cfg.backend
@@ -405,6 +414,16 @@ def run_bench(config: int = 2, backend: str | None = None,
                 ndata //= 2
             mesh_shape = {"data": ndata}
             result["mesh_downscaled_to"] = mesh_shape
+
+    # Resolve "auto" with the shape that will actually run (mesh model axis,
+    # dtype, k, chunk) so the recorded ``update`` is the strategy executed —
+    # and matches what kmeans_jax_full itself would resolve.
+    from ..ops.kmeans_jax import resolve_update
+
+    update = resolve_update(update,
+                            nmodel=int((mesh_shape or {}).get("model", 1)),
+                            dtype=cfg.dtype, k=cfg.k,
+                            chunk_rows=cfg.chunk_rows)
 
     dtype = np.dtype(cfg.dtype)
     if X_np is not None:

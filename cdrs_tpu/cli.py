@@ -440,9 +440,11 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser("bench", help="benchmark harness (BASELINE.md configs)")
     p.add_argument("--config", type=int, default=1)
-    p.add_argument("--update", choices=["matmul", "scatter", "pallas"],
+    p.add_argument("--update",
+                   choices=["auto", "matmul", "scatter", "pallas"],
                    default=None,
-                   help="Lloyd assign+reduce strategy (default: the config's)")
+                   help="Lloyd assign+reduce strategy (default: the config's; "
+                        "auto = pallas on TPU, matmul elsewhere)")
     _add_backend_arg(p, default=None)  # None = the config's own backend
     p.set_defaults(fn=_cmd_bench)
 

@@ -50,10 +50,80 @@ __all__ = [
     "kmeans_jax",
     "kmeans_jax_full",
     "padding_multiple",
+    "resolve_update",
 ]
 
-#: Row tile the pallas kernel iterates internally (ops/pallas_kernels.py).
-PALLAS_TILE_ROWS = 1024
+#: Row tile the pallas kernel iterates internally (ops/pallas_kernels.py):
+#: columns of the feature-major (d, n) view.  4096 won the v5e sweep
+#: (VMEM: (k_pad, 4096) f32 distance + one-hot blocks = 4 MB at k=1024...
+#: k_pad=128; larger tiles hit the 16 MB scoped-VMEM limit at k_pad >= 512).
+PALLAS_TILE_ROWS = 4096
+
+
+@functools.lru_cache(maxsize=64)
+def _device_key(seed: int):
+    """Per-seed PRNG key, staged on device once.
+
+    ``jax.random.PRNGKey`` per call costs a host->device dispatch; on a
+    remote-tunnel backend that is ~25-100 ms of fixed latency per kmeans
+    call (measured: ~230 ms of per-call transfers before this cache)."""
+    return jax.block_until_ready(jax.random.PRNGKey(seed))
+
+
+@functools.lru_cache(maxsize=16)
+def _device_scalar_i32(v: int):
+    return jax.block_until_ready(jnp.asarray(v, jnp.int32))
+
+
+def _zero_centroids(k: int, d: int, dtype_name: str):
+    # Placeholder for the unused c0 operand when the init runs on device;
+    # canonicalize f64 -> f32 silently when x64 is off (jnp.zeros warns).
+    # Canonicalization happens BEFORE the cache key so flipping
+    # jax_enable_x64 mid-process can't serve a stale-dtype buffer.
+    if dtype_name == "float64" and not jax.config.jax_enable_x64:
+        dtype_name = "float32"
+    return _zero_centroids_cached(k, d, dtype_name)
+
+
+@functools.lru_cache(maxsize=16)
+def _zero_centroids_cached(k: int, d: int, dtype_name: str):
+    return jax.block_until_ready(jnp.zeros((k, d), dtype_name))
+
+
+#: "auto" picks pallas only when the kernel's two (k_pad, tile) f32 VMEM
+#: blocks (distance + one-hot) fit comfortably under the 16 MB scoped-VMEM
+#: limit: k_pad * tile <= 2^20 elements = 2 x 4 MB blocks.
+_PALLAS_VMEM_ELEMS = 1 << 20
+
+
+def resolve_update(update: str, nmodel: int = 1, dtype=np.float32,
+                   k: int | None = None,
+                   chunk_rows: int | None = None) -> str:
+    """Resolve the "auto" Lloyd assign+reduce strategy.
+
+    "auto" -> "pallas" on a real TPU backend with an unsharded centroid
+    table, f32 data, and a (k, tile) shape whose VMEM blocks fit (the
+    fastest measured path: the fused feature-major VMEM kernel, 467 vs 139
+    iter/s for XLA matmul on v5e at 1M x 32, k=128); "matmul" everywhere
+    else (CPU tests run the pallas kernel only in interpret mode, which is
+    orders of magnitude slower than XLA; large k with large tiles exceeds
+    the 16 MB scoped-VMEM limit and would fail Mosaic compilation).
+    Explicitly requested strategies pass through untouched.
+    """
+    if update != "auto":
+        return update
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        on_tpu = False
+    if not (on_tpu and nmodel == 1 and np.dtype(dtype) == np.float32):
+        return "matmul"
+    if k is not None:
+        tile = int(chunk_rows or PALLAS_TILE_ROWS)
+        k_pad = ((max(int(k), 8) + 127) // 128) * 128
+        if k_pad * tile > _PALLAS_VMEM_ELEMS:
+            return "matmul"
+    return "pallas"
 
 
 def padding_multiple(ndata: int, chunk_rows: int | None, update: str) -> int:
@@ -65,7 +135,8 @@ def padding_multiple(ndata: int, chunk_rows: int | None, update: str) -> int:
     pallas kernel additionally tiles rows at PALLAS_TILE_ROWS.
     """
     return int(ndata) * int(
-        chunk_rows or (PALLAS_TILE_ROWS if update == "pallas" else 1))
+        chunk_rows or (PALLAS_TILE_ROWS if resolve_update(update) == "pallas"
+                       else 1))
 
 
 def pairwise_sq_dists_jax(x: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
@@ -289,7 +360,8 @@ def _weighted_cluster_stats(xc, wc, lab, k, update):
     return sums, counts
 
 
-def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None):
+def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None,
+                   xt=None):
     """Fused assignment + per-cluster (sum, count) reduction for one shard.
 
     ``chunk_rows=None`` materializes the full (n_loc, k) distance block — fast
@@ -299,16 +371,22 @@ def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None):
     §7.4 "memory at 100M×128").
     """
     if update == "pallas":
-        # Fused VMEM-resident kernel (ops/pallas_kernels.py).  The shard-local
-        # valid count is derived exactly from the static global n_valid (a
-        # float mask sum would saturate at 2**24 rows in f32).
-        from .pallas_kernels import lloyd_assign_reduce_pallas
+        # Fused VMEM-resident feature-major kernel (ops/pallas_kernels.py).
+        # The shard-local valid count is derived exactly from the static
+        # global n_valid (a float mask sum would saturate at 2**24 rows in
+        # f32).  The Lloyd while_loop discards labels, so the kernel omits
+        # that output — an unused custom-call output can't be DCE'd and
+        # would DMA an (n,) buffer per iteration.  ``xt`` is the (d, n_loc)
+        # transposed view, computed ONCE outside the loop by the caller (the
+        # per-iteration transpose would cost more than it saves).
+        from .pallas_kernels import lloyd_assign_reduce_pallas_t
 
         n_loc = x.shape[0]
         nv = jnp.clip(n_valid - lax.axis_index(DATA_AXIS) * n_loc, 0, n_loc
                       ).astype(jnp.int32)
-        labels, sums, counts = lloyd_assign_reduce_pallas(
-            x, c, nv, tile_rows=chunk_rows or 1024)
+        labels, sums, counts = lloyd_assign_reduce_pallas_t(
+            x.T if xt is None else xt, c, nv,
+            tile_cols=chunk_rows or PALLAS_TILE_ROWS, with_labels=False)
         return labels, sums.astype(x.dtype), counts.astype(x.dtype)
 
     if chunk_rows is None:
@@ -368,6 +446,11 @@ def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
     n_loc = x.shape[0]
     rank = lax.axis_index(DATA_AXIS)
     offset = rank * n_loc
+    # Feature-major copy for the pallas kernel, materialized once before the
+    # loop (loop-invariant closure): for d < 128 the row-major (n, d) layout
+    # is lane-padded to 128 in HBM, so reading it costs 128/d x the logical
+    # bytes per iteration; (d, n) is dense.
+    xt = x.T if update == "pallas" else None
 
     def cond(carry):
         _, _, it, shift = carry
@@ -376,7 +459,7 @@ def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
     def body(carry):
         c, _, it, _ = carry
         _, sums, counts = _assign_reduce(x, w, c, k, chunk_rows, update,
-                                         n_valid=n_valid)
+                                         n_valid=n_valid, xt=xt)
         sums = lax.psum(sums, DATA_AXIS)
         counts = lax.psum(counts, DATA_AXIS)
         # Reseed key depends on the GLOBAL iteration index (iter_offset + it),
@@ -603,7 +686,7 @@ def kmeans_jax_full(
     mesh_shape: dict[str, int] | None = None,
     dtype=None,
     chunk_rows: int | None = None,
-    update: str = "matmul",
+    update: str = "auto",
     n_valid: int | None = None,
     iter_offset: int = 0,
     init_method: str = "d2",
@@ -642,6 +725,9 @@ def kmeans_jax_full(
     nmodel = int((mesh_shape or {}).get(MODEL_AXIS, 1))
     if k % nmodel != 0:
         raise ValueError(f"k={k} must be divisible by the model axis size {nmodel}")
+    if update not in ("auto", "matmul", "scatter", "pallas"):
+        raise ValueError(f"unknown update strategy {update!r}")
+    update = resolve_update(update, nmodel, dtype, k=k, chunk_rows=chunk_rows)
 
     # pallas tiles rows internally (PALLAS_TILE_ROWS), so shards must divide it.
     multiple = padding_multiple(ndata, chunk_rows, update)
@@ -668,15 +754,15 @@ def kmeans_jax_full(
     # sums, counts, or sampling.
 
     with_init = init_centroids is not None
+    # Keep device-resident init centroids on device (np.asarray here would be
+    # a device->host fetch followed by a host->device upload, per call).
     c0 = (
-        np.asarray(init_centroids, dtype=dtype)
+        jnp.asarray(init_centroids, dtype=dtype)
         if with_init
-        else np.zeros((k, d), dtype=dtype)
+        else _zero_centroids(int(k), int(d), np.dtype(dtype).name)
     )
-    key = jax.random.PRNGKey(0 if seed is None else int(seed))
+    key = _device_key(0 if seed is None else int(seed))
 
-    if update not in ("matmul", "scatter", "pallas"):
-        raise ValueError(f"unknown update strategy {update!r}")
     if update == "pallas" and nmodel > 1:
         raise ValueError("pallas update not supported on a model-sharded mesh")
     if init_method not in ("d2", "kmeans||"):
@@ -697,7 +783,10 @@ def kmeans_jax_full(
     if k > n_valid:
         raise ValueError(f"k={k} exceeds number of valid samples {n_valid}")
     centroids, labels, it, shift = fn(
-        Xp, c0, key, jnp.asarray(int(iter_offset), jnp.int32))
+        Xp, c0, key, _device_scalar_i32(int(iter_offset)))
+    # One host fetch for both scalars — int(it); float(shift) would be two
+    # device->host round trips (each ~25-100 ms on remote-tunnel backends).
+    it, shift = jax.device_get((it, shift))
     return centroids, labels[:n_valid], int(it), float(shift)
 
 

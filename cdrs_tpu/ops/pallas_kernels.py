@@ -34,7 +34,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["lloyd_assign_reduce_pallas", "pallas_available"]
+__all__ = ["lloyd_assign_reduce_pallas", "lloyd_assign_reduce_pallas_t",
+           "pallas_available"]
 
 _LANE = 128
 
@@ -94,7 +95,7 @@ def _kernel(nv_ref, x_ref, c_ref, csq_ref, sums_ref, counts_ref, labels_ref, *,
         counts_ref[:] += cnt[None, :]
 
 
-@functools.lru_cache(maxsize=32)
+@functools.lru_cache(maxsize=64)
 def _build(n_rows, d, k, tile_rows, dtype_name, interpret):
     # Feature dim is used as-is (Mosaic lane-pads minor dims internally; an
     # explicit zero-pad to 128 would 4x the matmul FLOPs at d=32 and
@@ -151,9 +152,148 @@ def _build(n_rows, d, k, tile_rows, dtype_name, interpret):
     return fn
 
 
+def _kernel_t(nv_ref, xt_ref, c_ref, csq_ref, sums_ref, counts_ref,
+              labels_ref, *, k_pad, tile_cols):
+    """Feature-major body: one (k_pad, TN) distance block per grid step.
+
+    The row-major kernel reads x as (T, d) tiles; for d < 128 XLA stores the
+    (n, d) array lane-padded to 128 (layout T(8,128)), so every iteration
+    moves 128/d times the logical bytes.  Feature-major (d, n) is fully
+    dense — the lane dimension is n — and both matmuls are plain
+    (M, K) @ (K, N) forms on the MXU.
+    """
+    i = pl.program_id(0)
+    n_valid = nv_ref[0, 0]
+    xt = xt_ref[:]                     # (d, TN)
+    c = c_ref[:]                       # (k_pad, d)
+
+    dist = csq_ref[:] - 2.0 * jax.lax.dot_general(
+        c, xt,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                  # (k_pad, TN); csq (k_pad, 1) broadcasts
+
+    rows2 = jax.lax.broadcasted_iota(jnp.int32, (k_pad, tile_cols), 0)
+    dmin = jnp.min(dist, axis=0, keepdims=True)            # (1, TN)
+    lab2 = jnp.min(jnp.where(dist == dmin, rows2, k_pad), axis=0,
+                   keepdims=True)                           # (1, TN) first min
+    if labels_ref is not None:
+        labels_ref[:] = lab2.astype(jnp.int32)
+
+    # Validity mask from the global column index — no HBM traffic (an
+    # explicit (1, n) mask array would be sublane-padded 8x by XLA).
+    col0 = i * tile_cols
+    cols = jax.lax.broadcasted_iota(jnp.int32, (1, tile_cols), 1)
+    mask = ((col0 + cols) < n_valid).astype(xt.dtype)       # (1, TN)
+    oh = (rows2 == lab2).astype(xt.dtype) * mask            # (k_pad, TN)
+
+    s = jax.lax.dot_general(
+        oh, xt,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                  # (k_pad, d)
+    cnt = jnp.sum(oh, axis=1)          # (k_pad,)
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[:] = s
+        counts_ref[:] = cnt[:, None]
+
+    @pl.when(i > 0)
+    def _acc():
+        sums_ref[:] += s
+        counts_ref[:] += cnt[:, None]
+
+
+def _kernel_t_no_labels(nv_ref, xt_ref, c_ref, csq_ref, sums_ref, counts_ref,
+                        *, k_pad, tile_cols):
+    _kernel_t(nv_ref, xt_ref, c_ref, csq_ref, sums_ref, counts_ref, None,
+              k_pad=k_pad, tile_cols=tile_cols)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_t(n_cols, d, k, tile_cols, dtype_name, interpret, with_labels):
+    k_pad = _pad_to(max(k, 8), _LANE)
+    grid = n_cols // tile_cols
+
+    if with_labels:
+        kern = functools.partial(_kernel_t, k_pad=k_pad, tile_cols=tile_cols)
+    else:
+        kern = functools.partial(_kernel_t_no_labels, k_pad=k_pad,
+                                 tile_cols=tile_cols)
+
+    out_specs = [
+        pl.BlockSpec((k_pad, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((k_pad, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((k_pad, d), jnp.float32),
+        jax.ShapeDtypeStruct((k_pad, 1), jnp.float32),
+    ]
+    if with_labels:
+        out_specs.append(pl.BlockSpec((1, tile_cols), lambda i: (0, i),
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((1, n_cols), jnp.int32))
+
+    call = pl.pallas_call(
+        kern,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((d, tile_cols), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, d), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=bool(interpret),
+    )
+
+    dtype = jnp.dtype(dtype_name)
+
+    def fn(xt, c, n_valid):
+        big = jnp.asarray(1e30, dtype)
+        c_p = jnp.zeros((k_pad, d), dtype).at[:k].set(c)
+        c_sq = jnp.sum(c_p * c_p, axis=1)
+        c_sq = jnp.where(jax.lax.iota(jnp.int32, k_pad) < k, c_sq, big)
+        nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
+        out = call(nv, xt, c_p, c_sq[:, None])
+        labels = out[2][0] if with_labels else None
+        return labels, out[0][:k], out[1][:k, 0]
+
+    return fn
+
+
+def lloyd_assign_reduce_pallas_t(xt, c, n_valid, tile_cols: int = 4096,
+                                 interpret: bool | None = None,
+                                 with_labels: bool = True):
+    """Feature-major fused assignment + (sums, counts).
+
+    ``xt``: (d, n_cols) — the points matrix TRANSPOSED, n_cols % tile_cols
+    == 0 (zero-pad columns; they carry weight 0 via ``n_valid``).  ``c``:
+    (k, d).  Returns (labels (n_cols,) int32 or None, sums (k, d) f32,
+    counts (k,) f32) — identical semantics to ``lloyd_assign_reduce_pallas``
+    but reading x in its dense layout: for d < 128 the row-major (n, d)
+    array is lane-padded 128/d x in HBM, which made the row-major kernel
+    bandwidth-bound on padding bytes.
+    """
+    if interpret is None:
+        interpret = not pallas_available()
+    d, n_cols = xt.shape
+    k = c.shape[0]
+    if n_cols % tile_cols:
+        raise ValueError(f"cols {n_cols} not a multiple of tile_cols {tile_cols}")
+    fn = _build_t(n_cols, d, k, int(tile_cols),
+                  jnp.dtype(xt.dtype).name, bool(interpret), bool(with_labels))
+    return fn(xt, c, n_valid)
+
+
 def lloyd_assign_reduce_pallas(x, c, n_valid, tile_rows: int = 1024,
                                interpret: bool | None = None):
-    """Fused assignment + (sums, counts) for one device's rows.
+    """Fused assignment + (sums, counts) for one device's rows (row-major).
 
     ``x``: (n_rows, d) with n_rows % tile_rows == 0 (caller pads rows;
     tile_rows must be a multiple of 1024 to match XLA's 1D layout tiling);
@@ -161,6 +301,12 @@ def lloyd_assign_reduce_pallas(x, c, n_valid, tile_rows: int = 1024,
     rows >= n_valid get zero weight (their labels are still produced but
     meaningless).  Returns (labels (n_rows,) int32, sums (k, d) f32,
     counts (k,) f32).  Call from inside jit for fusion with neighbors.
+
+    The Lloyd loop itself uses the feature-major variant
+    (``lloyd_assign_reduce_pallas_t``): for d < 128 the row-major (n, d)
+    layout is lane-padded to 128 in HBM, so this kernel pays 128/d x the
+    logical read bytes.  Kept as the layout-matching API for callers whose
+    x is already row-major and read once.
     """
     if interpret is None:
         interpret = not pallas_available()

@@ -69,18 +69,16 @@ def simulate_access(
     rng = np.random.default_rng(cfg.seed)
     n = len(manifest)
     if sim_start is None:
-        # Seeded runs anchor to the *manifest's* timebase (latest creation
-        # timestamp) so the window is deterministic whenever the manifest is
-        # (see utils/params.SEEDED_EPOCH) AND always after every file exists —
-        # a fixed global epoch would put events ~years before wall-clock
-        # manifests, publishing negative age_seconds.  Unseeded runs keep the
-        # reference's wall clock (src/access_simulator.py:21).
-        if cfg.seed is not None:
-            sim_start = float(np.ceil(manifest.creation_ts.max())) + 1.0
-        else:
-            import time
-
-            sim_start = time.time()
+        # Anchor to the *manifest's* timebase (latest creation timestamp):
+        # deterministic whenever the manifest is (see utils/params
+        # .SEEDED_EPOCH) and always just after every file exists.  This also
+        # holds when a seeded manifest (anchored to SEEDED_EPOCH, ~2023) is
+        # simulated without a seed — the reference's wall clock
+        # (src/access_simulator.py:21) would put the window years after
+        # creation and flatten every age_seconds to the epoch gap.  For
+        # unseeded manifests creation is within the past year of wall clock,
+        # so this matches the reference's behavior up to that year.
+        sim_start = float(np.ceil(manifest.creation_ts.max())) + 1.0
 
     read, write, loc = jittered_rates(manifest, cfg, rng)
 

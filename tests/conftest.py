@@ -17,21 +17,29 @@ collection via their own imports.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+#: CDRS_TPU_TESTS=1 leaves the ambient (TPU) backend in place so the
+#: tpu-marked modules (tests/test_tpu_chip.py) can run non-interpret kernels
+#: on a real chip:  ``CDRS_TPU_TESTS=1 pytest tests/test_tpu_chip.py``.
+#: Everything else in the suite assumes the 8-device CPU mesh — run the full
+#: suite without this flag.
+_TPU_MODE = os.environ.get("CDRS_TPU_TESTS") == "1"
+
+if not _TPU_MODE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 try:
     import jax
 except ImportError:  # pragma: no cover - base install without the tpu extra
     jax = None
 
-if jax is not None:
+if jax is not None and not _TPU_MODE:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
     try:  # private API; best-effort cleanup of site-hook-initialized backends
