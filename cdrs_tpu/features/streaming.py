@@ -83,9 +83,27 @@ def _build_update(e: int, n: int, ndata: int = 1):
 
     The returned function takes the event shard columns plus the replicated
     state arrays and returns the updated state arrays.
+
+    ``ndata == 1`` compiles the body as a plain jit with identity collectives
+    and no shard-edge pass: wrapping a 1-device mesh in shard_map forces
+    XLA's SPMD scatter lowering, measured ~7x slower per segment_sum on v5e
+    (the whole fold: 4.9 s vs 0.18 s per 1M-event batch), and shard-edge
+    seconds cannot exist without shards.
     """
-    mesh = make_mesh(n_data=ndata)
+    sharded = ndata > 1
     imax = jnp.int32(np.iinfo(np.int32).max)
+
+    if sharded:
+        def ps(x):
+            return lax.psum(x, DATA_AXIS)
+
+        def pmax_(x):
+            return lax.pmax(x, DATA_AXIS)
+
+        def pmin_(x):
+            return lax.pmin(x, DATA_AXIS)
+    else:
+        ps = pmax_ = pmin_ = lambda x: x
 
     def local_fn(pid, sec, op, client, primary_node_id,
                  access_freq, writes, local_acc, conc_max, last_sec, last_count):
@@ -93,33 +111,30 @@ def _build_update(e: int, n: int, ndata: int = 1):
         wi = valid.astype(jnp.int32)
         pid_c = jnp.where(valid, pid, 0).astype(jnp.int32)
 
-        batch_access = lax.psum(
-            jax.ops.segment_sum(wi, pid_c, num_segments=n), DATA_AXIS)
+        batch_access = ps(jax.ops.segment_sum(wi, pid_c, num_segments=n))
         access_freq = access_freq + batch_access
-        writes = writes + lax.psum(
-            jax.ops.segment_sum(wi * (op == 1), pid_c, num_segments=n), DATA_AXIS)
+        writes = writes + ps(
+            jax.ops.segment_sum(wi * (op == 1), pid_c, num_segments=n))
         is_local = (client == primary_node_id[pid_c]).astype(jnp.int32) * wi
-        local_acc = local_acc + lax.psum(
-            jax.ops.segment_sum(is_local, pid_c, num_segments=n), DATA_AXIS)
+        local_acc = local_acc + ps(
+            jax.ops.segment_sum(is_local, pid_c, num_segments=n))
         present = batch_access > 0
 
         # --- concurrency ---
         sort_pid = jnp.where(valid, pid, n).astype(jnp.int32)
         conc = jnp.maximum(
             conc_max,
-            lax.pmax(_concurrency_local(sort_pid, sec, wi, n), DATA_AXIS),
+            pmax_(_concurrency_local(sort_pid, sec, wi, n)),
         )
 
         # Per-file first/last second of this batch (int-extreme defaults for
         # absent files; ``present`` gates every use).
         sec_hi = jnp.where(valid, sec, imax)
         sec_lo = jnp.where(valid, sec, -1)
-        s_first = lax.pmin(
-            jnp.minimum(jax.ops.segment_min(sec_hi, pid_c, num_segments=n), imax),
-            DATA_AXIS)
-        s_last = lax.pmax(
-            jnp.maximum(jax.ops.segment_max(sec_lo, pid_c, num_segments=n), -1),
-            DATA_AXIS)
+        s_first = pmin_(
+            jnp.minimum(jax.ops.segment_min(sec_hi, pid_c, num_segments=n), imax))
+        s_last = pmax_(
+            jnp.maximum(jax.ops.segment_max(sec_lo, pid_c, num_segments=n), -1))
 
         # Cross-batch carry: the carried (last_sec, last_count) continues into
         # this batch iff the file's first second here equals the carried one.
@@ -129,25 +144,28 @@ def _build_update(e: int, n: int, ndata: int = 1):
         # that file's first-second bucket, psum-merged, plus the carry).
         l_first = jax.ops.segment_sum(
             wi * (sec == s_first[pid_c]), pid_c, num_segments=n)
-        total_first = lax.psum(l_first, DATA_AXIS) + carry
+        total_first = ps(l_first) + carry
         conc = jnp.maximum(conc, jnp.where(present, total_first, 0))
 
-        # Shard-edge seconds (time-contiguous shards ⇒ only these can hold a
-        # (file, second) bucket split across shards): psum exact counts, with
-        # the carry folded in where the edge second is a file's first.
-        smin = jnp.min(sec_hi)
-        smax = jnp.max(sec_lo)
-        bounds = lax.all_gather(jnp.stack([smin, smax]), DATA_AXIS).reshape(-1)
+        if sharded:
+            # Shard-edge seconds (time-contiguous shards ⇒ only these can
+            # hold a (file, second) bucket split across shards): psum exact
+            # counts, with the carry folded in where the edge second is a
+            # file's first.  Single-shard batches have no edges — the block
+            # would only re-derive counts the run-length pass already has.
+            smin = jnp.min(sec_hi)
+            smax = jnp.max(sec_lo)
+            bounds = lax.all_gather(jnp.stack([smin, smax]),
+                                    DATA_AXIS).reshape(-1)
 
-        def edge_count(i, conc):
-            b = bounds[i]
-            cnt = lax.psum(
-                jax.ops.segment_sum(wi * (sec == b), pid_c, num_segments=n),
-                DATA_AXIS)
-            cnt = cnt + jnp.where(s_first == b, carry, 0)
-            return jnp.maximum(conc, jnp.where(present, cnt, 0))
+            def edge_count(i, conc):
+                b = bounds[i]
+                cnt = ps(jax.ops.segment_sum(wi * (sec == b), pid_c,
+                                             num_segments=n))
+                cnt = cnt + jnp.where(s_first == b, carry, 0)
+                return jnp.maximum(conc, jnp.where(present, cnt, 0))
 
-        conc = lax.fori_loop(0, bounds.shape[0], edge_count, conc)
+            conc = lax.fori_loop(0, bounds.shape[0], edge_count, conc)
 
         # Trailing (second, running count) for the next batch.  The last
         # second's total is exact: either all its events sit on one shard
@@ -157,13 +175,16 @@ def _build_update(e: int, n: int, ndata: int = 1):
         # total), plus the carry when the batch has a single bucket.
         l_last = jax.ops.segment_sum(
             wi * (sec == s_last[pid_c]), pid_c, num_segments=n)
-        total_last = lax.psum(l_last, DATA_AXIS) + jnp.where(
-            s_last == s_first, carry, 0)
+        total_last = ps(l_last) + jnp.where(s_last == s_first, carry, 0)
         new_last_sec = jnp.where(present, s_last, last_sec)
         new_last_count = jnp.where(present, total_last, last_count)
 
         return access_freq, writes, local_acc, conc, new_last_sec, new_last_count
 
+    if not sharded:
+        return jax.jit(local_fn)
+
+    mesh = make_mesh(n_data=ndata)
     return jax.jit(jax.shard_map(
         local_fn,
         mesh=mesh,
