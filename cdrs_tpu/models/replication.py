@@ -170,7 +170,11 @@ class ReplicationPolicyModel:
                             self.scoring_cfg)
         from ..ops.scoring_jax import classify_jax
 
-        winner, scores, medians = classify_jax(X, labels, self.kmeans_cfg.k, self.scoring_cfg)
+        # The model's mesh shards the median stage too (VERDICT r2 #5): at
+        # the scales that need a mesh, X only exists sharded.
+        winner, scores, medians = classify_jax(
+            X, labels, self.kmeans_cfg.k, self.scoring_cfg,
+            mesh_shape=self.mesh_shape)
         return np.asarray(winner), np.asarray(scores), np.asarray(medians)
 
     # -- end to end -------------------------------------------------------

@@ -145,6 +145,95 @@ def compute_cluster_medians_hist_jax(
     return _hist_medians(x, labels, k, bins, False)[0]
 
 
+@functools.lru_cache(maxsize=32)
+def _build_hist_medians_sharded(k: int, bins: int, with_global: bool,
+                                ndata: int, nmodel: int = 1):
+    """Compile the data-sharded histogram-median kernel (VERDICT r2 #5).
+
+    Each shard bins its rows into per-(cluster, bin) counts; one ``psum``
+    of the (k, bins) histogram per feature merges them — the feature matrix
+    never moves off its shards (at 100M x 128 it cannot: ~51 GB f32 spans
+    the whole v5e-8 mesh).  Padded rows carry the sentinel label ``k`` and
+    are masked out of counts, histograms, and the min/max range.
+    Reference semantics: per-cluster medians of src/scoring.py:40-55.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS, make_mesh
+
+    # Same mesh shape as the clustering stage (x arrives sharded over data,
+    # replicated over any model axis) so dispatch needs no resharding; the
+    # median reductions only ever communicate over the data axis.
+    mesh = make_mesh(n_data=ndata, n_model=nmodel)
+
+    def local_fn(x_loc, lab_loc):
+        ftype = x_loc.dtype
+        valid = lab_loc < k
+        wi = valid.astype(jnp.int32)
+        lab_c = jnp.where(valid, lab_loc, 0)
+        counts = lax.psum(
+            jax.ops.segment_sum(wi, lab_c, num_segments=k), DATA_AXIS)
+        n_total = jnp.sum(counts)[None]
+        big = jnp.asarray(jnp.inf, ftype)
+        lo = lax.pmin(
+            jnp.min(jnp.where(valid[:, None], x_loc, big), axis=0), DATA_AXIS)
+        hi = lax.pmax(
+            jnp.max(jnp.where(valid[:, None], x_loc, -big), axis=0), DATA_AXIS)
+
+        def one_feature(args):
+            col, lo_f, hi_f = args
+            w_f = jnp.where(hi_f > lo_f, hi_f - lo_f, 1.0)
+            b = jnp.clip(((col - lo_f) / w_f * bins).astype(jnp.int32),
+                         0, bins - 1)
+            H = lax.psum(
+                jax.ops.segment_sum(wi, lab_c * bins + b,
+                                    num_segments=k * bins),
+                DATA_AXIS).reshape(k, bins)
+            exact_const = hi_f <= lo_f
+            med = jnp.where(
+                exact_const, lo_f,
+                _medians_from_hist(H, counts, lo_f, w_f, bins, ftype))
+            if with_global:
+                gmed = jnp.where(
+                    exact_const, lo_f,
+                    _medians_from_hist(H.sum(0, keepdims=True), n_total,
+                                       lo_f, w_f, bins, ftype))[0]
+            else:
+                gmed = jnp.zeros((), ftype)
+            return med, gmed
+
+        meds, gmeds = lax.map(one_feature, (x_loc.T, lo, hi))
+        return meds.T, gmeds
+
+    return jax.jit(jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    ))
+
+
+def _hist_medians_sharded(x, labels, k: int, bins: int, with_global: bool,
+                          ndata: int, nmodel: int = 1):
+    """Data-sharded histogram medians over an ``ndata``-way mesh.
+
+    ``x`` (n, d) and ``labels`` (n,) may be host arrays (they are padded to
+    a shard multiple with the sentinel label and resharded by jit) or
+    already-sharded device arrays.  Returns ((k, d) medians, (d,) global
+    medians or zeros).
+    """
+    x = jnp.asarray(x)
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    rem = (-x.shape[0]) % ndata
+    if rem:
+        x = jnp.pad(x, ((0, rem), (0, 0)))
+        labels = jnp.pad(labels, (0, rem), constant_values=k)
+    fn = _build_hist_medians_sharded(int(k), int(bins), bool(with_global),
+                                     int(ndata), int(nmodel))
+    return fn(x, labels)
+
+
 @jax.jit
 def score_table_jax(
     cluster_medians: jnp.ndarray,   # (k, d)
@@ -188,6 +277,7 @@ def classify_jax(
     k: int,
     cfg: ScoringConfig | None = None,
     global_medians=None,
+    mesh_shape: dict[str, int] | None = None,
 ):
     """Full classification: medians -> scores -> categories.
 
@@ -197,20 +287,39 @@ def classify_jax(
     Median strategy follows ``cfg.median_method``: ``"sort"`` (exact),
     ``"hist"`` (fixed-bin histogram, O(n), for large n), or ``"auto"``
     (hist past HIST_MEDIAN_THRESHOLD rows).
+
+    ``mesh_shape={"data": N}`` runs the median stage under shard_map with X
+    and labels sharded over the data axis (per-shard (k, bins) histograms +
+    one psum per feature) — X never gathers to one device.  Sharded mode is
+    histogram-only: a distributed exact sort is the wrong shape for the
+    scales that need sharding (SURVEY.md §7.4), so ``median_method="sort"``
+    raises and ``"auto"`` always resolves to ``"hist"``.
     """
     cfg = cfg or ScoringConfig()
     x = jnp.asarray(X)
     labels = jnp.asarray(labels).astype(jnp.int32)
+    ndata = int((mesh_shape or {}).get("data", 1))
 
     method = getattr(cfg, "median_method", "auto")
-    if method == "auto":
+    if ndata > 1:
+        if method == "sort":
+            raise ValueError(
+                "median_method='sort' is single-device; sharded scoring "
+                "(mesh_shape data > 1) uses histogram medians — pass "
+                "median_method='hist' or 'auto'")
+        method = "hist"
+    elif method == "auto":
         method = "hist" if x.shape[0] > HIST_MEDIAN_THRESHOLD else "sort"
     if method not in ("sort", "hist"):
         raise ValueError(f"unknown median_method {method!r}")
     bins = int(getattr(cfg, "median_bins", 2048))
 
     want_global = global_medians is None and cfg.compute_global_medians_from_data
-    if method == "hist":
+    if ndata > 1:
+        medians, gmeds = _hist_medians_sharded(
+            x, labels, int(k), bins, want_global, ndata,
+            int((mesh_shape or {}).get("model", 1)))
+    elif method == "hist":
         # Global medians (when needed) fall out of the same histograms —
         # one data pass total.
         medians, gmeds = _hist_medians(x, labels, int(k), bins, want_global)

@@ -117,3 +117,87 @@ def test_all_zero_scores_tiebreak_archival():
     wj2, sj2, _ = classify_jax(X, labels, 2, cfg)
     assert cfg.categories[int(np.asarray(wj2)[1])] == "Archival"
     assert np.allclose(np.asarray(sj2)[1], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Sharded scoring (VERDICT r2 #5): data-sharded histogram medians
+# ---------------------------------------------------------------------------
+
+
+def _blob_workload(n, k, seed=11):
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(0.1, 0.9, size=(k, 5))
+    lab = rng.integers(0, k, size=n)
+    X = np.clip(centers[lab] + rng.normal(size=(n, 5)) * 0.05, 0, 1)
+    return X.astype(np.float64), lab.astype(np.int32)
+
+
+@pytest.mark.parametrize("mesh_shape", [
+    {"data": 8},
+    {"data": 4, "model": 2},   # 2D mesh: medians reduce over data only
+])
+def test_sharded_hist_medians_match_single_device(mesh_shape):
+    X, labels, k = *_blob_workload(4096, 6), 6
+    cfg = ScoringConfig(median_method="hist",
+                        compute_global_medians_from_data=True)
+    w1, s1, m1 = classify_jax(X, labels, k, cfg)
+    w8, s8, m8 = classify_jax(X, labels, k, cfg, mesh_shape=mesh_shape)
+    np.testing.assert_allclose(np.asarray(m8), np.asarray(m1),
+                               atol=1e-6, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s8), np.asarray(s1),
+                               atol=1e-6, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(w8), np.asarray(w1))
+
+
+def test_sharded_scoring_category_parity_vs_exact():
+    """Categories from the sharded hist path match the exact sort path."""
+    X, labels, k = *_blob_workload(8192, 5, seed=13), 5
+    cfg_exact = ScoringConfig(median_method="sort",
+                              compute_global_medians_from_data=True)
+    cfg_auto = ScoringConfig(compute_global_medians_from_data=True)
+    we, _, _ = classify_jax(X, labels, k, cfg_exact)
+    ws, _, _ = classify_jax(X, labels, k, cfg_auto, mesh_shape={"data": 8})
+    np.testing.assert_array_equal(np.asarray(ws), np.asarray(we))
+
+
+def test_sharded_scoring_pads_uneven_rows():
+    """n not divisible by the mesh: sentinel-padded rows change nothing."""
+    X, labels, k = *_blob_workload(1000, 3, seed=17), 3   # 1000 % 8 != 0
+    cfg = ScoringConfig(median_method="hist",
+                        compute_global_medians_from_data=True)
+    w1, _, m1 = classify_jax(X, labels, k, cfg)
+    w8, _, m8 = classify_jax(X, labels, k, cfg, mesh_shape={"data": 8})
+    np.testing.assert_allclose(np.asarray(m8), np.asarray(m1),
+                               atol=1e-6, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(w8), np.asarray(w1))
+
+
+def test_sharded_scoring_rejects_sort():
+    X, labels = _blob_workload(256, 2)
+    with pytest.raises(ValueError, match="single-device"):
+        classify_jax(X, labels, 2,
+                     ScoringConfig(median_method="sort"),
+                     mesh_shape={"data": 8})
+
+
+def test_model_score_honors_mesh_shape():
+    """ReplicationPolicyModel.score routes through the sharded median stage
+    and matches the unsharded model's categories (VERDICT r2 weak #5)."""
+    from cdrs_tpu.models.replication import ReplicationPolicyModel
+    from cdrs_tpu.config import KMeansConfig
+
+    X, _ = _blob_workload(2048, 4, seed=23)
+    kcfg = KMeansConfig(k=4, seed=3, max_iter=10)
+    scfg = ScoringConfig(median_method="hist",
+                         compute_global_medians_from_data=True)
+    m1 = ReplicationPolicyModel(kcfg, scfg, backend="jax")
+    m8 = ReplicationPolicyModel(kcfg, scfg, backend="jax",
+                                mesh_shape={"data": 8})
+    # Cluster once; the mesh under test is the SCORING stage (the sharded
+    # kmeans threads a different per-shard PRNG stream by design, so labels
+    # across meshes are not comparable).
+    d1 = m1.run(np.asarray(X, np.float32))
+    w8, s8, m8_med = m8.score(np.asarray(X, np.float32), d1.labels)
+    np.testing.assert_allclose(m8_med, d1.cluster_medians,
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_array_equal(w8, d1.category_idx)
