@@ -1,0 +1,109 @@
+"""Log-ingestion throughput: chunked native parser vs python csv.
+
+The 1B-event streaming config (BASELINE.md config 5) is gated on parse
+speed before the device fold ever runs (VERDICT r2 #4: the python csv row
+loop would spend hours there).  This microbench writes a synthetic
+access.log and measures rows/sec through both paths of
+``EventLog.read_csv_batches``:
+
+    python -m cdrs_tpu.benchmarks.ingest [--rows 2000000] [--files 100000]
+
+Prints one JSON line with rows/sec for both paths and the speedup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+__all__ = ["bench_ingest"]
+
+
+def _write_log(path: str, manifest, rows: int, seed: int = 0) -> None:
+    from ..config import SimulatorConfig
+    from ..io.events import EventLog
+    from ..sim.access import simulate_access
+
+    # Scale the simulated window until we have at least `rows` events, then
+    # truncate — rates are per-second, so duration ~ rows / (files * rate).
+    duration = max(60.0, rows / max(len(manifest), 1) * 6.0)
+    events = simulate_access(manifest, SimulatorConfig(
+        duration_seconds=duration, seed=seed,
+        clients=("client0", "client1", "client2")))
+    take = min(rows, len(events))
+    EventLog(ts=events.ts[:take], path_id=events.path_id[:take],
+             op=events.op[:take], client_id=events.client_id[:take],
+             clients=events.clients).write_csv(path, manifest)
+
+
+def bench_ingest(rows: int = 2_000_000, files: int = 100_000,
+                 batch_size: int = 1_000_000, seed: int = 0,
+                 py_rows_cap: int = 500_000) -> dict:
+    """Measure native vs python ingestion rows/sec on one synthetic log.
+
+    The python path is timed on at most ``py_rows_cap`` rows and scaled
+    (it is a per-row loop — linear in rows); the native path parses the
+    whole file.
+    """
+    from ..config import GeneratorConfig
+    from ..io.events import EventLog
+    from ..runtime.native import native_available
+    from ..sim.generator import generate_population
+
+    manifest = generate_population(GeneratorConfig(n_files=files, seed=seed))
+    with tempfile.TemporaryDirectory() as td:
+        log = os.path.join(td, "access.log")
+        _write_log(log, manifest, rows, seed)
+        n_rows = sum(1 for _ in open(log, "rb"))
+
+        native_rps = None
+        if native_available():
+            t0 = time.perf_counter()
+            total = sum(len(b) for b in EventLog.read_csv_batches(
+                log, manifest, batch_size=batch_size, native=True))
+            native_rps = total / (time.perf_counter() - t0)
+            assert total == n_rows
+
+        # python path on a capped prefix (linear per-row cost)
+        py_rows = 0
+        t0 = time.perf_counter()
+        for b in EventLog.read_csv_batches(log, manifest,
+                                           batch_size=batch_size,
+                                           native=False):
+            py_rows += len(b)
+            if py_rows >= py_rows_cap:
+                break
+        py_rps = py_rows / (time.perf_counter() - t0)
+
+    out = {
+        "metric": f"log_ingest_rows_per_sec_rows{n_rows}_files{files}",
+        "rows": n_rows,
+        "python_rows_per_sec": py_rps,
+        "native_rows_per_sec": native_rps,
+        "unit": "row/s",
+    }
+    if native_rps:
+        out["value"] = native_rps
+        out["vs_python"] = native_rps / py_rps
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=2_000_000)
+    p.add_argument("--files", type=int, default=100_000)
+    p.add_argument("--batch_size", type=int, default=1_000_000)
+    args = p.parse_args()
+    print(json.dumps(bench_ingest(args.rows, args.files, args.batch_size)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -21,7 +21,7 @@ import threading
 import numpy as np
 
 __all__ = ["load", "native_available", "simulate_events_native",
-           "parse_access_log_native"]
+           "parse_access_log_native", "parse_log_chunk_native", "InternMap"]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
@@ -83,6 +83,27 @@ def load():
             ctypes.c_char_p, _i64, _i64, _i64, _p_f64, _p_i8,
             _p_char, _p_i64, _p_char, _p_i64,
         ]
+        lib.log_fill_chunk.restype = _i64
+        lib.log_fill_chunk.argtypes = [
+            ctypes.c_char_p, _i64, _i64, _i64, _i64, _p_f64, _p_i8,
+            _p_char, _p_i64, _p_char, _p_i64, ctypes.POINTER(_i64),
+        ]
+        lib.intern_build.restype = ctypes.c_void_p
+        lib.intern_build.argtypes = [_p_char, _p_i64, _i64]
+        lib.intern_free.restype = None
+        lib.intern_free.argtypes = [ctypes.c_void_p]
+        lib.intern_size.restype = _i64
+        lib.intern_size.argtypes = [ctypes.c_void_p]
+        lib.intern_lookup.restype = None
+        lib.intern_lookup.argtypes = [
+            ctypes.c_void_p, _p_char, _p_i64, _i64, _p_i32]
+        lib.intern_insert_lookup.restype = _i64
+        lib.intern_insert_lookup.argtypes = [
+            ctypes.c_void_p, _p_char, _p_i64, _i64, _p_i32]
+        lib.intern_export_bytes.restype = _i64
+        lib.intern_export_bytes.argtypes = [ctypes.c_void_p, _i64]
+        lib.intern_export.restype = None
+        lib.intern_export.argtypes = [ctypes.c_void_p, _i64, _p_char, _p_i64]
         _lib = lib
         return _lib
 
@@ -128,6 +149,122 @@ def simulate_events_native(
                  float(sim_start), int(seed) & (2**64 - 1), int(n_threads),
                  ts, pid, op, client)
     return ts, pid, op, client
+
+
+def _strings_to_blob(strings):
+    """(uint8 blob, int64 offsets) encoding of a string list."""
+    encoded = [s.encode("utf-8") for s in strings]
+    off = np.zeros(len(encoded) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in encoded], out=off[1:])
+    blob = np.frombuffer(b"".join(encoded), dtype=np.uint8).copy() \
+        if encoded else np.zeros(0, dtype=np.uint8)
+    return np.ascontiguousarray(blob), off
+
+
+class InternMap:
+    """Native string->id map (path/client interning without a Python loop).
+
+    Ids are the positions of ``strings`` at construction.  ``lookup`` maps a
+    (blob, offsets) batch of byte strings to int32 ids (-1 = absent).
+    """
+
+    def __init__(self, strings):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable (no g++/make?)")
+        self._lib = lib
+        blob, off = _strings_to_blob(strings)
+        if len(blob) == 0:
+            blob = np.zeros(1, dtype=np.uint8)  # non-null pointer
+        self._handle = ctypes.c_void_p(
+            lib.intern_build(blob, off, len(strings)))
+
+    def lookup(self, blob: np.ndarray, off: np.ndarray) -> np.ndarray:
+        n = len(off) - 1
+        out = np.empty(n, dtype=np.int32)
+        if len(blob) == 0:
+            blob = np.zeros(1, dtype=np.uint8)
+        self._lib.intern_lookup(self._handle, np.ascontiguousarray(blob),
+                                np.ascontiguousarray(off), n, out)
+        return out
+
+    def insert_lookup(self, blob: np.ndarray, off: np.ndarray) -> np.ndarray:
+        """Lookup that ASSIGNS the next id to unseen strings (growing
+        vocabulary, insertion order) — new names are readable via
+        ``names_from``."""
+        n = len(off) - 1
+        out = np.empty(n, dtype=np.int32)
+        if len(blob) == 0:
+            blob = np.zeros(1, dtype=np.uint8)
+        self._lib.intern_insert_lookup(
+            self._handle, np.ascontiguousarray(blob),
+            np.ascontiguousarray(off), n, out)
+        return out
+
+    def __len__(self) -> int:
+        return int(self._lib.intern_size(self._handle))
+
+    def names_from(self, start: int) -> list[str]:
+        """Names with id >= start, in id order."""
+        count = len(self) - int(start)
+        if count <= 0:
+            return []
+        nbytes = int(self._lib.intern_export_bytes(self._handle, int(start)))
+        blob = np.empty(max(nbytes, 1), dtype=np.uint8)
+        off = np.empty(count + 1, dtype=np.int64)
+        self._lib.intern_export(self._handle, int(start), blob, off)
+        raw = blob.tobytes()
+        return [raw[off[i]:off[i + 1]].decode("utf-8", "replace")
+                for i in range(count)]
+
+    def __del__(self):  # pragma: no cover - interpreter-shutdown dependent
+        h, lib = getattr(self, "_handle", None), getattr(self, "_lib", None)
+        if h and lib is not None:
+            lib.intern_free(h)
+
+
+#: Blob bytes reserved per row in a chunk (paths and clients are far
+#: shorter in practice; a longer row just ends the chunk early).
+_CHUNK_BYTES_PER_ROW = 256
+
+
+def parse_log_chunk_native(path: str, offset: int, max_rows: int):
+    """Parse up to ``max_rows`` rows starting at byte ``offset``.
+
+    Returns ``(ts, op, path_blob, path_off, client_blob, client_off,
+    next_offset)`` — raw columnar output for InternMap lookups — or None
+    when the chunk needs the python csv parser (quoting / malformed row /
+    missing library), in which case the caller resumes from ``offset``.
+    An empty chunk at EOF returns arrays of length 0.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    cap = max_rows * _CHUNK_BYTES_PER_ROW
+    ts = np.empty(max_rows, dtype=np.float64)
+    op = np.empty(max_rows, dtype=np.int8)
+    path_blob = np.empty(cap, dtype=np.uint8)
+    client_blob = np.empty(cap, dtype=np.uint8)
+    path_off = np.empty(max_rows + 1, dtype=np.int64)
+    client_off = np.empty(max_rows + 1, dtype=np.int64)
+    nxt = _i64(0)
+    rows = int(lib.log_fill_chunk(
+        path.encode(), int(offset), int(max_rows), cap, cap,
+        ts, op, path_blob, path_off, client_blob, client_off,
+        ctypes.byref(nxt)))
+    if rows < 0:
+        return None  # quoting/malformed/IO: python fallback from `offset`
+    if rows == 0 and int(nxt.value) == int(offset):
+        sz = os.path.getsize(path)
+        if offset < sz:
+            # A single row larger than the whole chunk budget — pathological;
+            # let the python parser take it from here.
+            return None
+    if rows and np.isnan(ts[:rows]).any():
+        return None  # timestamp grammar the native parser rejects
+    return (ts[:rows], op[:rows], path_blob[:path_off[rows]], path_off[:rows + 1],
+            client_blob[:client_off[rows]], client_off[:rows + 1],
+            int(nxt.value))
 
 
 def parse_access_log_native(path: str):

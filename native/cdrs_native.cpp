@@ -22,7 +22,9 @@
 #include <cstdio>
 #include <cstring>
 #include <random>
+#include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 #if defined(_OPENMP)
@@ -320,6 +322,185 @@ int64_t log_fill(const char* path, int64_t max_rows, int64_t path_cap,
   std::fclose(f);
   if (overflow) return -1;
   return row;
+}
+
+// ---------------------------------------------------------------------------
+// Chunked log ingestion (streaming; the 1B-event feed must never be resident)
+// ---------------------------------------------------------------------------
+
+// Single-pass chunk parser: parse up to max_rows complete rows starting at
+// byte `offset`, stopping early when the path/client blob capacities would
+// overflow (the unread row starts at *next_offset — the caller simply issues
+// the next chunk from there).  Returns rows parsed; -1 on IO error; -2 when
+// a row uses CSV quoting; -3 when a non-empty row has fewer than 4 fields
+// (for -2/-3, *next_offset is the offending row's start so the caller can
+// resume with the python csv parser from that exact byte).
+int64_t log_fill_chunk(const char* path, int64_t offset, int64_t max_rows,
+                       int64_t path_cap, int64_t client_cap,
+                       double* ts_out, int8_t* op_out,
+                       char* path_blob, int64_t* path_off,
+                       char* client_blob, int64_t* client_off,
+                       int64_t* next_offset) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  if (offset > 0 && std::fseek(f, (long)offset, SEEK_SET) != 0) {
+    std::fclose(f);
+    return -1;
+  }
+  int64_t row = 0, ppos = 0, cpos = 0;
+  int64_t line_start = offset;   // absolute byte offset of the current line
+  int64_t consumed = offset;     // absolute offset just past the last row taken
+  int err = 0;                   // 0 ok, -2 quoted, -3 malformed
+  bool full = false;             // max_rows or caps reached
+  std::vector<char> buf(1 << 20);
+  std::string carry;
+  size_t got;
+
+  // Returns false when the chunk must stop (full or error).
+  auto handle = [&](const char* s, size_t len, int64_t abs_end) -> bool {
+    if (len == 0) { consumed = abs_end; return true; }
+    if (row >= max_rows) { full = true; return false; }
+    if (memchr(s, '"', len)) { err = -2; return false; }
+    const char* c1 = (const char*)memchr(s, ',', len);
+    const char* c2 = c1 ? (const char*)memchr(c1 + 1, ',', len - (c1 + 1 - s)) : nullptr;
+    const char* c3 = c2 ? (const char*)memchr(c2 + 1, ',', len - (c2 + 1 - s)) : nullptr;
+    if (!c3) { err = -3; return false; }
+    const char* c4 = (const char*)memchr(c3 + 1, ',', len - (c3 + 1 - s));
+    const char* end4 = c4 ? c4 : s + len;
+    int64_t plen = (int64_t)(c2 - c1 - 1);
+    int64_t clen = (int64_t)(end4 - c3 - 1);
+    if (ppos + plen > path_cap || cpos + clen > client_cap) {
+      full = true;   // next chunk starts at this row
+      return false;
+    }
+    ts_out[row] = parse_iso(s, c1 - s);
+    std::memcpy(path_blob + ppos, c1 + 1, (size_t)plen);
+    ppos += plen;
+    op_out[row] = (c3 - c2 - 1 == 5 && std::memcmp(c2 + 1, "WRITE", 5) == 0)
+                      ? 1 : 0;
+    std::memcpy(client_blob + cpos, c3 + 1, (size_t)clen);
+    cpos += clen;
+    ++row;
+    path_off[row] = ppos;
+    client_off[row] = cpos;
+    consumed = abs_end;
+    return true;
+  };
+
+  path_off[0] = 0;
+  client_off[0] = 0;
+  int64_t file_pos = offset;
+  bool stop = false;
+  while (!stop && (got = std::fread(buf.data(), 1, buf.size(), f)) > 0) {
+    size_t start = 0;
+    for (size_t i = 0; i < got; ++i) {
+      if (buf[i] != '\n') continue;
+      int64_t abs_end = file_pos + (int64_t)i + 1;
+      bool ok;
+      if (!carry.empty()) {
+        carry.append(buf.data() + start, i - start);
+        ok = handle(carry.data(), carry.size(), abs_end);
+        carry.clear();
+      } else {
+        ok = handle(buf.data() + start, i - start, abs_end);
+      }
+      if (!ok) { stop = true; break; }
+      start = i + 1;
+      line_start = abs_end;
+    }
+    if (!stop) carry.append(buf.data() + start, got - start);
+    file_pos += (int64_t)got;
+  }
+  if (!stop && !carry.empty()) {
+    // Final line without a trailing newline.
+    handle(carry.data(), carry.size(), file_pos);
+  }
+  std::fclose(f);
+  if (err) { *next_offset = line_start; return err; }
+  *next_offset = consumed;
+  return row;
+}
+
+// ---------------------------------------------------------------------------
+// Native string interning — path -> id lookups without a Python row loop
+// ---------------------------------------------------------------------------
+
+struct InternMap {
+  std::unordered_map<std::string, int32_t> map;
+  std::vector<std::string> names;  // id -> string (insertion order)
+};
+
+// Build an intern map from a byte blob + (n+1) offsets.  Ids are positions.
+void* intern_build(const char* blob, const int64_t* off, int64_t n) {
+  auto* h = new InternMap();
+  h->map.reserve((size_t)n * 2);
+  h->names.reserve((size_t)n);
+  for (int64_t i = 0; i < n; ++i) {
+    std::string key(blob + off[i], (size_t)(off[i + 1] - off[i]));
+    h->map.emplace(key, (int32_t)i);
+    h->names.push_back(std::move(key));
+  }
+  return h;
+}
+
+void intern_free(void* handle) { delete (InternMap*)handle; }
+
+int64_t intern_size(void* handle) {
+  return (int64_t)((InternMap*)handle)->names.size();
+}
+
+// out[i] = id of blob[off[i]:off[i+1]] in the map, or -1 when absent.
+void intern_lookup(void* handle, const char* blob, const int64_t* off,
+                   int64_t n, int32_t* out) {
+  auto& m = ((InternMap*)handle)->map;
+  std::string key;
+  for (int64_t i = 0; i < n; ++i) {
+    key.assign(blob + off[i], (size_t)(off[i + 1] - off[i]));
+    auto it = m.find(key);
+    out[i] = it == m.end() ? -1 : it->second;
+  }
+}
+
+// Like intern_lookup, but unseen keys are INSERTED with the next id (growing
+// vocabulary — the client-node interning path).  Returns the map size after.
+int64_t intern_insert_lookup(void* handle, const char* blob,
+                             const int64_t* off, int64_t n, int32_t* out) {
+  auto* h = (InternMap*)handle;
+  std::string key;
+  for (int64_t i = 0; i < n; ++i) {
+    key.assign(blob + off[i], (size_t)(off[i + 1] - off[i]));
+    auto it = h->map.find(key);
+    if (it == h->map.end()) {
+      int32_t id = (int32_t)h->names.size();
+      h->map.emplace(key, id);
+      h->names.push_back(key);
+      out[i] = id;
+    } else {
+      out[i] = it->second;
+    }
+  }
+  return (int64_t)h->names.size();
+}
+
+// Total bytes of names[start:] — sizes the export blob.
+int64_t intern_export_bytes(void* handle, int64_t start) {
+  auto& names = ((InternMap*)handle)->names;
+  int64_t total = 0;
+  for (size_t i = (size_t)start; i < names.size(); ++i)
+    total += (int64_t)names[i].size();
+  return total;
+}
+
+// Export names[start:] as a blob + (count+1) offsets (insertion order).
+void intern_export(void* handle, int64_t start, char* blob, int64_t* off) {
+  auto& names = ((InternMap*)handle)->names;
+  int64_t pos = 0, j = 0;
+  off[0] = 0;
+  for (size_t i = (size_t)start; i < names.size(); ++i) {
+    std::memcpy(blob + pos, names[i].data(), names[i].size());
+    pos += (int64_t)names[i].size();
+    off[++j] = pos;
+  }
 }
 
 }  // extern "C"

@@ -136,3 +136,107 @@ def test_malformed_rows_fall_back(tmp_path):
     p = tmp_path / "bad.log"
     p.write_text("2026-01-01T00:00:00.000Z,/f,READ\n")  # only 3 fields
     assert parse_access_log_native(str(p)) is None
+
+
+# ---------------------------------------------------------------------------
+# Chunked ingestion + native interning (VERDICT r2 #4)
+# ---------------------------------------------------------------------------
+
+
+def _make_workload(tmp_path, n_files=40, duration=120.0, seed=5):
+    from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
+    from cdrs_tpu.sim.access import simulate_access
+    from cdrs_tpu.sim.generator import generate_population
+
+    manifest = generate_population(GeneratorConfig(n_files=n_files, seed=seed))
+    events = simulate_access(manifest, SimulatorConfig(
+        duration_seconds=duration, seed=seed + 1))
+    log = tmp_path / "access.log"
+    events.write_csv(str(log), manifest)
+    return manifest, str(log)
+
+
+def _assert_logs_equal(a, b):
+    np.testing.assert_allclose(a.ts, b.ts, atol=1e-6)
+    np.testing.assert_array_equal(a.path_id, b.path_id)
+    np.testing.assert_array_equal(a.op, b.op)
+    np.testing.assert_array_equal(a.client_id, b.client_id)
+    assert a.clients == b.clients
+
+
+@pytest.mark.parametrize("batch_size", [None, 97, 1000])
+def test_chunked_native_batches_match_python(tmp_path, batch_size):
+    """Native chunked ingestion is byte-exact with the python csv path,
+    including client-vocabulary growth order, at any batch size."""
+    from cdrs_tpu.io.events import EventLog
+
+    manifest, log = _make_workload(tmp_path)
+    nat = list(EventLog.read_csv_batches(log, manifest, batch_size=batch_size,
+                                         native=True))
+    py = list(EventLog.read_csv_batches(log, manifest, batch_size=batch_size,
+                                        native=False))
+    assert sum(len(b) for b in nat) == sum(len(b) for b in py) > 0
+    # Concatenated streams are identical (native chunking may split
+    # batch_size=None into internal chunks).
+    def cat(batches):
+        return (np.concatenate([b.ts for b in batches]),
+                np.concatenate([b.path_id for b in batches]),
+                np.concatenate([b.op for b in batches]),
+                np.concatenate([b.client_id for b in batches]),
+                batches[-1].clients)
+    for x, y in zip(cat(nat), cat(py)):
+        if isinstance(x, list):
+            assert x == y
+        else:
+            np.testing.assert_array_equal(np.asarray(x, np.float64),
+                                          np.asarray(y, np.float64))
+
+
+def test_chunked_read_csv_equals_python(tmp_path):
+    from cdrs_tpu.io.events import EventLog
+
+    manifest, log = _make_workload(tmp_path, n_files=17, duration=60.0)
+    _assert_logs_equal(EventLog.read_csv(log, manifest, native=True),
+                       EventLog.read_csv(log, manifest, native=False))
+
+
+def test_chunked_falls_back_mid_stream_on_quoting(tmp_path):
+    """A quoted row mid-file hands over to the python parser at that byte —
+    nothing is lost or duplicated."""
+    from cdrs_tpu.io.events import EventLog
+
+    manifest, log = _make_workload(tmp_path, n_files=8, duration=30.0)
+    with open(log) as f:
+        lines = f.read().splitlines()
+    assert len(lines) > 10
+    # Quote a client field halfway through the file.
+    mid = len(lines) // 2
+    parts = lines[mid].split(",")
+    parts[3] = f'"{parts[3]}"'
+    lines[mid] = ",".join(parts)
+    with open(log, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+    nat = EventLog.read_csv(log, manifest, native=True)
+    py = EventLog.read_csv(log, manifest, native=False)
+    _assert_logs_equal(nat, py)
+
+
+def test_intern_map_lookup(tmp_path):
+    from cdrs_tpu.runtime.native import InternMap, _strings_to_blob
+
+    m = InternMap(["/a", "/bb", "/ccc"])
+    blob, off = _strings_to_blob(["/bb", "/zz", "/a", "/ccc", "/a"])
+    np.testing.assert_array_equal(m.lookup(blob, off), [1, -1, 0, 2, 0])
+
+
+def test_unknown_paths_get_minus_one(tmp_path):
+    from cdrs_tpu.io.events import EventLog
+
+    manifest, log = _make_workload(tmp_path, n_files=6, duration=30.0)
+    with open(log, "a") as f:
+        f.write("2026-01-01T00:00:00.000Z,/not/in/manifest,READ,dn1,77\n")
+    nat = EventLog.read_csv(log, manifest, native=True)
+    py = EventLog.read_csv(log, manifest, native=False)
+    _assert_logs_equal(nat, py)
+    assert (nat.path_id == -1).sum() == 1
