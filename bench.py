@@ -24,6 +24,8 @@ def main() -> int:
                    choices=["auto", "matmul", "scatter", "pallas"],
                    help="Lloyd assign+reduce strategy (default: the config's; "
                         "auto = pallas on TPU where it fits, matmul else)")
+    p.add_argument("--e2e", action="store_true",
+                   help="wall-clock time-to-categories instead of iter/s")
     args = p.parse_args()
 
     import os
@@ -32,7 +34,7 @@ def main() -> int:
     from cdrs_tpu.benchmarks.harness import run_bench
 
     out = run_bench(config=args.config, backend=args.backend,
-                    update=args.update)
+                    update=args.update, e2e=args.e2e)
     line = {
         "metric": out["metric"],
         "value": out["value"],

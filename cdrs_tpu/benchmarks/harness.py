@@ -158,6 +158,106 @@ def _bench_streaming(cfg: BenchConfig, seed: int,
     return out
 
 
+def _bench_e2e(cfg: BenchConfig, config_num: int, seed: int,
+               mesh_shape: dict[str, int] | None, update: str) -> dict:
+    """Wall-clock time-to-categories: device-resident features -> sharded
+    KMeans -> sharded scoring -> host category table (VERDICT r2 #6 — the
+    measurable stand-in for BASELINE config 4's "<60 s end-to-end").
+
+    The feature matrix is synthesized on device (sharded over the mesh),
+    clustered for exactly ``cfg.iters`` Lloyd iterations from a D² init, and
+    classified with data-sharded histogram medians; the clock stops when the
+    per-cluster categories land on host.  The numpy baseline runs the same
+    pipeline (same iteration budget, exact medians) on a row subsample and
+    scales linearly.
+    """
+    import jax
+
+    from ..config import ScoringConfig
+    from ..ops.kmeans_jax import kmeans_jax_full
+    from ..ops.scoring_jax import classify_jax
+
+    n, d, k = cfg.n, cfg.d, cfg.k
+    X = _synth_blobs_device(n, d, min(k, 64), seed, cfg.dtype, mesh_shape)
+    X = jax.block_until_ready(X)
+    # Scoring tables spanning the synthetic d features (the pipeline's real
+    # tables cover its 5 features; the benchmark scores all d columns so the
+    # median/score kernels carry the full width).
+    feats = tuple(f"f{i}" for i in range(d))
+    dirs = {"Hot": 1, "Shared": 1, "Moderate": 0, "Archival": -1}
+    scoring = ScoringConfig(
+        features=feats,
+        global_medians={f: 0.5 for f in feats},
+        weights={c: {f: 1.0 for f in feats} for c in dirs},
+        directions={c: {f: v for f in feats} for c, v in dirs.items()},
+        median_method="hist",
+        compute_global_medians_from_data=True)
+
+    def run_once(init_method):
+        t0 = time.perf_counter()
+        centroids, labels, it, _ = kmeans_jax_full(
+            X, k, tol=0.0, seed=seed, max_iter=cfg.iters,
+            mesh_shape=mesh_shape, dtype=np.dtype(cfg.dtype),
+            chunk_rows=cfg.chunk_rows, update=update,
+            init_method=init_method)
+        winner, _, _ = classify_jax(X, labels, k, scoring,
+                                    mesh_shape=mesh_shape)
+        cats = np.asarray(winner)   # clock stops when categories hit host
+        return time.perf_counter() - t0, int(it), cats
+
+    # kmeans|| init: its cost does not grow with k (D² is k sequential
+    # rounds — 7.7 s alone at k=1024 on v5e); fall back where its per-round
+    # sample cannot fit the shard.
+    try:
+        run_once("kmeans||")        # compile pass
+        init_method = "kmeans||"
+    except ValueError:
+        run_once("d2")
+        init_method = "d2"
+    secs, it, cats = run_once(init_method)
+
+    # numpy baseline: same pipeline shape on a subsample, scaled in n.
+    n_sub = min(n, 200_000)
+    Xs = synth_blobs_np(n_sub, d, min(k, 64), seed)
+    from ..ops.kmeans_np import lloyd_step
+    from ..ops.scoring_np import classify as classify_np
+
+    rng = np.random.default_rng(seed)
+    c = _init_from_rows(Xs, k, seed)
+    t0 = time.perf_counter()
+    labels_np = None
+    for _ in range(max(1, min(2, cfg.iters))):
+        c, labels_np, _ = lloyd_step(Xs, c, rng)
+    per_iter = (time.perf_counter() - t0) / max(1, min(2, cfg.iters))
+    import dataclasses
+
+    t0 = time.perf_counter()
+    classify_np(Xs, labels_np, k,
+                dataclasses.replace(scoring, median_method="sort"))
+    np_score = time.perf_counter() - t0
+    np_secs = (per_iter * cfg.iters + np_score) * (n / n_sub)
+
+    return {
+        "config": int(config_num),
+        "e2e": True,
+        "n": n, "d": d, "k": k,
+        "metric": f"e2e_seconds_to_categories_n{n}_d{d}_k{k}",
+        "value": secs,
+        "unit": "s",
+        "vs_baseline": np_secs / secs,   # >1 = faster than the numpy pipeline
+        "lloyd_iters": it,
+        "init_method": init_method,
+        "files_per_sec": n / secs,
+        "categories_found": sorted(set(int(x) for x in cats)),
+        "numpy_seconds_estimated": np_secs,
+        "backend": "jax",
+        "update": update,
+        "mesh": dict(mesh_shape or {}),
+        "jax_devices": len(jax.devices()),
+        "jax_platform": jax.devices()[0].platform,
+    }
+
+
 def synth_blobs_np(n: int, d: int, k_true: int, seed: int = 0) -> np.ndarray:
     """Host-side Gaussian blob mixture (small configs)."""
     rng = np.random.default_rng(seed)
@@ -326,7 +426,8 @@ def decision_quality_metrics(seed: int = 21) -> dict:
 
 def run_bench(config: int = 2, backend: str | None = None,
               seed: int = 0, mesh_shape: dict[str, int] | None = None,
-              update: str | None = None, quality: bool = True) -> dict:
+              update: str | None = None, quality: bool = True,
+              e2e: bool = False) -> dict:
     """Run one BASELINE config; returns the bench JSON dict.
 
     ``vs_baseline`` is jax-iterations/sec over numpy-iterations/sec on the
@@ -338,6 +439,9 @@ def run_bench(config: int = 2, backend: str | None = None,
     ("auto" | "matmul" | "scatter" | "pallas"; "auto" resolves to the fused
     pallas kernel on TPU when its VMEM blocks fit, else matmul — the
     recorded ``update`` field is the resolved strategy).
+    ``e2e`` switches the metric from Lloyd iterations/sec to wall-clock
+    time-to-categories: sharded features -> kmeans -> sharded scoring ->
+    host categories (the BASELINE config-4 "<60 s end-to-end" stand-in).
     """
     cfg = CONFIGS[int(config)]
     backend = backend or cfg.backend
@@ -357,36 +461,42 @@ def run_bench(config: int = 2, backend: str | None = None,
     if backend == "numpy" and update_requested:
         raise ValueError("--update selects a jax assign+reduce strategy; "
                          "not applicable to --backend numpy")
+    if e2e and backend != "jax":
+        raise ValueError("--e2e measures the jax pipeline; "
+                         "--backend numpy is not supported")
     quality_block = decision_quality_metrics() if quality else None
-    np_iters = max(2, min(3, cfg.iters))
 
-    # The subsample guard applies regardless of backend — a direct numpy
-    # measurement at 100M x 128 float64 would need ~107 GB of host RAM.
-    if cfg.n <= cfg.direct_np_limit:
-        X_np = synth_blobs_np(cfg.n, cfg.d, min(cfg.k, 64), seed)
-        np_sub = X_np
-        np_scale = 1.0
-        numpy_estimated = False
-    else:
-        n_sub = cfg.direct_np_limit // 4
-        X_np = None
-        np_sub = synth_blobs_np(n_sub, cfg.d, min(cfg.k, 64), seed)
-        np_scale = cfg.n / n_sub
-        numpy_estimated = True
+    result: dict = {}
+    if not e2e:
+        np_iters = max(2, min(3, cfg.iters))
 
-    init_np = _init_from_rows(np_sub, cfg.k, seed)
-    np_sec = _time_numpy_lloyd(np_sub, cfg.k, init_np, np_iters) * np_scale
-    np_ips = 1.0 / np_sec
+        # The subsample guard applies regardless of backend — a direct numpy
+        # measurement at 100M x 128 float64 would need ~107 GB of host RAM.
+        if cfg.n <= cfg.direct_np_limit:
+            X_np = synth_blobs_np(cfg.n, cfg.d, min(cfg.k, 64), seed)
+            np_sub = X_np
+            np_scale = 1.0
+            numpy_estimated = False
+        else:
+            n_sub = cfg.direct_np_limit // 4
+            X_np = None
+            np_sub = synth_blobs_np(n_sub, cfg.d, min(cfg.k, 64), seed)
+            np_scale = cfg.n / n_sub
+            numpy_estimated = True
 
-    result = {
-        "config": int(config),
-        "n": cfg.n, "d": cfg.d, "k": cfg.k,
-        "numpy_iters_per_sec": np_ips,
-        "numpy_estimated": numpy_estimated,
-    }
+        init_np = _init_from_rows(np_sub, cfg.k, seed)
+        np_sec = _time_numpy_lloyd(np_sub, cfg.k, init_np, np_iters) * np_scale
+        np_ips = 1.0 / np_sec
 
-    if quality_block is not None:
-        result["decision_quality"] = quality_block
+        result = {
+            "config": int(config),
+            "n": cfg.n, "d": cfg.d, "k": cfg.k,
+            "numpy_iters_per_sec": np_ips,
+            "numpy_estimated": numpy_estimated,
+        }
+
+        if quality_block is not None:
+            result["decision_quality"] = quality_block
 
     if backend == "numpy":
         result.update({
@@ -424,6 +534,14 @@ def run_bench(config: int = 2, backend: str | None = None,
                             nmodel=int((mesh_shape or {}).get("model", 1)),
                             dtype=cfg.dtype, k=cfg.k,
                             chunk_rows=cfg.chunk_rows)
+
+    if e2e:
+        out = _bench_e2e(cfg, int(config), seed, mesh_shape, update)
+        if "mesh_downscaled_to" in result:
+            out["mesh_downscaled_to"] = result["mesh_downscaled_to"]
+        if quality_block is not None:
+            out["decision_quality"] = quality_block
+        return out
 
     dtype = np.dtype(cfg.dtype)
     if X_np is not None:

@@ -336,7 +336,8 @@ def _cmd_bench(args) -> int:
         return 1
     out = run_bench(config=args.config, backend=args.backend,
                     mesh_shape=_parse_mesh(args.mesh),
-                    update=getattr(args, "update", None))
+                    update=getattr(args, "update", None),
+                    e2e=getattr(args, "e2e", False))
     print(json.dumps(out))
     return 0
 
@@ -445,6 +446,10 @@ def main(argv: list[str] | None = None) -> int:
                    default=None,
                    help="Lloyd assign+reduce strategy (default: the config's; "
                         "auto = pallas on TPU, matmul elsewhere)")
+    p.add_argument("--e2e", action="store_true",
+                   help="measure wall-clock time-to-categories (sharded "
+                        "features -> kmeans -> scoring -> host) instead of "
+                        "Lloyd iterations/sec")
     _add_backend_arg(p, default=None)  # None = the config's own backend
     p.set_defaults(fn=_cmd_bench)
 

@@ -310,6 +310,14 @@ def scoring_config_from_dict(d: Mapping) -> ScoringConfig:
         if key in kwargs:
             kwargs[key] = tuple(kwargs[key])
     cfg = ScoringConfig(**kwargs)
+    # Validate enum-ish fields here rather than deep inside a backend kernel
+    # (an invalid value like "histo" would otherwise only surface mid-run).
+    if cfg.median_method not in ("auto", "sort", "hist"):
+        raise ValueError(
+            f"median_method must be 'auto', 'sort', or 'hist'; "
+            f"got {cfg.median_method!r}")
+    if int(cfg.median_bins) < 2:
+        raise ValueError(f"median_bins must be >= 2, got {cfg.median_bins}")
     # Validate cross-references early (a missing weight/direction entry would
     # otherwise surface as a KeyError deep inside the score kernel).
     for c in cfg.categories:

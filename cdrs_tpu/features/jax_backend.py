@@ -61,11 +61,16 @@ from .numpy_backend import FeatureTable
 __all__ = ["compute_features_jax", "features_kernel"]
 
 
-def _pad_events(pid, sec, op, client, multiple):
+def _pad_events(pid, sec, op, client, multiple, target: int | None = None):
     """Pad event columns to an even shard split.  Padded rows are pid=-1
     (masked in-kernel) with the last real second so they never widen the
-    boundary-second set; mesh.pad_rows would zero-pad, aliasing pid 0."""
-    pad = (-len(pid)) % multiple
+    boundary-second set; mesh.pad_rows would zero-pad, aliasing pid 0.
+    ``target`` additionally pads up to a fixed row count (bucketing — a
+    variable-length tail batch then hits the SAME compiled program as the
+    full batches instead of triggering a fresh XLA compile)."""
+    want = max(len(pid), int(target or 0))
+    want += (-want) % multiple
+    pad = want - len(pid)
     if pad:
         pid = np.concatenate([pid, np.full(pad, -1, np.int32)])
         sec = np.concatenate([sec, np.full(pad, sec[-1], np.int32)])

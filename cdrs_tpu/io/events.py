@@ -145,13 +145,6 @@ class EventLog:
         large logs; ``native=False`` forces pure Python, ``None``
         auto-detects.  Quoted CSVs fall back automatically.
         """
-        if native is True:
-            from ..runtime.native import native_available
-
-            if not native_available():
-                raise RuntimeError(
-                    "native log parser unavailable (library not built; "
-                    "needs g++/make)")
         batches = list(cls.read_csv_batches(path, manifest, batch_size=None,
                                             native=native))
         if not batches:
@@ -161,15 +154,7 @@ class EventLog:
                 client_id=np.zeros(0, dtype=np.int32),
                 clients=list(manifest.nodes),
             )
-        if len(batches) == 1:
-            return batches[0]
-        return cls(
-            ts=np.concatenate([b.ts for b in batches]),
-            path_id=np.concatenate([b.path_id for b in batches]),
-            op=np.concatenate([b.op for b in batches]),
-            client_id=np.concatenate([b.client_id for b in batches]),
-            clients=batches[-1].clients,  # vocab grows monotonically
-        )
+        return batches[0]  # batch_size=None yields exactly one batch
 
     #: Rows per internal native chunk when reading "the whole file at once"
     #: (keeps the parse blobs bounded; output batches are concatenated).
@@ -191,7 +176,42 @@ class EventLog:
         hash-map interning, no Python row loop); rows the native grammar
         cannot take (CSV quoting, malformed rows, exotic timestamps) hand
         over to the python csv parser from the exact byte offset reached.
+        ``native=True`` raises when the library cannot be built (mirroring
+        ``read_csv`` — a silent python fallback would run the 1B-event
+        stream through a per-row loop).
         """
+        if native is True:
+            from ..runtime.native import native_available
+
+            if not native_available():
+                raise RuntimeError(
+                    "native log parser unavailable (library not built; "
+                    "needs g++/make)")
+        gen = cls._read_batches_impl(path, manifest, batch_size, native)
+        if batch_size is not None:
+            yield from gen
+            return
+        # batch_size=None contract: everything in ONE batch (the impl may
+        # still chunk internally to bound the native parse blobs).
+        batches = list(gen)
+        if not batches:
+            return
+        if len(batches) == 1:
+            yield batches[0]
+            return
+        yield cls(
+            ts=np.concatenate([b.ts for b in batches]),
+            path_id=np.concatenate([b.path_id for b in batches]),
+            op=np.concatenate([b.op for b in batches]),
+            client_id=np.concatenate([b.client_id for b in batches]),
+            clients=batches[-1].clients,  # vocab grows monotonically
+        )
+
+    @classmethod
+    def _read_batches_impl(cls, path: str, manifest: Manifest,
+                           batch_size: int | None, native: bool | None):
+        """Raw batch stream: native chunks, then python csv from the byte
+        offset where (if anywhere) the native grammar gave up."""
         client_vocab: dict[str, int] = {nm: i for i, nm in enumerate(manifest.nodes)}
         clients = list(manifest.nodes)
         rows_per_chunk = batch_size or cls._NATIVE_CHUNK_ROWS

@@ -139,11 +139,21 @@ class ReplicationPolicyModel:
         bs = int(cfg.batch_size)
         if bs < 1:
             raise ValueError(f"batch_size must be >= 1, got {bs}")
+        if bs < cfg.k and init_centroids is None:
+            # The first batch seeds the D2 init; fewer valid rows than
+            # centroids would silently produce duplicate centroids (the
+            # full-batch path raises the same class of error) — ADVICE r2.
+            # Warm starts (init_centroids given) never run the init, and
+            # small batches are valid updates there.
+            raise ValueError(
+                f"batch_size={bs} must be >= k={cfg.k} (the first mini-batch "
+                f"seeds the centroid init; pass init_centroids to warm-start "
+                f"with smaller batches)")
         mb = MiniBatchKMeans(k=cfg.k, seed=cfg.seed, mesh_shape=self.mesh_shape)
         if init_centroids is not None:
             mb.state = MiniBatchState(
                 centroids=jnp.asarray(np.asarray(init_centroids, np.float32)),
-                counts=jnp.zeros((cfg.k,), np.float32),
+                counts=jnp.zeros((cfg.k,), np.int32),
             )
         import jax
 

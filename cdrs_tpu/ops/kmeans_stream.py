@@ -38,7 +38,11 @@ __all__ = ["MiniBatchState", "minibatch_init", "minibatch_update", "MiniBatchKMe
 @dataclass
 class MiniBatchState:
     centroids: jax.Array   # (k, d)
-    counts: jax.Array      # (k,) float — total points ever assigned per center
+    #: (k,) int32 — total points ever assigned per center.  Integer on
+    #: purpose (ADVICE r2): float32 totals distort the eta = bcount/total
+    #: learning-rate decay past 2**24 points per center, well within the
+    #: 1B-row streaming target.  int32 is exact to 2.1e9 per center.
+    counts: jax.Array
     n_batches: int = 0
 
 
@@ -68,8 +72,11 @@ def _build_update(n_rows, n_valid, d, k, ndata, dtype_name, update):
         sums = lax.psum(sums, DATA_AXIS)
         bcounts = lax.psum(bcounts, DATA_AXIS)
 
-        new_counts = counts + bcounts
-        eta = jnp.where(bcounts > 0, bcounts / jnp.maximum(new_counts, 1.0), 0.0)
+        # Integer running totals (exact); the f32 per-batch counts are exact
+        # too (one-hot sums, batch <= 2**24 rows/center).
+        new_counts = counts + bcounts.astype(counts.dtype)
+        total_f = jnp.maximum(new_counts, 1).astype(x.dtype)
+        eta = jnp.where(bcounts > 0, bcounts / total_f, 0.0)
         bmean = sums / jnp.maximum(bcounts, 1.0)[:, None]
         new_c = centroids + eta[:, None] * (bmean - centroids)
         return new_c, new_counts, labels
@@ -108,13 +115,17 @@ def minibatch_init(
     """Seed centroids via the on-device D² init over the first batch."""
     ndata = int((mesh_shape or {}).get(DATA_AXIS, 1))
     xp, n_valid = _prep_batch(first_batch, ndata, np.dtype(dtype))
+    if n_valid < k:
+        raise ValueError(
+            f"first mini-batch has {n_valid} rows < k={k}; the D2 init "
+            f"would draw duplicate centroids")
     fn = _build_init(xp.shape[0], n_valid, xp.shape[1], int(k), ndata,
                      np.dtype(dtype).name)
     key = jax.random.PRNGKey(0 if seed is None else int(seed))
     centroids = fn(xp, key)
     return MiniBatchState(
         centroids=centroids,
-        counts=jnp.zeros((k,), np.dtype(dtype)),
+        counts=jnp.zeros((k,), jnp.int32),
         n_batches=0,
     )
 

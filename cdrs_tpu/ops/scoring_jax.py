@@ -47,9 +47,10 @@ __all__ = [
     "HIST_MEDIAN_THRESHOLD",
 ]
 
-#: Row count past which classify_jax's "auto" mode switches from exact
-#: sort-based medians to histogram medians.
-HIST_MEDIAN_THRESHOLD = 2_000_000
+#: Row count past which "auto" switches from exact sort-based medians to
+#: histogram medians — single-sourced in the numpy backend so both backends
+#: route identically on the same data (ADVICE r2).
+from .scoring_np import HIST_MEDIAN_THRESHOLD  # noqa: E402  (re-export)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))

@@ -31,10 +31,17 @@ from ..config import ScoringConfig
 
 __all__ = [
     "compute_cluster_medians",
+    "compute_cluster_medians_hist",
     "score_table",
     "classify_medians",
     "classify",
+    "HIST_MEDIAN_THRESHOLD",
 ]
+
+#: Row count past which "auto" median selection switches from exact sorting
+#: to fixed-bin histograms — shared by both backends (ops/scoring_jax
+#: re-exports it) so they take the same route on the same data.
+HIST_MEDIAN_THRESHOLD = 2_000_000
 
 
 def compute_cluster_medians(
@@ -58,6 +65,64 @@ def compute_cluster_medians(
         lo, hi = boundaries[j], boundaries[j + 1]
         if hi > lo:
             out[j] = np.median(X[order[lo:hi]], axis=0)
+    return out
+
+
+def _medians_from_hist_np(H, counts, lo_f, w_f, bins):
+    """(k,) medians off a (k, bins) histogram — numpy mirror of
+    ops/scoring_jax._medians_from_hist (same middle-rank + intra-bin linear
+    interpolation, so both backends agree bin-for-bin)."""
+    cum = np.cumsum(H, axis=1)
+    r0 = (counts - 1) // 2
+    r1 = counts // 2
+
+    def value_at(r):
+        j = np.argmax(cum > r[:, None], axis=1)
+        cum_before = np.where(
+            j > 0,
+            np.take_along_axis(cum, np.maximum(j - 1, 0)[:, None], 1)[:, 0],
+            0,
+        )
+        h = np.take_along_axis(H, j[:, None], 1)[:, 0]
+        frac = (r - cum_before + 0.5) / np.maximum(h, 1)
+        return (j.astype(np.float64) + frac) * (w_f / bins)
+
+    med = lo_f + 0.5 * (value_at(r0) + value_at(r1))
+    return np.where(counts > 0, med, np.nan)
+
+
+def compute_cluster_medians_hist(
+    X: np.ndarray, labels: np.ndarray, k: int, bins: int = 2048,
+    with_global: bool = False,
+):
+    """(k, d) approximate per-cluster medians via fixed-bin histograms —
+    numpy twin of ops/scoring_jax.compute_cluster_medians_hist_jax (error
+    <= feature_range / bins; constant columns exact; NaN for empty
+    clusters).  ``with_global=True`` also returns the (d,) global medians
+    read off the same histograms (one data pass)."""
+    n, d = X.shape
+    labels = np.asarray(labels, dtype=np.int64)
+    counts = np.bincount(labels, minlength=k)
+    lo = X.min(axis=0)
+    hi = X.max(axis=0)
+    out = np.full((k, d), np.nan, dtype=np.float64)
+    gout = np.empty(d, dtype=np.float64)
+    n_total = np.array([n], dtype=np.int64)
+    for f in range(d):
+        if hi[f] <= lo[f]:   # constant column: the value itself, exactly
+            out[:, f] = np.where(counts > 0, lo[f], np.nan)
+            gout[f] = lo[f]
+            continue
+        w_f = hi[f] - lo[f]
+        b = np.clip(((X[:, f] - lo[f]) / w_f * bins).astype(np.int64),
+                    0, bins - 1)
+        H = np.bincount(labels * bins + b, minlength=k * bins).reshape(k, bins)
+        out[:, f] = _medians_from_hist_np(H, counts, lo[f], w_f, bins)
+        if with_global:
+            gout[f] = _medians_from_hist_np(
+                H.sum(axis=0, keepdims=True), n_total, lo[f], w_f, bins)[0]
+    if with_global:
+        return out, gout
     return out
 
 
@@ -134,10 +199,28 @@ def classify(
 
     Returns ``(category_idx (k,), scores (k, C), cluster_medians (k, d))``.
     Reference call stack: src/scoring.py:111-130.
+
+    Honors ``cfg.median_method`` exactly like the jax backend (ADVICE r2):
+    "sort" = exact medians, "hist" = fixed-bin histogram medians, "auto" =
+    hist past HIST_MEDIAN_THRESHOLD rows — so both backends take the same
+    route on the same data.
     """
     cfg = cfg or ScoringConfig()
-    medians = compute_cluster_medians(X, labels, k)
-    if global_medians is None and cfg.compute_global_medians_from_data:
-        global_medians = np.median(X, axis=0)
+    method = getattr(cfg, "median_method", "auto")
+    if method == "auto":
+        method = "hist" if X.shape[0] > HIST_MEDIAN_THRESHOLD else "sort"
+    if method not in ("sort", "hist"):
+        raise ValueError(f"unknown median_method {method!r}")
+    want_global = global_medians is None and cfg.compute_global_medians_from_data
+    if method == "hist":
+        medians, gmeds = compute_cluster_medians_hist(
+            X, labels, k, bins=int(getattr(cfg, "median_bins", 2048)),
+            with_global=True)
+        if want_global:
+            global_medians = gmeds
+    else:
+        medians = compute_cluster_medians(X, labels, k)
+        if want_global:
+            global_medians = np.median(X, axis=0)
     winner, scores = classify_medians(medians, cfg, global_medians)
     return winner, scores, medians

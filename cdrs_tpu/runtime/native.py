@@ -21,7 +21,7 @@ import threading
 import numpy as np
 
 __all__ = ["load", "native_available", "simulate_events_native",
-           "parse_access_log_native", "parse_log_chunk_native", "InternMap"]
+           "parse_log_chunk_native", "InternMap"]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
@@ -74,14 +74,6 @@ def load():
         lib.sim_fill.argtypes = [
             _i64, _p_i64, _p_f64, _p_f64, _p_f64, _p_i32, _p_i32, _i64,
             _f64, _f64, _u64, _i64, _p_f64, _p_i32, _p_i8, _p_i32,
-        ]
-        lib.log_scan.restype = _i64
-        lib.log_scan.argtypes = [ctypes.c_char_p,
-                                 ctypes.POINTER(_i64), ctypes.POINTER(_i64)]
-        lib.log_fill.restype = _i64
-        lib.log_fill.argtypes = [
-            ctypes.c_char_p, _i64, _i64, _i64, _p_f64, _p_i8,
-            _p_char, _p_i64, _p_char, _p_i64,
         ]
         lib.log_fill_chunk.restype = _i64
         lib.log_fill_chunk.argtypes = [
@@ -265,37 +257,3 @@ def parse_log_chunk_native(path: str, offset: int, max_rows: int):
     return (ts[:rows], op[:rows], path_blob[:path_off[rows]], path_off[:rows + 1],
             client_blob[:client_off[rows]], client_off[:rows + 1],
             int(nxt.value))
-
-
-def parse_access_log_native(path: str):
-    """Fast access.log parse.  Returns (ts, op, path_strs, client_strs) with
-    paths/clients as Python string lists, or None when the native parser
-    cannot handle the file (quoted CSV) or the library is unavailable."""
-    lib = load()
-    if lib is None:
-        return None
-    pb = _i64(0)
-    cb = _i64(0)
-    rows = int(lib.log_scan(path.encode(), ctypes.byref(pb), ctypes.byref(cb)))
-    if rows < 0:
-        return None  # IO error or quoted CSV -> python fallback
-    ts = np.empty(rows, dtype=np.float64)
-    op = np.empty(rows, dtype=np.int8)
-    path_blob = np.empty(max(pb.value, 1), dtype=np.uint8)
-    client_blob = np.empty(max(cb.value, 1), dtype=np.uint8)
-    path_off = np.empty(rows + 1, dtype=np.int64)
-    client_off = np.empty(rows + 1, dtype=np.int64)
-    got = int(lib.log_fill(path.encode(), rows, int(pb.value), int(cb.value),
-                           ts, op, path_blob, path_off,
-                           client_blob, client_off))
-    if got != rows or np.isnan(ts).any():
-        # Re-read mismatch or a timestamp the native grammar rejects: let the
-        # python csv path handle (and properly diagnose) the file.
-        return None
-    pbytes = path_blob.tobytes()
-    cbytes = client_blob.tobytes()
-    paths = [pbytes[path_off[i]:path_off[i + 1]].decode("utf-8", "replace")
-             for i in range(rows)]
-    clients = [cbytes[client_off[i]:client_off[i + 1]].decode("utf-8", "replace")
-               for i in range(rows)]
-    return ts, op, paths, clients

@@ -61,6 +61,7 @@ def kmeans_jax_checkpointed(
     mesh_shape: dict[str, int] | None = None,
     resume: bool = True,
     init_centroids=None,
+    labels: str = "final",
     **kwargs,
 ):
     """Lloyd loop in durable blocks.  Returns (centroids, labels, total_iters).
@@ -73,12 +74,20 @@ def kmeans_jax_checkpointed(
     exactly regardless of where the blocks fall, including iterations where
     empty-cluster reseeds fire.
 
-    Labels are the assignment against the FINAL centroids (one extra pass) —
-    consistent across fresh/resumed/already-complete invocations; note this
-    differs from kmeans_jax_full's reference-parity labels, which are taken
-    against the pre-update centroids of the last iteration.
+    ``labels`` selects the label semantics (VERDICT r2 weak #7):
+
+    * ``"final"`` (default) — assignment against the FINAL centroids (one
+      extra pass); consistent across fresh/resumed/already-complete runs.
+    * ``"parity"`` — the reference's loop-order labels (assignment against
+      the pre-update centroids of the last executed iteration,
+      kmeans_plusplus.py:33-48), bit-identical to an uninterrupted
+      ``kmeans_jax_full`` run.  The final snapshot stores them, so a resumed
+      invocation of an already-complete run returns the same labels.
     """
     from ..ops.kmeans_jax import kmeans_jax_full
+
+    if labels not in ("final", "parity"):
+        raise ValueError(f"labels must be 'final' or 'parity', got {labels!r}")
 
     X = np.asarray(X) if not hasattr(X, "devices") else X
     iters_done = 0
@@ -86,9 +95,11 @@ def kmeans_jax_checkpointed(
     centroids = None if init_centroids is None else np.asarray(init_centroids)
 
     converged = False
+    parity_labels = None
     if resume and os.path.exists(checkpoint_path):
         arrays, meta = load_state(checkpoint_path)
         centroids = arrays["centroids"]
+        parity_labels = arrays.get("parity_labels")
         iters_done = int(meta["iters_done"])
         converged = bool(meta.get("converged", False))
         if meta.get("k") != int(k):
@@ -98,7 +109,7 @@ def kmeans_jax_checkpointed(
     base_seed = 0 if seed is None else int(seed)
     while not converged and iters_done < max_iter:
         block = min(block_iters, max_iter - iters_done)
-        centroids_out, _, it, shift = kmeans_jax_full(
+        centroids_out, labels_out, it, shift = kmeans_jax_full(
             X, k, tol=tol,
             seed=base_seed,
             max_iter=block,
@@ -110,14 +121,30 @@ def kmeans_jax_checkpointed(
         centroids = np.asarray(centroids_out)
         iters_done += it
         converged = shift < tol
-        save_state(checkpoint_path, {"centroids": centroids},
+        done = converged or iters_done >= max_iter
+        arrays = {"centroids": centroids}
+        if labels == "parity" and done:
+            # The block's labels ARE the reference-parity labels: the last
+            # executed iteration's assignment against its pre-update
+            # centroids.  Stored only in the final snapshot (the (n,) array
+            # is dead weight mid-run).
+            parity_labels = np.asarray(labels_out)
+            arrays["parity_labels"] = parity_labels
+        save_state(checkpoint_path, arrays,
                    {"iters_done": iters_done, "k": int(k),
                     "shift": shift, "converged": converged})
+
+    if labels == "parity":
+        if parity_labels is None:
+            raise ValueError(
+                "checkpoint predates labels='parity' (no stored labels); "
+                "re-run with resume=False or use labels='final'")
+        return centroids, parity_labels, iters_done
 
     import jax.numpy as jnp
 
     from ..ops.kmeans_jax import assign_labels_jax
 
-    labels = assign_labels_jax(jnp.asarray(np.asarray(X)),
-                               jnp.asarray(centroids))
-    return centroids, np.asarray(labels), iters_done
+    final_labels = assign_labels_jax(jnp.asarray(np.asarray(X)),
+                                     jnp.asarray(centroids))
+    return centroids, np.asarray(final_labels), iters_done

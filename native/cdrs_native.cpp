@@ -7,9 +7,9 @@
 //
 //   * simulate_events — threaded Poisson access-event generation, sorted by
 //     timestamp, deterministic per (seed, file) regardless of thread count.
-//   * parse_access_log — access.log CSV reader emitting columnar arrays
-//     (epoch seconds, op, and offset-indexed path/client byte ranges that
-//     Python interns against the manifest).
+//   * log_fill_chunk / intern_* — chunked access.log CSV reader emitting
+//     columnar arrays (epoch seconds, op, offset-indexed path/client byte
+//     ranges) plus hash-map string interning, resumable by byte offset.
 //
 // Exact distributional semantics match cdrs_tpu/sim/access.py (order-
 // statistics Poisson: count ~ Poisson(lambda*T), times uniform on [0, T)),
@@ -191,137 +191,6 @@ static double parse_iso(const char* s, int64_t len) {
   }
   return (double)(days_from_civil(Y, M, D) * 86400 + h * 3600 + m * 60 + sec) +
          frac - tz_off;
-}
-
-// Phase 1: count data rows and total path/client byte lengths.
-// Returns row count, or -1 on IO error, -2 if the file uses CSV quoting,
-// -3 if a non-empty row has fewer than 4 fields (caller falls back to the
-// Python csv parser, which raises a proper diagnostic).
-int64_t log_scan(const char* path, int64_t* path_bytes, int64_t* client_bytes) {
-  FILE* f = std::fopen(path, "rb");
-  if (!f) return -1;
-  int64_t rows = 0, pb = 0, cb = 0;
-  bool quoted = false, malformed = false;
-  std::vector<char> buf(1 << 20);
-  std::string line;
-  line.reserve(512);
-  size_t got;
-  std::string carry;
-  while ((got = std::fread(buf.data(), 1, buf.size(), f)) > 0) {
-    size_t start = 0;
-    for (size_t i = 0; i < got; ++i) {
-      if (buf[i] == '"') quoted = true;
-      if (buf[i] == '\n') {
-        std::string full = carry + std::string(buf.data() + start, i - start);
-        carry.clear();
-        start = i + 1;
-        if (full.empty()) continue;
-        // fields: ts,path,op,client,pid
-        size_t c1 = full.find(',');
-        size_t c2 = c1 == std::string::npos ? std::string::npos
-                                            : full.find(',', c1 + 1);
-        size_t c3 = c2 == std::string::npos ? std::string::npos
-                                            : full.find(',', c2 + 1);
-        if (c3 == std::string::npos) { malformed = true; continue; }
-        size_t c4 = full.find(',', c3 + 1);
-        if (c4 == std::string::npos) c4 = full.size();
-        pb += (int64_t)(c2 - c1 - 1);
-        cb += (int64_t)(c4 - c3 - 1);
-        ++rows;
-      }
-    }
-    carry.append(buf.data() + start, got - start);
-  }
-  std::fclose(f);
-  if (!carry.empty()) {
-    size_t c1 = carry.find(',');
-    size_t c2 = c1 == std::string::npos ? std::string::npos
-                                        : carry.find(',', c1 + 1);
-    size_t c3 = c2 == std::string::npos ? std::string::npos
-                                        : carry.find(',', c2 + 1);
-    if (c3 != std::string::npos) {
-      size_t c4 = carry.find(',', c3 + 1);
-      if (c4 == std::string::npos) c4 = carry.size();
-      pb += (int64_t)(c2 - c1 - 1);
-      cb += (int64_t)(c4 - c3 - 1);
-      ++rows;
-    } else {
-      malformed = true;
-    }
-  }
-  if (quoted) return -2;
-  if (malformed) return -3;
-  *path_bytes = pb;
-  *client_bytes = cb;
-  return rows;
-}
-
-// Phase 2: fill columnar output.  Path/client strings are concatenated into
-// byte blobs with (rows+1) offset arrays; Python slices + interns them.
-// Returns rows parsed, or -1 on IO error.
-int64_t log_fill(const char* path, int64_t max_rows, int64_t path_cap,
-                 int64_t client_cap, double* ts_out,
-                 int8_t* op_out, char* path_blob, int64_t* path_off,
-                 char* client_blob, int64_t* client_off) {
-  FILE* f = std::fopen(path, "rb");
-  if (!f) return -1;
-  int64_t row = 0, ppos = 0, cpos = 0;
-  bool overflow = false;
-  path_off[0] = 0;
-  client_off[0] = 0;
-  std::vector<char> buf(1 << 20);
-  std::string carry;
-  size_t got;
-  auto handle = [&](const char* s, size_t len) {
-    if (len == 0 || row >= max_rows) return;
-    const char* c1 = (const char*)memchr(s, ',', len);
-    if (!c1) return;
-    const char* c2 = (const char*)memchr(c1 + 1, ',', len - (c1 + 1 - s));
-    if (!c2) return;
-    const char* c3 = (const char*)memchr(c2 + 1, ',', len - (c2 + 1 - s));
-    if (!c3) return;
-    const char* c4 = (const char*)memchr(c3 + 1, ',', len - (c3 + 1 - s));
-    const char* end4 = c4 ? c4 : s + len;
-    size_t plen = c2 - c1 - 1;
-    size_t clen = end4 - c3 - 1;
-    // Bounds vs the scan-pass sizing: a file rewritten between the two
-    // passes must not overflow the caller's numpy buffers.
-    if (ppos + (int64_t)plen > path_cap || cpos + (int64_t)clen > client_cap) {
-      overflow = true;
-      return;
-    }
-    ts_out[row] = parse_iso(s, c1 - s);
-    std::memcpy(path_blob + ppos, c1 + 1, plen);
-    ppos += (int64_t)plen;
-    // op field: "WRITE" -> 1 else 0
-    op_out[row] = (c3 - c2 - 1 == 5 && std::memcmp(c2 + 1, "WRITE", 5) == 0)
-                      ? 1 : 0;
-    std::memcpy(client_blob + cpos, c3 + 1, clen);
-    cpos += (int64_t)clen;
-    ++row;
-    path_off[row] = ppos;
-    client_off[row] = cpos;
-  };
-  while ((got = std::fread(buf.data(), 1, buf.size(), f)) > 0) {
-    size_t start = 0;
-    for (size_t i = 0; i < got; ++i) {
-      if (buf[i] == '\n') {
-        if (!carry.empty()) {
-          carry.append(buf.data() + start, i - start);
-          handle(carry.data(), carry.size());
-          carry.clear();
-        } else {
-          handle(buf.data() + start, i - start);
-        }
-        start = i + 1;
-      }
-    }
-    carry.append(buf.data() + start, got - start);
-  }
-  if (!carry.empty()) handle(carry.data(), carry.size());
-  std::fclose(f);
-  if (overflow) return -1;
-  return row;
 }
 
 // ---------------------------------------------------------------------------

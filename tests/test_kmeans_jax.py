@@ -167,3 +167,36 @@ def test_device_array_n_valid(blobs):
     assert np.asarray(l2).shape == (n,)
     np.testing.assert_allclose(np.asarray(c2), np.asarray(c1), atol=1e-8)
     assert (np.asarray(l2) == np.asarray(l1)).all()
+
+
+def test_minibatch_counts_are_integer():
+    """ADVICE r2: per-center totals accumulate exactly (int32), not f32."""
+    import jax.numpy as jnp
+
+    from cdrs_tpu.ops.kmeans_stream import MiniBatchKMeans
+
+    rng = np.random.default_rng(7)
+    mb = MiniBatchKMeans(k=4, seed=0)
+    for _ in range(3):
+        mb.partial_fit(rng.normal(size=(256, 4)).astype(np.float32))
+    assert mb.state.counts.dtype == jnp.int32
+    assert int(mb.state.counts.sum()) == 3 * 256
+
+
+def test_minibatch_first_batch_smaller_than_k_raises():
+    from cdrs_tpu.ops.kmeans_stream import minibatch_init
+
+    X = np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32)
+    with pytest.raises(ValueError, match="rows < k"):
+        minibatch_init(X, k=8, seed=0)
+
+
+def test_model_minibatch_batch_size_below_k_raises():
+    from cdrs_tpu.config import KMeansConfig
+    from cdrs_tpu.models.replication import ReplicationPolicyModel
+
+    X = np.random.default_rng(1).normal(size=(64, 3)).astype(np.float32)
+    model = ReplicationPolicyModel(
+        KMeansConfig(k=16, batch_size=8, seed=0), backend="jax")
+    with pytest.raises(ValueError, match="batch_size"):
+        model.cluster(X)

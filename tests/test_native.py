@@ -11,7 +11,7 @@ import pytest
 
 from cdrs_tpu.runtime.native import (
     native_available,
-    parse_access_log_native,
+    parse_log_chunk_native,
     simulate_events_native,
 )
 
@@ -94,7 +94,8 @@ def test_log_parser_quoted_csv_falls_back():
         p = os.path.join(d, "access.log")
         with open(p, "w") as f:
             f.write('2026-01-01T00:00:00.000Z,"/a,b.bin",READ,dn1,1000\n')
-        assert parse_access_log_native(p) is None  # refuses quoted csv
+        # the chunked parser refuses quoted csv (python resumes at byte 0)
+        assert parse_log_chunk_native(p, 0, 100) is None
         ev = EventLog.read_csv(p, m)  # auto-falls back to python
     assert len(ev) == 1 and ev.path_id[0] == 0
 
@@ -124,9 +125,9 @@ def test_parse_iso_timezone_offsets(tmp_path):
     ]
     p = tmp_path / "tz.log"
     p.write_text("\n".join(rows) + "\n")
-    parsed = parse_access_log_native(str(p))
+    parsed = parse_log_chunk_native(str(p), 0, 100)
     assert parsed is not None
-    ts, op, paths, clients = parsed
+    ts = parsed[0]
     want = [parse_iso_ts(r.split(",")[0]) for r in rows]
     np.testing.assert_allclose(ts, want, atol=1e-9)
 
@@ -135,7 +136,7 @@ def test_malformed_rows_fall_back(tmp_path):
     """Short/garbled rows make the native scanner bail (python path raises)."""
     p = tmp_path / "bad.log"
     p.write_text("2026-01-01T00:00:00.000Z,/f,READ\n")  # only 3 fields
-    assert parse_access_log_native(str(p)) is None
+    assert parse_log_chunk_native(str(p), 0, 100) is None
 
 
 # ---------------------------------------------------------------------------

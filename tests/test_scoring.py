@@ -7,6 +7,7 @@ reference: C1→Hot, C2→Archival, C3→Archival, C4→Hot.
 """
 
 import numpy as np
+import pytest
 
 from cdrs_tpu.compat.reference_api import ClusterClassifier
 from cdrs_tpu.config import CATEGORIES, ScoringConfig
@@ -172,3 +173,41 @@ def test_compute_global_medians_from_data():
     # one cluster whose medians equal the global medians -> all deltas 0
     # -> Moderate wins (its band rewards zero deviation).
     assert CATEGORIES[int(winner[0])] == "Moderate"
+
+
+def test_numpy_hist_medians_match_jax(tmp_path):
+    """Both backends honor median_method='hist' with matching bins/medians
+    (ADVICE r2: numpy used to silently ignore it)."""
+    pytest.importorskip("jax")
+    from cdrs_tpu.ops.scoring_jax import classify_jax
+    from cdrs_tpu.ops.scoring_np import classify
+
+    rng = np.random.default_rng(41)
+    X = rng.uniform(size=(50_000, 5))
+    labels = rng.integers(0, 6, size=50_000).astype(np.int32)
+    cfg = ScoringConfig(median_method="hist",
+                        compute_global_medians_from_data=True)
+    wn, sn, mn = classify(X, labels, 6, cfg)
+    wj, sj, mj = classify_jax(X.astype(np.float32), labels, 6, cfg)
+    np.testing.assert_allclose(mn, np.asarray(mj), atol=1e-3)
+    np.testing.assert_array_equal(wn, np.asarray(wj))
+
+
+def test_scoring_config_rejects_bad_median_method():
+    from cdrs_tpu.config import scoring_config_from_dict
+
+    with pytest.raises(ValueError, match="median_method"):
+        scoring_config_from_dict({"median_method": "histo"})
+    with pytest.raises(ValueError, match="median_bins"):
+        scoring_config_from_dict({"median_bins": 1})
+
+
+def test_numpy_classify_rejects_bad_median_method():
+    from cdrs_tpu.ops.scoring_np import classify
+
+    X = np.random.default_rng(0).uniform(size=(32, 5))
+    labels = np.zeros(32, dtype=np.int32)
+    cfg = ScoringConfig()
+    cfg.median_method = "bogus"
+    with pytest.raises(ValueError, match="median_method"):
+        classify(X, labels, 1, cfg)
