@@ -532,8 +532,7 @@ def run_bench(config: int = 2, backend: str | None = None,
 
     update = resolve_update(update,
                             nmodel=int((mesh_shape or {}).get("model", 1)),
-                            dtype=cfg.dtype, k=cfg.k,
-                            chunk_rows=cfg.chunk_rows)
+                            dtype=cfg.dtype, k=cfg.k)
 
     if e2e:
         out = _bench_e2e(cfg, int(config), seed, mesh_shape, update)
@@ -551,7 +550,8 @@ def run_bench(config: int = 2, backend: str | None = None,
         from ..ops.kmeans_jax import padding_multiple
 
         multiple = padding_multiple(
-            int((mesh_shape or {}).get("data", 1)), cfg.chunk_rows, update)
+            int((mesh_shape or {}).get("data", 1)), cfg.chunk_rows, update,
+            k=cfg.k)
         if cfg.n % multiple == 0:
             if mesh_shape and mesh_shape.get("data", 1) > 1:
                 from jax.sharding import NamedSharding, PartitionSpec as P

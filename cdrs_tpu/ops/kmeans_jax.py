@@ -90,25 +90,37 @@ def _zero_centroids_cached(k: int, d: int, dtype_name: str):
     return jax.block_until_ready(jnp.zeros((k, d), dtype_name))
 
 
-#: "auto" picks pallas only when the kernel's two (k_pad, tile) f32 VMEM
-#: blocks (distance + one-hot) fit comfortably under the 16 MB scoped-VMEM
-#: limit: k_pad * tile <= 2^20 elements = 2 x 4 MB blocks.
+#: The pallas kernel's two (k_pad, tile) f32 VMEM blocks (distance +
+#: one-hot) must fit comfortably under the 16 MB scoped-VMEM limit:
+#: k_pad * tile <= 2^20 elements = 2 x 4 MB blocks.
 _PALLAS_VMEM_ELEMS = 1 << 20
 
 
+def pallas_tile(k: int) -> int | None:
+    """Column tile for the fused kernel at this k, or None when no tile
+    fits VMEM.  ``chunk_rows`` deliberately plays no part: it bounds the
+    XLA scan's (chunk, k) HBM buffer, while the pallas kernel's working set
+    is VMEM-tiled internally and never materializes (n, k) at all — on v5e
+    the kernel beats the 131072-row matmul scan at config 3 (8.8 vs 6.9
+    iter/s, k=1024) precisely by using its own much smaller tile."""
+    k_pad = ((max(int(k), 8) + 127) // 128) * 128
+    for t in (PALLAS_TILE_ROWS, 2048, 1024, 512):
+        if k_pad * t <= _PALLAS_VMEM_ELEMS:
+            return t
+    return None
+
+
 def resolve_update(update: str, nmodel: int = 1, dtype=np.float32,
-                   k: int | None = None,
-                   chunk_rows: int | None = None) -> str:
+                   k: int | None = None) -> str:
     """Resolve the "auto" Lloyd assign+reduce strategy.
 
     "auto" -> "pallas" on a real TPU backend with an unsharded centroid
-    table, f32 data, and a (k, tile) shape whose VMEM blocks fit (the
-    fastest measured path: the fused feature-major VMEM kernel, 467 vs 139
-    iter/s for XLA matmul on v5e at 1M x 32, k=128); "matmul" everywhere
-    else (CPU tests run the pallas kernel only in interpret mode, which is
-    orders of magnitude slower than XLA; large k with large tiles exceeds
-    the 16 MB scoped-VMEM limit and would fail Mosaic compilation).
-    Explicitly requested strategies pass through untouched.
+    table, f32 data, and a k whose VMEM tile exists (the fastest measured
+    path: the fused feature-major VMEM kernel, 467 vs 139 iter/s for XLA
+    matmul on v5e at 1M x 32, k=128); "matmul" everywhere else (CPU tests
+    run the pallas kernel only in interpret mode, which is orders of
+    magnitude slower than XLA).  Explicitly requested strategies pass
+    through untouched.
     """
     if update != "auto":
         return update
@@ -118,25 +130,24 @@ def resolve_update(update: str, nmodel: int = 1, dtype=np.float32,
         on_tpu = False
     if not (on_tpu and nmodel == 1 and np.dtype(dtype) == np.float32):
         return "matmul"
-    if k is not None:
-        tile = int(chunk_rows or PALLAS_TILE_ROWS)
-        k_pad = ((max(int(k), 8) + 127) // 128) * 128
-        if k_pad * tile > _PALLAS_VMEM_ELEMS:
-            return "matmul"
+    if k is not None and pallas_tile(k) is None:
+        return "matmul"
     return "pallas"
 
 
-def padding_multiple(ndata: int, chunk_rows: int | None, update: str) -> int:
+def padding_multiple(ndata: int, chunk_rows: int | None, update: str,
+                     k: int | None = None) -> int:
     """Row-count multiple the kernel pads/shards to.
 
     Single source for callers (e.g. the benchmark harness) that pre-stage a
     sharded device array and must match ``kmeans_jax_full``'s padding rule:
-    each of the ``ndata`` shards must hold a whole number of chunks, and the
-    pallas kernel additionally tiles rows at PALLAS_TILE_ROWS.
+    each of the ``ndata`` shards must hold a whole number of chunks
+    (matmul/scatter scan) or pallas tiles (``pallas_tile(k)``).
     """
-    return int(ndata) * int(
-        chunk_rows or (PALLAS_TILE_ROWS if resolve_update(update) == "pallas"
-                       else 1))
+    if resolve_update(update, k=k) == "pallas":
+        return int(ndata) * int(pallas_tile(k) if k is not None
+                                else PALLAS_TILE_ROWS)
+    return int(ndata) * int(chunk_rows or 1)
 
 
 def pairwise_sq_dists_jax(x: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
@@ -386,7 +397,7 @@ def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None,
                       ).astype(jnp.int32)
         labels, sums, counts = lloyd_assign_reduce_pallas_t(
             x.T if xt is None else xt, c, nv,
-            tile_cols=chunk_rows or PALLAS_TILE_ROWS, with_labels=False)
+            tile_cols=pallas_tile(k), with_labels=False)
         return labels, sums.astype(x.dtype), counts.astype(x.dtype)
 
     if chunk_rows is None:
@@ -727,10 +738,10 @@ def kmeans_jax_full(
         raise ValueError(f"k={k} must be divisible by the model axis size {nmodel}")
     if update not in ("auto", "matmul", "scatter", "pallas"):
         raise ValueError(f"unknown update strategy {update!r}")
-    update = resolve_update(update, nmodel, dtype, k=k, chunk_rows=chunk_rows)
+    update = resolve_update(update, nmodel, dtype, k=k)
 
     # pallas tiles rows internally (PALLAS_TILE_ROWS), so shards must divide it.
-    multiple = padding_multiple(ndata, chunk_rows, update)
+    multiple = padding_multiple(ndata, chunk_rows, update, k=k)
     if is_device_array:
         # Device-resident input (pipeline / benchmark / streaming path): never
         # copy to host.  ``n_valid`` marks the true row count when the caller
@@ -765,6 +776,10 @@ def kmeans_jax_full(
 
     if update == "pallas" and nmodel > 1:
         raise ValueError("pallas update not supported on a model-sharded mesh")
+    if update == "pallas" and pallas_tile(k) is None:
+        raise ValueError(
+            f"k={k} exceeds the pallas kernel's VMEM budget "
+            f"(no (k_pad, tile) block fits); use update='matmul'")
     if init_method not in ("d2", "kmeans||"):
         raise ValueError(f"unknown init_method {init_method!r}")
     init_per_round = 0
