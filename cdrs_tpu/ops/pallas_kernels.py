@@ -192,7 +192,9 @@ def _kernel_t(nv_ref, xt_ref, c_ref, csq_ref, sums_ref, counts_ref,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                  # (k_pad, d)
-    cnt = jnp.sum(oh, axis=1)          # (k_pad,)
+    # f32 accumulation regardless of x dtype (a bf16 sum of ones saturates
+    # past 256).
+    cnt = jnp.sum(oh.astype(jnp.float32), axis=1)      # (k_pad,)
 
     @pl.when(i == 0)
     def _init():
@@ -255,9 +257,12 @@ def _build_t(n_cols, d, k, tile_cols, dtype_name, interpret, with_labels):
     dtype = jnp.dtype(dtype_name)
 
     def fn(xt, c, n_valid):
-        big = jnp.asarray(1e30, dtype)
-        c_p = jnp.zeros((k_pad, d), dtype).at[:k].set(c)
-        c_sq = jnp.sum(c_p * c_p, axis=1)
+        big = jnp.asarray(1e30, jnp.float32)
+        c_p = jnp.zeros((k_pad, d), dtype).at[:k].set(c.astype(dtype))
+        # ||c||^2 in f32 from the (possibly bf16-rounded) centroids actually
+        # used in the matmul — the distance ranking stays consistent.
+        c32 = c_p.astype(jnp.float32)
+        c_sq = jnp.sum(c32 * c32, axis=1)
         c_sq = jnp.where(jax.lax.iota(jnp.int32, k_pad) < k, c_sq, big)
         nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
         out = call(nv, xt, c_p, c_sq[:, None])
