@@ -1,0 +1,102 @@
+"""Multi-host initialization — the DCN tier of the communication layer.
+
+The reference scales across machines with YARN containers exchanging Spark
+shuffles over TCP (reference: docker/docker-compose.yml:22-64, Makefile:45-60);
+its "communication backend" is the JVM's (SURVEY.md §2.5).  The TPU-native
+equivalent is ``jax.distributed``: one Python process per host, a coordinator
+for rendezvous, and after initialization ``jax.devices()`` spans every chip of
+every host — the meshes built by ``parallel.mesh`` then stretch across hosts
+transparently and XLA routes collectives over ICI within a slice and DCN
+between hosts.
+
+This workload's cross-shard traffic is deliberately tiny — per-iteration
+``psum`` of the (k, d) centroid statistics and (k, bins) median histograms,
+never the points matrix — so the data axis can span DCN without the usual
+bandwidth penalty: the ICI/DCN boundary matters for all-gathers of activations
+in an LLM, not for kilobyte-scale stat reductions (scaling-book recipe: keep
+the fat axis on ICI; our fat axis never leaves the chip).
+
+Usage (one process per host)::
+
+    from cdrs_tpu.parallel.distributed import init_distributed, global_mesh
+
+    init_distributed()                 # env-driven on TPU pods (GKE/QR set
+                                       # the coordinator + process env vars)
+    mesh = global_mesh(n_model=2)      # data axis spans all hosts
+    model = ReplicationPolicyModel(..., mesh_shape=mesh_axis_sizes(mesh))
+
+On a single host everything is a no-op: ``global_mesh`` over the local
+devices is exactly ``parallel.mesh.make_mesh``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+__all__ = ["init_distributed", "global_mesh", "mesh_axis_sizes"]
+
+_initialized = False
+
+
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> bool:
+    """Idempotent ``jax.distributed.initialize`` wrapper.
+
+    With no arguments, relies on the environment (TPU pod runtimes and GKE
+    set ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/... for you);
+    explicit arguments support manual bring-up (e.g. two CPU hosts over
+    DCN).  Returns True when a multi-process runtime is active after the
+    call, False when running single-process (in which case nothing was
+    initialized and local devices are used as-is — the single-host path
+    must keep working without a coordinator).
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = int(num_processes)
+    if process_id is not None:
+        kwargs["process_id"] = int(process_id)
+    if not kwargs and jax.process_count() <= 1:
+        import os
+
+        env_driven = any(v in os.environ for v in (
+            "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS"))
+        if not env_driven:
+            return False   # plain single-process run; nothing to do
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    return jax.process_count() > 1
+
+
+def global_mesh(n_data: int | None = None, n_model: int = 1):
+    """Mesh over the GLOBAL device set (all hosts after init_distributed).
+
+    ``n_data=None`` uses every device not consumed by the model axis.  The
+    device order groups each host's chips contiguously (jax.devices() order),
+    so a 2D mesh keeps the model axis intra-host (ICI) and lets the data
+    axis cross hosts (DCN) — the right layout for this workload's traffic
+    (see module docstring).
+    """
+    devices = jax.devices()
+    if n_data is None:
+        if len(devices) % n_model:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by model axis "
+                f"{n_model}")
+        n_data = len(devices) // n_model
+    return make_mesh(n_data=n_data, n_model=n_model, devices=devices)
+
+
+def mesh_axis_sizes(mesh) -> dict[str, int]:
+    """``{"data": N, "model": M}`` dict for APIs taking ``mesh_shape``."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return {DATA_AXIS: sizes.get(DATA_AXIS, 1),
+            MODEL_AXIS: sizes.get(MODEL_AXIS, 1)}

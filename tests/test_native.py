@@ -241,3 +241,67 @@ def test_unknown_paths_get_minus_one(tmp_path):
     py = EventLog.read_csv(log, manifest, native=False)
     _assert_logs_equal(nat, py)
     assert (nat.path_id == -1).sum() == 1
+
+
+# ---------------------------------------------------------------------------
+# Property test: native ingestion == python ingestion on adversarial logs
+# ---------------------------------------------------------------------------
+
+try:
+    from hypothesis import given, settings, strategies as st
+    HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover
+    HAVE_HYPOTHESIS = False
+
+
+if HAVE_HYPOTHESIS:
+    _name = st.text(
+        alphabet=st.characters(
+            codec="utf-8",
+            # no newlines/CR (CSV rows), no NUL; commas/quotes INCLUDED so
+            # some rows force the quoted-csv python fallback mid-stream
+            exclude_characters="\n\r\x00"),
+        min_size=1, max_size=20)
+
+    @settings(max_examples=25, deadline=None)
+    @given(st.data())
+    def test_ingestion_parity_fuzz(tmp_path_factory, data):
+        import csv as _csv
+
+        from cdrs_tpu.io.events import EventLog, Manifest
+
+        n_files = data.draw(st.integers(1, 8))
+        paths = data.draw(st.lists(_name, min_size=n_files, max_size=n_files,
+                                   unique=True))
+        nodes = ["dn1", "dn2"]
+        m = Manifest(paths=paths, creation_ts=np.zeros(n_files),
+                     primary_node_id=np.zeros(n_files, dtype=np.int32),
+                     size_bytes=np.ones(n_files, dtype=np.int64),
+                     category=["moderate"] * n_files, nodes=nodes)
+
+        n_rows = data.draw(st.integers(0, 30))
+        rows = []
+        for _ in range(n_rows):
+            ts = 1.7e9 + data.draw(st.floats(0, 1e6, allow_nan=False))
+            path = data.draw(st.one_of(st.sampled_from(paths), _name))
+            op = data.draw(st.sampled_from(["READ", "WRITE"]))
+            client = data.draw(st.one_of(st.sampled_from(nodes), _name))
+            from datetime import datetime, timezone
+            iso = datetime.fromtimestamp(ts, tz=timezone.utc).strftime(
+                "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+            rows.append([iso, path, op, client, "1000"])
+
+        d = tmp_path_factory.mktemp("fuzz")
+        log = os.path.join(str(d), "access.log")
+        with open(log, "w", newline="") as f:
+            w = _csv.writer(f)
+            for r in rows:
+                w.writerow(r)
+
+        nat = EventLog.read_csv(log, m, native=True)
+        py = EventLog.read_csv(log, m, native=False)
+        np.testing.assert_allclose(nat.ts, py.ts, atol=1e-6)
+        np.testing.assert_array_equal(nat.path_id, py.path_id)
+        np.testing.assert_array_equal(nat.op, py.op)
+        np.testing.assert_array_equal(nat.client_id, py.client_id)
+        assert nat.clients == py.clients
