@@ -417,29 +417,21 @@ def _time_jax_lloyd(X, k: int, init: np.ndarray, iters: int,
     return elapsed / iters
 
 
-def decision_quality_metrics(seed: int = 21) -> dict:
-    """Decision quality as tracked bench numbers (VERDICT r2 next #1).
-
-    Runs the deterministic seeded 300-file workload through the standard
-    pipeline (pipeline.run_pipeline, evaluate=True) with the validated
-    scoring tables and reports planted-category recovery plus the
-    read-locality gain over the reference's uniform rf=1.  Cheap (<1 s) and
-    fully deterministic — the same numbers tests/test_cluster.py asserts
-    lower bounds on.
-    """
+def _quality_one(n_files: int, duration: float, seed: int) -> dict:
     from ..config import (GeneratorConfig, KMeansConfig, PipelineConfig,
                           SimulatorConfig, validated_scoring_config)
     from ..pipeline import run_pipeline
 
     result = run_pipeline(PipelineConfig(
-        generator=GeneratorConfig(n_files=300, seed=seed),
-        simulator=SimulatorConfig(duration_seconds=300.0, seed=seed + 1),
+        generator=GeneratorConfig(n_files=n_files, seed=seed),
+        simulator=SimulatorConfig(duration_seconds=duration, seed=seed + 1),
         kmeans=KMeansConfig(k=8, seed=42),
         scoring=validated_scoring_config(),
         evaluate=True,
     ))
     ev = result.evaluation
     return {
+        "n_files": n_files,
         "planted_accuracy": result.planted_accuracy,
         "read_locality_policy": ev["policy"]["read_locality"],
         "read_locality_uniform1": ev["uniform_1"]["read_locality"],
@@ -448,6 +440,23 @@ def decision_quality_metrics(seed: int = 21) -> dict:
         "storage_vs_uniform1": (ev["policy"]["total_storage_bytes"]
                                 / ev["uniform_1"]["total_storage_bytes"]),
     }
+
+
+def decision_quality_metrics(seed: int = 21) -> dict:
+    """Decision quality as tracked bench numbers (VERDICT r2 next #1).
+
+    Runs two deterministic seeded workloads (300 files/300 s and 2000
+    files/600 s) through the standard pipeline (pipeline.run_pipeline,
+    evaluate=True) with the validated scoring tables and reports
+    planted-category recovery plus the read-locality gain over the
+    reference's uniform rf=1.  The small workload's numbers are the fields
+    tests/test_cluster.py asserts lower bounds on; the larger one guards
+    against the tables being tuned to one tiny scenario.  Deterministic,
+    a few seconds total.
+    """
+    out = _quality_one(300, 300.0, seed)
+    out["at_2000_files"] = _quality_one(2000, 600.0, seed + 100)
+    return out
 
 
 def run_bench(config: int = 2, backend: str | None = None,

@@ -178,3 +178,13 @@ def test_foreign_clients_never_count_local():
     assert metrics.read_locality == 0.0
     # both reads still get served by some real replica node
     assert metrics.reads_per_node.sum() == 2
+
+
+def test_decision_quality_holds_at_larger_scale():
+    """The validated scoring tables are not overfit to the 300-file
+    workload: planted recovery and locality gain hold at 2000 files."""
+    from cdrs_tpu.benchmarks.harness import _quality_one
+
+    q = _quality_one(2000, 600.0, 121)
+    assert q["planted_accuracy"] >= 0.75
+    assert q["read_locality_gain"] >= 0.05
