@@ -277,7 +277,23 @@ class EventLog:
 
         Events with ``path_id == -1`` (path unknown to the manifest) are
         skipped — their original path string was not retained at ingest.
+        Uses the native writer when available and no string needs CSV
+        quoting (~50x the python csv loop — the 1B-event feed is ~60 GB).
         """
+        needs_quoting = any(
+            any(ch in s for ch in (",", '"', "\n", "\r"))
+            for s in (*manifest.paths, *self.clients))
+        if not needs_quoting:
+            from ..runtime.native import native_available, \
+                write_access_log_native
+
+            if native_available():
+                valid = self.path_id >= 0
+                write_access_log_native(
+                    path, self.ts[valid], self.path_id[valid],
+                    self.op[valid], self.client_id[valid],
+                    manifest.paths, self.clients)
+                return
         with open(path, "w", newline="") as f:
             w = csv.writer(f)
             for i in range(len(self.ts)):

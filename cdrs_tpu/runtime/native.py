@@ -21,7 +21,7 @@ import threading
 import numpy as np
 
 __all__ = ["load", "native_available", "simulate_events_native",
-           "parse_log_chunk_native", "InternMap"]
+           "parse_log_chunk_native", "write_access_log_native", "InternMap"]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
@@ -74,6 +74,11 @@ def load():
         lib.sim_fill.argtypes = [
             _i64, _p_i64, _p_f64, _p_f64, _p_f64, _p_i32, _p_i32, _i64,
             _f64, _f64, _u64, _i64, _p_f64, _p_i32, _p_i8, _p_i32,
+        ]
+        lib.log_write.restype = _i64
+        lib.log_write.argtypes = [
+            ctypes.c_char_p, _i64, _p_f64, _p_i32, _p_i8, _p_i32,
+            _p_char, _p_i64, _p_char, _p_i64, _i64,
         ]
         lib.log_fill_chunk.restype = _i64
         lib.log_fill_chunk.argtypes = [
@@ -141,6 +146,35 @@ def simulate_events_native(
                  float(sim_start), int(seed) & (2**64 - 1), int(n_threads),
                  ts, pid, op, client)
     return ts, pid, op, client
+
+
+def write_access_log_native(path: str, ts, pid, op, client,
+                            paths, clients, append: bool = False) -> int:
+    """Emit access.log rows (``iso_ts,path,op,client,pid``) at native speed.
+
+    ``paths``/``clients`` are the string tables indexed by pid/client ids.
+    Rows with pid < 0 are the caller's to filter (ids index the tables
+    directly here).  Returns rows written."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native library unavailable (no g++/make?)")
+    pblob, poff = _strings_to_blob(paths)
+    cblob, coff = _strings_to_blob(clients)
+    if len(pblob) == 0:
+        pblob = np.zeros(1, dtype=np.uint8)
+    if len(cblob) == 0:
+        cblob = np.zeros(1, dtype=np.uint8)
+    n = len(ts)
+    got = int(lib.log_write(
+        path.encode(), n,
+        np.ascontiguousarray(ts, dtype=np.float64),
+        np.ascontiguousarray(pid, dtype=np.int32),
+        np.ascontiguousarray(op, dtype=np.int8),
+        np.ascontiguousarray(client, dtype=np.int32),
+        pblob, poff, cblob, coff, 1 if append else 0))
+    if got != n:
+        raise IOError(f"log_write wrote {got} of {n} rows to {path}")
+    return got
 
 
 def _strings_to_blob(strings):

@@ -194,6 +194,97 @@ static double parse_iso(const char* s, int64_t len) {
 }
 
 // ---------------------------------------------------------------------------
+// Log writing (the inverse of ingestion — emitting the reference's
+// access.log format at native speed; the python csv loop writes ~0.3M
+// rows/s, hours at the 1B-event scale)
+// ---------------------------------------------------------------------------
+
+// Inverse of days_from_civil (Hinnant's civil_from_days shape).
+static void civil_from_days(int64_t z, int64_t* y, int64_t* m, int64_t* d) {
+  z += 719468;
+  const int64_t era = (z >= 0 ? z : z - 146096) / 146097;
+  const int64_t doe = z - era * 146097;
+  const int64_t yoe = (doe - doe / 1460 + doe / 36524 - doe / 146096) / 365;
+  const int64_t doy = doe - (365 * yoe + yoe / 4 - yoe / 100);
+  const int64_t mp = (5 * doy + 2) / 153;
+  *d = doy - (153 * mp + 2) / 5 + 1;
+  *m = mp + (mp < 10 ? 3 : -9);
+  *y = yoe + era * 400 + (*m <= 2);
+}
+
+// Append `n` rows "iso_ts,path,op,client,pid" to `path`.  Timestamps are
+// formatted with millisecond precision + 'Z' (reference:
+// src/access_simulator.py:5-6).  Paths/clients come as blob+offset string
+// tables indexed by pid/client.  Returns rows written, -1 on IO error.
+int64_t log_write(const char* path, int64_t n, const double* ts,
+                  const int32_t* pid, const int8_t* op, const int32_t* client,
+                  const char* pblob, const int64_t* poff,
+                  const char* cblob, const int64_t* coff,
+                  int64_t append) {
+  FILE* f = std::fopen(path, append ? "ab" : "wb");
+  if (!f) return -1;
+  std::vector<char> buf(1 << 22);
+  size_t pos = 0;
+  // The stream is time-sorted, so consecutive rows usually share the whole
+  // second: cache the formatted "YYYY-MM-DDTHH:MM:SS." prefix per second
+  // (snprintf per row was the writer's bottleneck: 0.67M -> ~5M rows/s).
+  int64_t last_whole = INT64_MIN;
+  char datebuf[32];
+  int datelen = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    double t = ts[i];
+    int64_t whole = (int64_t)t;
+    if ((double)whole > t) --whole;               // floor for negative ts
+    int64_t ms = (int64_t)((t - (double)whole) * 1000.0 + 0.5);
+    if (ms >= 1000) { ms -= 1000; ++whole; }
+    if (whole != last_whole) {
+      int64_t days = whole / 86400;
+      int64_t sod = whole - days * 86400;
+      if (sod < 0) { sod += 86400; --days; }
+      int64_t Y, M, D;
+      civil_from_days(days, &Y, &M, &D);
+      datelen = std::snprintf(
+          datebuf, sizeof(datebuf), "%04lld-%02lld-%02lldT%02lld:%02lld:%02lld.",
+          (long long)Y, (long long)M, (long long)D,
+          (long long)(sod / 3600), (long long)((sod / 60) % 60),
+          (long long)(sod % 60));
+      last_whole = whole;
+    }
+    const int64_t p = pid[i], c = client[i];
+    const int64_t plen = poff[p + 1] - poff[p];
+    const int64_t clen = coff[c + 1] - coff[c];
+    // row: ts(datelen+5), path, op(<=5), client, pid(4) + separators
+    if (pos + (size_t)datelen + (size_t)plen + (size_t)clen + 32 > buf.size()) {
+      if (std::fwrite(buf.data(), 1, pos, f) != pos) { std::fclose(f); return -1; }
+      pos = 0;
+    }
+    std::memcpy(buf.data() + pos, datebuf, (size_t)datelen);
+    pos += (size_t)datelen;
+    buf[pos++] = (char)('0' + ms / 100);
+    buf[pos++] = (char)('0' + (ms / 10) % 10);
+    buf[pos++] = (char)('0' + ms % 10);
+    buf[pos++] = 'Z';
+    buf[pos++] = ',';
+    std::memcpy(buf.data() + pos, pblob + poff[p], (size_t)plen); pos += (size_t)plen;
+    buf[pos++] = ',';
+    if (op[i]) { std::memcpy(buf.data() + pos, "WRITE", 5); pos += 5; }
+    else { std::memcpy(buf.data() + pos, "READ", 4); pos += 4; }
+    buf[pos++] = ',';
+    std::memcpy(buf.data() + pos, cblob + coff[c], (size_t)clen); pos += (size_t)clen;
+    buf[pos++] = ',';
+    int64_t tag = 1000 + i % 9000;                // always 4 digits
+    buf[pos++] = (char)('0' + tag / 1000);
+    buf[pos++] = (char)('0' + (tag / 100) % 10);
+    buf[pos++] = (char)('0' + (tag / 10) % 10);
+    buf[pos++] = (char)('0' + tag % 10);
+    buf[pos++] = '\n';
+  }
+  if (pos && std::fwrite(buf.data(), 1, pos, f) != pos) { std::fclose(f); return -1; }
+  std::fclose(f);
+  return n;
+}
+
+// ---------------------------------------------------------------------------
 // Chunked log ingestion (streaming; the 1B-event feed must never be resident)
 // ---------------------------------------------------------------------------
 

@@ -261,3 +261,32 @@ def test_variable_tail_batches_single_compile():
 
     table = S.stream_finalize(st, manifest)
     np.testing.assert_allclose(np.asarray(table.raw), want.raw, atol=1e-9)
+
+
+def test_stream1b_path_small_scale_matches_batch(tmp_path):
+    """The full simulate -> native write -> native ingest -> device fold
+    pipeline (benchmarks/stream1b) produces the same features as the batch
+    backend at a small scale."""
+    from cdrs_tpu.benchmarks.stream1b import run_stream1b
+    from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
+    from cdrs_tpu.features.streaming import (stream_finalize, stream_init,
+                                             stream_update)
+    from cdrs_tpu.io.events import EventLog
+    from cdrs_tpu.sim.generator import generate_population
+
+    out = run_stream1b(events=50_000, n_files=500, batch_size=7_000,
+                       seed=11, workdir=str(tmp_path), keep_log=True)
+    assert out["feature_rows"] == 500
+    assert out["events_simulated"] > 10_000
+
+    # Re-derive features from the written log with the batch numpy backend.
+    manifest = generate_population(GeneratorConfig(n_files=500, seed=11))
+    log = str(tmp_path / "access.log")
+    ev = EventLog.read_csv(log, manifest)
+    golden = compute_features(manifest, ev)
+
+    st = stream_init(500)
+    for b in EventLog.read_csv_batches(log, manifest, batch_size=7_000):
+        st = stream_update(st, b, manifest)
+    table = stream_finalize(st, manifest)
+    np.testing.assert_allclose(np.asarray(table.raw), golden.raw, atol=1e-9)
