@@ -121,3 +121,23 @@ def test_concurrency_bucket_edges():
     ev = make_events([(10.99, 0, 0, 0), (11.01, 0, 0, 0), (11.99, 0, 0, 0)], m)
     t = compute_features(m, ev)
     np.testing.assert_allclose(t.raw[0, 4], 2.0)
+
+
+def test_seeded_manifest_unseeded_simulator_sane_ages():
+    """A seeded manifest (anchored to the fixed epoch, ~2023) driven by an
+    UNSEEDED simulator must not report multi-year ages: the simulation
+    window anchors to the manifest's latest creation timestamp, not wall
+    clock (r3 code-review finding on the seeded-workload change)."""
+    from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
+    from cdrs_tpu.features.numpy_backend import compute_features
+    from cdrs_tpu.sim.access import simulate_access
+    from cdrs_tpu.sim.generator import generate_population
+
+    manifest = generate_population(GeneratorConfig(n_files=50, seed=5))
+    events = simulate_access(manifest, SimulatorConfig(
+        duration_seconds=60.0, seed=None))   # unseeded on purpose
+    table = compute_features(manifest, events)
+    age_col = table.raw_names.index("age_seconds")
+    ages = np.asarray(table.raw)[:, age_col]
+    assert ages.min() >= 0.0
+    assert ages.max() <= 366 * 86400 + 120.0
