@@ -23,6 +23,7 @@
 #include <cstring>
 #include <random>
 #include <string>
+#include <string_view>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -385,8 +386,23 @@ int64_t log_fill_chunk(const char* path, int64_t offset, int64_t max_rows,
 // Native string interning — path -> id lookups without a Python row loop
 // ---------------------------------------------------------------------------
 
+// Transparent hashing: lookups take string_views over the parse blob with
+// zero per-row allocation (paths routinely exceed the 15-byte SSO).
+struct SvHash {
+  using is_transparent = void;
+  size_t operator()(std::string_view s) const noexcept {
+    return std::hash<std::string_view>{}(s);
+  }
+};
+struct SvEq {
+  using is_transparent = void;
+  bool operator()(std::string_view a, std::string_view b) const noexcept {
+    return a == b;
+  }
+};
+
 struct InternMap {
-  std::unordered_map<std::string, int32_t> map;
+  std::unordered_map<std::string, int32_t, SvHash, SvEq> map;
   std::vector<std::string> names;  // id -> string (insertion order)
 };
 
@@ -413,9 +429,13 @@ int64_t intern_size(void* handle) {
 void intern_lookup(void* handle, const char* blob, const int64_t* off,
                    int64_t n, int32_t* out) {
   auto& m = ((InternMap*)handle)->map;
-  std::string key;
+  // Read-only probes: allocation-free string_view keys, threaded for the
+  // multi-million-row chunks (the 1M-file map spills L2 per probe).
+#if defined(_OPENMP)
+#pragma omp parallel for schedule(static) if (n > 65536)
+#endif
   for (int64_t i = 0; i < n; ++i) {
-    key.assign(blob + off[i], (size_t)(off[i + 1] - off[i]));
+    std::string_view key(blob + off[i], (size_t)(off[i + 1] - off[i]));
     auto it = m.find(key);
     out[i] = it == m.end() ? -1 : it->second;
   }
@@ -429,7 +449,7 @@ int64_t intern_insert_lookup(void* handle, const char* blob,
   std::string key;
   for (int64_t i = 0; i < n; ++i) {
     key.assign(blob + off[i], (size_t)(off[i + 1] - off[i]));
-    auto it = h->map.find(key);
+    auto it = h->map.find(std::string_view(key));
     if (it == h->map.end()) {
       int32_t id = (int32_t)h->names.size();
       h->map.emplace(key, id);
