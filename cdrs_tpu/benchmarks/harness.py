@@ -605,8 +605,9 @@ def run_bench(config: int = 2, backend: str | None = None,
 
     if e2e:
         out = _bench_e2e(cfg, int(config), seed, mesh_shape, update)
-        if "mesh_downscaled_to" in result:
-            out["mesh_downscaled_to"] = result["mesh_downscaled_to"]
+        for key in ("mesh_downscaled_to", "n_downscaled_from"):
+            if key in result:
+                out[key] = result[key]
         if quality_block is not None:
             out["decision_quality"] = quality_block
         return out

@@ -45,6 +45,8 @@ def run_stream1b(events: int = 1_000_000_000, n_files: int = 1_000_000,
     log = os.path.join(td, "access.log")
     out: dict = {"events_requested": int(events), "n_files": int(n_files),
                  "batch_size": int(batch_size)}
+    if keep_log:
+        out["log_path"] = log  # a kept ~60 GB file must be findable
     try:
         t0 = time.perf_counter()
         manifest = generate_population(GeneratorConfig(

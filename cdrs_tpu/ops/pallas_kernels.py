@@ -82,7 +82,9 @@ def _kernel(nv_ref, x_ref, c_ref, csq_ref, sums_ref, counts_ref, labels_ref, *,
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                  # (k_pad, d_pad)
-    cnt = jnp.sum(oh, axis=0)          # (k_pad,)
+    # f32 accumulation regardless of x dtype (a bf16 ones-sum saturates
+    # past 256) — same contract as the feature-major kernel.
+    cnt = jnp.sum(oh.astype(jnp.float32), axis=0)      # (k_pad,)
 
     @pl.when(i == 0)
     def _init():
@@ -140,10 +142,12 @@ def _build(n_rows, d, k, tile_rows, dtype_name, interpret):
 
     def fn(x, c, n_valid):
         # Pad centroids to k_pad rows pushed to +inf distance (via c_sq) so
-        # the argmin never selects them.
-        big = jnp.asarray(1e30, dtype)
-        c_p = jnp.zeros((k_pad, d_pad), dtype).at[:k].set(c)
-        c_sq = jnp.sum(c_p * c_p, axis=1)
+        # the argmin never selects them.  ||c||^2 in f32 from the centroids
+        # actually used by the matmul (same contract as _build_t).
+        big = jnp.asarray(1e30, jnp.float32)
+        c_p = jnp.zeros((k_pad, d_pad), dtype).at[:k].set(c.astype(dtype))
+        c32 = c_p.astype(jnp.float32)
+        c_sq = jnp.sum(c32 * c32, axis=1)
         c_sq = jnp.where(jax.lax.iota(jnp.int32, k_pad) < k, c_sq, big)
         nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
         sums, counts, labels = call(nv, x, c_p, c_sq[None, :])

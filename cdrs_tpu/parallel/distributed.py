@@ -63,7 +63,10 @@ def init_distributed(coordinator_address: str | None = None,
         kwargs["num_processes"] = int(num_processes)
     if process_id is not None:
         kwargs["process_id"] = int(process_id)
-    if not kwargs and jax.process_count() <= 1:
+    if not kwargs:
+        # Decide from the ENVIRONMENT only: any jax call here (even
+        # jax.process_count()) would initialize the XLA backend, which
+        # jax.distributed.initialize() then rejects outright.
         import os
 
         env_driven = any(v in os.environ for v in (
