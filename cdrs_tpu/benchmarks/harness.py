@@ -552,7 +552,10 @@ def run_bench(config: int = 2, backend: str | None = None,
     # ~16 GB of HBM).  Scale n down by powers of two, keeping d/k/mesh — the
     # recorded metric name carries the true n and ``n_downscaled_from`` the
     # config's.
-    ndev = max(1, min(int(np.prod(list((mesh_shape or {"data": 1}).values()))),
+    # X is sharded over the data axis only (replicated across model shards),
+    # so per-device bytes scale with the data axis — counting the model axis
+    # here would under-estimate per-chip residency (ADVICE r3).
+    ndev = max(1, min(int((mesh_shape or {}).get("data", 1)),
                       len(jax.devices())))
     # Per-chip budget for the points matrix: ~5 GiB of the v5e's 16 GiB —
     # the pallas path holds x AND its feature-major transpose, plus labels

@@ -72,8 +72,10 @@ def _pad_events(pid, sec, op, client, multiple, target: int | None = None):
     want += (-want) % multiple
     pad = want - len(pid)
     if pad:
+        # Empty batch: any fill second works — pid=-1 masks every padded row.
+        last_sec = sec[-1] if len(sec) else np.int32(0)
         pid = np.concatenate([pid, np.full(pad, -1, np.int32)])
-        sec = np.concatenate([sec, np.full(pad, sec[-1], np.int32)])
+        sec = np.concatenate([sec, np.full(pad, last_sec, np.int32)])
         op = np.concatenate([op, np.zeros(pad, op.dtype)])
         client = np.concatenate([client, np.zeros(pad, client.dtype)])
     return pid, sec, op, client

@@ -230,7 +230,18 @@ class EventLog:
                         break  # python csv takes over from `offset`
                     ts, op, pblob, poff, cblob, coff, nxt = chunk
                     if len(ts) == 0:
-                        return  # EOF
+                        # rows==0 means EOF only when the scan actually
+                        # reached the end of the file; a chunk can also
+                        # legally parse zero rows (blank lines followed by a
+                        # single row larger than the native blob caps) — in
+                        # that case the remainder belongs to the python
+                        # parser, not the bin (ADVICE r3).
+                        import os
+
+                        if nxt >= os.path.getsize(path):
+                            return  # EOF
+                        offset = nxt
+                        break  # python csv takes over from `offset`
                     pid = path_map.lookup(pblob, poff)
                     # Unseen clients get the next ids (insertion order —
                     # identical vocabulary growth to the python csv path).
@@ -295,14 +306,25 @@ class EventLog:
                     manifest.paths, self.clients)
                 return
         with open(path, "w", newline="") as f:
-            w = csv.writer(f)
-            for i in range(len(self.ts)):
-                if self.path_id[i] < 0:
+            # "\n" terminator (csv default is "\r\n") — byte parity with the
+            # native writer; both csv.reader and the native parser accept it.
+            w = csv.writer(f, lineterminator="\n")
+            out_i = 0   # EMITTED-row index: the native writer gets
+            for i in range(len(self.ts)):   # pre-filtered arrays, so its
+                if self.path_id[i] < 0:     # tag column counts valid rows
                     continue
-                dt = datetime.fromtimestamp(float(self.ts[i]), tz=timezone.utc)
-                iso = dt.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+                # Millisecond field computed exactly as the native writer
+                # does — truncate (t - floor(t)) * 1000.0 with the same IEEE
+                # double ops — so both writers emit byte-identical rows.
+                t = float(self.ts[i])
+                whole = int(np.floor(t))
+                ms = min(int((t - whole) * 1000.0), 999)
+                dt = datetime.fromtimestamp(whole, tz=timezone.utc)
+                iso = dt.strftime("%Y-%m-%dT%H:%M:%S") + f".{ms:03d}Z"
                 op = "WRITE" if self.op[i] else "READ"
                 w.writerow([
                     iso, manifest.paths[int(self.path_id[i])], op,
-                    self.clients[int(self.client_id[i])], 1000 + i % 9000,
+                    self.clients[int(self.client_id[i])],
+                    1000 + out_i % 9000,
                 ])
+                out_i += 1

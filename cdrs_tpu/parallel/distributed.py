@@ -40,16 +40,63 @@ __all__ = ["init_distributed", "global_mesh", "mesh_axis_sizes"]
 _initialized = False
 
 
+# Markers of a multi-process launch jax.distributed can auto-configure from.
+# Mirrors the detectors in jax's cluster registry (jax._src.clusters):
+# explicit coordinator overrides, multislice (MEGASCALE_*), single-slice
+# GKE/QR TPU pods (the TPU runtime publishes the worker roster), SLURM, and
+# Open MPI / mpiexec launches.  Presence alone is not enough — a 1-chip VM
+# also carries TPU_WORKER_HOSTNAMES and a 1-task SLURM job carries
+# SLURM_JOB_ID — so the size markers are checked for world size > 1 (a
+# single-process "cluster" stays on the no-op path per the contract below).
+_COORDINATOR_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+_WORLD_SIZE_ENV_VARS = (    # var -> process count (int, or comma-roster)
+    "TPU_WORKER_HOSTNAMES",  # comma-separated host roster (TPU pod)
+    "SLURM_NTASKS", "SLURM_NPROCS",              # SLURM
+    "OMPI_COMM_WORLD_SIZE", "PMI_SIZE",          # Open MPI / mpiexec
+)
+
+
+def _env_multiprocess() -> bool:
+    """True when the environment describes a >1-process launch."""
+    import os
+
+    if any(v in os.environ for v in _COORDINATOR_ENV_VARS):
+        return True
+    for v in _WORLD_SIZE_ENV_VARS:
+        raw = os.environ.get(v)
+        if raw is None:
+            continue
+        if "," in raw or not raw.strip().isdigit():
+            if len([h for h in raw.split(",") if h.strip()]) > 1:
+                return True
+        elif int(raw) > 1:
+            return True
+    return False
+
+
 def init_distributed(coordinator_address: str | None = None,
                      num_processes: int | None = None,
-                     process_id: int | None = None) -> bool:
+                     process_id: int | None = None,
+                     force: bool = False) -> bool:
     """Idempotent ``jax.distributed.initialize`` wrapper.
 
-    With no arguments, relies on the environment (TPU pod runtimes and GKE
-    set ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/... for you);
-    explicit arguments support manual bring-up (e.g. two CPU hosts over
-    DCN).  Returns True when a multi-process runtime is active after the
-    call, False when running single-process (in which case nothing was
+    With no arguments, initializes when the environment describes a
+    multi-process launch — an explicit coordinator
+    (``JAX_COORDINATOR_ADDRESS``/``COORDINATOR_ADDRESS``, multislice
+    ``MEGASCALE_*``) or a world size > 1 from the markers JAX's own
+    cluster detectors key on (``TPU_WORKER_HOSTNAMES`` roster,
+    ``SLURM_NTASKS``, ``OMPI_COMM_WORLD_SIZE``/``PMI_SIZE``) — and defers
+    the actual address/rank resolution to ``jax.distributed.initialize()``'s
+    auto-detection.  Pass ``force=True`` to skip the environment gate and
+    always call ``initialize()`` (e.g. a pod runtime that exposes only the
+    TPU metadata server, none of the env markers).  Explicit arguments
+    support manual bring-up (e.g. two CPU hosts over DCN).
+
+    Returns True when a multi-process runtime is active after the call,
+    False when running single-process (in which case nothing was
     initialized and local devices are used as-is — the single-host path
     must keep working without a coordinator).
     """
@@ -63,16 +110,11 @@ def init_distributed(coordinator_address: str | None = None,
         kwargs["num_processes"] = int(num_processes)
     if process_id is not None:
         kwargs["process_id"] = int(process_id)
-    if not kwargs:
+    if not kwargs and not force:
         # Decide from the ENVIRONMENT only: any jax call here (even
         # jax.process_count()) would initialize the XLA backend, which
         # jax.distributed.initialize() then rejects outright.
-        import os
-
-        env_driven = any(v in os.environ for v in (
-            "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
-            "MEGASCALE_COORDINATOR_ADDRESS"))
-        if not env_driven:
+        if not _env_multiprocess():
             return False   # plain single-process run; nothing to do
     jax.distributed.initialize(**kwargs)
     _initialized = True

@@ -236,8 +236,11 @@ int64_t log_write(const char* path, int64_t n, const double* ts,
     double t = ts[i];
     int64_t whole = (int64_t)t;
     if ((double)whole > t) --whole;               // floor for negative ts
-    int64_t ms = (int64_t)((t - (double)whole) * 1000.0 + 0.5);
-    if (ms >= 1000) { ms -= 1000; ++whole; }
+    // Truncate to ms (no rounding) — byte-identical to the python
+    // fallback writer, which computes (t - floor(t)) * 1000.0 and
+    // truncates with the same IEEE double ops (ADVICE r3).
+    int64_t ms = (int64_t)((t - (double)whole) * 1000.0);
+    if (ms > 999) ms = 999;
     if (whole != last_whole) {
       int64_t days = whole / 86400;
       int64_t sod = whole - days * 86400;

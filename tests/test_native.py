@@ -324,6 +324,62 @@ def test_native_log_writer_roundtrip(tmp_path):
     np.testing.assert_array_equal(ev2.client_id, ev.client_id)
 
 
+def test_ingest_blank_lines_then_oversized_row(tmp_path):
+    """rows==0 with next_offset advanced is NOT EOF: a chunk that consumes
+    only blank lines and then stops on a row bigger than the native blob
+    caps must hand the remainder to the python parser instead of silently
+    dropping it (ADVICE r3)."""
+    from cdrs_tpu.io.events import EventLog, Manifest
+
+    big_path = "/synth/" + "x" * 1500 + ".bin"   # > 4-row chunk blob cap
+    m = Manifest(paths=[big_path, "/synth/a.bin"],
+                 creation_ts=np.zeros(2),
+                 primary_node_id=np.zeros(2, dtype=np.int32),
+                 size_bytes=np.ones(2, dtype=np.int64),
+                 category=["hot", "hot"], nodes=["dn1"])
+    log = str(tmp_path / "access.log")
+    with open(log, "w") as f:
+        f.write("\n\n")
+        f.write(f"2026-01-01T00:00:00.000Z,{big_path},READ,dn1,1000\n")
+        f.write("2026-01-01T00:00:01.000Z,/synth/a.bin,WRITE,dn1,1001\n")
+    batches = list(EventLog.read_csv_batches(log, m, batch_size=4))
+    ev = batches[0]
+    assert len(ev) == 2
+    np.testing.assert_array_equal(ev.path_id, [0, 1])
+    np.testing.assert_array_equal(ev.op, [0, 1])
+
+
+def test_native_python_writer_byte_parity(tmp_path, monkeypatch):
+    """Native and python log writers emit byte-identical files: both truncate
+    the millisecond field as (t - floor(t)) * 1000.0 with the same IEEE
+    double ops (ADVICE r3 — the native writer used to round)."""
+    from cdrs_tpu.io import events as ev_mod
+    from cdrs_tpu.io.events import EventLog
+
+    manifest, log = _make_workload(tmp_path, n_files=20, duration=60.0)
+    ev = EventLog.read_csv(log, manifest)
+    # Append adversarial fractional seconds right at ms boundaries, plus an
+    # INVALID row (path_id=-1): both writers must skip it without it shifting
+    # the synthetic pid/tag column of the rows that follow.
+    extra = np.array([1.7e9 + 0.0005, 1.7e9 + 0.9995, 1.7e9 + 0.123999,
+                      1.7e9 + 0.5])
+    ev = EventLog(
+        ts=np.concatenate([ev.ts, extra]),
+        path_id=np.concatenate(
+            [ev.path_id, np.array([0, -1, 0, 0], np.int32)]),
+        op=np.concatenate([ev.op, np.zeros(4, np.int8)]),
+        client_id=np.concatenate([ev.client_id, np.zeros(4, np.int32)]),
+        clients=ev.clients)
+    p_nat = str(tmp_path / "nat.log")
+    ev.write_csv(p_nat, manifest)
+    from cdrs_tpu.runtime import native as native_mod
+    monkeypatch.setattr(native_mod, "native_available", lambda: False)
+    p_py = str(tmp_path / "py.log")
+    ev.write_csv(p_py, manifest)
+    with open(p_nat, "rb") as a, open(p_py, "rb") as b:
+        assert a.read() == b.read()
+
+
 def test_native_writer_quoting_fallback(tmp_path):
     """Paths needing CSV quoting route to the python csv writer."""
     from cdrs_tpu.io.events import EventLog, Manifest
