@@ -385,8 +385,17 @@ def _time_init(X, k: int, init: np.ndarray, mesh_shape, chunk_rows, dtype,
 
 def _time_jax_lloyd(X, k: int, init: np.ndarray, iters: int,
                     mesh_shape, chunk_rows, dtype,
-                    update: str = "matmul") -> float:
-    """Seconds per Lloyd iteration for the jax backend (compile excluded)."""
+                    update: str = "matmul",
+                    repeats: int = 5) -> tuple[float, list[float]]:
+    """Seconds per Lloyd iteration for the jax backend (compile excluded).
+
+    Times ``repeats`` independent windows of ``iters`` iterations each and
+    returns (best window sec/iter, all window sec/iter).  Best-of-N because
+    the noise on a remote-tunnel backend (dispatch jitter, competing tunnel
+    traffic) is strictly additive — the fastest window is the closest
+    observation of the chip's actual rate (BENCH_r03 recorded 288 iter/s on
+    a single window of a kernel that repeatedly measures 368-467).
+    """
     import jax
 
     from ..ops.kmeans_jax import kmeans_jax_full
@@ -409,12 +418,14 @@ def _time_jax_lloyd(X, k: int, init: np.ndarray, iters: int,
     # centroids to host is the only reliable sync on remote-tunnel backends.
     c, l, it, _ = kmeans_jax_full(X, k, **kwargs)
     np.asarray(c)
-    t0 = time.perf_counter()
-    c, l, it, _ = kmeans_jax_full(X, k, **kwargs)
-    np.asarray(c)
-    elapsed = time.perf_counter() - t0
-    assert it == iters
-    return elapsed / iters
+    windows = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        c, l, it, _ = kmeans_jax_full(X, k, **kwargs)
+        np.asarray(c)
+        windows.append((time.perf_counter() - t0) / iters)
+        assert it == iters
+    return min(windows), windows
 
 
 def _quality_one(n_files: int, duration: float, seed: int) -> dict:
@@ -645,9 +656,14 @@ def run_bench(config: int = 2, backend: str | None = None,
                                 mesh_shape)
         init = np.asarray(X[: cfg.k]).astype(dtype)
 
-    jax_sec = _time_jax_lloyd(X, cfg.k, init, cfg.iters, mesh_shape,
-                              cfg.chunk_rows, dtype, update)
+    jax_sec, windows = _time_jax_lloyd(X, cfg.k, init, cfg.iters, mesh_shape,
+                                       cfg.chunk_rows, dtype, update)
     jax_ips = 1.0 / jax_sec
+    # Disclosure: every timed window's rate (best is the headline; the spread
+    # is the tunnel/dispatch noise, not kernel behavior).
+    result["window_iters_per_sec"] = [1.0 / w for w in windows]
+    result["window_iters_per_sec_median"] = float(
+        1.0 / np.median(windows))
 
     # Init cost (SURVEY.md §7.4: the D² loop is k sequential rounds — the
     # north-star configs need to know whether it dominates, and what the

@@ -54,7 +54,11 @@ _COORDINATOR_ENV_VARS = (
 )
 _WORLD_SIZE_ENV_VARS = (    # var -> process count (int, or comma-roster)
     "TPU_WORKER_HOSTNAMES",  # comma-separated host roster (TPU pod)
-    "SLURM_NTASKS", "SLURM_NPROCS",              # SLURM
+    # SLURM: the STEP-scoped count (set only under srun, once per task).
+    # The allocation-scoped SLURM_NTASKS is deliberately not consulted — a
+    # bare `python ...` inside an `#SBATCH -n 4` allocation is still ONE
+    # process, and initialize() would hang waiting for 3 phantom peers.
+    "SLURM_STEP_NUM_TASKS",
     "OMPI_COMM_WORLD_SIZE", "PMI_SIZE",          # Open MPI / mpiexec
 )
 
@@ -88,7 +92,7 @@ def init_distributed(coordinator_address: str | None = None,
     (``JAX_COORDINATOR_ADDRESS``/``COORDINATOR_ADDRESS``, multislice
     ``MEGASCALE_*``) or a world size > 1 from the markers JAX's own
     cluster detectors key on (``TPU_WORKER_HOSTNAMES`` roster,
-    ``SLURM_NTASKS``, ``OMPI_COMM_WORLD_SIZE``/``PMI_SIZE``) — and defers
+    ``SLURM_STEP_NUM_TASKS``, ``OMPI_COMM_WORLD_SIZE``/``PMI_SIZE``) — and defers
     the actual address/rank resolution to ``jax.distributed.initialize()``'s
     auto-detection.  Pass ``force=True`` to skip the environment gate and
     always call ``initialize()`` (e.g. a pod runtime that exposes only the

@@ -27,7 +27,7 @@ def test_single_process_is_noop():
 def test_env_gate_detects_cluster_markers(monkeypatch):
     """A multi-process launch must reach jax.distributed.initialize even
     without an explicit coordinator address: single-slice TPU pods publish
-    the worker roster (TPU_WORKER_HOSTNAMES), SLURM/Open MPI publish world
+    the worker roster (TPU_WORKER_HOSTNAMES), SLURM steps/Open MPI publish world
     sizes — none of which set *COORDINATOR_ADDRESS (ADVICE r3, medium).
     Size-1 launches (1-chip TPU VM, 1-task SLURM job) must stay no-op."""
     import jax
@@ -49,7 +49,7 @@ def test_env_gate_detects_cluster_markers(monkeypatch):
     # Size-1 markers (this very axon box carries a 1-host
     # TPU_WORKER_HOSTNAMES): still single-process, still no-op.
     for var, val in (("TPU_WORKER_HOSTNAMES", "t1v-n-0"),
-                     ("SLURM_NTASKS", "1"), ("OMPI_COMM_WORLD_SIZE", "1")):
+                     ("SLURM_STEP_NUM_TASKS", "1"), ("OMPI_COMM_WORLD_SIZE", "1")):
         monkeypatch.setenv(var, val)
         monkeypatch.setattr(dist, "_initialized", False)
         assert dist.init_distributed() is False, var
@@ -58,7 +58,7 @@ def test_env_gate_detects_cluster_markers(monkeypatch):
 
     # World size > 1 -> must defer to jax's auto-detection.
     for var, val in (("TPU_WORKER_HOSTNAMES", "t1v-n-0,t1v-n-1"),
-                     ("SLURM_NTASKS", "4"), ("OMPI_COMM_WORLD_SIZE", "2"),
+                     ("SLURM_STEP_NUM_TASKS", "4"), ("OMPI_COMM_WORLD_SIZE", "2"),
                      ("JAX_COORDINATOR_ADDRESS", "10.0.0.1:8476")):
         monkeypatch.setenv(var, val)
         monkeypatch.setattr(dist, "_initialized", False)
