@@ -99,7 +99,8 @@ def _bench_streaming(cfg: BenchConfig, seed: int,
     import jax
     import jax.numpy as jnp
 
-    from ..features.streaming import _build_update
+    from ..features.streaming import _build_update, _prep_batch
+    from ..io.events import EventLog, Manifest
 
     n, e = cfg.n, STREAM_BATCH_EVENTS
     ndata = int((mesh_shape or {}).get("data", 1))
@@ -108,9 +109,14 @@ def _bench_streaming(cfg: BenchConfig, seed: int,
         # Largest available power of two — always divides the 2^20 batch.
         ndata = 1 << (len(jax.devices()).bit_length() - 1)
     rng = np.random.default_rng(seed)
-    primary = jnp.asarray(rng.integers(0, 4, size=n, dtype=np.int32))
     e_shard = e + ((-e) % ndata)  # padded like stream_update does
-    fn = _build_update(e_shard, n, ndata)
+
+    manifest = Manifest(
+        paths=[f"/f{i}" for i in range(n)],
+        creation_ts=np.zeros(n),
+        primary_node_id=rng.integers(0, 4, size=n, dtype=np.int32),
+        size_bytes=np.ones(n, dtype=np.int64),
+        category=["moderate"] * n, nodes=["dn1", "dn2", "dn3", "dn4"])
 
     def dev_state():
         z = jnp.zeros((n,), jnp.int32)
@@ -118,45 +124,52 @@ def _bench_streaming(cfg: BenchConfig, seed: int,
 
     batches = [_synth_event_batch(rng, n, e, 1.7e9 + 60.0 * i)
                for i in range(cfg.iters)]
-    from ..features.jax_backend import _pad_events
+    logs = [EventLog(ts=b["ts"], path_id=b["pid"], op=b["op"],
+                     client_id=b["client"], clients=manifest.nodes)
+            for b in batches]
 
-    dev_batches = [
-        tuple(jnp.asarray(a) for a in _pad_events(
-            b["pid"], (np.floor(b["ts"]) - 1.7e9).astype(np.int32),
-            b["op"], b["client"], ndata))
-        for b in batches
-    ]
+    # The PRODUCTION prep (features/streaming._prep_batch) decides the wire
+    # format and builds the columns — the bench measures the same kernel fed
+    # the same encoding as the real pipeline.
+    prepped = []
+    sec_base = None
+    for lg in logs:
+        pb = _prep_batch(lg, manifest, sec_base=sec_base,
+                         pad_target=e_shard, ndata=ndata)
+        sec_base = pb.sec_base
+        prepped.append(pb)
+    wire = prepped[0].wire
+    fn = _build_update(e_shard, n, ndata, wire)
+
+    def dev_args(pb):
+        if pb.wire == "packed":
+            return (jnp.asarray(pb.pid), jnp.asarray(pb.sec),
+                    jnp.asarray(np.int32(pb.sec0)))
+        return (jnp.asarray(pb.pid), jnp.asarray(pb.sec),
+                jnp.asarray(pb.flags))
+
+    dev_batches = [dev_args(pb) for pb in prepped]
 
     # warmup + timed pass
     st = dev_state()
-    st = list(fn(*dev_batches[0], primary, *st))
+    st = list(fn(*dev_batches[0], *st))
     np.asarray(st[0])
     st = dev_state()
     t0 = time.perf_counter()
     for db in dev_batches:
-        st = list(fn(*db, primary, *st))
+        st = list(fn(*db, *st))
     np.asarray(st[0])  # sync
     dev_eps = (cfg.iters * e) / (time.perf_counter() - t0)
 
     # Exact numpy streaming backend (features/streaming_np): the same
     # semantics as the device fold — this is the ``vs_baseline`` denominator.
     from ..features.streaming_np import stream_init_np, stream_update_np
-    from ..io.events import EventLog, Manifest
 
-    manifest = Manifest(
-        paths=[f"/f{i}" for i in range(n)],
-        creation_ts=np.zeros(n),
-        primary_node_id=np.asarray(primary),
-        size_bytes=np.ones(n, dtype=np.int64),
-        category=["moderate"] * n, nodes=["dn1", "dn2", "dn3", "dn4"])
     np_batches = max(2, cfg.iters // 4)
     st_np = stream_init_np(n)
-    logs = [EventLog(ts=b["ts"], path_id=b["pid"], op=b["op"],
-                     client_id=b["client"], clients=manifest.nodes)
-            for b in batches[:np_batches + 1]]
     st_np = stream_update_np(st_np, logs[0], manifest)   # warmup
     t0 = time.perf_counter()
-    for lg in logs[1:]:
+    for lg in logs[1:np_batches + 1]:
         st_np = stream_update_np(st_np, lg, manifest)
     np_exact_eps = (np_batches * e) / (time.perf_counter() - t0)
 
@@ -178,6 +191,7 @@ def _bench_streaming(cfg: BenchConfig, seed: int,
         "numpy_approx_events_per_sec": np_approx_eps,
         "backend": "jax",
         "mesh_data": ndata,
+        "wire": wire,
     }
     if ndata != requested:
         out["mesh_downscaled_to"] = {"data": ndata}

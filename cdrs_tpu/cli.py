@@ -279,15 +279,21 @@ def _cmd_stream(args) -> int:
             return 1
     if args.backend == "jax":
         try:
-            from .features.streaming import (stream_finalize, stream_init,
-                                             stream_update)
+            from .features.streaming import fold_stream, stream_finalize
         except ImportError as e:
             print(f"--backend jax requires jax (the 'tpu' extra): {e}",
                   file=sys.stderr)
             return 1
-        import functools
-
-        stream_update = functools.partial(stream_update, mesh_shape=mesh_shape)
+        stats = {}
+        with StageTimer("stream") as t:
+            manifest = Manifest.read_csv(args.manifest)
+            # Parse+prep pipelined against the device fold on a prefetch
+            # thread (features/streaming.fold_stream).
+            state = fold_stream(args.access_log, manifest,
+                                batch_size=args.batch_size,
+                                mesh_shape=mesh_shape, stats=stats)
+            table = stream_finalize(state, manifest)
+        n_batches = stats["batches"]
     else:
         from .features.streaming_np import (
             stream_finalize_np as stream_finalize,
@@ -297,16 +303,15 @@ def _cmd_stream(args) -> int:
         if args.mesh:
             print("warning: --mesh ignored for the numpy backend",
                   file=sys.stderr)
-
-    with StageTimer("stream") as t:
-        manifest = Manifest.read_csv(args.manifest)
-        state = stream_init(len(manifest))
-        n_batches = 0
-        for batch in EventLog.read_csv_batches(args.access_log, manifest,
-                                               batch_size=args.batch_size):
-            state = stream_update(state, batch, manifest)
-            n_batches += 1
-        table = stream_finalize(state, manifest)
+        with StageTimer("stream") as t:
+            manifest = Manifest.read_csv(args.manifest)
+            state = stream_init(len(manifest))
+            n_batches = 0
+            for batch in EventLog.read_csv_batches(args.access_log, manifest,
+                                                   batch_size=args.batch_size):
+                state = stream_update(state, batch, manifest)
+                n_batches += 1
+            table = stream_finalize(state, manifest)
     print(f"Streamed {state.n_events} events in {n_batches} batches "
           f"({t.elapsed:.2f}s)")
 

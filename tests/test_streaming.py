@@ -263,6 +263,83 @@ def test_variable_tail_batches_single_compile():
     np.testing.assert_allclose(np.asarray(table.raw), want.raw, atol=1e-9)
 
 
+def test_fold_stream_matches_batch(tmp_path, workload):
+    """The pipelined driver (prefetch thread) is feature-exact vs the batch
+    backend, from a real on-disk log."""
+    from cdrs_tpu.features.streaming import fold_stream
+
+    manifest, events = workload
+    log = str(tmp_path / "access.log")
+    events.write_csv(log, manifest)
+    # Golden from the RE-READ log (on-disk timestamps are ms-truncated, so
+    # age differs sub-ms from the in-memory events).
+    want = compute_features(manifest, EventLog.read_csv(log, manifest))
+
+    stats = {}
+    state = fold_stream(log, manifest, batch_size=997, stats=stats)
+    got = stream_finalize(state, manifest)
+    np.testing.assert_allclose(got.raw, want.raw, rtol=1e-12, atol=1e-9)
+    np.testing.assert_allclose(got.norm, want.norm, rtol=1e-12, atol=1e-12)
+    assert stats["batches"] == -(-len(events) // 997)
+    assert stats["producer_seconds"] > 0 and stats["fold_seconds"] > 0
+
+
+def test_fold_stream_sharded_and_iterable_source(workload):
+    """fold_stream over an iterable of batches on the 8-device mesh matches
+    the batch features; producer exceptions surface in the caller."""
+    from cdrs_tpu.features.streaming import fold_stream
+
+    manifest, events = workload
+    want = compute_features(manifest, events)
+    cuts = np.linspace(0, len(events), 4).astype(int)
+    batches = [_slice_events(events, int(lo), int(hi))
+               for lo, hi in zip(cuts[:-1], cuts[1:])]
+    state = fold_stream(batches, manifest, mesh_shape={"data": 4})
+    got = stream_finalize(state, manifest)
+    np.testing.assert_allclose(got.raw, want.raw, rtol=1e-12, atol=1e-9)
+
+    def bad_batches():
+        yield batches[0]
+        raise RuntimeError("boom in the parser thread")
+
+    with pytest.raises(RuntimeError, match="boom in the parser"):
+        fold_stream(bad_batches(), manifest)
+
+
+def test_wire_format_fallbacks_match(workload):
+    """Unsorted batches and second-gaps > 255 must route to the "cols" wire
+    format (the packed 5 B/event encoding requires monotone uint8 deltas)
+    and stay feature-exact; sorted batches take "packed"."""
+    from cdrs_tpu.features import streaming as S
+
+    manifest, events = workload
+    want = compute_features(manifest, events)
+
+    # Shuffled within-batch order is legal on one device (the kernel
+    # lexsorts); the negative deltas force wire="cols".
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(len(events))
+    shuffled = EventLog(ts=events.ts[perm], path_id=events.path_id[perm],
+                        op=events.op[perm], client_id=events.client_id[perm],
+                        clients=events.clients)
+    pb = S._prep_batch(shuffled, manifest, sec_base=None, pad_target=0)
+    assert pb.wire == "cols"
+    state = stream_update(stream_init(len(manifest)), shuffled, manifest)
+    got = stream_finalize(state, manifest, observation_end=float(events.ts.max()))
+    np.testing.assert_allclose(got.raw, want.raw, rtol=1e-12, atol=1e-9)
+
+    # A sorted stream with a > 255 s silence also falls back...
+    gap = EventLog(
+        ts=np.array([1.7e9, 1.7e9 + 1.0, 1.7e9 + 1000.0]),
+        path_id=np.zeros(3, np.int32), op=np.zeros(3, np.int8),
+        client_id=np.zeros(3, np.int32), clients=["dn1"])
+    pb = S._prep_batch(gap, manifest, sec_base=None, pad_target=0)
+    assert pb.wire == "cols"
+    # ...while the sorted workload log packs to 5 B/event.
+    pb = S._prep_batch(events, manifest, sec_base=None, pad_target=0)
+    assert pb.wire == "packed" and pb.sec.dtype == np.uint8
+
+
 def test_stream1b_path_small_scale_matches_batch(tmp_path):
     """The full simulate -> native write -> native ingest -> device fold
     pipeline (benchmarks/stream1b) produces the same features as the batch
