@@ -413,36 +413,37 @@ static inline uint64_t hash_key(const char* s, size_t len) {
   return h ? h : 1;  // 0 marks an empty slot
 }
 
+struct Slot {
+  uint64_t h;   // 0 = empty
+  int32_t id;
+};
+
 struct InternMap {
-  std::vector<uint64_t> slot_hash;  // 0 = empty
-  std::vector<int32_t> slot_id;
+  std::vector<Slot> slots;          // one cache line covers hash AND id
   std::vector<std::string> names;   // id -> string (insertion order)
   uint64_t mask = 0;
 
   void rehash(size_t want) {
     size_t cap = 64;
     while (cap < want * 2) cap <<= 1;   // load factor <= 0.5
-    std::vector<uint64_t> nh(cap, 0);
-    std::vector<int32_t> ni(cap, -1);
+    std::vector<Slot> ns(cap, Slot{0, -1});
     uint64_t nm = cap - 1;
-    for (size_t i = 0; i < slot_hash.size(); ++i) {
-      if (!slot_hash[i]) continue;
-      uint64_t j = slot_hash[i] & nm;
-      while (nh[j]) j = (j + 1) & nm;
-      nh[j] = slot_hash[i];
-      ni[j] = slot_id[i];
+    for (const Slot& s : slots) {
+      if (!s.h) continue;
+      uint64_t j = s.h & nm;
+      while (ns[j].h) j = (j + 1) & nm;
+      ns[j] = s;
     }
-    slot_hash.swap(nh);
-    slot_id.swap(ni);
+    slots.swap(ns);
     mask = nm;
   }
 
   // Returns the slot holding `key`, or the empty slot where it belongs.
   inline uint64_t probe(uint64_t h, const char* key, size_t len) const {
     uint64_t j = h & mask;
-    while (slot_hash[j]) {
-      if (slot_hash[j] == h) {
-        const std::string& nm = names[(size_t)slot_id[j]];
+    while (slots[j].h) {
+      if (slots[j].h == h) {
+        const std::string& nm = names[(size_t)slots[j].id];
         if (nm.size() == len && std::memcmp(nm.data(), key, len) == 0)
           return j;
       }
@@ -451,31 +452,35 @@ struct InternMap {
     return j;
   }
 
-  inline int32_t find(const char* key, size_t len) const {
-    uint64_t j = probe(hash_key(key, len), key, len);
-    return slot_hash[j] ? slot_id[j] : -1;
-  }
-
+  // Deduplicating insert (the growing client-vocabulary path).
   int32_t insert(const char* key, size_t len) {
-    if ((names.size() + 1) * 2 > slot_hash.size()) rehash(names.size() + 1);
+    if ((names.size() + 1) * 2 > slots.size()) rehash(names.size() + 1);
     uint64_t h = hash_key(key, len);
     uint64_t j = probe(h, key, len);
-    if (slot_hash[j]) return slot_id[j];
+    if (slots[j].h) return slots[j].id;
     int32_t id = (int32_t)names.size();
-    slot_hash[j] = h;
-    slot_id[j] = id;
+    slots[j] = Slot{h, id};
     names.emplace_back(key, len);
     return id;
   }
 };
 
-// Build an intern map from a byte blob + (n+1) offsets.  Ids are positions.
+// Build an intern map from a byte blob + (n+1) offsets.  Ids are POSITIONS:
+// names keeps all n entries (even duplicates) so exported vocabularies and
+// intern_size match the input exactly; a duplicate key looks up its FIRST
+// position (the unordered_map emplace semantics this table replaced).
 void* intern_build(const char* blob, const int64_t* off, int64_t n) {
   auto* h = new InternMap();
   h->rehash((size_t)n + 1);
   h->names.reserve((size_t)n);
-  for (int64_t i = 0; i < n; ++i)
-    h->insert(blob + off[i], (size_t)(off[i + 1] - off[i]));
+  for (int64_t i = 0; i < n; ++i) {
+    const char* key = blob + off[i];
+    const size_t len = (size_t)(off[i + 1] - off[i]);
+    h->names.emplace_back(key, len);
+    uint64_t hk = hash_key(key, len);
+    uint64_t j = h->probe(hk, key, len);
+    if (!h->slots[j].h) h->slots[j] = Slot{hk, (int32_t)i};
+  }
   return h;
 }
 
@@ -502,14 +507,13 @@ void intern_lookup(void* handle, const char* blob, const int64_t* off,
     uint64_t hs[B];
     for (int64_t i = base; i < hi; ++i) {
       hs[i - base] = hash_key(blob + off[i], (size_t)(off[i + 1] - off[i]));
-      __builtin_prefetch(&m.slot_hash[hs[i - base] & m.mask]);
-      __builtin_prefetch(&m.slot_id[hs[i - base] & m.mask]);
+      __builtin_prefetch(&m.slots[hs[i - base] & m.mask]);
     }
     for (int64_t i = base; i < hi; ++i) {
       const char* key = blob + off[i];
       const size_t len = (size_t)(off[i + 1] - off[i]);
       uint64_t j = m.probe(hs[i - base], key, len);
-      out[i] = m.slot_hash[j] ? m.slot_id[j] : -1;
+      out[i] = m.slots[j].h ? m.slots[j].id : -1;
     }
   }
 }

@@ -324,6 +324,23 @@ def test_native_log_writer_roundtrip(tmp_path):
     np.testing.assert_array_equal(ev2.client_id, ev.client_id)
 
 
+def test_intern_build_ids_are_positions_with_duplicates():
+    """intern_build ids are input POSITIONS even with duplicate strings: a
+    duplicate resolves to its first position, later uniques keep their own
+    position, and the size/export cover all n entries (the unordered_map
+    emplace semantics the open-addressing table replaced)."""
+    from cdrs_tpu.runtime.native import InternMap, _strings_to_blob, \
+        native_available
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    m = InternMap(["/a", "/b", "/a", "/c"])
+    assert len(m) == 4
+    blob, off = _strings_to_blob(["/c", "/a", "/b", "/zzz"])
+    np.testing.assert_array_equal(m.lookup(blob, off), [3, 0, 1, -1])
+    assert m.names_from(0) == ["/a", "/b", "/a", "/c"]
+
+
 def test_ingest_blank_lines_then_oversized_row(tmp_path):
     """rows==0 with next_offset advanced is NOT EOF: a chunk that consumes
     only blank lines and then stops on a row bigger than the native blob
