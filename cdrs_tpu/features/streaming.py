@@ -52,7 +52,8 @@ from .jax_backend import _concurrency_local
 from .numpy_backend import FeatureTable
 
 __all__ = ["StreamFeatureState", "stream_init", "stream_update",
-           "stream_finalize", "fold_stream"]
+           "stream_finalize", "fold_stream", "save_stream_state",
+           "load_stream_state"]
 
 
 @dataclass
@@ -373,6 +374,48 @@ def stream_update(state: StreamFeatureState, events: EventLog,
     return _fold_prepped(state, pb)
 
 
+#: Fields of StreamFeatureState snapshotted by save/load_stream_state.
+_STATE_ARRAYS = ("access_freq", "writes", "local_acc", "conc_max",
+                 "last_sec", "last_count")
+
+
+def save_stream_state(path: str, state: StreamFeatureState,
+                      log_offset: int | None = None,
+                      log_bytes: int | None = None) -> None:
+    """Atomic snapshot of the fold state (+ the log byte offset it covers).
+
+    ``log_bytes`` (the log's size at snapshot time) lets resume detect a
+    swapped/rewritten log; n_files is implicit in the array shapes and
+    validated against the manifest on resume.
+    """
+    from ..utils.checkpoint import save_state
+
+    save_state(path,
+               {k: np.asarray(getattr(state, k)) for k in _STATE_ARRAYS},
+               meta={"sec_base": state.sec_base,
+                     "observation_end": state.observation_end,
+                     "n_events": state.n_events,
+                     "pad_events": state.pad_events,
+                     "log_offset": log_offset,
+                     "log_bytes": log_bytes})
+
+
+def load_stream_state(path: str) -> tuple[StreamFeatureState, int | None,
+                                          int | None]:
+    """Returns (state, log_offset, log_bytes) saved by save_stream_state."""
+    from ..utils.checkpoint import load_state
+
+    arrays, meta = load_state(path)
+    state = StreamFeatureState(
+        **{k: jnp.asarray(arrays[k]) for k in _STATE_ARRAYS},
+        sec_base=meta.get("sec_base"),
+        observation_end=meta.get("observation_end"),
+        n_events=int(meta.get("n_events", 0)),
+        pad_events=int(meta.get("pad_events", 0)),
+    )
+    return state, meta.get("log_offset"), meta.get("log_bytes")
+
+
 def fold_stream(source, manifest: Manifest, *,
                 state: StreamFeatureState | None = None,
                 batch_size: int = 4_000_000,
@@ -380,6 +423,8 @@ def fold_stream(source, manifest: Manifest, *,
                 native: bool | None = None,
                 check_sorted: bool = True,
                 queue_depth: int = 2,
+                checkpoint_path: str | None = None,
+                checkpoint_every: int = 25,
                 stats: dict | None = None) -> StreamFeatureState:
     """Fold a whole log with parse/prep PIPELINED against the device fold.
 
@@ -394,11 +439,47 @@ def fold_stream(source, manifest: Manifest, *,
     or an iterable of EventLog batches.  ``stats``, when given, receives
     ``producer_seconds`` (parse+prep busy time) and ``fold_seconds``
     (transfer+fold busy time) for disclosure.
+
+    ``checkpoint_path`` makes the hour-scale 1B-event fold crash-safe: every
+    ``checkpoint_every`` folded batches the state is fetched and snapshotted
+    (atomic npz) together with the log byte offset it covers, and a later
+    call with the same path resumes the scan from that offset — the resumed
+    result is bit-identical to an uninterrupted fold (the cross-batch
+    concurrency carry lives in the state arrays).  Requires a path source;
+    the snapshot cadence stops if the python fallback parser takes over
+    (no byte offsets there).
     """
     import queue as _queue
     import threading
     import time as _time
 
+    start_offset = 0
+    if checkpoint_path is not None:
+        if not isinstance(source, (str, bytes, os.PathLike)):
+            raise ValueError("checkpoint_path requires a log-path source "
+                             "(resume needs byte offsets)")
+        if state is not None:
+            raise ValueError("pass state via the checkpoint, not both")
+        if os.path.exists(checkpoint_path):
+            state, off, ck_bytes = load_stream_state(checkpoint_path)
+            # A stale checkpoint from a different dataset must be a loud
+            # error, not silently-wrong features: the state arrays must
+            # match the manifest, and the log must still be the (possibly
+            # grown) file the snapshot's offset indexes into.
+            n_ck = int(state.access_freq.shape[0])
+            if n_ck != len(manifest):
+                raise ValueError(
+                    f"checkpoint {checkpoint_path!r} covers {n_ck} files "
+                    f"but the manifest has {len(manifest)} — stale "
+                    "checkpoint? delete it to start over")
+            size_now = os.path.getsize(source)
+            if ck_bytes is not None and size_now < int(ck_bytes):
+                raise ValueError(
+                    f"log {source!r} is smaller ({size_now} B) than when "
+                    f"the checkpoint was written ({ck_bytes} B) — the log "
+                    "was swapped or truncated; delete the checkpoint to "
+                    "start over")
+            start_offset = int(off or 0)
     if state is None:
         state = stream_init(len(manifest))
     ndata = int((mesh_shape or {}).get(DATA_AXIS, 1))
@@ -406,9 +487,11 @@ def fold_stream(source, manifest: Manifest, *,
     if isinstance(source, (str, bytes, os.PathLike)):
         batches = EventLog.read_csv_batches(source, manifest,
                                             batch_size=batch_size,
-                                            native=native)
+                                            native=native,
+                                            start_offset=start_offset,
+                                            with_offsets=True)
     else:
-        batches = iter(source)
+        batches = ((ev, None) for ev in source)
 
     q: _queue.Queue = _queue.Queue(maxsize=max(1, queue_depth))
     done = object()
@@ -422,7 +505,7 @@ def fold_stream(source, manifest: Manifest, *,
             while not stop.is_set():
                 t0 = _time.perf_counter()
                 try:
-                    ev = next(it)
+                    ev, off = next(it)
                 except StopIteration:
                     break
                 meta["parse"] += _time.perf_counter() - t0
@@ -435,7 +518,7 @@ def fold_stream(source, manifest: Manifest, *,
                     continue
                 meta["sec_base"] = pb.sec_base
                 meta["pad_target"] = max(meta["pad_target"], len(pb.pid))
-                q.put(pb)
+                q.put((pb, off))
         except BaseException as exc:   # surface in the consumer
             q.put(exc)
         else:
@@ -445,6 +528,7 @@ def fold_stream(source, manifest: Manifest, *,
     t.start()
     fold_busy = 0.0
     n_batches = 0
+    since_ckpt = 0
     try:
         while True:
             item = q.get()
@@ -452,10 +536,17 @@ def fold_stream(source, manifest: Manifest, *,
                 break
             if isinstance(item, BaseException):
                 raise item
+            pb, off = item
             t0 = _time.perf_counter()
-            state = _fold_prepped(state, item)
+            state = _fold_prepped(state, pb)
             fold_busy += _time.perf_counter() - t0
             n_batches += 1
+            since_ckpt += 1
+            if (checkpoint_path is not None and off is not None
+                    and since_ckpt >= max(1, checkpoint_every)):
+                save_stream_state(checkpoint_path, state, log_offset=int(off),
+                                  log_bytes=os.path.getsize(source))
+                since_ckpt = 0
     finally:
         # A consumer exception can leave the producer blocked in q.put with
         # the log generator (and its file handle) open: signal it to stop
@@ -467,12 +558,17 @@ def fold_stream(source, manifest: Manifest, *,
             except _queue.Empty:
                 pass
             t.join(timeout=0.05)
+    if checkpoint_path is not None and os.path.exists(checkpoint_path):
+        # The fold is complete: the checkpoint has served its purpose (a
+        # stale one must not hijack a future fresh run over the same path).
+        os.unlink(checkpoint_path)
     if stats is not None:
         stats["producer_seconds"] = meta["busy"] + meta["parse"]
         stats["parse_seconds"] = meta["parse"]
         stats["prep_seconds"] = meta["busy"]
         stats["fold_seconds"] = fold_busy
         stats["batches"] = n_batches
+        stats["resumed_from_offset"] = start_offset
     return state
 
 

@@ -163,7 +163,9 @@ class EventLog:
     @classmethod
     def read_csv_batches(cls, path: str, manifest: Manifest,
                          batch_size: int | None = 1_000_000,
-                         native: bool | None = None):
+                         native: bool | None = None,
+                         start_offset: int = 0,
+                         with_offsets: bool = False):
         """Yield EventLog batches of up to ``batch_size`` rows (streaming IO;
         ``None`` = everything in one batch).
 
@@ -179,6 +181,14 @@ class EventLog:
         ``native=True`` raises when the library cannot be built (mirroring
         ``read_csv`` — a silent python fallback would run the 1B-event
         stream through a per-row loop).
+
+        ``start_offset`` resumes the scan from a byte offset previously
+        reported via ``with_offsets=True``, which changes the yield to
+        ``(batch, next_offset)`` pairs — ``next_offset`` is the byte just
+        past the batch's last row, valid as a later ``start_offset``, or
+        None once the python fallback parser has taken over (csv.reader
+        read-ahead makes mid-stream tells meaningless).  Both are the
+        checkpoint/resume hooks of features/streaming.fold_stream.
         """
         if native is True:
             from ..runtime.native import native_available
@@ -187,36 +197,45 @@ class EventLog:
                 raise RuntimeError(
                     "native log parser unavailable (library not built; "
                     "needs g++/make)")
-        gen = cls._read_batches_impl(path, manifest, batch_size, native)
+        gen = cls._read_batches_impl(path, manifest, batch_size, native,
+                                     start_offset)
         if batch_size is not None:
-            yield from gen
+            if with_offsets:
+                yield from gen
+            else:
+                yield from (b for b, _ in gen)
             return
         # batch_size=None contract: everything in ONE batch (the impl may
-        # still chunk internally to bound the native parse blobs).
-        batches = list(gen)
+        # still chunk internally to bound the native parse blobs).  A single
+        # whole-file batch has no meaningful resume offset — with_offsets
+        # keeps the (batch, offset) shape but reports None.
+        batches = [b for b, _ in gen]
         if not batches:
             return
         if len(batches) == 1:
-            yield batches[0]
-            return
-        yield cls(
-            ts=np.concatenate([b.ts for b in batches]),
-            path_id=np.concatenate([b.path_id for b in batches]),
-            op=np.concatenate([b.op for b in batches]),
-            client_id=np.concatenate([b.client_id for b in batches]),
-            clients=batches[-1].clients,  # vocab grows monotonically
-        )
+            out = batches[0]
+        else:
+            out = cls(
+                ts=np.concatenate([b.ts for b in batches]),
+                path_id=np.concatenate([b.path_id for b in batches]),
+                op=np.concatenate([b.op for b in batches]),
+                client_id=np.concatenate([b.client_id for b in batches]),
+                clients=batches[-1].clients,  # vocab grows monotonically
+            )
+        yield (out, None) if with_offsets else out
 
     @classmethod
     def _read_batches_impl(cls, path: str, manifest: Manifest,
-                           batch_size: int | None, native: bool | None):
-        """Raw batch stream: native chunks, then python csv from the byte
-        offset where (if anywhere) the native grammar gave up."""
+                           batch_size: int | None, native: bool | None,
+                           start_offset: int = 0):
+        """Raw (batch, next_offset|None) stream: native chunks, then python
+        csv from the byte offset where (if anywhere) the native grammar gave
+        up."""
         client_vocab: dict[str, int] = {nm: i for i, nm in enumerate(manifest.nodes)}
         clients = list(manifest.nodes)
         rows_per_chunk = batch_size or cls._NATIVE_CHUNK_ROWS
 
-        offset = 0
+        offset = int(start_offset)
         if native is not False:
             from ..runtime.native import InternMap, native_available, \
                 parse_log_chunk_native
@@ -250,7 +269,7 @@ class EventLog:
                         client_vocab[s] = len(clients)
                         clients.append(s)
                     yield cls(ts=ts, path_id=pid, op=op, client_id=cid,
-                              clients=list(clients))
+                              clients=list(clients)), nxt
                     offset = nxt
 
         def flush(ts, pid, op, cid):
@@ -278,10 +297,10 @@ class EventLog:
                     clients.append(c)
                 cid.append(client_vocab[c])
                 if batch_size is not None and len(ts) >= batch_size:
-                    yield flush(ts, pid, op, cid)
+                    yield flush(ts, pid, op, cid), None
                     ts, pid, op, cid = [], [], [], []
         if ts:
-            yield flush(ts, pid, op, cid)
+            yield flush(ts, pid, op, cid), None
 
     def write_csv(self, path: str, manifest: Manifest) -> None:
         """Emit the reference's access.log format (ts,path,op,client,pid).

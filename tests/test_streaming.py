@@ -5,6 +5,8 @@ full-log features exactly (including seconds split across batch boundaries),
 and mini-batch KMeans must recover planted blob structure.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -304,6 +306,60 @@ def test_fold_stream_sharded_and_iterable_source(workload):
 
     with pytest.raises(RuntimeError, match="boom in the parser"):
         fold_stream(bad_batches(), manifest)
+
+
+def test_fold_stream_checkpoint_resume_bit_identical(tmp_path, workload,
+                                                     monkeypatch):
+    """A fold killed mid-stream resumes from the checkpoint's byte offset and
+    produces the SAME state as an uninterrupted fold (including the cross-
+    batch concurrency carry); the checkpoint is deleted on completion.
+    Checkpoint offsets exist only on the native parse path."""
+    from cdrs_tpu.runtime.native import native_available
+
+    if not native_available():
+        pytest.skip("checkpoint offsets need the native parser")
+    from cdrs_tpu.features import streaming as S
+
+    manifest, events = workload
+    log = str(tmp_path / "access.log")
+    events.write_csv(log, manifest)
+    ckpt = str(tmp_path / "stream.ckpt.npz")
+
+    golden = S.fold_stream(log, manifest, batch_size=500)
+    want = stream_finalize(golden, manifest)
+
+    # Crash after the 4th fold (checkpoints every 2 batches -> the last
+    # snapshot covers batch 4; batches 5+ were never folded).
+    real_fold = S._fold_prepped
+    calls = {"n": 0}
+
+    def exploding(state, pb):
+        calls["n"] += 1
+        if calls["n"] > 4:
+            raise RuntimeError("simulated crash")
+        return real_fold(state, pb)
+
+    monkeypatch.setattr(S, "_fold_prepped", exploding)
+    with pytest.raises(RuntimeError, match="simulated crash"):
+        S.fold_stream(log, manifest, batch_size=500,
+                      checkpoint_path=ckpt, checkpoint_every=2)
+    monkeypatch.setattr(S, "_fold_prepped", real_fold)
+    assert os.path.exists(ckpt)
+
+    # A stale checkpoint against a different manifest is a loud error.
+    m2 = generate_population(GeneratorConfig(n_files=50, seed=4))
+    with pytest.raises(ValueError, match="stale"):
+        S.fold_stream(log, m2, batch_size=500, checkpoint_path=ckpt)
+
+    stats = {}
+    resumed = S.fold_stream(log, manifest, batch_size=500,
+                            checkpoint_path=ckpt, checkpoint_every=2,
+                            stats=stats)
+    assert stats["resumed_from_offset"] > 0
+    assert not os.path.exists(ckpt)   # consumed on success
+    got = stream_finalize(resumed, manifest)
+    assert resumed.n_events == golden.n_events == len(events)
+    np.testing.assert_array_equal(np.asarray(got.raw), np.asarray(want.raw))
 
 
 def test_wire_format_fallbacks_match(workload):
