@@ -77,8 +77,11 @@ def run_stream1b(events: int = 1_000_000_000, n_files: int = 1_000_000,
 
         t0 = time.perf_counter()
         stats: dict = {}
+        # Crash-safe by default: the hour-scale fold snapshots its state +
+        # log offset beside the log; a rerun with the same workdir resumes.
         state = fold_stream(log, manifest, batch_size=batch_size,
-                            stats=stats)
+                            stats=stats,
+                            checkpoint_path=os.path.join(td, "stream.ckpt.npz"))
         table = stream_finalize(state, manifest)
         total = time.perf_counter() - t0
         out.update({

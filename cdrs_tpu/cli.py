@@ -291,9 +291,14 @@ def _cmd_stream(args) -> int:
             # thread (features/streaming.fold_stream).
             state = fold_stream(args.access_log, manifest,
                                 batch_size=args.batch_size,
-                                mesh_shape=mesh_shape, stats=stats)
+                                mesh_shape=mesh_shape, stats=stats,
+                                checkpoint_path=args.checkpoint,
+                                checkpoint_every=args.checkpoint_every)
             table = stream_finalize(state, manifest)
         n_batches = stats["batches"]
+        if args.checkpoint and stats.get("resumed_from_offset"):
+            print(f"Resumed from checkpoint at byte "
+                  f"{stats['resumed_from_offset']}")
     else:
         from .features.streaming_np import (
             stream_finalize_np as stream_finalize,
@@ -302,6 +307,12 @@ def _cmd_stream(args) -> int:
         )
         if args.mesh:
             print("warning: --mesh ignored for the numpy backend",
+                  file=sys.stderr)
+        if args.checkpoint:
+            print("warning: --checkpoint requires --backend jax; ignored",
+                  file=sys.stderr)
+        if args.checkpoint:
+            print("warning: --checkpoint requires --backend jax; ignored",
                   file=sys.stderr)
         with StageTimer("stream") as t:
             manifest = Manifest.read_csv(args.manifest)
@@ -440,6 +451,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--output_csv", default="final_categories.csv")
     p.add_argument("--medians_from_data", action="store_true")
     p.add_argument("--scoring_config", default=None, metavar="JSON")
+    p.add_argument("--checkpoint", default=None, metavar="NPZ",
+                   help="crash-safe folding (jax backend): snapshot the fold "
+                        "state + log offset here every --checkpoint_every "
+                        "batches; rerunning the same command resumes")
+    p.add_argument("--checkpoint_every", type=int, default=25, metavar="B")
     _add_backend_arg(p)
     _add_init_method_arg(p)
     p.set_defaults(fn=_cmd_stream)

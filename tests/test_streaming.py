@@ -190,8 +190,11 @@ def test_cli_stream_minibatch_and_numpy_fold(tmp_path, workload):
     rc = main(["stream", "--manifest", str(mpath), "--access_log", str(apath),
                "--batch_size", "512", "--k", "4", "--seed", "0",
                "--backend", "jax", "--kmeans_batch", "64",
-               "--output_csv", str(out_mb), "--medians_from_data"])
+               "--output_csv", str(out_mb), "--medians_from_data",
+               "--checkpoint", str(tmp_path / "stream.ckpt.npz"),
+               "--checkpoint_every", "2"])
     assert rc == 0
+    assert not os.path.exists(tmp_path / "stream.ckpt.npz")  # consumed
     out_np = tmp_path / "np.csv"
     rc = main(["stream", "--manifest", str(mpath), "--access_log", str(apath),
                "--batch_size", "512", "--k", "4", "--seed", "0",
