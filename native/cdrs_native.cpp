@@ -116,24 +116,63 @@ void sim_fill(int64_t n_files, const int64_t* counts, const double* read_rate,
   for (int64_t t = 0; t < n_threads; ++t) threads.emplace_back(worker);
   for (auto& t : threads) t.join();
 
-  // Global time sort (reference: access_simulator.py:60).  Sort an index
-  // permutation, then apply it column-by-column out of place.
-  std::vector<int64_t> idx(total);
-  for (int64_t i = 0; i < total; ++i) idx[i] = i;
-  CDRS_SORT(idx.begin(), idx.end(), [&](int64_t a, int64_t b) {
-    return ts_out[a] < ts_out[b];
-  });
-  std::vector<double> ts2(total);
-  std::vector<int32_t> i2(total);
-  for (int64_t i = 0; i < total; ++i) ts2[i] = ts_out[idx[i]];
-  std::memcpy(ts_out, ts2.data(), sizeof(double) * total);
-  for (int64_t i = 0; i < total; ++i) i2[i] = pid_out[idx[i]];
-  std::memcpy(pid_out, i2.data(), sizeof(int32_t) * total);
-  for (int64_t i = 0; i < total; ++i) i2[i] = client_out[idx[i]];
-  std::memcpy(client_out, i2.data(), sizeof(int32_t) * total);
-  std::vector<int8_t> o2(total);
-  for (int64_t i = 0; i < total; ++i) o2[i] = op_out[idx[i]];
-  std::memcpy(op_out, o2.data(), sizeof(int8_t) * total);
+  // Global time sort (reference: access_simulator.py:60).  An index
+  // permutation + per-column gathers is cache-hostile at 1B events (every
+  // comparison and every gather is a random read across a 17 GB working
+  // set); instead: pack rows into 24 B structs, scatter them into time
+  // buckets (sequential read, ~4K append streams), stable-sort each small
+  // bucket by ts, and unpack sequentially.  Bucket append preserves input
+  // order and the per-bucket sort is stable, so ties keep the original
+  // (file-major) order — identical output to the stable index sort.
+  struct Ev {
+    double ts;
+    int32_t pid;
+    int32_t client;
+    int8_t op;
+  };
+  std::vector<Ev> packed(total);
+  for (int64_t i = 0; i < total; ++i)
+    packed[i] = Ev{ts_out[i], pid_out[i], client_out[i], op_out[i]};
+
+  const int64_t n_buckets =
+      std::max<int64_t>(1, std::min<int64_t>(4096, total >> 18));
+  std::vector<int64_t> bucket_pos(n_buckets + 1, 0);
+  const double inv_span = duration > 0 ? (double)n_buckets / duration : 0.0;
+  auto bucket_of = [&](double t) {
+    int64_t b = (int64_t)((t - sim_start) * inv_span);
+    return b < 0 ? 0 : (b >= n_buckets ? n_buckets - 1 : b);
+  };
+  for (int64_t i = 0; i < total; ++i) ++bucket_pos[bucket_of(packed[i].ts) + 1];
+  for (int64_t b = 0; b < n_buckets; ++b) bucket_pos[b + 1] += bucket_pos[b];
+  std::vector<Ev> binned(total);
+  {
+    std::vector<int64_t> cur(bucket_pos.begin(), bucket_pos.end() - 1);
+    for (int64_t i = 0; i < total; ++i)
+      binned[cur[bucket_of(packed[i].ts)]++] = packed[i];
+  }
+  packed.clear();
+  packed.shrink_to_fit();
+
+  std::atomic<int64_t> next_bucket(0);
+  auto sort_worker = [&]() {
+    for (;;) {
+      int64_t b = next_bucket.fetch_add(1);
+      if (b >= n_buckets) return;
+      std::stable_sort(binned.begin() + bucket_pos[b],
+                       binned.begin() + bucket_pos[b + 1],
+                       [](const Ev& a, const Ev& c) { return a.ts < c.ts; });
+    }
+  };
+  threads.clear();
+  for (int64_t t = 0; t < n_threads; ++t) threads.emplace_back(sort_worker);
+  for (auto& t : threads) t.join();
+
+  for (int64_t i = 0; i < total; ++i) {
+    ts_out[i] = binned[i].ts;
+    pid_out[i] = binned[i].pid;
+    client_out[i] = binned[i].client;
+    op_out[i] = binned[i].op;
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -233,6 +272,17 @@ int64_t log_write(const char* path, int64_t n, const double* ts,
   char datebuf[32];
   int datelen = 0;
   for (int64_t i = 0; i < n; ++i) {
+    // The path/client blob reads are random across a multi-MB table (pids
+    // are time-ordered, i.e. shuffled): prefetch a few rows ahead so the
+    // misses overlap the formatting work.
+    if (i + 8 < n) {
+      __builtin_prefetch(&poff[pid[i + 8]]);
+      __builtin_prefetch(&coff[client[i + 8]]);
+    }
+    if (i + 4 < n) {
+      __builtin_prefetch(pblob + poff[pid[i + 4]]);
+      __builtin_prefetch(cblob + coff[client[i + 4]]);
+    }
     double t = ts[i];
     int64_t whole = (int64_t)t;
     if ((double)whole > t) --whole;               // floor for negative ts
