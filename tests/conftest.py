@@ -58,3 +58,30 @@ if jax is not None and not _TPU_MODE:
             f"tests need 8 virtual CPU devices, got {n_dev} "
             f"(XLA_FLAGS={os.environ.get('XLA_FLAGS')!r})", returncode=3,
         )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def crash_fold_after(monkeypatch):
+    """Install a streaming-fold crash injector: the fold raises after N
+    successful batches.  Returns a restore() callable so the test can put
+    the real fold back before resuming; teardown restores regardless."""
+
+    def _install(n: int, msg: str = "simulated crash"):
+        from cdrs_tpu.features import streaming as S
+
+        real = S._fold_prepped
+        calls = {"n": 0}
+
+        def exploding(state, pb):
+            calls["n"] += 1
+            if calls["n"] > n:
+                raise RuntimeError(msg)
+            return real(state, pb)
+
+        monkeypatch.setattr(S, "_fold_prepped", exploding)
+        return lambda: monkeypatch.setattr(S, "_fold_prepped", real)
+
+    return _install

@@ -78,6 +78,75 @@ def test_resume_from_complete_checkpoint(blobs, tmp_path):
     assert it2 == it1
 
 
+def test_elastic_resume_on_smaller_mesh(blobs, tmp_path):
+    """Elastic recovery: a run checkpointed on an 8-device mesh resumes on a
+    2-device mesh (e.g. after losing chips) — the snapshot carries only
+    mesh-independent state (centroids + the global iteration index), so the
+    shrunken-mesh run continues the same trajectory."""
+    init = kmeans_plusplus_init(blobs, 4, random_state=0)
+    p = str(tmp_path / "elastic.npz")
+    kmeans_jax_checkpointed(blobs, 4, p, seed=0, max_iter=4, block_iters=4,
+                            init_centroids=init, tol=0.0,
+                            mesh_shape={"data": 8})
+    _, meta = load_state(p)
+    assert meta["iters_done"] == 4   # the mesh-8 snapshot really exists
+    c2, l2, it2 = kmeans_jax_checkpointed(
+        blobs, 4, p, seed=0, max_iter=30, block_iters=30,
+        init_centroids=init, mesh_shape={"data": 2})
+    assert it2 >= 4
+    # Uninterrupted single-mesh reference: same trajectory up to float
+    # reduction order across shard counts.
+    pref = str(tmp_path / "elastic_ref.npz")
+    c3, l3, _ = kmeans_jax_checkpointed(
+        blobs, 4, pref, seed=0, max_iter=30, block_iters=4,
+        init_centroids=init, mesh_shape={"data": 2})
+    np.testing.assert_allclose(c2, c3, atol=1e-5)
+    assert (l2 == l3).mean() > 0.999
+
+
+def test_stream_elastic_resume_cross_mesh(tmp_path, crash_fold_after):
+    """The stream-fold checkpoint is mesh-independent: crash while folding on
+    a data=8 mesh, resume on data=2 — bit-identical features (the counters
+    are int32, so no reduction-order drift exists at all)."""
+    import os
+
+    from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
+    from cdrs_tpu.features import streaming as S
+    from cdrs_tpu.features.numpy_backend import compute_features
+    from cdrs_tpu.io.events import EventLog
+    from cdrs_tpu.runtime.native import native_available
+    from cdrs_tpu.sim.access import simulate_access
+    from cdrs_tpu.sim.generator import generate_population
+
+    if not native_available():
+        pytest.skip("checkpoint offsets need the native parser")
+
+    manifest = generate_population(GeneratorConfig(n_files=80, seed=5))
+    events = simulate_access(manifest,
+                             SimulatorConfig(duration_seconds=60.0, seed=5))
+    log = str(tmp_path / "a.log")
+    events.write_csv(log, manifest)
+    golden = compute_features(manifest, EventLog.read_csv(log, manifest))
+
+    ckpt = str(tmp_path / "s.ckpt.npz")
+    restore = crash_fold_after(3, "chip lost")
+    with pytest.raises(RuntimeError, match="chip lost"):
+        S.fold_stream(log, manifest, batch_size=400,
+                      mesh_shape={"data": 8},
+                      checkpoint_path=ckpt, checkpoint_every=1)
+    restore()
+    assert os.path.exists(ckpt)   # the crash run really snapshotted
+
+    stats = {}
+    state = S.fold_stream(log, manifest, batch_size=400,
+                          mesh_shape={"data": 2}, checkpoint_path=ckpt,
+                          stats=stats)
+    assert stats["resumed_from_offset"] > 0   # ...and the resume used it
+    got = S.stream_finalize(state, manifest)
+    np.testing.assert_array_equal(np.asarray(got.raw),
+                                  np.asarray(golden.raw))
+
+
 def test_k_mismatch_rejected(blobs, tmp_path):
     p = str(tmp_path / "f.npz")
     kmeans_jax_checkpointed(blobs, 4, p, seed=0, max_iter=2, block_iters=2,

@@ -312,7 +312,7 @@ def test_fold_stream_sharded_and_iterable_source(workload):
 
 
 def test_fold_stream_checkpoint_resume_bit_identical(tmp_path, workload,
-                                                     monkeypatch):
+                                                     crash_fold_after):
     """A fold killed mid-stream resumes from the checkpoint's byte offset and
     produces the SAME state as an uninterrupted fold (including the cross-
     batch concurrency carry); the checkpoint is deleted on completion.
@@ -333,20 +333,11 @@ def test_fold_stream_checkpoint_resume_bit_identical(tmp_path, workload,
 
     # Crash after the 4th fold (checkpoints every 2 batches -> the last
     # snapshot covers batch 4; batches 5+ were never folded).
-    real_fold = S._fold_prepped
-    calls = {"n": 0}
-
-    def exploding(state, pb):
-        calls["n"] += 1
-        if calls["n"] > 4:
-            raise RuntimeError("simulated crash")
-        return real_fold(state, pb)
-
-    monkeypatch.setattr(S, "_fold_prepped", exploding)
+    restore = crash_fold_after(4)
     with pytest.raises(RuntimeError, match="simulated crash"):
         S.fold_stream(log, manifest, batch_size=500,
                       checkpoint_path=ckpt, checkpoint_every=2)
-    monkeypatch.setattr(S, "_fold_prepped", real_fold)
+    restore()
     assert os.path.exists(ckpt)
 
     # A stale checkpoint against a different manifest is a loud error.
