@@ -61,16 +61,13 @@ from .numpy_backend import FeatureTable
 __all__ = ["compute_features_jax", "features_kernel"]
 
 
-def _pad_events(pid, sec, op, client, multiple, target: int | None = None):
+def _pad_events(pid, sec, op, client, multiple):
     """Pad event columns to an even shard split.  Padded rows are pid=-1
     (masked in-kernel) with the last real second so they never widen the
     boundary-second set; mesh.pad_rows would zero-pad, aliasing pid 0.
-    ``target`` additionally pads up to a fixed row count (bucketing — a
-    variable-length tail batch then hits the SAME compiled program as the
-    full batches instead of triggering a fresh XLA compile)."""
-    want = max(len(pid), int(target or 0))
-    want += (-want) % multiple
-    pad = want - len(pid)
+    (Bucket padding for the streaming path lives in streaming._prep_batch.)
+    """
+    pad = (-len(pid)) % multiple
     if pad:
         # Empty batch: any fill second works — pid=-1 masks every padded row.
         last_sec = sec[-1] if len(sec) else np.int32(0)

@@ -294,9 +294,14 @@ def _prep_batch(events: EventLog, manifest: Manifest, *,
                 and (e == 1 or (dsec.min(initial=0) >= 0
                                 and dsec.max(initial=0) <= 255)))
 
+    # Bucket-pad: batches no larger than the biggest seen so far reuse its
+    # compiled fold (padded rows are pid-invalid, masked in-kernel).
     want = max(e, int(pad_target))
     want += (-want) % ndata
     pad = want - e
+
+    def padded(a, fill):
+        return np.concatenate([a, np.full(pad, fill, a.dtype)]) if pad else a
 
     if packable:
         pidf = np.where(valid, pid, _PACK_PID_LIMIT).astype(np.int32) \
@@ -304,22 +309,14 @@ def _prep_batch(events: EventLog, manifest: Manifest, *,
         d8 = np.empty(e, np.uint8)
         d8[0] = 0
         d8[1:] = dsec
-        if pad:
-            pidf = np.concatenate(
-                [pidf, np.full(pad, _PACK_PID_LIMIT, np.int32)])
-            d8 = np.concatenate([d8, np.zeros(pad, np.uint8)])
-        return _PreppedBatch(pid=pidf, sec=d8, flags=None, n_events=e,
+        return _PreppedBatch(pid=padded(pidf, _PACK_PID_LIMIT),
+                             sec=padded(d8, 0), flags=None, n_events=e,
                              batch_max=float(events.ts.max()),
                              sec_base=sec_base, ndata=ndata,
                              wire="packed", sec0=int(sec[0]))
 
-    # Bucket-pad: batches no larger than the biggest seen so far reuse its
-    # compiled fold (padded rows are pid=-1, masked in-kernel).
-    if pad:
-        pid = np.concatenate([pid, np.full(pad, -1, np.int32)])
-        sec = np.concatenate([sec, np.full(pad, sec[-1], np.int32)])
-        flags = np.concatenate([flags, np.zeros(pad, np.uint8)])
-    return _PreppedBatch(pid=pid, sec=sec, flags=flags, n_events=e,
+    return _PreppedBatch(pid=padded(pid, -1), sec=padded(sec, sec[-1]),
+                         flags=padded(flags, 0), n_events=e,
                          batch_max=float(events.ts.max()), sec_base=sec_base,
                          ndata=ndata)
 
@@ -329,19 +326,14 @@ def _fold_prepped(state: StreamFeatureState,
     """Device-side half: dispatch one prepped batch into the state."""
     n = int(state.access_freq.shape[0])
     fn = _build_update(len(pb.pid), n, pb.ndata, pb.wire)
-    if pb.wire == "packed":
-        af, wr, la, cm, ls, lc = fn(
-            jnp.asarray(pb.pid), jnp.asarray(pb.sec),
-            jnp.asarray(np.int32(pb.sec0)),
-            state.access_freq, state.writes, state.local_acc,
-            state.conc_max, state.last_sec, state.last_count,
-        )
-    else:
-        af, wr, la, cm, ls, lc = fn(
-            jnp.asarray(pb.pid), jnp.asarray(pb.sec), jnp.asarray(pb.flags),
-            state.access_freq, state.writes, state.local_acc,
-            state.conc_max, state.last_sec, state.last_count,
-        )
+    # Both wires take (pid-ish, sec-ish, third): sec0 scalar when packed,
+    # the flags column otherwise.
+    third = np.int32(pb.sec0) if pb.wire == "packed" else pb.flags
+    af, wr, la, cm, ls, lc = fn(
+        jnp.asarray(pb.pid), jnp.asarray(pb.sec), jnp.asarray(third),
+        state.access_freq, state.writes, state.local_acc,
+        state.conc_max, state.last_sec, state.last_count,
+    )
     obs = pb.batch_max if state.observation_end is None else max(
         state.observation_end, pb.batch_max)
     return replace(
