@@ -51,7 +51,7 @@ class BenchConfig:
 CONFIGS: dict[int, BenchConfig] = {
     1: BenchConfig(n=10_000, d=8, k=10, backend="numpy", iters=10),
     2: BenchConfig(n=1_048_576, d=32, k=128, backend="jax", iters=100),
-    3: BenchConfig(n=10_485_760, d=128, k=1024, backend="jax", iters=5,
+    3: BenchConfig(n=10_485_760, d=128, k=1024, backend="jax", iters=10,
                    chunk_rows=131_072),
     4: BenchConfig(n=104_857_600, d=128, k=1024, backend="jax", iters=5,
                    chunk_rows=131_072, mesh_shape=(("data", 8),)),
@@ -487,7 +487,7 @@ def decision_quality_metrics(seed: int = 21) -> dict:
 def run_bench(config: int = 2, backend: str | None = None,
               seed: int = 0, mesh_shape: dict[str, int] | None = None,
               update: str | None = None, quality: bool = True,
-              e2e: bool = False) -> dict:
+              e2e: bool = False, dtype: str | None = None) -> dict:
     """Run one BASELINE config; returns the bench JSON dict.
 
     ``vs_baseline`` is jax-iterations/sec over numpy-iterations/sec on the
@@ -504,15 +504,26 @@ def run_bench(config: int = 2, backend: str | None = None,
     host categories (the BASELINE config-4 "<60 s end-to-end" stand-in).
     """
     cfg = CONFIGS[int(config)]
+    if dtype is not None:
+        # Points dtype override (e.g. "bfloat16": halves the HBM stream the
+        # Lloyd step is bound by; centroids/stats stay f32 — _stat_dtype).
+        import dataclasses as _dc
+        cfg = _dc.replace(cfg, dtype=str(dtype))
     backend = backend or cfg.backend
     update_requested = update
     update = update or cfg.update
+    if backend == "numpy" and dtype is not None:
+        raise ValueError("--dtype selects the jax points dtype; "
+                         "not applicable to --backend numpy")
     if int(config) == 5:
         if backend != "jax":
             raise ValueError("config 5 (streaming) is a jax fold; "
                              "--backend numpy is not supported")
         if update_requested:
             raise ValueError("--update applies to the Lloyd configs, not the "
+                             "streaming fold (config 5)")
+        if dtype is not None:
+            raise ValueError("--dtype applies to the Lloyd configs, not the "
                              "streaming fold (config 5)")
         result = _bench_streaming(cfg, seed, mesh_shape=mesh_shape)
         if quality:
@@ -695,6 +706,7 @@ def run_bench(config: int = 2, backend: str | None = None,
         "vs_baseline": jax_ips / np_ips,
         "backend": "jax",
         "update": update,
+        "dtype": cfg.dtype,
         "jax_devices": len(jax.devices()),
         "jax_platform": jax.devices()[0].platform,
     })

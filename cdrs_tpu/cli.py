@@ -56,6 +56,12 @@ def _add_init_method_arg(p: argparse.ArgumentParser) -> None:
              "semantics; 'kmeans||' = oversampling init whose cost does "
              "not grow with k",
     )
+    p.add_argument(
+        "--dtype", choices=["float32", "bfloat16", "float64"], default=None,
+        help="clustering points dtype (jax backend; default: the feature "
+             "matrix's). bfloat16 halves the Lloyd HBM stream; centroids "
+             "and stats stay float32",
+    )
 
 
 def _load_scoring(args) -> ScoringConfig:
@@ -174,7 +180,8 @@ def _cmd_cluster(args) -> int:
 
     model = ReplicationPolicyModel(
         kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed,
-                                init_method=getattr(args, 'init_method', 'd2')),
+                                init_method=getattr(args, 'init_method', 'd2'),
+                                dtype=getattr(args, 'dtype', None)),
         scoring_cfg=_load_scoring(args),
         backend=args.backend,
         mesh_shape=_parse_mesh(args.mesh),
@@ -199,7 +206,8 @@ def _cmd_pipeline(args) -> int:
         simulator=SimulatorConfig(duration_seconds=args.duration_seconds,
                                   seed=None if args.seed is None else args.seed + 1),
         kmeans=KMeansConfig(k=args.k, seed=args.seed,
-                            init_method=getattr(args, 'init_method', 'd2')),
+                            init_method=getattr(args, 'init_method', 'd2'),
+                            dtype=getattr(args, 'dtype', None)),
         scoring=_load_scoring(args),
         mesh_shape=_parse_mesh(args.mesh),
         evaluate=args.evaluate,
@@ -329,7 +337,8 @@ def _cmd_stream(args) -> int:
     model = ReplicationPolicyModel(
         kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed,
                                 batch_size=args.kmeans_batch,
-                                init_method=getattr(args, 'init_method', 'd2')),
+                                init_method=getattr(args, 'init_method', 'd2'),
+                                dtype=getattr(args, 'dtype', None)),
         scoring_cfg=_load_scoring(args),
         backend=args.backend,
         mesh_shape=mesh_shape,
@@ -353,7 +362,8 @@ def _cmd_bench(args) -> int:
     out = run_bench(config=args.config, backend=args.backend,
                     mesh_shape=_parse_mesh(args.mesh),
                     update=getattr(args, "update", None),
-                    e2e=getattr(args, "e2e", False))
+                    e2e=getattr(args, "e2e", False),
+                    dtype=getattr(args, "dtype", None))
     print(json.dumps(out))
     return 0
 
@@ -471,6 +481,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="measure wall-clock time-to-categories (sharded "
                         "features -> kmeans -> scoring -> host) instead of "
                         "Lloyd iterations/sec")
+    p.add_argument("--dtype", choices=["float32", "bfloat16", "float64"],
+                   default=None,
+                   help="points dtype override (jax configs; bfloat16 halves "
+                        "the HBM stream — centroids/stats stay float32)")
     _add_backend_arg(p, default=None)  # None = the config's own backend
     p.set_defaults(fn=_cmd_bench)
 

@@ -204,6 +204,10 @@ class KMeansConfig:
     #: or "kmeans||" (oversampling init whose cost does not scale with k —
     #: ops/kmeans_jax._kmeans_par_init_local, SURVEY.md §7.4 hard part).
     init_method: str = "d2"
+    #: Points dtype for the jax backend (None = keep the input's float dtype).
+    #: "bfloat16" halves the HBM stream the Lloyd assignment is bound by;
+    #: centroids/stats stay float32 (ops/kmeans_jax._stat_dtype).
+    dtype: str | None = None
 
     def resolve_max_iter(self, n: int) -> int:
         if self.max_iter is not None:

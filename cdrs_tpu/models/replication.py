@@ -102,6 +102,9 @@ class ReplicationPolicyModel:
             if cfg.init_method != "d2":
                 raise ValueError(
                     f"init_method {cfg.init_method!r} requires the jax backend")
+            if cfg.dtype is not None:
+                raise ValueError(
+                    f"dtype {cfg.dtype!r} requires the jax backend")
             from ..ops.kmeans_np import kmeans
 
             return kmeans(
@@ -119,6 +122,7 @@ class ReplicationPolicyModel:
             init_centroids=init_centroids,
             mesh_shape=self.mesh_shape,
             init_method=cfg.init_method,
+            dtype=cfg.dtype,
         )
         return np.asarray(centroids), np.asarray(labels)
 
@@ -139,6 +143,12 @@ class ReplicationPolicyModel:
         bs = int(cfg.batch_size)
         if bs < 1:
             raise ValueError(f"batch_size must be >= 1, got {bs}")
+        if cfg.dtype not in (None, "float32"):
+            # Mini-batch state keeps f32 centroids over small resident
+            # batches; a low-precision points stream buys nothing there.
+            raise ValueError(
+                f"dtype {cfg.dtype!r} is a full-batch Lloyd knob; mini-batch "
+                f"KMeans (batch_size) always runs float32")
         if bs < cfg.k and init_centroids is None:
             # The first batch seeds the D2 init; fewer valid rows than
             # centroids would silently produce duplicate centroids (the

@@ -54,10 +54,13 @@ __all__ = [
 ]
 
 #: Row tile the pallas kernel iterates internally (ops/pallas_kernels.py):
-#: columns of the feature-major (d, n) view.  4096 won the v5e sweep
-#: (VMEM: (k_pad, 4096) f32 distance + one-hot blocks = 4 MB at k=1024...
-#: k_pad=128; larger tiles hit the 16 MB scoped-VMEM limit at k_pad >= 512).
-PALLAS_TILE_ROWS = 4096
+#: columns of the feature-major (d, n) view.  2048 won the round-4 in-loop
+#: v5e sweep at k=128 (1.10 ms/iter vs 1.48 at 4096 / 1.47 at 8192, n=1M
+#: d=32 — the (k_pad, 2048) f32 distance + one-hot pair double-buffers
+#: cleanly at 2x1 MB); at k_pad >= 512 only smaller tiles fit the VMEM
+#: budget and the ladder below takes over (k=1024 measured best at 1024:
+#: 31.7 ms/iter vs 35.0 at 512, n=4M d=128).
+PALLAS_TILE_ROWS = 2048
 
 
 @functools.lru_cache(maxsize=64)
@@ -90,6 +93,21 @@ def _zero_centroids_cached(k: int, d: int, dtype_name: str):
     return jax.block_until_ready(jnp.zeros((k, d), dtype_name))
 
 
+def _stat_dtype(dtype):
+    """Accumulator/centroid dtype for a given points dtype.
+
+    Sub-f32 floats (bfloat16/float16) keep the POINTS low-precision — halving
+    the HBM stream the Lloyd step is bound by — but centroids, per-cluster
+    sums, counts, and the convergence shift stay float32: a bf16 count
+    saturates at 256 and a bf16 sum of ~n/k values has ~2 useful digits.
+    f32/f64 pass through unchanged (full-precision parity paths).
+    """
+    d = jnp.dtype(dtype)
+    if d in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+        return jnp.dtype(jnp.float32)
+    return d
+
+
 #: The pallas kernel's two (k_pad, tile) f32 VMEM blocks (distance +
 #: one-hot) must fit comfortably under the 16 MB scoped-VMEM limit:
 #: k_pad * tile <= 2^20 elements = 2 x 4 MB blocks.
@@ -104,7 +122,7 @@ def pallas_tile(k: int) -> int | None:
     the kernel beats the 131072-row matmul scan at config 3 (8.8 vs 6.9
     iter/s, k=1024) precisely by using its own much smaller tile."""
     k_pad = ((max(int(k), 8) + 127) // 128) * 128
-    for t in (PALLAS_TILE_ROWS, 2048, 1024, 512):
+    for t in (PALLAS_TILE_ROWS, 1024, 512):
         if k_pad * t <= _PALLAS_VMEM_ELEMS:
             return t
     return None
@@ -115,10 +133,10 @@ def resolve_update(update: str, nmodel: int = 1, dtype=np.float32,
     """Resolve the "auto" Lloyd assign+reduce strategy.
 
     "auto" -> "pallas" on a real TPU backend with an unsharded centroid
-    table, f32 data, and a k whose VMEM tile exists (the fastest measured
-    path: the fused feature-major VMEM kernel, 467 vs 139 iter/s for XLA
-    matmul on v5e at 1M x 32, k=128); "matmul" everywhere else (CPU tests
-    run the pallas kernel only in interpret mode, which is orders of
+    table, f32 or bf16 data, and a k whose VMEM tile exists (the fastest
+    measured path: the fused feature-major VMEM kernel, 467 vs 139 iter/s
+    for XLA matmul on v5e at 1M x 32, k=128); "matmul" everywhere else (CPU
+    tests run the pallas kernel only in interpret mode, which is orders of
     magnitude slower than XLA).  Explicitly requested strategies pass
     through untouched.
     """
@@ -128,7 +146,8 @@ def resolve_update(update: str, nmodel: int = 1, dtype=np.float32,
         on_tpu = jax.default_backend() == "tpu"
     except Exception:  # pragma: no cover
         on_tpu = False
-    if not (on_tpu and nmodel == 1 and np.dtype(dtype) == np.float32):
+    pallas_dtypes = (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
+    if not (on_tpu and nmodel == 1 and jnp.dtype(dtype) in pallas_dtypes):
         return "matmul"
     if k is not None and pallas_tile(k) is None:
         return "matmul"
@@ -362,12 +381,17 @@ def _weighted_cluster_stats(xc, wc, lab, k, update):
     a (k, n)x(n, d) matmul — MXU work, ~3x faster than scatter on TPU.
     ``scatter`` uses ``segment_sum`` — less memory (no (n, k) one-hot), and
     bit-identical to numpy's bincount ordering.
+
+    Stats accumulate in ``_stat_dtype`` (f32 for bf16 points): the MXU takes
+    bf16 inputs natively but a bf16 *sum* of ~n/k terms is unusable.
     """
+    acc = _stat_dtype(xc.dtype)
     if update == "matmul":
-        oh = jax.nn.one_hot(lab, k, dtype=xc.dtype) * wc[:, None]  # (n, k)
-        return oh.T @ xc, oh.sum(axis=0)
-    sums = jax.ops.segment_sum(xc * wc[:, None], lab, num_segments=k)
-    counts = jax.ops.segment_sum(wc, lab, num_segments=k)
+        oh = jax.nn.one_hot(lab, k, dtype=acc) * wc[:, None].astype(acc)
+        return jnp.dot(oh.T, xc, preferred_element_type=acc), oh.sum(axis=0)
+    sums = jax.ops.segment_sum(
+        xc.astype(acc) * wc[:, None].astype(acc), lab, num_segments=k)
+    counts = jax.ops.segment_sum(wc.astype(acc), lab, num_segments=k)
     return sums, counts
 
 
@@ -398,7 +422,8 @@ def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None,
         labels, sums, counts = lloyd_assign_reduce_pallas_t(
             x.T if xt is None else xt, c, nv,
             tile_cols=pallas_tile(k), with_labels=False)
-        return labels, sums.astype(x.dtype), counts.astype(x.dtype)
+        acc = _stat_dtype(x.dtype)
+        return labels, sums.astype(acc), counts.astype(acc)
 
     if chunk_rows is None:
         labels = assign_labels_jax(x, c)
@@ -419,16 +444,30 @@ def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None,
         s, cnt = _weighted_cluster_stats(xc, wc, lab, k, update)
         return (sums + s, counts + cnt), lab
 
+    acc = _stat_dtype(x.dtype)
     (sums, counts), labels = lax.scan(
         step,
-        (jnp.zeros((k, d), x.dtype), jnp.zeros((k,), x.dtype)),
+        (jnp.zeros((k, d), acc), jnp.zeros((k,), acc)),
         (xr, wr),
     )
     return labels.reshape(n_loc), sums, counts
 
 
-def _assign_only(x, c, chunk_rows):
-    """Labels for one shard without the stats reduction (post-loop pass)."""
+def _assign_only(x, c, chunk_rows, update="matmul", xt=None, k=None):
+    """Labels for one shard without the stats reduction (post-loop pass).
+
+    On the pallas path the labels ride the fused kernel too (first-min
+    tie-break, same as argmin): the XLA fallback materializes an
+    (chunk, k) distance block in HBM per scan step — at config 3 that one
+    epilogue pass costs as much as several fused Lloyd iterations.
+    """
+    if update == "pallas":
+        from .pallas_kernels import lloyd_assign_reduce_pallas_t
+
+        labels, _, _ = lloyd_assign_reduce_pallas_t(
+            x.T if xt is None else xt, c, n_valid=x.shape[0],
+            tile_cols=pallas_tile(k if k is not None else c.shape[0]))
+        return labels
     if chunk_rows is None:
         return assign_labels_jax(x, c)
     n_loc, d = x.shape
@@ -471,6 +510,9 @@ def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
         c, _, it, _ = carry
         _, sums, counts = _assign_reduce(x, w, c, k, chunk_rows, update,
                                          n_valid=n_valid, xt=xt)
+        return _update_step(c, sums, counts, it)
+
+    def _update_step(c, sums, counts, it):
         sums = lax.psum(sums, DATA_AXIS)
         counts = lax.psum(counts, DATA_AXIS)
         # Reseed key depends on the GLOBAL iteration index (iter_offset + it),
@@ -509,10 +551,18 @@ def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
         centroids,
         centroids,
         jnp.array(0, jnp.int32),
-        jnp.array(jnp.inf, x.dtype),
+        jnp.array(jnp.inf, centroids.dtype),
     )
-    c, c_prev, it, shift = lax.while_loop(cond, body, init)
-    labels = _assign_only(x, c_prev, chunk_rows)
+    if tol <= 0:
+        # Fixed iteration budget (tol disabled): a static-trip fori_loop —
+        # identical iteration count (shift >= 0 keeps the while cond true)
+        # but ~0.4 ms/iter cheaper on v5e, where the dynamic trip count
+        # blocks XLA's cross-iteration scheduling.
+        c, c_prev, it, shift = lax.fori_loop(
+            0, max_iter, lambda _, carry: body(carry), init)
+    else:
+        c, c_prev, it, shift = lax.while_loop(cond, body, init)
+    labels = _assign_only(x, c_prev, chunk_rows, update=update, xt=xt, k=k)
     return c, labels, it, shift
 
 
@@ -569,9 +619,10 @@ def _lloyd_local_2d(x, w, c_loc, key, iter_offset, *, k, n_valid, tol,
             s, cnt = _weighted_cluster_stats(xc, wc, lab, k, update)
             return (sums + s, counts + cnt), lab
 
+        acc = _stat_dtype(x.dtype)
         (sums, counts), labels = lax.scan(
             step,
-            (jnp.zeros((k, x.shape[1]), x.dtype), jnp.zeros((k,), x.dtype)),
+            (jnp.zeros((k, x.shape[1]), acc), jnp.zeros((k,), acc)),
             (xr, wr),
         )
         return labels.reshape(n_loc), sums, counts
@@ -623,9 +674,14 @@ def _lloyd_local_2d(x, w, c_loc, key, iter_offset, *, k, n_valid, tol,
         c_loc,
         c_loc,
         jnp.array(0, jnp.int32),
-        jnp.array(jnp.inf, x.dtype),
+        jnp.array(jnp.inf, c_loc.dtype),
     )
-    c_loc, c_prev, it, shift = lax.while_loop(cond, body, init)
+    if tol <= 0:
+        # Static-trip loop for a fixed iteration budget (see _lloyd_local).
+        c_loc, c_prev, it, shift = lax.fori_loop(
+            0, max_iter, lambda _, carry: body(carry), init)
+    else:
+        c_loc, c_prev, it, shift = lax.while_loop(cond, body, init)
     labels = assign_2d(c_prev)
     return c_loc, labels, it, shift
 
@@ -658,6 +714,9 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
                 per_round=init_per_round)
         else:
             centroids = _d2_init_local(x, w, init_key, k=k)
+        # Centroids iterate in the stat dtype (f32 for bf16 points): the init
+        # samples/averages in x's dtype, the Lloyd loop must not.
+        centroids = centroids.astype(_stat_dtype(x.dtype))
         if nmodel == 1:
             return _lloyd_local(
                 x, w, centroids, lloyd_key, iter_offset,
@@ -728,7 +787,7 @@ def kmeans_jax_full(
     if not is_device_array:
         X = np.asarray(X)
     if dtype is None:
-        dtype = X.dtype if np.issubdtype(np.dtype(X.dtype), np.floating) else np.float32
+        dtype = X.dtype if jnp.issubdtype(X.dtype, jnp.floating) else np.float32
     n, d = X.shape
     if k > n:
         raise ValueError(f"k={k} exceeds number of samples n={n}")
@@ -767,10 +826,12 @@ def kmeans_jax_full(
     with_init = init_centroids is not None
     # Keep device-resident init centroids on device (np.asarray here would be
     # a device->host fetch followed by a host->device upload, per call).
+    # Centroids live in the stat dtype (f32 for bf16 points, _stat_dtype).
+    cdtype = _stat_dtype(dtype)
     c0 = (
-        jnp.asarray(init_centroids, dtype=dtype)
+        jnp.asarray(init_centroids, dtype=cdtype)
         if with_init
-        else _zero_centroids(int(k), int(d), np.dtype(dtype).name)
+        else _zero_centroids(int(k), int(d), jnp.dtype(cdtype).name)
     )
     key = _device_key(0 if seed is None else int(seed))
 

@@ -156,7 +156,7 @@ def _build(n_rows, d, k, tile_rows, dtype_name, interpret):
     return fn
 
 
-def _kernel_t(nv_ref, xt_ref, c_ref, csq_ref, sums_ref, counts_ref,
+def _kernel_t(xt_ref, c_ref, csq_ref, sums_ref, counts_ref,
               labels_ref, *, k_pad, tile_cols):
     """Feature-major body: one (k_pad, TN) distance block per grid step.
 
@@ -167,7 +167,6 @@ def _kernel_t(nv_ref, xt_ref, c_ref, csq_ref, sums_ref, counts_ref,
     (M, K) @ (K, N) forms on the MXU.
     """
     i = pl.program_id(0)
-    n_valid = nv_ref[0, 0]
     xt = xt_ref[:]                     # (d, TN)
     c = c_ref[:]                       # (k_pad, d)
 
@@ -184,12 +183,12 @@ def _kernel_t(nv_ref, xt_ref, c_ref, csq_ref, sums_ref, counts_ref,
     if labels_ref is not None:
         labels_ref[:] = lab2.astype(jnp.int32)
 
-    # Validity mask from the global column index — no HBM traffic (an
-    # explicit (1, n) mask array would be sublane-padded 8x by XLA).
-    col0 = i * tile_cols
-    cols = jax.lax.broadcasted_iota(jnp.int32, (1, tile_cols), 1)
-    mask = ((col0 + cols) < n_valid).astype(xt.dtype)       # (1, TN)
-    oh = (rows2 == lab2).astype(xt.dtype) * mask            # (k_pad, TN)
+    # No validity mask: padded columns are REQUIRED to be zero vectors (the
+    # wrapper contract), so they add nothing to sums and all land on the one
+    # centroid argmin(csq) picks — the wrapper subtracts their count there.
+    # Dropping the iota/compare/multiply saves a full (k_pad, TN) VPU pass
+    # per tile (~5% of the kernel at k=1024 on v5e).
+    oh = (rows2 == lab2).astype(xt.dtype)                   # (k_pad, TN)
 
     s = jax.lax.dot_general(
         oh, xt,
@@ -211,9 +210,9 @@ def _kernel_t(nv_ref, xt_ref, c_ref, csq_ref, sums_ref, counts_ref,
         counts_ref[:] += cnt[:, None]
 
 
-def _kernel_t_no_labels(nv_ref, xt_ref, c_ref, csq_ref, sums_ref, counts_ref,
+def _kernel_t_no_labels(xt_ref, c_ref, csq_ref, sums_ref, counts_ref,
                         *, k_pad, tile_cols):
-    _kernel_t(nv_ref, xt_ref, c_ref, csq_ref, sums_ref, counts_ref, None,
+    _kernel_t(xt_ref, c_ref, csq_ref, sums_ref, counts_ref, None,
               k_pad=k_pad, tile_cols=tile_cols)
 
 
@@ -245,7 +244,6 @@ def _build_t(n_cols, d, k, tile_cols, dtype_name, interpret, with_labels):
         kern,
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((d, tile_cols), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((k_pad, d), lambda i: (0, 0),
@@ -268,10 +266,20 @@ def _build_t(n_cols, d, k, tile_cols, dtype_name, interpret, with_labels):
         c32 = c_p.astype(jnp.float32)
         c_sq = jnp.sum(c32 * c32, axis=1)
         c_sq = jnp.where(jax.lax.iota(jnp.int32, k_pad) < k, c_sq, big)
-        nv = jnp.asarray(n_valid, jnp.int32).reshape(1, 1)
-        out = call(nv, xt, c_p, c_sq[:, None])
+        out = call(xt, c_p, c_sq[:, None])
         labels = out[2][0] if with_labels else None
-        return labels, out[0][:k], out[1][:k, 0]
+        # Padded columns are zero vectors (wrapper contract): they add
+        # nothing to sums but all count toward the centroid nearest the
+        # origin — the kernel's first-min over csq, i.e. argmin(c_sq).
+        # Subtract them here instead of masking inside the kernel (a full
+        # (k_pad, TN) VPU pass per tile).
+        counts = out[1][:, 0]
+        j_pad = jnp.argmin(c_sq)
+        # Difference in int32 BEFORE the f32 cast: n_valid itself exceeds
+        # f32's 2^24 integer range on >16M-row shards; the pad count never.
+        counts = counts.at[j_pad].add(
+            (jnp.asarray(n_valid, jnp.int32) - n_cols).astype(jnp.float32))
+        return labels, out[0][:k], counts[:k]
 
     return fn
 
@@ -282,10 +290,14 @@ def lloyd_assign_reduce_pallas_t(xt, c, n_valid, tile_cols: int = 4096,
     """Feature-major fused assignment + (sums, counts).
 
     ``xt``: (d, n_cols) — the points matrix TRANSPOSED, n_cols % tile_cols
-    == 0 (zero-pad columns; they carry weight 0 via ``n_valid``).  ``c``:
-    (k, d).  Returns (labels (n_cols,) int32 or None, sums (k, d) f32,
-    counts (k,) f32) — identical semantics to ``lloyd_assign_reduce_pallas``
-    but reading x in its dense layout: for d < 128 the row-major (n, d)
+    == 0.  Columns past ``n_valid`` MUST be zero vectors (every caller
+    zero-pads): instead of masking them per tile — a full (k_pad, TN) VPU
+    pass — the wrapper subtracts their count from the origin-nearest
+    centroid they deterministically land on.  Their labels are produced
+    but meaningless (argmin of ||c||²).  ``c``: (k, d).  Returns (labels
+    (n_cols,) int32 or None, sums (k, d) f32, counts (k,) f32) — same
+    semantics as ``lloyd_assign_reduce_pallas`` on zero-padded input, but
+    reading x in its dense layout: for d < 128 the row-major (n, d)
     array is lane-padded 128/d x in HBM, which made the row-major kernel
     bandwidth-bound on padding bytes.
     """

@@ -1,0 +1,159 @@
+"""Mixed-precision (bfloat16 points) KMeans path.
+
+Contract (ops/kmeans_jax._stat_dtype): the POINTS may be bfloat16 — halving
+the HBM stream the Lloyd assignment is bandwidth-bound by — while centroids,
+per-cluster sums, counts, and the convergence shift stay float32 (a bf16
+count saturates at 256; a bf16 sum of ~n/k terms has ~2 useful digits).
+
+Replaces the reference's float64-everywhere Lloyd loop
+(src/kmeans_plusplus.py:24-50) with an accelerator-typed one; CPU runs the
+matmul path, the real chip runs the same contract through the fused Pallas
+kernel (tests/test_tpu_chip.py).
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import jax
+import jax.numpy as jnp
+
+from cdrs_tpu.ops.kmeans_jax import (
+    _stat_dtype,
+    _weighted_cluster_stats,
+    kmeans_jax_full,
+    resolve_update,
+)
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    rng = np.random.default_rng(11)
+    centers = rng.normal(size=(4, 8)) * 4.0
+    X = np.concatenate([rng.normal(size=(300, 8)) * 0.4 + c for c in centers])
+    return X.astype(np.float32)
+
+
+def test_stat_dtype_mapping():
+    assert _stat_dtype(jnp.bfloat16) == jnp.dtype(jnp.float32)
+    assert _stat_dtype(jnp.float16) == jnp.dtype(jnp.float32)
+    assert _stat_dtype(np.float32) == jnp.dtype(np.float32)
+    assert _stat_dtype(np.float64) == jnp.dtype(np.float64)
+
+
+def test_resolve_update_bf16(monkeypatch):
+    # CPU: auto never picks pallas, any dtype.
+    assert resolve_update("auto", dtype=jnp.bfloat16, k=128) == "matmul"
+    # TPU backend: bf16 rides the fused kernel like f32; f64 does not.
+    import cdrs_tpu.ops.kmeans_jax as kj
+    monkeypatch.setattr(kj.jax, "default_backend", lambda: "tpu")
+    assert kj.resolve_update("auto", dtype=jnp.bfloat16, k=128) == "pallas"
+    assert kj.resolve_update("auto", dtype=np.float32, k=128) == "pallas"
+    assert kj.resolve_update("auto", dtype=np.float64, k=128) == "matmul"
+
+
+@pytest.mark.parametrize("update", ["matmul", "scatter"])
+def test_bf16_stats_are_exact_f32(update):
+    """Counts past bf16's 256-integer ceiling stay exact — the stats
+    accumulate in f32 regardless of the points dtype."""
+    n, d, k = 4096, 4, 3   # ~1365 rows/cluster: a bf16 count would saturate
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.bfloat16)
+    lab = jnp.asarray(rng.integers(0, k, size=n), jnp.int32)
+    w = jnp.ones((n,), jnp.bfloat16)
+    sums, counts = jax.jit(
+        lambda xc, wc, l: _weighted_cluster_stats(xc, wc, l, k, update)
+    )(x, w, lab)
+    assert sums.dtype == jnp.float32
+    assert counts.dtype == jnp.float32
+    np.testing.assert_array_equal(
+        np.asarray(counts), np.bincount(np.asarray(lab), minlength=k))
+    ref = np.zeros((k, d), np.float32)
+    np.add.at(ref, np.asarray(lab), np.asarray(x, np.float32))
+    np.testing.assert_allclose(np.asarray(sums), ref, rtol=1e-5, atol=1e-3)
+
+
+@pytest.mark.parametrize("mesh", [None, {"data": 4}])
+def test_bf16_kmeans_near_parity_with_f32(blobs, mesh):
+    """bf16 points, f32 centroids: same clustering as the f32 run on
+    well-separated data (identical init; labels near-identical, centroids
+    within bf16 rounding of the f32 ones)."""
+    k = 4
+    init = blobs[:k]
+    c32, l32, it32, _ = kmeans_jax_full(
+        blobs, k, seed=0, init_centroids=init, mesh_shape=mesh,
+        dtype=np.float32)
+    cbf, lbf, itbf, shift = kmeans_jax_full(
+        blobs, k, seed=0, init_centroids=init, mesh_shape=mesh,
+        dtype=jnp.bfloat16)
+    assert cbf.dtype == jnp.float32        # centroids live in the stat dtype
+    # boundary points may flip under bf16 rounding (~0.5% on this workload)
+    assert (np.asarray(lbf) == np.asarray(l32)).mean() > 0.99
+    np.testing.assert_allclose(np.asarray(cbf), np.asarray(c32),
+                               rtol=2e-2, atol=2e-2)
+    assert np.isfinite(shift)
+
+
+def test_bf16_2d_mesh_chunked(blobs):
+    """bf16 on a (data, model) mesh with row chunking — the 2D scan carry
+    must accumulate in the stat dtype too (code-review regression)."""
+    k = 4
+    init = blobs[:k]
+    c32, l32, *_ = kmeans_jax_full(
+        blobs, k, seed=0, init_centroids=init,
+        mesh_shape={"data": 2, "model": 2}, chunk_rows=100,
+        dtype=np.float32)
+    cbf, lbf, *_ = kmeans_jax_full(
+        blobs, k, seed=0, init_centroids=init,
+        mesh_shape={"data": 2, "model": 2}, chunk_rows=100,
+        dtype=jnp.bfloat16)
+    assert cbf.dtype == jnp.float32
+    assert (np.asarray(lbf) == np.asarray(l32)).mean() > 0.99
+    np.testing.assert_allclose(np.asarray(cbf), np.asarray(c32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_bf16_dtype_inferred_from_device_array(blobs):
+    """A bf16 device array keeps its dtype when ``dtype`` is omitted (the
+    old np.issubdtype gate silently upcast bf16 to f32)."""
+    X = jnp.asarray(blobs, jnp.bfloat16)
+    c, lab, _, _ = kmeans_jax_full(X, 4, seed=0, init_centroids=blobs[:4])
+    assert c.dtype == jnp.float32
+    assert lab.shape == (blobs.shape[0],)
+
+
+def test_bf16_pallas_interpret_parity(blobs):
+    """The fused feature-major kernel under bf16 points (interpret mode):
+    counts exact, sums within bf16 rounding, labels matching an f32
+    recomputation from the same bf16-rounded inputs."""
+    from cdrs_tpu.ops.pallas_kernels import lloyd_assign_reduce_pallas_t
+
+    n, d = 1024, 8
+    k = 7
+    x = jnp.asarray(blobs[:n, :d], jnp.bfloat16)
+    c = jnp.asarray(np.asarray(blobs[:k, :d]), jnp.float32)
+    lab, sums, counts = lloyd_assign_reduce_pallas_t(
+        x.T, c, n_valid=n, interpret=True, tile_cols=512)
+
+    xf = np.asarray(x, np.float32)          # bf16-rounded values, f32 math
+    cf = np.asarray(c.astype(jnp.bfloat16), np.float32)  # kernel casts c
+    dist = (cf * cf).sum(1)[None, :] - 2.0 * (xf @ cf.T)
+    lab_ref = dist.argmin(1)
+    assert (np.asarray(lab) == lab_ref).mean() > 0.99
+    np.testing.assert_array_equal(
+        np.asarray(counts), np.bincount(lab_ref, minlength=k))
+    ref = np.zeros((k, d), np.float32)
+    np.add.at(ref, lab_ref, xf)
+    np.testing.assert_allclose(np.asarray(sums), ref, rtol=2e-2, atol=2e-2)
+
+
+def test_bench_config_dtype_override():
+    """run_bench(dtype=...) rewrites the config and records the dtype."""
+    from cdrs_tpu.benchmarks.harness import run_bench
+
+    out = run_bench(config=1, backend="jax", dtype="bfloat16", quality=False)
+    assert out["dtype"] == "bfloat16"
+    assert out["value"] > 0
+    with pytest.raises(ValueError):
+        run_bench(config=1, backend="numpy", dtype="bfloat16", quality=False)
