@@ -114,6 +114,12 @@ class ReplicationPolicyModel:
             )
         if cfg.batch_size is not None:
             return self._cluster_minibatch(X, init_centroids)
+        if cfg.dtype == "float64":
+            import jax
+            if not jax.config.jax_enable_x64:
+                raise ValueError(
+                    "dtype='float64' needs JAX_ENABLE_X64=1; without it jax "
+                    "silently computes in float32")
         from ..ops.kmeans_jax import kmeans_jax
 
         centroids, labels = kmeans_jax(

@@ -198,13 +198,16 @@ def _sq_dist_to_row(x: jnp.ndarray, x_sq: jnp.ndarray, row: jnp.ndarray) -> jnp.
 # ---------------------------------------------------------------------------
 
 
-def _pick_row_global(x: jnp.ndarray, scores: jnp.ndarray) -> jnp.ndarray:
+def _pick_row_global(x: jnp.ndarray, scores: jnp.ndarray,
+                     sharded: bool = True) -> jnp.ndarray:
     """Row of the global argmax of ``scores`` across all shards.
 
     Cross-shard argmax: pmax of the local max, deterministic tie-break by the
     lowest device rank, then a psum-select of the winning row — communicates
-    O(d), never gathers points.
+    O(d), never gathers points.  Unsharded: a plain argmax gather.
     """
+    if not sharded:
+        return x[jnp.argmax(scores)]
     rank = lax.axis_index(DATA_AXIS)
     ndev = lax.axis_size(DATA_AXIS)
     local_max = jnp.max(scores)
@@ -216,7 +219,7 @@ def _pick_row_global(x: jnp.ndarray, scores: jnp.ndarray) -> jnp.ndarray:
     return lax.psum(row, DATA_AXIS)
 
 
-def _d2_init_local(x, w, key, *, k):
+def _d2_init_local(x, w, key, *, k, sharded=True):
     """KMeans++ D² sampling, shard-local view (x: (n_loc, d) shard).
 
     Gumbel-max: argmax(log p_i + G_i) is a categorical draw ∝ p_i, and argmax
@@ -225,7 +228,7 @@ def _d2_init_local(x, w, key, *, k):
     Degenerate rounds (all residual distances 0) fall back to a uniform draw
     (reference: kmeans_np.kmeans_plusplus_init fallback).
     """
-    rank = lax.axis_index(DATA_AXIS)
+    rank = lax.axis_index(DATA_AXIS) if sharded else jnp.int32(0)
     d = x.shape[1]
     x_sq = jnp.sum(x * x, axis=1)
     neg_inf = jnp.array(-jnp.inf, x.dtype)
@@ -233,7 +236,8 @@ def _d2_init_local(x, w, key, *, k):
     def sample(round_idx, logits):
         noise_key = jax.random.fold_in(jax.random.fold_in(key, round_idx), rank)
         g = jax.random.gumbel(noise_key, logits.shape, x.dtype)
-        return _pick_row_global(x, jnp.where(w > 0, logits + g, neg_inf))
+        return _pick_row_global(x, jnp.where(w > 0, logits + g, neg_inf),
+                                sharded)
 
     # Round 0: uniform over valid points (reference kmeans_plusplus.py:9-10).
     c0 = sample(0, jnp.zeros_like(x_sq))
@@ -242,7 +246,9 @@ def _d2_init_local(x, w, key, *, k):
 
     def round_body(i, carry):
         centroids, min_sq = carry
-        total = lax.psum(jnp.sum(min_sq * w), DATA_AXIS)
+        total = jnp.sum(min_sq * w)
+        if sharded:
+            total = lax.psum(total, DATA_AXIS)
         # p_i ∝ min_sq_i  ⇒  logits = log(min_sq); log(0) = -inf is exactly
         # "probability zero".  All-zero residuals ⇒ uniform fallback.
         logits = jnp.where(total > 0, jnp.log(min_sq), jnp.zeros_like(min_sq))
@@ -298,6 +304,9 @@ def _weighted_kmeanspp(c, wts, key, k):
 def _weighted_lloyd_small(c, wts, cent, iters):
     """A few weighted Lloyd iterations on the candidate set (replicated)."""
     k = cent.shape[0]
+    # Carry in the stat dtype: wts are f32 for bf16 candidates, so the
+    # updated centroids promote — the loop carry must match from iter 0.
+    cent = cent.astype(_stat_dtype(c.dtype))
 
     def body(_, cent):
         lab = assign_labels_jax(c, cent)
@@ -310,7 +319,7 @@ def _weighted_lloyd_small(c, wts, cent, iters):
 
 
 def _kmeans_par_init_local(x, w, key, *, k, rounds, per_round,
-                           cand_lloyd_iters=10):
+                           cand_lloyd_iters=10, sharded=True):
     """k-means|| init, shard-local view — O(rounds) passes instead of k.
 
     The reference's D² init is inherently sequential in k (1024 rounds at the
@@ -325,7 +334,7 @@ def _kmeans_par_init_local(x, w, key, *, k, rounds, per_round,
     are then weighted by an assignment count pass and reduced to k with a
     replicated weighted D² + a few weighted Lloyd steps (Bahmani §3.3).
     """
-    rank = lax.axis_index(DATA_AXIS)
+    rank = lax.axis_index(DATA_AXIS) if sharded else jnp.int32(0)
     n_loc, d = x.shape
     x_sq = jnp.sum(x * x, axis=1)
     neg_inf = jnp.array(-jnp.inf, x.dtype)
@@ -337,7 +346,7 @@ def _kmeans_par_init_local(x, w, key, *, k, rounds, per_round,
     g0 = jax.random.gumbel(
         jax.random.fold_in(jax.random.fold_in(key_rounds, 0), rank),
         (n_loc,), x.dtype)
-    c0 = _pick_row_global(x, jnp.where(w > 0, g0, neg_inf))
+    c0 = _pick_row_global(x, jnp.where(w > 0, g0, neg_inf), sharded)
     cands = jnp.zeros((n_cand, d), x.dtype).at[0].set(c0)
     min_sq = _sq_dist_to_row(x, x_sq, c0)
 
@@ -346,17 +355,22 @@ def _kmeans_par_init_local(x, w, key, *, k, rounds, per_round,
         noise_key = jax.random.fold_in(
             jax.random.fold_in(key_rounds, r + 1), rank)
         g = jax.random.gumbel(noise_key, (n_loc,), x.dtype)
-        total = lax.psum(jnp.sum(min_sq * w), DATA_AXIS)
+        total = jnp.sum(min_sq * w)
+        if sharded:
+            total = lax.psum(total, DATA_AXIS)
         logits = jnp.where(total > 0,
                            jnp.log(jnp.maximum(min_sq, 1e-38)),
                            jnp.zeros_like(min_sq))
         scores = jnp.where(w > 0, logits + g, neg_inf)
         vals, idx = lax.top_k(scores, per_round)          # local top-m
         rows = x[idx]                                     # (m, d)
-        all_vals = lax.all_gather(vals, DATA_AXIS).reshape(-1)
-        all_rows = lax.all_gather(rows, DATA_AXIS).reshape(-1, d)
-        _, gsel = lax.top_k(all_vals, per_round)          # global top-m
-        new_rows = all_rows[gsel]                         # replicated (m, d)
+        if sharded:
+            all_vals = lax.all_gather(vals, DATA_AXIS).reshape(-1)
+            all_rows = lax.all_gather(rows, DATA_AXIS).reshape(-1, d)
+            _, gsel = lax.top_k(all_vals, per_round)      # global top-m
+            new_rows = all_rows[gsel]                     # replicated (m, d)
+        else:
+            new_rows = rows                               # local IS global
         cands = lax.dynamic_update_slice(cands, new_rows,
                                          (1 + r * per_round, 0))
         d2new = jnp.maximum(
@@ -367,8 +381,13 @@ def _kmeans_par_init_local(x, w, key, *, k, rounds, per_round,
     cands, _ = lax.fori_loop(0, rounds, round_body, (cands, min_sq))
 
     # Weight candidates by how many points they own (one assignment pass).
+    # Counts accumulate in the stat dtype — a bf16 sum of ones stalls at 256
+    # (same contract as _weighted_cluster_stats).
     lab = assign_labels_jax(x, cands)
-    wts = lax.psum(jax.ops.segment_sum(w, lab, num_segments=n_cand), DATA_AXIS)
+    wts = jax.ops.segment_sum(w.astype(_stat_dtype(w.dtype)), lab,
+                              num_segments=n_cand)
+    if sharded:
+        wts = lax.psum(wts, DATA_AXIS)
 
     cent = _weighted_kmeanspp(cands, wts, key_reduce, k)
     return _weighted_lloyd_small(cands, wts, cent, cand_lloyd_iters)
@@ -396,7 +415,7 @@ def _weighted_cluster_stats(xc, wc, lab, k, update):
 
 
 def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None,
-                   xt=None):
+                   xt=None, sharded=True):
     """Fused assignment + per-cluster (sum, count) reduction for one shard.
 
     ``chunk_rows=None`` materializes the full (n_loc, k) distance block — fast
@@ -417,8 +436,8 @@ def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None,
         from .pallas_kernels import lloyd_assign_reduce_pallas_t
 
         n_loc = x.shape[0]
-        nv = jnp.clip(n_valid - lax.axis_index(DATA_AXIS) * n_loc, 0, n_loc
-                      ).astype(jnp.int32)
+        row0 = lax.axis_index(DATA_AXIS) * n_loc if sharded else 0
+        nv = jnp.clip(n_valid - row0, 0, n_loc).astype(jnp.int32)
         labels, sums, counts = lloyd_assign_reduce_pallas_t(
             x.T if xt is None else xt, c, nv,
             tile_cols=pallas_tile(k), with_labels=False)
@@ -483,7 +502,7 @@ def _assign_only(x, c, chunk_rows, update="matmul", xt=None, k=None):
 
 
 def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
-                 max_iter, chunk_rows=None, update="matmul"):
+                 max_iter, chunk_rows=None, update="matmul", sharded=True):
     """Lloyd loop, shard-local view.  Returns (centroids, labels, iters, shift).
 
     Labels are the assignment against the centroids *before* the final update
@@ -494,8 +513,7 @@ def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
     v5e: 24 ms vs 7 ms per iteration at n=1M, k=128).
     """
     n_loc = x.shape[0]
-    rank = lax.axis_index(DATA_AXIS)
-    offset = rank * n_loc
+    offset = lax.axis_index(DATA_AXIS) * n_loc if sharded else 0
     # Feature-major copy for the pallas kernel, materialized once before the
     # loop (loop-invariant closure): for d < 128 the row-major (n, d) layout
     # is lane-padded to 128 in HBM, so reading it costs 128/d x the logical
@@ -509,12 +527,14 @@ def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
     def body(carry):
         c, _, it, _ = carry
         _, sums, counts = _assign_reduce(x, w, c, k, chunk_rows, update,
-                                         n_valid=n_valid, xt=xt)
+                                         n_valid=n_valid, xt=xt,
+                                         sharded=sharded)
         return _update_step(c, sums, counts, it)
 
     def _update_step(c, sums, counts, it):
-        sums = lax.psum(sums, DATA_AXIS)
-        counts = lax.psum(counts, DATA_AXIS)
+        if sharded:
+            sums = lax.psum(sums, DATA_AXIS)
+            counts = lax.psum(counts, DATA_AXIS)
         # Reseed key depends on the GLOBAL iteration index (iter_offset + it),
         # not on a per-call split chain — blocked/checkpointed runs draw the
         # same stream as uninterrupted ones (utils/checkpoint.py).
@@ -530,10 +550,10 @@ def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
             reseed_idx = jax.random.randint(sub, (k,), 0, n_valid)
             rel = reseed_idx - offset
             owned = (rel >= 0) & (rel < n_loc)
-            cand = lax.psum(
-                jnp.where(owned[:, None], x[jnp.clip(rel, 0, n_loc - 1)], 0.0),
-                DATA_AXIS,
-            )
+            cand = jnp.where(owned[:, None],
+                             x[jnp.clip(rel, 0, n_loc - 1)], 0.0)
+            if sharded:
+                cand = lax.psum(cand, DATA_AXIS)
             return jnp.where(
                 counts[:, None] > 0,
                 sums / jnp.maximum(counts, 1.0)[:, None],
@@ -696,11 +716,16 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
                   dtype_name, chunk_rows=None, update="matmul",
                   init_method="d2", init_rounds=5, init_per_round=0):
     """Compile the full sharded kmeans for one (shape, mesh, config) point."""
-    mesh = make_mesh(n_data=ndata, n_model=nmodel)
     k_loc = k // nmodel
+    # Single-device bypass: a 1x1 mesh still pays shard_map's collective
+    # plumbing (~0.9 ms/iter at config 2 on v5e — the raw fused kernel runs
+    # 1.10 ms).  The same local body runs under plain jit with the
+    # collectives compiled out; identical PRNG streams (rank folds in 0
+    # either way).  Precedent: the streaming fold's one-device bypass.
+    sharded = ndata > 1 or nmodel > 1
 
     def local_fn(x, c0, key, iter_offset):
-        w = prefix_mask(x, n_valid)
+        w = prefix_mask(x, n_valid, sharded=sharded)
         # Split once: the init stream folds in round indices [0, k) and the
         # Lloyd stream folds in global iteration indices — a single fold_in
         # domain would collide for k > the fold constant (the round-269
@@ -711,9 +736,9 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
         elif init_method == "kmeans||":
             centroids = _kmeans_par_init_local(
                 x, w, init_key, k=k, rounds=init_rounds,
-                per_round=init_per_round)
+                per_round=init_per_round, sharded=sharded)
         else:
-            centroids = _d2_init_local(x, w, init_key, k=k)
+            centroids = _d2_init_local(x, w, init_key, k=k, sharded=sharded)
         # Centroids iterate in the stat dtype (f32 for bf16 points): the init
         # samples/averages in x's dtype, the Lloyd loop must not.
         centroids = centroids.astype(_stat_dtype(x.dtype))
@@ -721,7 +746,7 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
             return _lloyd_local(
                 x, w, centroids, lloyd_key, iter_offset,
                 k=k, n_valid=n_valid, tol=tol, max_iter=max_iter,
-                chunk_rows=chunk_rows, update=update,
+                chunk_rows=chunk_rows, update=update, sharded=sharded,
             )
         c_loc = lax.dynamic_slice_in_dim(
             centroids, lax.axis_index(MODEL_AXIS) * k_loc, k_loc
@@ -732,18 +757,21 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
             chunk_rows=chunk_rows, update=update,
         )
 
+    if not sharded:
+        return jax.jit(local_fn)
+    mesh = make_mesh(n_data=ndata, n_model=nmodel)
     if nmodel == 1:
         c_spec = P()
     else:
         c_spec = P(MODEL_AXIS, None)
-    sharded = jax.shard_map(
+    mapped = jax.shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(), P(), P()),
         out_specs=(c_spec, P(DATA_AXIS), P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded)
+    return jax.jit(mapped)
 
 
 def kmeans_jax_full(
@@ -815,6 +843,15 @@ def kmeans_jax_full(
         rem = (-Xp.shape[0]) % multiple
         if rem:
             Xp = jnp.pad(Xp, ((0, rem), (0, 0)))
+        if update == "pallas" and n_valid < Xp.shape[0]:
+            # The fused kernel's contract requires the padded tail to be
+            # zero vectors (its wrapper corrects counts instead of masking
+            # per tile).  Our own jnp.pad above guarantees that, but rows a
+            # CALLER pre-padded may hold anything — zero them once here
+            # (one O(n) pass per call, not per iteration).
+            Xp = jnp.where(
+                jnp.arange(Xp.shape[0])[:, None] < n_valid, Xp,
+                jnp.zeros((), Xp.dtype))
     else:
         if n_valid is not None and n_valid != n:
             raise ValueError("n_valid is only for pre-padded device arrays")

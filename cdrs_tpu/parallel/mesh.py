@@ -70,15 +70,16 @@ def pad_rows(x: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:
     return np.pad(x, pad_width), n
 
 
-def prefix_mask(x, n_valid: int):
+def prefix_mask(x, n_valid: int, sharded: bool = True):
     """Shard-local validity mask (valid rows are a global prefix).
 
     Built in-program from the static count so no O(n) mask array crosses the
-    host boundary.  For use inside ``shard_map`` bodies sharded over DATA_AXIS.
+    host boundary.  For use inside ``shard_map`` bodies sharded over
+    DATA_AXIS; ``sharded=False`` for the single-device bypass (no axis).
     """
     import jax.numpy as jnp
     from jax import lax
 
     n_loc = x.shape[0]
-    row0 = lax.axis_index(DATA_AXIS) * n_loc
+    row0 = lax.axis_index(DATA_AXIS) * n_loc if sharded else 0
     return ((row0 + jnp.arange(n_loc)) < n_valid).astype(x.dtype)

@@ -148,6 +148,58 @@ def test_bf16_pallas_interpret_parity(blobs):
     np.testing.assert_allclose(np.asarray(sums), ref, rtol=2e-2, atol=2e-2)
 
 
+def test_prepadded_garbage_tail_zeroed_on_pallas_path(blobs):
+    """A caller-pre-padded device array with a NON-zero tail must not leak
+    into pallas stats — kmeans_jax_full zeroes the tail in-program
+    (code-review regression: the kernel no longer masks columns)."""
+    k = 4
+    n_valid = blobs.shape[0]
+    pad = 2048 - (n_valid % 2048)
+    garbage = np.full((pad, blobs.shape[1]), 1e6, np.float32)
+    Xpad = jnp.asarray(np.concatenate([blobs, garbage]))
+    init = blobs[:k]
+    c_ref, l_ref, *_ = kmeans_jax_full(
+        blobs, k, seed=0, init_centroids=init, update="matmul")
+    c_pal, l_pal, *_ = kmeans_jax_full(
+        Xpad, k, seed=0, init_centroids=init, update="pallas",
+        n_valid=n_valid)
+    np.testing.assert_allclose(np.asarray(c_pal), np.asarray(c_ref),
+                               rtol=1e-4, atol=1e-4)
+    assert (np.asarray(l_pal)[:n_valid] == np.asarray(l_ref)).mean() > 0.999
+
+
+def test_bf16_kmeans_par_init_runs(blobs):
+    """kmeans|| with bf16 points: candidate weights accumulate in f32
+    (code-review regression — a bf16 sum of ones stalls at 256)."""
+    from cdrs_tpu.ops.kmeans_jax import _stat_dtype as sd
+    c, lab, it, _ = kmeans_jax_full(
+        jnp.asarray(blobs, jnp.bfloat16), 4, seed=3, max_iter=10,
+        init_method="kmeans||")
+    assert c.dtype == jnp.float32
+    counts = np.bincount(np.asarray(lab), minlength=4)
+    assert counts.sum() == blobs.shape[0]
+
+
+def test_float64_requires_x64():
+    """Explicit float64 without x64 must error, not silently run f32."""
+    import jax
+    from cdrs_tpu.benchmarks.harness import run_bench
+    from cdrs_tpu.config import KMeansConfig
+    from cdrs_tpu.models.replication import ReplicationPolicyModel
+
+    old = jax.config.jax_enable_x64
+    try:
+        jax.config.update("jax_enable_x64", False)
+        with pytest.raises(ValueError, match="JAX_ENABLE_X64"):
+            run_bench(config=1, backend="jax", dtype="float64", quality=False)
+        m = ReplicationPolicyModel(
+            kmeans_cfg=KMeansConfig(k=2, dtype="float64"), backend="jax")
+        with pytest.raises(ValueError, match="JAX_ENABLE_X64"):
+            m.cluster(np.ones((10, 3), np.float32))
+    finally:
+        jax.config.update("jax_enable_x64", old)
+
+
 def test_bench_config_dtype_override():
     """run_bench(dtype=...) rewrites the config and records the dtype."""
     from cdrs_tpu.benchmarks.harness import run_bench
