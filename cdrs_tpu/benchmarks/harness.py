@@ -40,6 +40,12 @@ class BenchConfig:
     #: Lloyd assign+reduce strategy: "auto" | "matmul" | "scatter" | "pallas"
     #: (ops/kmeans_jax._assign_reduce; "auto" = pallas on TPU, matmul else).
     update: str = "auto"
+    #: Lloyd budget for the --e2e time-to-categories run.  Decoupled from
+    #: ``iters``: the iter/s metric wants windows long enough to amortize
+    #: the tunnel's fixed per-call latency (thousands), while e2e is a
+    #: one-shot wall-clock workload whose definition must stay stable
+    #: across rounds.  None = use ``iters``.
+    e2e_iters: int | None = None
     # numpy baseline is measured directly when n <= direct_np_limit, else on a
     # row subsample and extrapolated linearly in n (documented estimate).
     direct_np_limit: int = 2_000_000
@@ -55,11 +61,13 @@ CONFIGS: dict[int, BenchConfig] = {
     # convergence (100/300/1000/3000 iters: 1.19/0.86/0.62/0.55 ms/iter)
     # shows the fixed cost must be amortized below the percent level for
     # the metric to be the chip's rate rather than the tunnel's.
-    2: BenchConfig(n=1_048_576, d=32, k=128, backend="jax", iters=2000),
+    2: BenchConfig(n=1_048_576, d=32, k=128, backend="jax", iters=2000,
+                   e2e_iters=100),
     3: BenchConfig(n=10_485_760, d=128, k=1024, backend="jax", iters=50,
-                   chunk_rows=131_072),
-    4: BenchConfig(n=104_857_600, d=128, k=1024, backend="jax", iters=5,
-                   chunk_rows=131_072, mesh_shape=(("data", 8),)),
+                   chunk_rows=131_072, e2e_iters=5),
+    4: BenchConfig(n=104_857_600, d=128, k=1024, backend="jax", iters=50,
+                   chunk_rows=131_072, mesh_shape=(("data", 8),),
+                   e2e_iters=5),
     # 5 = streaming: n is the file population, iters the number of event
     # batches; see _bench_streaming (events/sec is the metric).
     5: BenchConfig(n=1_048_576, d=32, k=128, backend="jax", iters=10),
@@ -154,6 +162,11 @@ def _bench_streaming(cfg: BenchConfig, seed: int,
                 jnp.asarray(pb.flags))
 
     dev_batches = [dev_args(pb) for pb in prepped]
+    # Force the staged host->device transfers to complete before the timed
+    # loop: jnp.asarray is async, and on the tunnel backend a deferred ~5 MB
+    # upload per batch would otherwise land inside the measurement (the
+    # metric is the device fold rate; transfer-bound e2e is the 1B scenario).
+    jax.block_until_ready(dev_batches)
 
     # warmup + timed pass
     st = dev_state()
@@ -210,7 +223,7 @@ def _bench_e2e(cfg: BenchConfig, config_num: int, seed: int,
     measurable stand-in for BASELINE config 4's "<60 s end-to-end").
 
     The feature matrix is synthesized on device (sharded over the mesh),
-    clustered for exactly ``cfg.iters`` Lloyd iterations from a D² init, and
+    clustered for exactly ``cfg.e2e_iters`` Lloyd iterations from a D² init, and
     classified with data-sharded histogram medians; the clock stops when the
     per-cluster categories land on host.  The numpy baseline runs the same
     pipeline (same iteration budget, exact medians) on a row subsample and
@@ -223,6 +236,7 @@ def _bench_e2e(cfg: BenchConfig, config_num: int, seed: int,
     from ..ops.scoring_jax import classify_jax
 
     n, d, k = cfg.n, cfg.d, cfg.k
+    e2e_iters = cfg.e2e_iters if cfg.e2e_iters is not None else cfg.iters
     X = _synth_blobs_device(n, d, min(k, 64), seed, cfg.dtype, mesh_shape)
     X = jax.block_until_ready(X)
     # Scoring tables spanning the synthetic d features (the pipeline's real
@@ -241,7 +255,7 @@ def _bench_e2e(cfg: BenchConfig, config_num: int, seed: int,
     def run_once(init_method):
         t0 = time.perf_counter()
         centroids, labels, it, _ = kmeans_jax_full(
-            X, k, tol=0.0, seed=seed, max_iter=cfg.iters,
+            X, k, tol=0.0, seed=seed, max_iter=e2e_iters,
             mesh_shape=mesh_shape, dtype=np.dtype(cfg.dtype),
             chunk_rows=cfg.chunk_rows, update=update,
             init_method=init_method)
@@ -271,16 +285,16 @@ def _bench_e2e(cfg: BenchConfig, config_num: int, seed: int,
     c = _init_from_rows(Xs, k, seed)
     t0 = time.perf_counter()
     labels_np = None
-    for _ in range(max(1, min(2, cfg.iters))):
+    for _ in range(max(1, min(2, e2e_iters))):
         c, labels_np, _ = lloyd_step(Xs, c, rng)
-    per_iter = (time.perf_counter() - t0) / max(1, min(2, cfg.iters))
+    per_iter = (time.perf_counter() - t0) / max(1, min(2, e2e_iters))
     import dataclasses
 
     t0 = time.perf_counter()
     classify_np(Xs, labels_np, k,
                 dataclasses.replace(scoring, median_method="sort"))
     np_score = time.perf_counter() - t0
-    np_secs = (per_iter * cfg.iters + np_score) * (n / n_sub)
+    np_secs = (per_iter * e2e_iters + np_score) * (n / n_sub)
 
     return {
         "config": int(config_num),
@@ -297,6 +311,7 @@ def _bench_e2e(cfg: BenchConfig, config_num: int, seed: int,
         "numpy_seconds_estimated": np_secs,
         "backend": "jax",
         "update": update,
+        "dtype": cfg.dtype,
         "mesh": dict(mesh_shape or {}),
         "jax_devices": len(jax.devices()),
         "jax_platform": jax.devices()[0].platform,
@@ -412,8 +427,9 @@ def _time_jax_lloyd(X, k: int, init: np.ndarray, iters: int,
     returns (best window sec/iter, all window sec/iter).  Best-of-N because
     the noise on a remote-tunnel backend (dispatch jitter, competing tunnel
     traffic) is strictly additive — the fastest window is the closest
-    observation of the chip's actual rate (BENCH_r03 recorded 288 iter/s on
-    a single window of a kernel that repeatedly measures 368-467).
+    observation of the chip's actual rate.  ``iters`` must be large enough
+    to amortize the tunnel's fixed ~60-100 ms per-call latency (see the
+    CONFIGS comment); with long windows the spread collapses to ~±2%.
     """
     import jax
 

@@ -200,6 +200,28 @@ def test_float64_requires_x64():
         jax.config.update("jax_enable_x64", old)
 
 
+def test_pipeline_bf16_dtype_flows_through():
+    """PipelineConfig -> model -> kmeans: dtype='bfloat16' produces a valid
+    decision on the jax backend (sharded mesh), same category count."""
+    from cdrs_tpu.config import (GeneratorConfig, KMeansConfig,
+                                 PipelineConfig, ScoringConfig,
+                                 SimulatorConfig)
+    from cdrs_tpu.pipeline import run_pipeline
+
+    cfg = PipelineConfig(
+        backend="jax",
+        generator=GeneratorConfig(n_files=150, seed=5),
+        simulator=SimulatorConfig(duration_seconds=60, seed=6),
+        kmeans=KMeansConfig(k=4, seed=0, dtype="bfloat16"),
+        scoring=ScoringConfig(compute_global_medians_from_data=True),
+        mesh_shape={"data": 2},
+    )
+    res = run_pipeline(cfg)
+    assert res.decision.labels.shape == (150,)
+    assert res.decision.centroids.dtype == np.float32
+    assert len(res.decision.categories) == 4
+
+
 def test_bench_config_dtype_override():
     """run_bench(dtype=...) rewrites the config and records the dtype."""
     from cdrs_tpu.benchmarks.harness import run_bench
