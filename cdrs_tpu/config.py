@@ -109,8 +109,10 @@ class ScoringConfig:
     #: from the dataset (fixing reference quirk SURVEY.md §6.1.5).
     compute_global_medians_from_data: bool = False
     #: Per-cluster median strategy for the jax backend: "sort" (exact),
-    #: "hist" (O(n) fixed-bin histogram for very large n), or "auto"
-    #: (hist past ops/scoring_jax.HIST_MEDIAN_THRESHOLD rows).
+    #: "hist" (O(n) fixed-bin histogram), "bisect" (scatter-free MXU rank
+    #: bisection — the fast path on TPU at very large n), or "auto"
+    #: (past ops/scoring_jax.HIST_MEDIAN_THRESHOLD rows: bisect on a real
+    #: TPU backend, hist elsewhere).
     median_method: str = "auto"
     #: Histogram resolution for the "hist" strategy (error <= range/bins).
     median_bins: int = 2048
@@ -316,9 +318,9 @@ def scoring_config_from_dict(d: Mapping) -> ScoringConfig:
     cfg = ScoringConfig(**kwargs)
     # Validate enum-ish fields here rather than deep inside a backend kernel
     # (an invalid value like "histo" would otherwise only surface mid-run).
-    if cfg.median_method not in ("auto", "sort", "hist"):
+    if cfg.median_method not in ("auto", "sort", "hist", "bisect"):
         raise ValueError(
-            f"median_method must be 'auto', 'sort', or 'hist'; "
+            f"median_method must be 'auto', 'sort', 'hist', or 'bisect'; "
             f"got {cfg.median_method!r}")
     if int(cfg.median_bins) < 2:
         raise ValueError(f"median_bins must be >= 2, got {cfg.median_bins}")

@@ -146,6 +146,130 @@ def compute_cluster_medians_hist_jax(
     return _hist_medians(x, labels, k, bins, False)[0]
 
 
+#: Rows per chunk of the bisection median scan — bounds the (chunk, 2d)
+#: comparison buffer (bf16) so the pass never materializes an O(n·d) y.
+_BISECT_CHUNK = 1 << 20
+
+
+@functools.partial(jax.jit, static_argnames=("k", "bins", "with_global"))
+def _bisect_medians(x, labels, k: int, bins: int, with_global: bool):
+    """Per-cluster (k, d) + optionally global (d,) medians by parallel
+    bisection — scatter-free.
+
+    The histogram path costs one ``segment_sum`` scatter PER ELEMENT per
+    feature (~7 ns each on v5e: 9.2 s at 10M x 128, k=1024).  Bisection
+    reframes the median as ceil(log2(bins)) rank queries answered on the
+    MXU: per iteration, per (cluster, feature) thresholds are gathered per
+    row, compared (one fused pass over x), and counted with the one-hot
+    label matmul (ops/pallas_kernels.label_segment_matmul — the Lloyd
+    update structure with y = the 0/1 comparison matrix).  ~0.9 s for the
+    same workload.  Error <= feature_range / 2^iters with iters =
+    ceil(log2(bins)) + 1 — at the default bins=2048 that is half the
+    histogram path's bin width (and the hist path adds in-bin
+    interpolation error on top).
+
+    Both middle ranks (r0 = (cnt-1)//2, r1 = cnt//2) bisect simultaneously
+    (stacked along the feature axis); the result averages them — the same
+    even-count contract as the sort and hist kernels.  NaN rows for empty
+    clusters; constant columns are exact.
+    """
+    from .pallas_kernels import label_segment_matmul, seg_tile
+
+    n, d = x.shape
+    ftype = x.dtype
+    iters = max(8, int(np.ceil(np.log2(max(bins, 2)))) + 1)
+
+    counts = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.int32), labels, num_segments=k)      # (k,)
+    lo_f = x.min(axis=0)
+    hi_f = x.max(axis=0)
+
+    # Pad rows to the chunk grid; padded labels -1 never match a one-hot
+    # column, and the global counts only sum real chunks' rows via the mask.
+    chunk = min(_BISECT_CHUNK, 1 << 14) if not pallas_is_tpu() else _BISECT_CHUNK
+    tile = seg_tile(k)
+    chunk = max(tile, (chunk // tile) * tile)
+    n_pad = int(np.ceil(n / chunk)) * chunk
+    if n_pad != n:
+        x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
+        labels = jnp.pad(labels, (0, n_pad - n), constant_values=-1)
+    nch = n_pad // chunk
+    xr = x.reshape(nch, chunk, d)
+    labr = labels.reshape(nch, chunk)
+    validr = (jnp.arange(n_pad).reshape(nch, chunk) < n)
+
+    # Ranks: value at 0-indexed rank r is the smallest v with
+    # count(x <= v) >= r + 1.
+    r0 = ((counts - 1) // 2 + 1).astype(jnp.int32)   # target count, rank lo
+    r1 = (counts // 2 + 1).astype(jnp.int32)         # target count, rank hi
+    targets = jnp.stack([r0, r1])                     # (2, k)
+    g_targets = jnp.asarray([(n - 1) // 2 + 1, n // 2 + 1], jnp.int32)
+
+    lo = jnp.broadcast_to(lo_f, (2, k, d)).astype(jnp.float32)
+    hi = jnp.broadcast_to(hi_f, (2, k, d)).astype(jnp.float32)
+    glo = jnp.broadcast_to(lo_f, (2, d)).astype(jnp.float32)
+    ghi = jnp.broadcast_to(hi_f, (2, d)).astype(jnp.float32)
+
+    def body(_, carry):
+        lo, hi, glo, ghi = carry
+        thr = 0.5 * (lo + hi)                         # (2, k, d)
+        gthr = 0.5 * (glo + ghi)                      # (2, d)
+        thr_cat = jnp.concatenate([thr[0], thr[1]], axis=1)   # (k, 2d)
+
+        def chunk_body(acc, args):
+            cb, gcb = acc
+            xc, lc, vc = args
+            # Per-row thresholds for both ranks; the gather + compare + cast
+            # fuse into the (chunk, 2d) bf16 y — no (chunk, 2d) f32 buffer.
+            t_rows = thr_cat[jnp.clip(lc, 0, k - 1)]          # (chunk, 2d)
+            xx = jnp.concatenate([xc, xc], axis=1)            # (chunk, 2d)
+            y = (xx.astype(jnp.float32) <= t_rows).astype(jnp.bfloat16)
+            # Per-chunk kernel sums are exact integers <= chunk (< 2^24);
+            # accumulate across chunks in int32 — an f32 running total loses
+            # count exactness past 16.7M rows per cluster.
+            cb = cb + label_segment_matmul(lc, y, k).astype(jnp.int32)
+            if with_global:
+                gy = (xc.astype(jnp.float32)[None] <= gthr[:, None, :])
+                gcb = gcb + jnp.sum(gy & vc[None, :, None], axis=1,
+                                    dtype=jnp.int32)
+            return (cb, gcb), None
+
+        (cb_cat, gcb), _ = lax.scan(
+            chunk_body,
+            (jnp.zeros((k, 2 * d), jnp.int32),
+             jnp.zeros((2, d), jnp.int32)),
+            (xr, labr, validr))
+        cb = jnp.stack([cb_cat[:, :d], cb_cat[:, d:]])        # (2, k, d)
+
+        ge = cb >= targets[:, :, None]
+        lo = jnp.where(ge, lo, thr)
+        hi = jnp.where(ge, thr, hi)
+        if with_global:
+            gge = gcb >= g_targets[:, None]
+            glo = jnp.where(gge, glo, gthr)
+            ghi = jnp.where(gge, gthr, ghi)
+        return lo, hi, glo, ghi
+
+    lo, hi, glo, ghi = lax.fori_loop(0, iters, body, (lo, hi, glo, ghi))
+
+    exact_const = hi_f <= lo_f
+    med = (0.25 * (lo[0] + hi[0] + lo[1] + hi[1])).astype(ftype)  # rank avg
+    med = jnp.where(exact_const[None, :], lo_f[None, :], med)
+    med = jnp.where(counts[:, None] > 0, med, jnp.nan)
+    if with_global:
+        gmed = (0.25 * (glo[0] + ghi[0] + glo[1] + ghi[1])).astype(ftype)
+        gmed = jnp.where(exact_const, lo_f, gmed)
+    else:
+        gmed = jnp.zeros((d,), ftype)
+    return med, gmed
+
+
+def pallas_is_tpu() -> bool:
+    from .pallas_kernels import pallas_available
+
+    return pallas_available()
+
+
 @functools.lru_cache(maxsize=32)
 def _build_hist_medians_sharded(k: int, bins: int, with_global: bool,
                                 ndata: int, nmodel: int = 1):
@@ -286,8 +410,11 @@ def classify_jax(
     jax arrays.  Mirrors ops/scoring_np.classify (reference: scoring.py:111-130).
 
     Median strategy follows ``cfg.median_method``: ``"sort"`` (exact),
-    ``"hist"`` (fixed-bin histogram, O(n), for large n), or ``"auto"``
-    (hist past HIST_MEDIAN_THRESHOLD rows).
+    ``"hist"`` (fixed-bin histogram, O(n)), ``"bisect"`` (scatter-free
+    rank bisection on the MXU — ~10x the hist path on TPU at 10M x 128,
+    k=1024; ops/pallas_kernels.label_segment_matmul), or ``"auto"``
+    (past HIST_MEDIAN_THRESHOLD rows: bisect on a real TPU backend, hist
+    elsewhere).
 
     ``mesh_shape={"data": N}`` runs the median stage under shard_map with X
     and labels sharded over the data axis (per-shard (k, bins) histograms +
@@ -303,15 +430,18 @@ def classify_jax(
 
     method = getattr(cfg, "median_method", "auto")
     if ndata > 1:
-        if method == "sort":
+        if method in ("sort", "bisect"):
             raise ValueError(
-                "median_method='sort' is single-device; sharded scoring "
-                "(mesh_shape data > 1) uses histogram medians — pass "
-                "median_method='hist' or 'auto'")
+                f"median_method={method!r} is single-device; sharded "
+                "scoring (mesh_shape data > 1) uses histogram medians — "
+                "pass median_method='hist' or 'auto'")
         method = "hist"
     elif method == "auto":
-        method = "hist" if x.shape[0] > HIST_MEDIAN_THRESHOLD else "sort"
-    if method not in ("sort", "hist"):
+        if x.shape[0] <= HIST_MEDIAN_THRESHOLD:
+            method = "sort"
+        else:
+            method = "bisect" if pallas_is_tpu() else "hist"
+    if method not in ("sort", "hist", "bisect"):
         raise ValueError(f"unknown median_method {method!r}")
     bins = int(getattr(cfg, "median_bins", 2048))
 
@@ -320,6 +450,8 @@ def classify_jax(
         medians, gmeds = _hist_medians_sharded(
             x, labels, int(k), bins, want_global, ndata,
             int((mesh_shape or {}).get("model", 1)))
+    elif method == "bisect":
+        medians, gmeds = _bisect_medians(x, labels, int(k), bins, want_global)
     elif method == "hist":
         # Global medians (when needed) fall out of the same histograms —
         # one data pass total.
@@ -328,7 +460,8 @@ def classify_jax(
         medians = compute_cluster_medians_jax(x, labels, int(k))
     if global_medians is None:
         if cfg.compute_global_medians_from_data:
-            global_medians = gmeds if method == "hist" else jnp.median(x, axis=0)
+            global_medians = (gmeds if method in ("hist", "bisect")
+                              else jnp.median(x, axis=0))
         else:
             global_medians = jnp.asarray(
                 [cfg.global_medians[f] for f in cfg.features], dtype=x.dtype

@@ -209,6 +209,11 @@ def classify(
     method = getattr(cfg, "median_method", "auto")
     if method == "auto":
         method = "hist" if X.shape[0] > HIST_MEDIAN_THRESHOLD else "sort"
+    if method == "bisect":
+        # The MXU rank-bisection is a jax/TPU strategy; its numpy twin in
+        # accuracy class (error <= range/2^iters vs range/bins) is the
+        # histogram path — same config runs on both backends.
+        method = "hist"
     if method not in ("sort", "hist"):
         raise ValueError(f"unknown median_method {method!r}")
     want_global = global_medians is None and cfg.compute_global_medians_from_data
