@@ -251,12 +251,10 @@ def _bench_e2e(cfg: BenchConfig, config_num: int, seed: int,
         directions={c: {f: v for f in feats} for c, v in dirs.items()},
         # On the chip the scatter-free bisect medians win at every e2e scale
         # (at 1M rows "auto" would pick the exact sort, ~0.45 s slower);
-        # bisect is single-device, so a data-sharded mesh keeps the sharded
-        # hist path; elsewhere (CPU e2e, tests) keep auto — interpret-mode
-        # pallas would crawl.  Disclosed in the result as ``median_method``.
-        median_method=("bisect"
-                       if (jax.default_backend() == "tpu"
-                           and int((mesh_shape or {}).get("data", 1)) <= 1)
+        # sharded meshes run the psum'd bisection.  Elsewhere (CPU e2e,
+        # tests) keep auto — interpret-mode pallas would crawl.  Disclosed
+        # in the result as ``median_method``.
+        median_method=("bisect" if jax.default_backend() == "tpu"
                        else "auto"),
         compute_global_medians_from_data=True)
 

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..config import ScoringConfig
+from ..parallel.mesh import DATA_AXIS
 
 __all__ = [
     "compute_cluster_medians_jax",
@@ -173,37 +174,75 @@ def _bisect_medians(x, labels, k: int, bins: int, with_global: bool):
     even-count contract as the sort and hist kernels.  NaN rows for empty
     clusters; constant columns are exact.
     """
-    from .pallas_kernels import label_segment_matmul, seg_tile
+    x, labels = _bisect_pad(x, labels, k)
+    return _bisect_core(x, labels, k, bins, with_global, sharded=False)
 
-    n, d = x.shape
-    ftype = x.dtype
-    iters = max(8, int(np.ceil(np.log2(max(bins, 2)))) + 1)
 
-    counts = jax.ops.segment_sum(
-        jnp.ones((n,), jnp.int32), labels, num_segments=k)      # (k,)
-    lo_f = x.min(axis=0)
-    hi_f = x.max(axis=0)
-
-    # Pad rows to the chunk grid; padded labels -1 never match a one-hot
-    # column, and the global counts only sum real chunks' rows via the mask.
-    chunk = min(_BISECT_CHUNK, 1 << 14) if not pallas_is_tpu() else _BISECT_CHUNK
-    tile = seg_tile(k)
-    chunk = max(tile, (chunk // tile) * tile)
+def _bisect_pad(x, labels, k: int):
+    """Pad rows to the chunk grid with the -1 sentinel label (never matches
+    a one-hot column; masked out of counts and min/max)."""
+    n = x.shape[0]
+    chunk = _bisect_chunk(k)
     n_pad = int(np.ceil(n / chunk)) * chunk
     if n_pad != n:
         x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
         labels = jnp.pad(labels, (0, n_pad - n), constant_values=-1)
+    return x, labels
+
+
+def _bisect_chunk(k: int) -> int:
+    from .pallas_kernels import seg_tile
+
+    from .pallas_kernels import pallas_available
+
+    chunk = (_BISECT_CHUNK if pallas_available()
+             else min(_BISECT_CHUNK, 1 << 14))
+    tile = seg_tile(k)
+    return max(tile, (chunk // tile) * tile)
+
+
+def _bisect_core(x, labels, k: int, bins: int, with_global: bool,
+                 sharded: bool):
+    """Bisection body over one device's (chunk-grid-padded) rows.
+
+    ``sharded=True`` runs inside shard_map over DATA_AXIS: the per-shard
+    (2, k, d)-shaped counts are psum-merged each iteration — the only
+    cross-shard traffic; x never moves.  Labels < 0 mark padded/invalid
+    rows on either path.
+    """
+    from .pallas_kernels import label_segment_matmul
+
+    n_pad, d = x.shape
+    ftype = x.dtype
+    iters = max(8, int(np.ceil(np.log2(max(bins, 2)))) + 1)
+    chunk = min(_bisect_chunk(k), n_pad)
+
+    def psum_(v):
+        return lax.psum(v, DATA_AXIS) if sharded else v
+
+    valid = labels >= 0
+    wi = valid.astype(jnp.int32)
+    lab_c = jnp.where(valid, labels, 0)
+    counts = psum_(jax.ops.segment_sum(wi, lab_c, num_segments=k))   # (k,)
+    n_total = jnp.sum(counts)
+    big = jnp.asarray(jnp.inf, ftype)
+    lo_f = jnp.min(jnp.where(valid[:, None], x, big), axis=0)
+    hi_f = jnp.max(jnp.where(valid[:, None], x, -big), axis=0)
+    if sharded:
+        lo_f = lax.pmin(lo_f, DATA_AXIS)
+        hi_f = lax.pmax(hi_f, DATA_AXIS)
+
     nch = n_pad // chunk
     xr = x.reshape(nch, chunk, d)
     labr = labels.reshape(nch, chunk)
-    validr = (jnp.arange(n_pad).reshape(nch, chunk) < n)
 
     # Ranks: value at 0-indexed rank r is the smallest v with
     # count(x <= v) >= r + 1.
     r0 = ((counts - 1) // 2 + 1).astype(jnp.int32)   # target count, rank lo
     r1 = (counts // 2 + 1).astype(jnp.int32)         # target count, rank hi
     targets = jnp.stack([r0, r1])                     # (2, k)
-    g_targets = jnp.asarray([(n - 1) // 2 + 1, n // 2 + 1], jnp.int32)
+    g_targets = jnp.stack([(n_total - 1) // 2 + 1,
+                           n_total // 2 + 1]).astype(jnp.int32)
 
     lo = jnp.broadcast_to(lo_f, (2, k, d)).astype(jnp.float32)
     hi = jnp.broadcast_to(hi_f, (2, k, d)).astype(jnp.float32)
@@ -218,7 +257,7 @@ def _bisect_medians(x, labels, k: int, bins: int, with_global: bool):
 
         def chunk_body(acc, args):
             cb, gcb = acc
-            xc, lc, vc = args
+            xc, lc = args
             # Per-row thresholds for both ranks; the gather + compare + cast
             # fuse into the (chunk, 2d) bf16 y — no (chunk, 2d) f32 buffer.
             t_rows = thr_cat[jnp.clip(lc, 0, k - 1)]          # (chunk, 2d)
@@ -230,7 +269,7 @@ def _bisect_medians(x, labels, k: int, bins: int, with_global: bool):
             cb = cb + label_segment_matmul(lc, y, k).astype(jnp.int32)
             if with_global:
                 gy = (xc.astype(jnp.float32)[None] <= gthr[:, None, :])
-                gcb = gcb + jnp.sum(gy & vc[None, :, None], axis=1,
+                gcb = gcb + jnp.sum(gy & (lc >= 0)[None, :, None], axis=1,
                                     dtype=jnp.int32)
             return (cb, gcb), None
 
@@ -238,14 +277,15 @@ def _bisect_medians(x, labels, k: int, bins: int, with_global: bool):
             chunk_body,
             (jnp.zeros((k, 2 * d), jnp.int32),
              jnp.zeros((2, d), jnp.int32)),
-            (xr, labr, validr))
+            (xr, labr))
+        cb_cat = psum_(cb_cat)
         cb = jnp.stack([cb_cat[:, :d], cb_cat[:, d:]])        # (2, k, d)
 
         ge = cb >= targets[:, :, None]
         lo = jnp.where(ge, lo, thr)
         hi = jnp.where(ge, thr, hi)
         if with_global:
-            gge = gcb >= g_targets[:, None]
+            gge = psum_(gcb) >= g_targets[:, None]
             glo = jnp.where(gge, glo, gthr)
             ghi = jnp.where(gge, gthr, ghi)
         return lo, hi, glo, ghi
@@ -264,10 +304,35 @@ def _bisect_medians(x, labels, k: int, bins: int, with_global: bool):
     return med, gmed
 
 
-def pallas_is_tpu() -> bool:
-    from .pallas_kernels import pallas_available
+@functools.lru_cache(maxsize=16)
+def _build_bisect_medians_sharded(k: int, bins: int, with_global: bool,
+                                  ndata: int, nmodel: int = 1):
+    """Compile the data-sharded bisection-median kernel.
 
-    return pallas_available()
+    Same dispatch convention as the sharded histogram path (x and labels
+    arrive sharded over the data axis, outputs replicated); padded rows
+    carry the sentinel label ``k`` (mapped to -1 for the core, whose
+    one-hot ignores it).  Cross-shard traffic per iteration is one psum of
+    the (k, 2d) count block (+ (2, d) global counts) — x never moves.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_data=ndata, n_model=nmodel)
+
+    def local_fn(x_loc, lab_loc):
+        lab = jnp.where(lab_loc < k, lab_loc, -1).astype(jnp.int32)
+        x_p, lab_p = _bisect_pad(x_loc, lab, k)
+        return _bisect_core(x_p, lab_p, k, bins, with_global, sharded=True)
+
+    return jax.jit(jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    ))
 
 
 @functools.lru_cache(maxsize=32)
@@ -337,6 +402,21 @@ def _build_hist_medians_sharded(k: int, bins: int, with_global: bool,
         out_specs=(P(), P()),
         check_vma=False,
     ))
+
+
+def _bisect_medians_sharded(x, labels, k: int, bins: int, with_global: bool,
+                            ndata: int, nmodel: int = 1):
+    """Data-sharded bisection medians (same calling convention as
+    ``_hist_medians_sharded``; sentinel label k pads to a shard multiple)."""
+    x = jnp.asarray(x)
+    labels = jnp.asarray(labels).astype(jnp.int32)
+    rem = (-x.shape[0]) % ndata
+    if rem:
+        x = jnp.pad(x, ((0, rem), (0, 0)))
+        labels = jnp.pad(labels, (0, rem), constant_values=k)
+    fn = _build_bisect_medians_sharded(int(k), int(bins), bool(with_global),
+                                       int(ndata), int(nmodel))
+    return fn(x, labels)
 
 
 def _hist_medians_sharded(x, labels, k: int, bins: int, with_global: bool,
@@ -417,11 +497,12 @@ def classify_jax(
     elsewhere).
 
     ``mesh_shape={"data": N}`` runs the median stage under shard_map with X
-    and labels sharded over the data axis (per-shard (k, bins) histograms +
-    one psum per feature) — X never gathers to one device.  Sharded mode is
-    histogram-only: a distributed exact sort is the wrong shape for the
-    scales that need sharding (SURVEY.md §7.4), so ``median_method="sort"``
-    raises and ``"auto"`` always resolves to ``"hist"``.
+    and labels sharded over the data axis — X never gathers to one device.
+    Sharded ``"hist"`` psums per-shard (k, bins) histograms per feature;
+    sharded ``"bisect"`` psums the (k, 2d) count block per iteration.  A
+    distributed exact sort is the wrong shape for the scales that need
+    sharding (SURVEY.md §7.4), so ``median_method="sort"`` raises; sharded
+    ``"auto"`` conservatively resolves to ``"hist"``.
     """
     cfg = cfg or ScoringConfig()
     x = jnp.asarray(X)
@@ -430,24 +511,33 @@ def classify_jax(
 
     method = getattr(cfg, "median_method", "auto")
     if ndata > 1:
-        if method in ("sort", "bisect"):
+        if method == "sort":
             raise ValueError(
-                f"median_method={method!r} is single-device; sharded "
-                "scoring (mesh_shape data > 1) uses histogram medians — "
-                "pass median_method='hist' or 'auto'")
-        method = "hist"
+                "median_method='sort' is single-device; sharded scoring "
+                "(mesh_shape data > 1) uses histogram or bisection medians "
+                "— pass median_method='hist', 'bisect', or 'auto'")
+        if method == "auto":
+            # Conservative sharded default: the psum-histogram path (proven
+            # on the virtual mesh and the multichip dryrun).  Explicit
+            # 'bisect' runs the sharded bisection (per-iteration psum of
+            # the (k, 2d) counts; x never moves).
+            method = "hist"
     elif method == "auto":
         if x.shape[0] <= HIST_MEDIAN_THRESHOLD:
             method = "sort"
         else:
-            method = "bisect" if pallas_is_tpu() else "hist"
+            from .pallas_kernels import pallas_available
+
+            method = "bisect" if pallas_available() else "hist"
     if method not in ("sort", "hist", "bisect"):
         raise ValueError(f"unknown median_method {method!r}")
     bins = int(getattr(cfg, "median_bins", 2048))
 
     want_global = global_medians is None and cfg.compute_global_medians_from_data
     if ndata > 1:
-        medians, gmeds = _hist_medians_sharded(
+        sharded_medians = (_bisect_medians_sharded if method == "bisect"
+                           else _hist_medians_sharded)
+        medians, gmeds = sharded_medians(
             x, labels, int(k), bins, want_global, ndata,
             int((mesh_shape or {}).get("model", 1)))
     elif method == "bisect":

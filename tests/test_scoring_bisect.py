@@ -94,15 +94,30 @@ def test_bisect_even_odd_rank_average():
     np.testing.assert_allclose(np.asarray(med)[:, 0], [1.5, 6.0], atol=2e-3)
 
 
-def test_bisect_rejected_on_sharded_mesh():
-    """Explicit bisect + data-sharded mesh must raise (like 'sort'), never
-    silently run a different method than the caller will report."""
+@pytest.mark.parametrize("mesh_shape", [{"data": 2}, {"data": 4, "model": 2}])
+def test_sharded_bisect_matches_single_device(mesh_shape):
+    """Explicit bisect on a data-sharded mesh: per-iteration psum of the
+    count block must reproduce the single-device medians exactly (the
+    bisection decisions are integer-count comparisons — identical on every
+    shard) — including an uneven row count (sentinel-label padding)."""
+    rng = np.random.default_rng(1)
+    X = rng.uniform(size=(1077, 5))    # does not divide the mesh
+    labels = rng.integers(0, 4, size=1077).astype(np.int32)
+    cfg = ScoringConfig(median_method="bisect",
+                        compute_global_medians_from_data=True)
+    w1, s1, m1 = classify_jax(X, labels, 4, cfg)
+    w2, s2, m2 = classify_jax(X, labels, 4, cfg, mesh_shape=mesh_shape)
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(m1), atol=0)
+    np.testing.assert_array_equal(np.asarray(w2), np.asarray(w1))
+
+
+def test_sort_still_rejected_on_sharded_mesh():
     rng = np.random.default_rng(1)
     X = rng.uniform(size=(1024, 3))
     labels = rng.integers(0, 4, size=1024).astype(np.int32)
-    cfg = ScoringConfig(median_method="bisect")
     with pytest.raises(ValueError, match="single-device"):
-        classify_jax(X, labels, 4, cfg, mesh_shape={"data": 2})
+        classify_jax(X, labels, 4, ScoringConfig(median_method="sort"),
+                     mesh_shape={"data": 2})
 
 
 def test_numpy_backend_maps_bisect_to_hist():
