@@ -1,0 +1,92 @@
+"""One-command benchmark sweep — reproduces the per-round summary artifact.
+
+    python -m cdrs_tpu.benchmarks.summary --out data/bench_summary.json
+
+Runs every BASELINE config through ``run_bench`` (iter/s + e2e), the
+ingestion bench, and the bfloat16 capacity point, emitting the RAW
+``run_bench``/``bench_ingest`` records under ``hardware / lloyd / e2e /
+streaming / ingestion`` keys — the curated per-round
+``data/bench_r*_summary.json`` files are hand-assembled views of one such
+sweep (every number traceable to a record here).  Each step is
+fault-isolated: a failing config records its error string instead of
+aborting the sweep.  Runtime on the single tunnel chip: ~20-30 minutes,
+dominated by the config-3/4 syntheses and the numpy baselines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _step(out: dict, key: str, fn):
+    try:
+        out[key] = fn()
+    except Exception as e:  # fault-isolate: record, keep sweeping
+        out[key] = {"error": f"{type(e).__name__}: {e}"}
+        print(f"[summary] {key} FAILED: {e}", file=sys.stderr)
+
+
+def run_summary(quality: bool = True) -> dict:
+    import jax
+
+    from .harness import run_bench
+
+    out: dict = {
+        "hardware": {
+            "jax_devices": len(jax.devices()),
+            "platform": jax.devices()[0].platform,
+        },
+        "lloyd": {},
+        "e2e": {},
+    }
+
+    # quality once (embedded in the config-2 record; ~2 pipeline runs)
+    _step(out["lloyd"], "config1",
+          lambda: run_bench(config=1, quality=False))
+    _step(out["lloyd"], "config2",
+          lambda: run_bench(config=2, quality=quality))
+    _step(out["lloyd"], "config2_matmul",
+          lambda: run_bench(config=2, update="matmul", quality=False))
+    _step(out["lloyd"], "config3",
+          lambda: run_bench(config=3, quality=False))
+    _step(out["lloyd"], "config4",
+          lambda: run_bench(config=4, quality=False))
+    _step(out["lloyd"], "config4_bf16",
+          lambda: run_bench(config=4, dtype="bfloat16", quality=False))
+    _step(out, "streaming",
+          lambda: run_bench(config=5, quality=False))
+
+    for cfg_num in (2, 3, 4):
+        _step(out["e2e"], f"config{cfg_num}",
+              lambda c=cfg_num: run_bench(config=c, e2e=True, quality=False))
+
+    def ingest():
+        from .ingest import bench_ingest
+        return bench_ingest()
+
+    _step(out, "ingestion", ingest)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=None, metavar="JSON",
+                   help="write the summary here (default: stdout only)")
+    p.add_argument("--no_quality", action="store_true",
+                   help="skip the decision-quality pipeline runs")
+    args = p.parse_args(argv)
+
+    out = run_summary(quality=not args.no_quality)
+    text = json.dumps(out, indent=2, default=str)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"[summary] wrote {args.out}", file=sys.stderr)
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

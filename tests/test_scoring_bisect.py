@@ -83,6 +83,30 @@ def test_bisect_classify_category_parity(from_data):
     np.testing.assert_array_equal(np.asarray(wb), np.asarray(we))
 
 
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_bisect_random_property(seed):
+    """Randomized workloads: random (n, k, d), heavy duplicates in one
+    column, possibly near-empty clusters — bisect medians within
+    range/2^(iters-1) of the exact sort medians per feature."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(500, 4000))
+    k = int(rng.integers(2, 9))
+    d = int(rng.integers(2, 6))
+    X = rng.uniform(size=(n, d))
+    X[:, 0] = rng.integers(0, 4, size=n) / 3.0   # discrete: duplicate-heavy
+    labels = rng.integers(0, k, size=n).astype(np.int32)
+    med, gmed = _bisect_medians(jnp.asarray(X), jnp.asarray(labels), k=k,
+                                bins=4096, with_global=True)
+    want = scoring_np.compute_cluster_medians(X, labels, k)
+    iters = 13  # max(8, ceil(log2(4096)) + 1)
+    tol = (X.max(axis=0) - X.min(axis=0)) / 2 ** (iters - 1) + 1e-9
+    got = np.asarray(med)
+    present = np.bincount(labels, minlength=k) > 0
+    assert np.isnan(got[~present]).all()
+    assert (np.abs(got[present] - want[present]) <= tol[None, :]).all()
+    assert (np.abs(np.asarray(gmed) - np.median(X, axis=0)) <= tol).all()
+
+
 def test_bisect_even_odd_rank_average():
     """Even-count clusters average the two middle order stats (the sort and
     hist kernels' contract) — check on a tiny hand-computed case."""
