@@ -179,11 +179,20 @@ def _bisect_medians(x, labels, k: int, bins: int, with_global: bool):
 
 
 def _bisect_pad(x, labels, k: int):
-    """Pad rows to the chunk grid with the -1 sentinel label (never matches
-    a one-hot column; masked out of counts and min/max)."""
+    """Pad rows to the scan grid with the -1 sentinel label (never matches
+    a one-hot column; masked out of counts and min/max).
+
+    Inputs at or below one chunk pad only to the kernel tile (a tiny input
+    — e.g. one shard of a small sharded run — must not pay a full-chunk
+    zero pass); larger inputs pad to a whole number of chunks.  Either way
+    ``_bisect_core``'s ``chunk = min(chunk, n_pad)`` divides ``n_pad``.
+    """
+    from .pallas_kernels import seg_tile
+
     n = x.shape[0]
     chunk = _bisect_chunk(k)
-    n_pad = int(np.ceil(n / chunk)) * chunk
+    mult = seg_tile(k) if n <= chunk else chunk
+    n_pad = int(np.ceil(max(n, 1) / mult)) * mult
     if n_pad != n:
         x = jnp.pad(x, ((0, n_pad - n), (0, 0)))
         labels = jnp.pad(labels, (0, n_pad - n), constant_values=-1)

@@ -171,7 +171,6 @@ def test_prepadded_garbage_tail_zeroed_on_pallas_path(blobs):
 def test_bf16_kmeans_par_init_runs(blobs):
     """kmeans|| with bf16 points: candidate weights accumulate in f32
     (code-review regression — a bf16 sum of ones stalls at 256)."""
-    from cdrs_tpu.ops.kmeans_jax import _stat_dtype as sd
     c, lab, it, _ = kmeans_jax_full(
         jnp.asarray(blobs, jnp.bfloat16), 4, seed=3, max_iter=10,
         init_method="kmeans||")

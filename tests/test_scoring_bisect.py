@@ -20,8 +20,6 @@ from cdrs_tpu.ops.scoring_jax import _bisect_medians, classify_jax
 
 
 def test_label_segment_matmul_matches_segment_sum():
-    import jax
-
     from cdrs_tpu.ops.pallas_kernels import label_segment_matmul
 
     rng = np.random.default_rng(0)
