@@ -223,9 +223,10 @@ def _bench_e2e(cfg: BenchConfig, config_num: int, seed: int,
     measurable stand-in for BASELINE config 4's "<60 s end-to-end").
 
     The feature matrix is synthesized on device (sharded over the mesh),
-    clustered for exactly ``cfg.e2e_iters`` Lloyd iterations from a D² init, and
-    classified with data-sharded histogram medians; the clock stops when the
-    per-cluster categories land on host.  The numpy baseline runs the same
+    clustered for exactly ``cfg.e2e_iters`` Lloyd iterations from a D² init,
+    and classified with scatter-free bisection medians on TPU (psum'd when
+    sharded; "auto" elsewhere); the clock stops when the per-cluster
+    categories land on host.  The numpy baseline runs the same
     pipeline (same iteration budget, exact medians) on a row subsample and
     scales linearly.
     """
@@ -533,9 +534,14 @@ def run_bench(config: int = 2, backend: str | None = None,
     host categories (the BASELINE config-4 "<60 s end-to-end" stand-in).
     """
     cfg = CONFIGS[int(config)]
+    backend = backend or cfg.backend
     if dtype is not None:
         # Points dtype override (e.g. "bfloat16": halves the HBM stream the
         # Lloyd step is bound by; centroids/stats stay f32 — _stat_dtype).
+        # Backend check first: a numpy run must not be told to flip x64.
+        if backend == "numpy":
+            raise ValueError("--dtype selects the jax points dtype; "
+                             "not applicable to --backend numpy")
         if str(dtype) == "float64":
             import jax
             if not jax.config.jax_enable_x64:
@@ -545,12 +551,8 @@ def run_bench(config: int = 2, backend: str | None = None,
                     "would lie")
         import dataclasses as _dc
         cfg = _dc.replace(cfg, dtype=str(dtype))
-    backend = backend or cfg.backend
     update_requested = update
     update = update or cfg.update
-    if backend == "numpy" and dtype is not None:
-        raise ValueError("--dtype selects the jax points dtype; "
-                         "not applicable to --backend numpy")
     if int(config) == 5:
         if backend != "jax":
             raise ValueError("config 5 (streaming) is a jax fold; "

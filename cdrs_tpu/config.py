@@ -211,6 +211,20 @@ class KMeansConfig:
     #: centroids/stats stay float32 (ops/kmeans_jax._stat_dtype).
     dtype: str | None = None
 
+    def __post_init__(self):
+        # Validate enum-ish fields at the config layer (same rationale as
+        # scoring_config_from_dict): a typo'd dtype must not surface as a
+        # np.dtype TypeError after clustering has started.
+        if self.dtype not in (None, "float32", "bfloat16", "float16",
+                              "float64"):
+            raise ValueError(
+                f"dtype must be one of float32/bfloat16/float16/float64 or "
+                f"None; got {self.dtype!r}")
+        if self.init_method not in ("d2", "kmeans||"):
+            raise ValueError(
+                f"init_method must be 'd2' or 'kmeans||'; "
+                f"got {self.init_method!r}")
+
     def resolve_max_iter(self, n: int) -> int:
         if self.max_iter is not None:
             return int(self.max_iter)

@@ -53,14 +53,6 @@ __all__ = [
     "resolve_update",
 ]
 
-#: Row tile the pallas kernel iterates internally (ops/pallas_kernels.py):
-#: columns of the feature-major (d, n) view.  2048 won the round-4 in-loop
-#: v5e sweep at k=128 (1.10 ms/iter vs 1.48 at 4096 / 1.47 at 8192, n=1M
-#: d=32 — the (k_pad, 2048) f32 distance + one-hot pair double-buffers
-#: cleanly at 2x1 MB); at k_pad >= 512 only smaller tiles fit the VMEM
-#: budget and the ladder below takes over (k=1024 measured best at 1024:
-#: 31.7 ms/iter vs 35.0 at 512, n=4M d=128).
-PALLAS_TILE_ROWS = 2048
 
 
 @functools.lru_cache(maxsize=64)
@@ -108,24 +100,17 @@ def _stat_dtype(dtype):
     return d
 
 
-#: The pallas kernel's two (k_pad, tile) f32 VMEM blocks (distance +
-#: one-hot) must fit comfortably under the 16 MB scoped-VMEM limit:
-#: k_pad * tile <= 2^20 elements = 2 x 4 MB blocks.
-_PALLAS_VMEM_ELEMS = 1 << 20
-
-
 def pallas_tile(k: int) -> int | None:
     """Column tile for the fused kernel at this k, or None when no tile
-    fits VMEM.  ``chunk_rows`` deliberately plays no part: it bounds the
-    XLA scan's (chunk, k) HBM buffer, while the pallas kernel's working set
-    is VMEM-tiled internally and never materializes (n, k) at all — on v5e
-    the kernel beats the 131072-row matmul scan ~2x at config 3 (k=1024)
-    precisely by using its own much smaller tile."""
-    k_pad = ((max(int(k), 8) + 127) // 128) * 128
-    for t in (PALLAS_TILE_ROWS, 1024, 512):
-        if k_pad * t <= _PALLAS_VMEM_ELEMS:
-            return t
-    return None
+    fits VMEM (single source: ops/pallas_kernels.lloyd_tile — the tuning
+    notes live there).  ``chunk_rows`` deliberately plays no part: it
+    bounds the XLA scan's (chunk, k) HBM buffer, while the pallas kernel's
+    working set is VMEM-tiled internally and never materializes (n, k) at
+    all — on v5e the kernel beats the 131072-row matmul scan ~2x at
+    config 3 (k=1024) precisely by using its own much smaller tile."""
+    from .pallas_kernels import lloyd_tile
+
+    return lloyd_tile(k)
 
 
 def resolve_update(update: str, nmodel: int = 1, dtype=np.float32,
@@ -164,8 +149,10 @@ def padding_multiple(ndata: int, chunk_rows: int | None, update: str,
     (matmul/scatter scan) or pallas tiles (``pallas_tile(k)``).
     """
     if resolve_update(update, k=k) == "pallas":
+        from .pallas_kernels import LLOYD_TILE_COLS
+
         return int(ndata) * int(pallas_tile(k) if k is not None
-                                else PALLAS_TILE_ROWS)
+                                else LLOYD_TILE_COLS)
     return int(ndata) * int(chunk_rows or 1)
 
 
@@ -827,7 +814,8 @@ def kmeans_jax_full(
         raise ValueError(f"unknown update strategy {update!r}")
     update = resolve_update(update, nmodel, dtype, k=k)
 
-    # pallas tiles rows internally (PALLAS_TILE_ROWS), so shards must divide it.
+    # pallas tiles rows internally (pallas_kernels.lloyd_tile), so shards
+    # must divide it.
     multiple = padding_multiple(ndata, chunk_rows, update, k=k)
     if is_device_array:
         # Device-resident input (pipeline / benchmark / streaming path): never
@@ -843,12 +831,14 @@ def kmeans_jax_full(
         rem = (-Xp.shape[0]) % multiple
         if rem:
             Xp = jnp.pad(Xp, ((0, rem), (0, 0)))
-        if update == "pallas" and n_valid < Xp.shape[0]:
+        if update == "pallas" and n_valid < n:
             # The fused kernel's contract requires the padded tail to be
             # zero vectors (its wrapper corrects counts instead of masking
-            # per tile).  Our own jnp.pad above guarantees that, but rows a
-            # CALLER pre-padded may hold anything — zero them once here
-            # (one O(n) pass per call, not per iteration).
+            # per tile).  Our own jnp.pad above guarantees rows [n, n_pad);
+            # only rows [n_valid, n) — the CALLER's pre-padding — may hold
+            # anything, so zero exactly when those exist (one O(n) pass per
+            # call, not per iteration, and none on the common un-pre-padded
+            # path).
             Xp = jnp.where(
                 jnp.arange(Xp.shape[0])[:, None] < n_valid, Xp,
                 jnp.zeros((), Xp.dtype))

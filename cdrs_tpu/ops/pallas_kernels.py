@@ -39,6 +39,29 @@ __all__ = ["lloyd_assign_reduce_pallas", "lloyd_assign_reduce_pallas_t",
 
 _LANE = 128
 
+#: The fused kernels' two (k_pad, tile) f32 VMEM blocks (distance + one-hot)
+#: must fit comfortably under the 16 MB scoped-VMEM limit:
+#: k_pad * tile <= 2^20 elements = 2 x 4 MB blocks.
+_VMEM_ELEMS = 1 << 20
+
+#: Column tile the Lloyd kernel iterates internally.  2048 won the round-4
+#: in-loop v5e sweep at k=128 (1.10 ms/iter vs 1.48 at 4096 / 1.47 at 8192,
+#: n=1M d=32 — the (k_pad, 2048) f32 distance + one-hot pair double-buffers
+#: cleanly at 2x1 MB); at k_pad >= 512 only smaller tiles fit the VMEM
+#: budget and the ladder below takes over (k=1024 measured best at 1024:
+#: 31.7 ms/iter vs 35.0 at 512, n=4M d=128).
+LLOYD_TILE_COLS = 2048
+
+
+def lloyd_tile(k: int) -> int | None:
+    """Column tile for the fused Lloyd kernel at this k, or None when no
+    tile fits the VMEM budget (callers fall back to the XLA matmul path)."""
+    k_pad = _pad_to(max(int(k), 8), _LANE)
+    for t in (LLOYD_TILE_COLS, 1024, 512):
+        if k_pad * t <= _VMEM_ELEMS:
+            return t
+    return None
+
 
 def pallas_available() -> bool:
     """True when running on a real TPU backend (otherwise use interpret)."""
@@ -284,7 +307,7 @@ def _build_t(n_cols, d, k, tile_cols, dtype_name, interpret, with_labels):
     return fn
 
 
-def lloyd_assign_reduce_pallas_t(xt, c, n_valid, tile_cols: int = 4096,
+def lloyd_assign_reduce_pallas_t(xt, c, n_valid, tile_cols: int | None = None,
                                  interpret: bool | None = None,
                                  with_labels: bool = True):
     """Feature-major fused assignment + (sums, counts).
@@ -305,6 +328,11 @@ def lloyd_assign_reduce_pallas_t(xt, c, n_valid, tile_cols: int = 4096,
         interpret = not pallas_available()
     d, n_cols = xt.shape
     k = c.shape[0]
+    if tile_cols is None:
+        tile_cols = lloyd_tile(k)
+        if tile_cols is None:
+            raise ValueError(
+                f"k={k} exceeds the kernel's VMEM budget (no tile fits)")
     if n_cols % tile_cols:
         raise ValueError(f"cols {n_cols} not a multiple of tile_cols {tile_cols}")
     fn = _build_t(n_cols, d, k, int(tile_cols),
@@ -429,7 +457,10 @@ def seg_tile(k: int) -> int:
 
     Single source for callers that must pre-pad rows to the tile grid
     (e.g. the bisection-median driver): the (TN, k_pad) one-hot block is
-    the big VMEM resident, same budget rule as the Lloyd kernel.
+    the big VMEM resident, same budget rule as the Lloyd kernel.  Unlike
+    ``lloyd_tile`` this never returns None — the tile shrinks (down to the
+    8-row f32 sublane minimum) so huge k stays within the VMEM budget
+    instead of overflowing it.
     """
     k_pad = _pad_to(max(int(k), 8), _LANE)
-    return max(512, min(2048, (1 << 20) // k_pad))
+    return max(8, min(2048, _VMEM_ELEMS // k_pad))

@@ -200,9 +200,7 @@ def _bisect_pad(x, labels, k: int):
 
 
 def _bisect_chunk(k: int) -> int:
-    from .pallas_kernels import seg_tile
-
-    from .pallas_kernels import pallas_available
+    from .pallas_kernels import pallas_available, seg_tile
 
     chunk = (_BISECT_CHUNK if pallas_available()
              else min(_BISECT_CHUNK, 1 << 14))
