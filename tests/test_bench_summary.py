@@ -1,0 +1,57 @@
+"""The one-command benchmark sweep (cdrs_tpu.benchmarks.summary).
+
+Unit-level: run_bench/bench_ingest are stubbed so no real benchmark runs —
+the real sweep is exercised on the chip (data/bench_sweep_r4.json).  What
+must hold structurally: every config lands under the right key, a failing
+step records its error instead of aborting the sweep, and --out writes
+valid JSON.
+"""
+
+import json
+
+import numpy as np  # noqa: F401  (jax-optional module gate parity)
+import pytest
+
+pytest.importorskip("jax")
+
+import cdrs_tpu.benchmarks.harness as harness
+import cdrs_tpu.benchmarks.ingest as ingest_mod
+from cdrs_tpu.benchmarks.summary import main, run_summary
+
+
+@pytest.fixture
+def stubbed(monkeypatch):
+    calls = []
+
+    def fake_run_bench(config=2, **kw):
+        calls.append((config, kw))
+        if kw.get("dtype") == "bfloat16":
+            raise RuntimeError("no bf16 today")
+        return {"config": config, "value": float(config), **kw}
+
+    monkeypatch.setattr(harness, "run_bench", fake_run_bench)
+    monkeypatch.setattr(ingest_mod, "bench_ingest",
+                        lambda: {"value": 123.0, "unit": "row/s"})
+    return calls
+
+
+def test_run_summary_structure_and_fault_isolation(stubbed):
+    out = run_summary(quality=False)
+    assert set(out) >= {"hardware", "lloyd", "e2e", "streaming", "ingestion"}
+    assert out["lloyd"]["config2"]["value"] == 2.0
+    assert out["lloyd"]["config2_matmul"]["update"] == "matmul"
+    # the bf16 step failed — recorded, not fatal, and the sweep continued
+    assert "no bf16 today" in out["lloyd"]["config4_bf16"]["error"]
+    assert out["streaming"]["config"] == 5
+    assert {f"config{c}" for c in (2, 3, 4)} <= set(out["e2e"])
+    assert all(v["e2e"] for v in out["e2e"].values())
+    assert out["ingestion"]["value"] == 123.0
+
+
+def test_summary_cli_writes_json(stubbed, tmp_path, capsys):
+    out_path = tmp_path / "sweep.json"
+    assert main(["--out", str(out_path), "--no_quality"]) == 0
+    on_disk = json.loads(out_path.read_text())
+    assert on_disk["lloyd"]["config1"]["value"] == 1.0
+    # stdout carries the same JSON
+    assert json.loads(capsys.readouterr().out)["lloyd"]["config1"]["value"] == 1.0
