@@ -26,6 +26,8 @@ src/kmeans_plusplus.py:33 (SURVEY.md §3.2 hot loop #4).
 from __future__ import annotations
 
 import functools
+import os
+import warnings
 
 import numpy as np
 
@@ -120,8 +122,23 @@ def _kernel(nv_ref, x_ref, c_ref, csq_ref, sums_ref, counts_ref, labels_ref, *,
         counts_ref[:] += cnt[None, :]
 
 
+def _warn_f32_count_ceiling(n_shard: int, caller: str) -> None:
+    if n_shard > (1 << 24):
+        # f32 grid accumulation of per-cluster counts loses integer
+        # exactness once one cluster owns > 2^24 rows on this shard —
+        # possible (though pathological) at this shard size.  The bisect
+        # path int32-accumulates for exactly this reason.
+        warnings.warn(
+            f"{caller}: shard has {n_shard} rows; a cluster owning > 2^24 "
+            "(~16.7M) of them overflows the f32 count accumulator's "
+            "exact-integer range. Shard the data axis further if cluster "
+            "sizes can be that skewed.",
+            stacklevel=4)
+
+
 @functools.lru_cache(maxsize=64)
 def _build(n_rows, d, k, tile_rows, dtype_name, interpret):
+    _warn_f32_count_ceiling(n_rows, "lloyd_assign_reduce_pallas")
     # Feature dim is used as-is (Mosaic lane-pads minor dims internally; an
     # explicit zero-pad to 128 would 4x the matmul FLOPs at d=32 and
     # materialize a padded copy of x in HBM).  k is padded so the argmin /
@@ -241,6 +258,7 @@ def _kernel_t_no_labels(xt_ref, c_ref, csq_ref, sums_ref, counts_ref,
 
 @functools.lru_cache(maxsize=64)
 def _build_t(n_cols, d, k, tile_cols, dtype_name, interpret, with_labels):
+    _warn_f32_count_ceiling(n_cols, "lloyd_assign_reduce_pallas_t")
     k_pad = _pad_to(max(k, 8), _LANE)
     grid = n_cols // tile_cols
 
@@ -309,24 +327,41 @@ def _build_t(n_cols, d, k, tile_cols, dtype_name, interpret, with_labels):
 
 def lloyd_assign_reduce_pallas_t(xt, c, n_valid, tile_cols: int | None = None,
                                  interpret: bool | None = None,
-                                 with_labels: bool = True):
+                                 with_labels: bool = True,
+                                 enforce_pad: bool = False):
     """Feature-major fused assignment + (sums, counts).
 
     ``xt``: (d, n_cols) — the points matrix TRANSPOSED, n_cols % tile_cols
     == 0.  Columns past ``n_valid`` MUST be zero vectors (every caller
     zero-pads): instead of masking them per tile — a full (k_pad, TN) VPU
     pass — the wrapper subtracts their count from the origin-nearest
-    centroid they deterministically land on.  Their labels are produced
-    but meaningless (argmin of ||c||²).  ``c``: (k, d).  Returns (labels
-    (n_cols,) int32 or None, sums (k, d) f32, counts (k,) f32) — same
-    semantics as ``lloyd_assign_reduce_pallas`` on zero-padded input, but
-    reading x in its dense layout: for d < 128 the row-major (n, d)
-    array is lane-padded 128/d x in HBM, which made the row-major kernel
-    bandwidth-bound on padding bytes.
+    centroid they deterministically land on.  A caller that cannot
+    guarantee the zero-pad must pass ``enforce_pad=True`` (one extra
+    ``where`` pass over xt that zeroes the tail) — non-zero pad columns
+    otherwise SILENTLY corrupt sums/counts.  ``CDRS_TPU_ENFORCE_PAD=1``
+    in the environment turns the guard on globally (debug aid; read at
+    TRACE time, so it must be set before the first jit-compiled call —
+    already-compiled callers replay without the guard).  Their
+    labels are produced but meaningless (argmin of ||c||²).  ``c``:
+    (k, d).  Returns (labels (n_cols,) int32 or None, sums (k, d) f32,
+    counts (k,) f32) — same semantics as ``lloyd_assign_reduce_pallas``
+    on zero-padded input, but reading x in its dense layout: for d < 128
+    the row-major (n, d) array is lane-padded 128/d x in HBM, which made
+    the row-major kernel bandwidth-bound on padding bytes.
+
+    Precision ceiling: per-cluster counts accumulate in f32 across the
+    grid, exact only while every cluster's shard-local count stays below
+    2^24 (~16.7M rows).  The wrapper warns (once per shape) past that —
+    at the demonstrated bf16 shard sizes (13.1M rows/chip) the ceiling is
+    unreachable unless one cluster owns essentially the whole shard.
     """
     if interpret is None:
         interpret = not pallas_available()
     d, n_cols = xt.shape
+    if enforce_pad or os.environ.get("CDRS_TPU_ENFORCE_PAD") == "1":
+        keep = jax.lax.iota(jnp.int32, n_cols) < jnp.asarray(n_valid,
+                                                             jnp.int32)
+        xt = jnp.where(keep[None, :], xt, jnp.zeros((), xt.dtype))
     k = c.shape[0]
     if tile_cols is None:
         tile_cols = lloyd_tile(k)

@@ -74,6 +74,36 @@ def test_pallas_feature_major_parity(n, d, k, n_valid):
     np.testing.assert_allclose(np.asarray(counts), counts_np, atol=0)
 
 
+def test_pallas_feature_major_enforce_pad():
+    """enforce_pad=True restores correct stats for NON-zero pad columns.
+
+    Without the guard, garbage past n_valid silently corrupts sums/counts
+    (the documented API failure mode); with it, results match the
+    zero-padded call exactly.
+    """
+    from cdrs_tpu.ops.pallas_kernels import lloyd_assign_reduce_pallas_t
+
+    rng = np.random.default_rng(7)
+    n, d, k, n_valid = 2048, 8, 16, 1500
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    c = x[:k].copy()
+    x_dirty = x.copy()
+    x_dirty[n_valid:] = 99.0  # violates the zero-pad contract
+
+    _, sums_ref, counts_ref = lloyd_assign_reduce_pallas_t(
+        jnp.asarray(np.where(np.arange(n)[:, None] < n_valid, x, 0.0)
+                    .astype(np.float32)).T,
+        jnp.asarray(c), n_valid=n_valid, interpret=True, tile_cols=512)
+    _, sums_g, counts_g = lloyd_assign_reduce_pallas_t(
+        jnp.asarray(x_dirty).T, jnp.asarray(c), n_valid=n_valid,
+        interpret=True, tile_cols=512, enforce_pad=True)
+
+    np.testing.assert_allclose(np.asarray(sums_g), np.asarray(sums_ref),
+                               atol=0)
+    np.testing.assert_allclose(np.asarray(counts_g), np.asarray(counts_ref),
+                               atol=0)
+
+
 def test_pallas_feature_major_no_labels():
     from cdrs_tpu.ops.pallas_kernels import lloyd_assign_reduce_pallas_t
 
