@@ -472,15 +472,18 @@ def _time_jax_lloyd(X, k: int, init: np.ndarray, iters: int,
     return min(windows), windows
 
 
-def _quality_one(n_files: int, duration: float, seed: int) -> dict:
+def _quality_one(n_files: int, duration: float, seed: int,
+                 backend: str = "numpy", init_method: str = "d2",
+                 k: int = 8) -> dict:
     from ..config import (GeneratorConfig, KMeansConfig, PipelineConfig,
                           SimulatorConfig, validated_scoring_config)
     from ..pipeline import run_pipeline
 
     result = run_pipeline(PipelineConfig(
+        backend=backend,
         generator=GeneratorConfig(n_files=n_files, seed=seed),
         simulator=SimulatorConfig(duration_seconds=duration, seed=seed + 1),
-        kmeans=KMeansConfig(k=8, seed=42),
+        kmeans=KMeansConfig(k=k, seed=42, init_method=init_method),
         scoring=validated_scoring_config(),
         evaluate=True,
     ))
@@ -500,17 +503,19 @@ def _quality_one(n_files: int, duration: float, seed: int) -> dict:
 def decision_quality_metrics(seed: int = 21) -> dict:
     """Decision quality as tracked bench numbers (VERDICT r2 next #1).
 
-    Runs two deterministic seeded workloads (300 files/300 s and 2000
-    files/600 s) through the standard pipeline (pipeline.run_pipeline,
-    evaluate=True) with the validated scoring tables and reports
-    planted-category recovery plus the read-locality gain over the
-    reference's uniform rf=1.  The small workload's numbers are the fields
-    tests/test_cluster.py asserts lower bounds on; the larger one guards
-    against the tables being tuned to one tiny scenario.  Deterministic,
-    a few seconds total.
+    Runs three deterministic seeded workloads (300 files/300 s, 2000
+    files/600 s, 100K files/600 s) through the standard pipeline
+    (pipeline.run_pipeline, evaluate=True) with the validated scoring
+    tables and reports planted-category recovery plus the read-locality
+    gain over the reference's uniform rf=1.  The small workload's numbers
+    are the fields tests/test_cluster.py asserts lower bounds on; the
+    larger ones guard against the tables being tuned to one tiny scenario
+    (VERDICT r4 #10: 100K recorded 0.832 accuracy / +0.133 locality —
+    within a point of the toy scales).  Deterministic, ~25 s total.
     """
     out = _quality_one(300, 300.0, seed)
     out["at_2000_files"] = _quality_one(2000, 600.0, seed + 100)
+    out["at_100000_files"] = _quality_one(100_000, 600.0, seed)
     return out
 
 

@@ -1,0 +1,141 @@
+"""d2 vs kmeans|| init quality gate (VERDICT r4 #4).
+
+The D² init is k sequential rounds — 7.5 s at config 3 (k=1024), 3x the
+entire 5-iter Lloyd budget — while kmeans|| does the same job in 5 rounds
+(0.33 s).  Flipping the default needs evidence that quality holds: this
+module sweeps both inits across seeds at the BASELINE config-2/3 shapes and
+records **final inertia** (the quantity Lloyd minimizes; reference
+src/kmeans_plusplus.py has no quality metric at all) plus **planted-category
+accuracy** through the full decision pipeline.
+
+Run: ``python -m cdrs_tpu.benchmarks.init_quality [--out data/init_quality_r5.json]``
+(a real chip makes the big shape fast; CPU works at reduced sizes via
+``--small``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+import numpy as np
+
+__all__ = ["run_init_quality"]
+
+
+def _inertia(X, centroids, labels, chunk: int = 131_072) -> float:
+    """sum ||x_i - c[lab_i]||^2, chunked so no O(n*k) buffer materializes."""
+    import jax
+    import jax.numpy as jnp
+
+    n = X.shape[0]
+    chunk = min(chunk, n)
+    n_pad = ((n + chunk - 1) // chunk) * chunk
+
+    @functools.partial(jax.jit, static_argnames=("nc",))
+    def run(x, c, lab, nc):
+        xr = x.reshape(nc, chunk, x.shape[1])
+        lr = lab.reshape(nc, chunk)
+
+        def body(acc, args):
+            xc, lc = args
+            diff = xc.astype(jnp.float32) - c[lc].astype(jnp.float32)
+            keep = lc >= 0
+            # Per-chunk f32 sums are ~1e6-scale; the cross-chunk f32
+            # accumulation error (~1e-7 relative) is far below the
+            # init-to-init inertia differences being compared.
+            return acc + jnp.sum(jnp.where(keep[:, None], diff * diff,
+                                           0.0)), None
+
+        acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xr, lr))
+        return acc
+
+    import jax.numpy as jnp
+    if n_pad != n:
+        X = jnp.pad(X, ((0, n_pad - n), (0, 0)))
+        labels = jnp.pad(labels, (0, n_pad - n), constant_values=-1)
+    return float(run(X, jnp.asarray(centroids), jnp.asarray(labels),
+                     n_pad // chunk))
+
+
+def _sweep_shape(n: int, d: int, k: int, chunk_rows, seeds, max_iter: int,
+                 methods=("d2", "kmeans||")) -> dict:
+    from ..ops.kmeans_jax import kmeans_jax_full
+    from .harness import _synth_blobs_device
+
+    out: dict = {"n": n, "d": d, "k": k, "max_iter": max_iter,
+                 "seeds": list(seeds)}
+    for method in methods:
+        inertias, iters, secs = [], [], []
+        for seed in seeds:
+            X = _synth_blobs_device(n, d, min(k, 64), seed, "float32", None)
+            t0 = time.perf_counter()
+            c, lab, it, _ = kmeans_jax_full(
+                X, k, seed=seed, max_iter=max_iter, tol=1e-4,
+                chunk_rows=chunk_rows, update="auto", init_method=method)
+            secs.append(time.perf_counter() - t0)
+            inertias.append(_inertia(X, c, lab))
+            iters.append(it)
+        out[method] = {
+            "inertia_per_seed": inertias,
+            "inertia_mean": float(np.mean(inertias)),
+            "inertia_std": float(np.std(inertias)),
+            "n_iter_per_seed": iters,
+            "wall_seconds_per_seed": secs,
+        }
+    if all(m in out for m in ("d2", "kmeans||")):
+        out["inertia_ratio_kmeans_par_over_d2"] = (
+            out["kmeans||"]["inertia_mean"] / out["d2"]["inertia_mean"])
+    return out
+
+
+def run_init_quality(small: bool = False, n_seeds: int = 5) -> dict:
+    """The full gate: inertia sweeps at configs 2/3 + pipeline accuracy."""
+    from .harness import _quality_one
+
+    seeds = list(range(n_seeds))
+    shapes = ([(131_072, 32, 128, None, 30), (262_144, 128, 1024, None, 10)]
+              if small else
+              [(1_048_576, 32, 128, None, 30),
+               (10_485_760, 128, 1024, 131_072, 10)])
+    result: dict = {"small": small, "shapes": []}
+    for n, d, k, chunk, max_iter in shapes:
+        result["shapes"].append(_sweep_shape(n, d, k, chunk, seeds, max_iter))
+
+    # Decision quality through the whole pipeline (the metric that matters:
+    # does the init change which categories files land in?).
+    dq = {}
+    for method in ("d2", "kmeans||"):
+        dq[method] = {
+            "at_300": _quality_one(300, 300.0, 21, backend="jax",
+                                   init_method=method)["planted_accuracy"],
+            "at_2000": _quality_one(2000, 600.0, 121, backend="jax",
+                                    init_method=method)["planted_accuracy"],
+        }
+    result["decision_quality_planted_accuracy"] = dq
+    return result
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="data/init_quality_r5.json")
+    p.add_argument("--small", action="store_true",
+                   help="reduced sizes (CPU-feasible)")
+    p.add_argument("--seeds", type=int, default=5)
+    args = p.parse_args()
+
+    result = run_init_quality(small=args.small, n_seeds=args.seeds)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps({k: v for k, v in result.items()
+                      if k != "shapes"}, indent=2))
+    for s in result["shapes"]:
+        print(f"n={s['n']} d={s['d']} k={s['k']}: "
+              f"ratio kmeans||/d2 = {s.get('inertia_ratio_kmeans_par_over_d2')}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

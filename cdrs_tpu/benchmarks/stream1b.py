@@ -33,17 +33,22 @@ __all__ = ["run_stream1b"]
 def run_stream1b(events: int = 1_000_000_000, n_files: int = 1_000_000,
                  batch_size: int = 4_000_000, seed: int = 0,
                  workdir: str | None = None, keep_log: bool = False,
-                 base_dir: str = "/user/root/synth") -> dict:
+                 base_dir: str = "/user/root/synth",
+                 log_format: str = "csv") -> dict:
     from ..config import GeneratorConfig, SimulatorConfig
     from ..features.streaming import fold_stream, stream_finalize
     from ..sim.access import simulate_access
     from ..sim.generator import generate_population
 
+    if log_format not in ("csv", "binary"):
+        raise ValueError(f"log_format must be 'csv' or 'binary', "
+                         f"got {log_format!r}")
     td = workdir or tempfile.mkdtemp(prefix="cdrs_stream1b_")
     os.makedirs(td, exist_ok=True)
-    log = os.path.join(td, "access.log")
+    log = os.path.join(
+        td, "access.cdrsb" if log_format == "binary" else "access.log")
     out: dict = {"events_requested": int(events), "n_files": int(n_files),
-                 "batch_size": int(batch_size)}
+                 "batch_size": int(batch_size), "log_format": log_format}
     if keep_log:
         out["log_path"] = log  # a kept ~60 GB file must be findable
     try:
@@ -68,7 +73,10 @@ def run_stream1b(events: int = 1_000_000_000, n_files: int = 1_000_000,
         out["simulate_events_per_sec"] = len(ev) / out["simulate_seconds"]
 
         t0 = time.perf_counter()
-        ev.write_csv(log, manifest)
+        if log_format == "binary":
+            ev.write_binary(log, manifest)
+        else:
+            ev.write_csv(log, manifest)
         out["write_seconds"] = time.perf_counter() - t0
         out["write_rows_per_sec"] = len(ev) / out["write_seconds"]
         out["log_bytes"] = os.path.getsize(log)
@@ -122,11 +130,16 @@ def main() -> int:
     p.add_argument("--base_dir", default="/user/root/synth",
                    help="manifest path prefix (shorter -> smaller log; the "
                         "1B-row log is ~73 GB at the default, ~62 GB at /s)")
+    p.add_argument("--format", choices=["csv", "binary"], default="csv",
+                   help="log format: 'csv' = the ~62-73 GB reference "
+                        "contract; 'binary' = the ~17 GB columnar .cdrsb "
+                        "fast path (VERDICT r4 #2)")
     args = p.parse_args()
     print(json.dumps(run_stream1b(
         events=int(args.events), n_files=args.n_files,
         batch_size=args.batch_size, workdir=args.workdir,
-        keep_log=args.keep_log, base_dir=args.base_dir)))
+        keep_log=args.keep_log, base_dir=args.base_dir,
+        log_format=args.format)))
     return 0
 
 

@@ -51,10 +51,11 @@ def _add_backend_arg(p: argparse.ArgumentParser, mesh: bool = True,
 
 def _add_init_method_arg(p: argparse.ArgumentParser) -> None:
     p.add_argument(
-        "--init_method", choices=["d2", "kmeans||"], default="d2",
+        "--init_method", choices=["auto", "d2", "kmeans||"], default="auto",
         help="centroid init (jax backend): 'd2' = reference KMeans++ "
              "semantics; 'kmeans||' = oversampling init whose cost does "
-             "not grow with k",
+             "not grow with k; 'auto' (default) = kmeans|| at k >= 256, "
+             "d2 below (quality gate: data/init_quality_r5.json)",
     )
     p.add_argument(
         "--dtype", choices=["float32", "bfloat16", "float64"], default=None,
@@ -132,10 +133,16 @@ def _cmd_simulate(args) -> int:
         clients=tuple(args.clients.split(",")),
         seed=args.seed,
     )
+    fmt = args.format
+    if fmt == "auto":
+        fmt = "binary" if args.out.endswith(".cdrsb") else "csv"
     with StageTimer("simulate") as t:
         manifest = Manifest.read_csv(args.manifest)
         events = simulate_access(manifest, cfg, engine=args.engine)
-        events.write_csv(args.out, manifest)
+        if fmt == "binary":
+            events.write_binary(args.out, manifest)
+        else:
+            events.write_csv(args.out, manifest)
     print(f"Wrote {args.out} with {len(events)} entries in {t.elapsed:.2f}s")
     return 0
 
@@ -180,7 +187,7 @@ def _cmd_cluster(args) -> int:
 
     model = ReplicationPolicyModel(
         kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed,
-                                init_method=getattr(args, 'init_method', 'd2'),
+                                init_method=getattr(args, 'init_method', 'auto'),
                                 dtype=getattr(args, 'dtype', None)),
         scoring_cfg=_load_scoring(args),
         backend=args.backend,
@@ -206,7 +213,7 @@ def _cmd_pipeline(args) -> int:
         simulator=SimulatorConfig(duration_seconds=args.duration_seconds,
                                   seed=None if args.seed is None else args.seed + 1),
         kmeans=KMeansConfig(k=args.k, seed=args.seed,
-                            init_method=getattr(args, 'init_method', 'd2'),
+                            init_method=getattr(args, 'init_method', 'auto'),
                             dtype=getattr(args, 'dtype', None)),
         scoring=_load_scoring(args),
         mesh_shape=_parse_mesh(args.mesh),
@@ -238,6 +245,8 @@ def _cmd_evaluate(args) -> int:
     scoring = _load_scoring(args)
     rf = np.full(len(manifest), args.default_rf, dtype=np.int32)
     rows = matched = 0
+    want_plan = bool(args.emit_plan or args.emit_setrep)
+    plan_rows: list[tuple[str, str]] = []
     with open(args.assignments_csv, newline="") as f:
         for row in _csv.DictReader(f):
             rows += 1
@@ -246,6 +255,8 @@ def _cmd_evaluate(args) -> int:
             if i is not None and r is not None:
                 rf[i] = r
                 matched += 1
+                if want_plan:
+                    plan_rows.append((row["path"], row["category"]))
     if rows and matched == 0:
         print(f"error: no row of {args.assignments_csv} matched a manifest "
               f"path with a known category — is this the cluster "
@@ -254,6 +265,20 @@ def _cmd_evaluate(args) -> int:
     if matched < rows:
         print(f"warning: {rows - matched}/{rows} assignment rows ignored "
               f"(unknown path or category)", file=sys.stderr)
+
+    if want_plan:
+        from .cluster import build_plan, write_plan_csv, write_setrep_script
+
+        entries = build_plan([p for p, _ in plan_rows],
+                             [c for _, c in plan_rows], scoring)
+        if args.emit_plan:
+            write_plan_csv(args.emit_plan, entries)
+            print(f"plan: {len(entries)} files -> {args.emit_plan}",
+                  file=sys.stderr)
+        if args.emit_setrep:
+            n = write_setrep_script(args.emit_setrep, entries)
+            print(f"setrep script: {n} commands -> {args.emit_setrep}",
+                  file=sys.stderr)
 
     nodes = tuple(args.nodes.split(",")) if args.nodes else tuple(manifest.nodes)
     out = compare_policies(manifest, events, rf,
@@ -337,7 +362,7 @@ def _cmd_stream(args) -> int:
     model = ReplicationPolicyModel(
         kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed,
                                 batch_size=args.kmeans_batch,
-                                init_method=getattr(args, 'init_method', 'd2'),
+                                init_method=getattr(args, 'init_method', 'auto'),
                                 dtype=getattr(args, 'dtype', None)),
         scoring_cfg=_load_scoring(args),
         backend=args.backend,
@@ -393,6 +418,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--engine", choices=["numpy", "native"], default="numpy",
                    help="'native' = threaded C++ generator (runtime/native.py)")
+    p.add_argument("--format", choices=["auto", "csv", "binary"],
+                   default="auto",
+                   help="log format: 'csv' = the reference access.log "
+                        "contract; 'binary' = the columnar .cdrsb fast path "
+                        "(every reader auto-detects it); 'auto' = binary "
+                        "when --out ends in .cdrsb")
     p.set_defaults(fn=_cmd_simulate)
 
     p = sub.add_parser("features", help="extract the 5 per-file features")
@@ -446,6 +477,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--scoring_config", default=None, metavar="JSON",
                    help="scoring config the assignments were produced with "
                         "(source of the category -> replication-factor table)")
+    p.add_argument("--emit_plan", default=None, metavar="CSV",
+                   help="write the per-file target-rf plan (path,category,rf)"
+                        " — the exportable decision a real cluster can apply")
+    p.add_argument("--emit_setrep", default=None, metavar="SH",
+                   help="write an 'hdfs dfs -setrep' command list applying "
+                        "the plan on a live HDFS")
     p.set_defaults(fn=_cmd_evaluate)
 
     p = sub.add_parser("stream", help="stream the access log in batches, then cluster")

@@ -12,8 +12,12 @@ load balance, and storage cost (SURVEY.md §4.2's missing validation loop).
 
 from .placement import ClusterTopology, PlacementResult, place_replicas
 from .evaluate import PolicyMetrics, evaluate_placement, compare_policies
+from .plan import (PlanEntry, build_plan, write_plan_csv, read_plan_csv,
+                   write_setrep_script)
 
 __all__ = [
     "ClusterTopology", "PlacementResult", "place_replicas",
     "PolicyMetrics", "evaluate_placement", "compare_policies",
+    "PlanEntry", "build_plan", "write_plan_csv", "read_plan_csv",
+    "write_setrep_script",
 ]

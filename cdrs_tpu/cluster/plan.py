@@ -1,0 +1,129 @@
+"""Exportable replication plan — the hook that can act on a REAL cluster.
+
+The reference stores files in a live HDFS (``hdfs dfs -put``,
+src/generator.py:9-10,39) with a uniform dfs.replication=1
+(docker/hadoop.env:2), decides per-category factors (main.py:131-142) — and
+never applies them.  The rebuild applies them inside its own simulator
+(cluster/placement.py); this module closes the remaining gap by exporting the
+decision in forms an external cluster can consume:
+
+* a **plan file** (CSV ``path,category,rf``) — the per-file target
+  replication factor, machine-readable and round-trippable;
+* an **``hdfs dfs -setrep`` command list** (a shell script, one command per
+  rf group) — directly runnable against the HDFS the reference's compose
+  cluster stands up.
+
+Plans are pure data: building one touches no cluster.  ``read_plan_csv``
+round-trips ``write_plan_csv`` exactly.
+"""
+
+from __future__ import annotations
+
+import csv
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..config import ScoringConfig
+
+__all__ = ["PlanEntry", "build_plan", "write_plan_csv", "read_plan_csv",
+           "write_setrep_script"]
+
+#: Paths per ``hdfs dfs -setrep`` invocation.  setrep accepts many paths per
+#: call; batching bounds the command-line length (HDFS paths in the
+#: reference's layout are short, but plans may cover millions of files).
+_SETREP_BATCH = 500
+
+
+@dataclass(frozen=True)
+class PlanEntry:
+    path: str
+    category: str
+    rf: int
+
+
+def build_plan(paths, categories, cfg: ScoringConfig | None = None,
+               rf=None) -> list[PlanEntry]:
+    """Per-file target-rf plan from decided categories.
+
+    ``rf`` overrides the config's category -> rf table when given (one int
+    per file); otherwise factors come from ``cfg.replication_factors`` —
+    the same table the cluster stage decided with (reference
+    main.py:131-142 semantics).  Unknown categories raise: a plan with a
+    silently-defaulted rf would mis-replicate on a real cluster.
+    """
+    cfg = cfg or ScoringConfig()
+    paths = list(paths)
+    categories = list(categories)
+    if len(paths) != len(categories):
+        raise ValueError(
+            f"{len(paths)} paths vs {len(categories)} categories")
+    if rf is not None:
+        rf = np.asarray(rf, dtype=np.int64)
+        if rf.shape != (len(paths),):
+            raise ValueError(f"rf shape {rf.shape} != ({len(paths)},)")
+        factors = [int(r) for r in rf]
+    else:
+        table = cfg.replication_factors
+        missing = sorted({c for c in categories if c not in table})
+        if missing:
+            raise ValueError(
+                f"categories {missing} have no replication factor in the "
+                f"scoring config (known: {sorted(table)})")
+        factors = [int(table[c]) for c in categories]
+    return [PlanEntry(p, c, f)
+            for p, c, f in zip(paths, categories, factors)]
+
+
+def write_plan_csv(path: str, entries: list[PlanEntry]) -> None:
+    """``path,category,rf`` — one row per file, header included."""
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["path", "category", "rf"])
+        for e in entries:
+            w.writerow([e.path, e.category, e.rf])
+
+
+def read_plan_csv(path: str) -> list[PlanEntry]:
+    """Inverse of ``write_plan_csv`` (exact round-trip)."""
+    out = []
+    with open(path, newline="") as f:
+        for row in csv.DictReader(f):
+            out.append(PlanEntry(row["path"], row["category"],
+                                 int(row["rf"])))
+    return out
+
+
+def write_setrep_script(path: str, entries: list[PlanEntry],
+                        batch: int = _SETREP_BATCH,
+                        wait: bool = False) -> int:
+    """Write a shell script of ``hdfs dfs -setrep`` commands applying the plan.
+
+    Files are grouped by target rf (one setrep per batch of ``batch`` paths)
+    so the script issues O(#rf-values * #files/batch) commands, not one per
+    file.  ``wait=True`` adds ``-w`` (block until re-replication completes —
+    slow on real clusters, per the HDFS docs, but deterministic).  Returns
+    the number of setrep commands written.  Paths are single-quoted (with
+    quote-escaping) for shell safety.
+    """
+    def q(s: str) -> str:
+        return "'" + s.replace("'", "'\\''") + "'"
+
+    by_rf: dict[int, list[str]] = {}
+    for e in entries:
+        by_rf.setdefault(e.rf, []).append(e.path)
+
+    n_cmds = 0
+    flag = "-w " if wait else ""
+    with open(path, "w") as f:
+        f.write("#!/bin/sh\n# Generated replication plan "
+                f"({len(entries)} files, {len(by_rf)} rf groups).\n"
+                "# Apply with: sh this_script  (requires the hdfs CLI "
+                "on PATH and a running namenode).\nset -e\n")
+        for rf in sorted(by_rf):
+            paths = by_rf[rf]
+            for i in range(0, len(paths), batch):
+                chunk = " ".join(q(p) for p in paths[i:i + batch])
+                f.write(f"hdfs dfs -setrep {flag}{rf} {chunk}\n")
+                n_cmds += 1
+    return n_cmds

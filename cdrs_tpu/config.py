@@ -202,10 +202,14 @@ class KMeansConfig:
     batch_size: int | None = None
     #: Shuffled passes over the data in mini-batch mode.
     batch_epochs: int = 5
-    #: Centroid init for the jax backend: "d2" (reference KMeans++ semantics)
-    #: or "kmeans||" (oversampling init whose cost does not scale with k —
-    #: ops/kmeans_jax._kmeans_par_init_local, SURVEY.md §7.4 hard part).
-    init_method: str = "d2"
+    #: Centroid init for the jax backend: "d2" (reference KMeans++ semantics),
+    #: "kmeans||" (oversampling init whose cost does not scale with k —
+    #: ops/kmeans_jax._kmeans_par_init_local, SURVEY.md §7.4 hard part), or
+    #: "auto" (kmeans|| at k >= 256 where D²'s k sequential rounds dominate,
+    #: d2 below — quality gate in data/init_quality_r5.json).  The numpy
+    #: backend always runs the reference D² init; "auto" is valid there and
+    #: resolves to it.
+    init_method: str = "auto"
     #: Points dtype for the jax backend (None = keep the input's float dtype).
     #: "bfloat16" halves the HBM stream the Lloyd assignment is bound by;
     #: centroids/stats stay float32 (ops/kmeans_jax._stat_dtype).
@@ -220,9 +224,9 @@ class KMeansConfig:
             raise ValueError(
                 f"dtype must be one of float32/bfloat16/float16/float64 or "
                 f"None; got {self.dtype!r}")
-        if self.init_method not in ("d2", "kmeans||"):
+        if self.init_method not in ("auto", "d2", "kmeans||"):
             raise ValueError(
-                f"init_method must be 'd2' or 'kmeans||'; "
+                f"init_method must be 'auto', 'd2' or 'kmeans||'; "
                 f"got {self.init_method!r}")
 
     def resolve_max_iter(self, n: int) -> int:

@@ -99,7 +99,9 @@ class ReplicationPolicyModel:
             if cfg.batch_size is not None:
                 raise ValueError(
                     "mini-batch KMeans (batch_size) requires the jax backend")
-            if cfg.init_method != "d2":
+            if cfg.init_method not in ("auto", "d2"):
+                # "auto" is the config default and the numpy backend has
+                # exactly one init — the reference D² — so it resolves there.
                 raise ValueError(
                     f"init_method {cfg.init_method!r} requires the jax backend")
             if cfg.dtype is not None:

@@ -51,7 +51,17 @@ __all__ = [
     "kmeans_jax_full",
     "padding_multiple",
     "resolve_update",
+    "resolve_init_method",
 ]
+
+#: "auto" init flips from d2 to kmeans|| at this k.  D² is k sequential
+#: rounds (7.5 s at k=1024, config 3 — 3x the 5-iter Lloyd budget) while
+#: kmeans||'s 5 rounds are k-independent (0.33 s); the recorded quality gate
+#: (data/init_quality_r5.json: final-inertia ratio ~1.00 across 5 seeds at
+#: configs 2 and 3, pipeline planted accuracy within seed noise) shows
+#: nothing is lost.  Below this k the D² cost is negligible and its
+#: reference-faithful semantics win by default.
+AUTO_INIT_KMEANS_PAR_MIN_K = 256
 
 
 
@@ -137,6 +147,21 @@ def resolve_update(update: str, nmodel: int = 1, dtype=np.float32,
     if k is not None and pallas_tile(k) is None:
         return "matmul"
     return "pallas"
+
+
+def resolve_init_method(init_method: str, k: int) -> str:
+    """Resolve the "auto" centroid init.
+
+    "auto" -> "kmeans||" once k reaches ``AUTO_INIT_KMEANS_PAR_MIN_K``
+    (the D² init's k sequential rounds dominate e2e time at large k;
+    quality gate recorded in data/init_quality_r5.json), "d2" below it.
+    Explicit choices pass through untouched.  Feasibility (kmeans||'s
+    per-round sample must fit one shard) is checked downstream by
+    ``kmeans_jax_full``, which falls back to d2 for auto-resolved runs.
+    """
+    if init_method != "auto":
+        return init_method
+    return "kmeans||" if int(k) >= AUTO_INIT_KMEANS_PAR_MIN_K else "d2"
 
 
 def padding_multiple(ndata: int, chunk_rows: int | None, update: str,
@@ -796,7 +821,9 @@ def kmeans_jax_full(
     drawing ``ceil(init_oversample * k / init_rounds)`` candidates — the init
     cost stops scaling with k (D² is 1024 sequential rounds at the BASELINE
     k=1024 configs).  Different (but comparable-quality) starting centroids
-    than "d2"; not available with ``init_centroids``.
+    than "d2"; not available with ``init_centroids``.  ``"auto"`` resolves
+    by k (``resolve_init_method``: kmeans|| at k >= 256, d2 below, falling
+    back to d2 when the oversample exceeds shard rows).
     """
     is_device_array = isinstance(X, jax.Array)
     if not is_device_array:
@@ -868,16 +895,23 @@ def kmeans_jax_full(
         raise ValueError(
             f"k={k} exceeds the pallas kernel's VMEM budget "
             f"(no (k_pad, tile) block fits); use update='matmul'")
-    if init_method not in ("d2", "kmeans||"):
+    if init_method not in ("auto", "d2", "kmeans||"):
         raise ValueError(f"unknown init_method {init_method!r}")
+    auto_init = init_method == "auto"
+    init_method = resolve_init_method(init_method, k)
     init_per_round = 0
     if init_method == "kmeans||" and not with_init:
         init_per_round = max(1, int(np.ceil(init_oversample * k / init_rounds)))
         n_loc = Xp.shape[0] // ndata
         if init_per_round > n_loc:
-            raise ValueError(
-                f"kmeans|| needs per-round sample {init_per_round} <= shard "
-                f"rows {n_loc}; use init_method='d2' at this scale")
+            if auto_init:
+                # Tiny shards (k comparable to shard rows): the oversample
+                # doesn't fit, and at that scale D² is cheap anyway.
+                init_method, init_per_round = "d2", 0
+            else:
+                raise ValueError(
+                    f"kmeans|| needs per-round sample {init_per_round} <= "
+                    f"shard rows {n_loc}; use init_method='d2' at this scale")
     fn = _build_kmeans(
         n_valid, d, int(k), ndata, nmodel, int(max_iter), float(tol),
         with_init, np.dtype(dtype).name, chunk_rows, update,

@@ -1,0 +1,227 @@
+"""Binary columnar event log (.cdrsb — VERDICT r4 #2).
+
+The CSV access.log stays the interchange contract; the binary sidecar is the
+parse-free fast path for billion-event feeds.  These tests pin round-trip
+fidelity against the CSV path, the auto-detect dispatch, append safety, and
+streaming-fold parity (offsets included).
+"""
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
+from cdrs_tpu.io.events import EventLog, is_binary_log
+from cdrs_tpu.sim.access import simulate_access
+from cdrs_tpu.sim.generator import generate_population
+
+
+@pytest.fixture(scope="module")
+def workload():
+    manifest = generate_population(GeneratorConfig(n_files=120, seed=11))
+    events = simulate_access(manifest,
+                             SimulatorConfig(duration_seconds=120.0, seed=12))
+    return manifest, events
+
+
+def _assert_logs_equal(a: EventLog, b: EventLog):
+    np.testing.assert_array_equal(a.ts, b.ts)
+    np.testing.assert_array_equal(a.path_id, b.path_id)
+    np.testing.assert_array_equal(a.op, b.op)
+    np.testing.assert_array_equal(a.client_id, b.client_id)
+    assert a.clients == b.clients
+
+
+def test_binary_round_trip_vs_csv(tmp_path, workload):
+    """Binary write -> read returns exactly what the CSV path returns —
+    except timestamps, where binary is BETTER (no ms truncation): compare
+    CSV-read fields against binary-read fields after CSV-equal rounding."""
+    manifest, events = workload
+    csv_p, bin_p = str(tmp_path / "a.log"), str(tmp_path / "a.cdrsb")
+    events.write_csv(csv_p, manifest)
+    events.write_binary(bin_p, manifest)
+    assert is_binary_log(bin_p) and not is_binary_log(csv_p)
+
+    from_csv = EventLog.read_csv(csv_p, manifest)
+    from_bin = EventLog.read_csv(bin_p, manifest)  # auto-dispatch
+    np.testing.assert_array_equal(from_csv.path_id, from_bin.path_id)
+    np.testing.assert_array_equal(from_csv.op, from_bin.op)
+    np.testing.assert_array_equal(from_csv.client_id, from_bin.client_id)
+    assert from_csv.clients == from_bin.clients
+    # CSV truncates to ms; binary preserves the f64 exactly.
+    np.testing.assert_array_equal(from_bin.ts, events.ts)
+    np.testing.assert_allclose(from_csv.ts, from_bin.ts, atol=1e-3)
+
+
+def test_binary_exact_event_log_round_trip(tmp_path, workload):
+    manifest, events = workload
+    p = str(tmp_path / "x.cdrsb")
+    events.write_binary(p, manifest)
+    back = EventLog.read_csv(p, manifest)
+    _assert_logs_equal(events, back)
+
+
+def test_binary_append_blocks(tmp_path, workload):
+    """Chunked appends (the 1B-generator pattern) concatenate exactly."""
+    manifest, events = workload
+    p = str(tmp_path / "app.cdrsb")
+    n = len(events)
+    half = n // 2
+
+    def slice_log(lo, hi):
+        return EventLog(ts=events.ts[lo:hi], path_id=events.path_id[lo:hi],
+                        op=events.op[lo:hi],
+                        client_id=events.client_id[lo:hi],
+                        clients=events.clients)
+
+    slice_log(0, half).write_binary(p, manifest)
+    slice_log(half, n).write_binary(p, manifest, append=True)
+    back = EventLog.read_csv(p, manifest)
+    _assert_logs_equal(events, back)
+
+
+def test_binary_append_vocab_mismatch_raises(tmp_path, workload):
+    manifest, events = workload
+    p = str(tmp_path / "bad.cdrsb")
+    events.write_binary(p, manifest)
+    other = EventLog(ts=events.ts, path_id=events.path_id, op=events.op,
+                     client_id=events.client_id,
+                     clients=events.clients + ["intruder"])
+    with pytest.raises(ValueError, match="vocabulary"):
+        other.write_binary(p, manifest, append=True)
+
+
+def test_binary_batches_and_offsets_resume(tmp_path, workload):
+    """Batch slicing respects batch_size; a reported offset resumes to the
+    identical remainder (the fold_stream checkpoint contract)."""
+    manifest, events = workload
+    p = str(tmp_path / "b.cdrsb")
+    n = len(events)
+    third = n // 3
+
+    def slice_log(lo, hi):
+        return EventLog(ts=events.ts[lo:hi], path_id=events.path_id[lo:hi],
+                        op=events.op[lo:hi],
+                        client_id=events.client_id[lo:hi],
+                        clients=events.clients)
+
+    slice_log(0, third).write_binary(p, manifest)
+    slice_log(third, n).write_binary(p, manifest, append=True)
+
+    got = list(EventLog.read_csv_batches(p, manifest, batch_size=100,
+                                         with_offsets=True))
+    assert sum(len(b) for b, _ in got) == n
+    for b, _ in got[:-1]:
+        assert len(b) <= 100
+    # Offsets only at block boundaries; at least the final one is reported.
+    offsets = [off for _, off in got if off is not None]
+    assert offsets, "block-final batches must report a resume offset"
+
+    # Resume from the first reported offset: remainder must be identical.
+    rows_before = 0
+    first_off = None
+    for b, off in got:
+        rows_before += len(b)
+        if off is not None:
+            first_off = off
+            break
+    resumed = list(EventLog.read_csv_batches(p, manifest, batch_size=None,
+                                             start_offset=first_off))
+    assert len(resumed) == 1
+    np.testing.assert_array_equal(resumed[0].ts, events.ts[rows_before:])
+    np.testing.assert_array_equal(resumed[0].path_id,
+                                  events.path_id[rows_before:])
+
+
+def test_binary_empty_log_and_empty_blocks(tmp_path, workload):
+    """A 0-row log reads back empty (CSV parity); an empty appended block
+    (the empty-final-flush pattern) is skipped, not a crash."""
+    manifest, events = workload
+    empty = EventLog(ts=np.zeros(0), path_id=np.zeros(0, np.int32),
+                     op=np.zeros(0, np.int8),
+                     client_id=np.zeros(0, np.int32),
+                     clients=list(events.clients))
+    p = str(tmp_path / "e.cdrsb")
+    empty.write_binary(p, manifest)
+    back = EventLog.read_csv(p, manifest)
+    assert len(back) == 0
+
+    p2 = str(tmp_path / "e2.cdrsb")
+    events.write_binary(p2, manifest)
+    empty.write_binary(p2, manifest, append=True)  # same vocab: legal
+    back2 = EventLog.read_csv(p2, manifest)
+    _assert_logs_equal(events, back2)
+
+
+def test_binary_truncated_file_raises_clearly(tmp_path, workload):
+    manifest, events = workload
+    p = str(tmp_path / "t.cdrsb")
+    events.write_binary(p, manifest)
+    size = (tmp_path / "t.cdrsb").stat().st_size
+    # Truncate inside the trailing cid column AND inside a count field.
+    for cut in (size - 3, size - len(events) * (8 + 4 + 1 + 4) - 3):
+        with open(p, "r+b") as f:
+            f.truncate(cut)
+        with pytest.raises(ValueError, match="truncated/corrupt block"):
+            EventLog.read_csv(p, manifest)
+        events.write_binary(p, manifest)  # restore
+
+
+def test_binary_foreign_manifest_left_join(tmp_path, workload):
+    """Reading with a manifest missing some paths maps them to -1 (the CSV
+    reader's left-join semantics) and extends the client vocabulary."""
+    manifest, events = workload
+    p = str(tmp_path / "f.cdrsb")
+    events.write_binary(p, manifest)
+
+    import copy
+
+    m2 = copy.deepcopy(manifest)
+    # Drop the last 20 files from the reader's manifest.
+    keep = len(manifest) - 20
+    m2.paths = m2.paths[:keep]
+    m2.creation_ts = m2.creation_ts[:keep]
+    m2.primary_node_id = m2.primary_node_id[:keep]
+    m2.size_bytes = m2.size_bytes[:keep]
+    m2.category = m2.category[:keep]
+    m2.path_to_id = {pp: i for i, pp in enumerate(m2.paths)}
+
+    back = EventLog.read_csv(p, m2)
+    dropped = events.path_id >= keep
+    assert (back.path_id[dropped] == -1).all()
+    np.testing.assert_array_equal(back.path_id[~dropped],
+                                  events.path_id[~dropped])
+
+
+def test_fold_stream_binary_csv_parity(tmp_path, workload):
+    """The streaming feature fold over the binary log equals the CSV fold
+    bit-for-bit once timestamps match (write CSV, read it back, binarize)."""
+    from cdrs_tpu.features.streaming import fold_stream, stream_finalize
+
+    manifest, events = workload
+    csv_p, bin_p = str(tmp_path / "p.log"), str(tmp_path / "p.cdrsb")
+    events.write_csv(csv_p, manifest)
+    # Round timestamps through the CSV to make the two sources identical.
+    ev_ms = EventLog.read_csv(csv_p, manifest)
+    ev_ms.write_binary(bin_p, manifest)
+
+    t_csv = stream_finalize(fold_stream(csv_p, manifest, batch_size=500),
+                            manifest)
+    t_bin = stream_finalize(fold_stream(bin_p, manifest, batch_size=500),
+                            manifest)
+    np.testing.assert_array_equal(np.asarray(t_csv.raw),
+                                  np.asarray(t_bin.raw))
+
+
+def test_cli_simulate_binary_format(tmp_path, capsys):
+    from cdrs_tpu.cli import main
+
+    mpath = tmp_path / "m.csv"
+    manifest = generate_population(GeneratorConfig(n_files=40, seed=5))
+    manifest.write_csv(str(mpath))
+    out = tmp_path / "a.cdrsb"
+    rc = main(["simulate", "--manifest", str(mpath), "--out", str(out),
+               "--duration_seconds", "60", "--seed", "5"])
+    assert rc == 0
+    assert is_binary_log(str(out))  # --format auto picked binary by suffix
+    ev = EventLog.read_csv(str(out), manifest)
+    assert len(ev) > 0

@@ -1,0 +1,110 @@
+"""Exportable replication plan (cluster/plan.py, VERDICT r4 #9).
+
+The plan is the hook that lets the decision act on a REAL cluster (the
+reference stands up a live HDFS but never applies its decided factors —
+docker/hadoop.env:2 pins dfs.replication=1).
+"""
+
+import json
+import subprocess
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.cluster import (PlanEntry, build_plan, read_plan_csv,
+                              write_plan_csv, write_setrep_script)
+from cdrs_tpu.config import ScoringConfig
+
+
+def test_build_plan_uses_config_rf_table():
+    cfg = ScoringConfig()
+    entries = build_plan(["/a", "/b"], ["Hot", "Archival"], cfg)
+    assert entries == [
+        PlanEntry("/a", "Hot", cfg.replication_factors["Hot"]),
+        PlanEntry("/b", "Archival", cfg.replication_factors["Archival"]),
+    ]
+
+
+def test_build_plan_rejects_unknown_category():
+    with pytest.raises(ValueError, match="Sizzling"):
+        build_plan(["/a"], ["Sizzling"], ScoringConfig())
+
+
+def test_build_plan_explicit_rf_overrides_table():
+    entries = build_plan(["/a", "/b"], ["Hot", "Hot"], rf=np.array([5, 1]))
+    assert [e.rf for e in entries] == [5, 1]
+
+
+def test_plan_csv_round_trip(tmp_path):
+    entries = build_plan(
+        [f"/data/file_{i:04d}.bin" for i in range(50)],
+        ["Hot", "Moderate", "Shared", "Archival"] * 12 + ["Hot", "Shared"],
+        ScoringConfig())
+    p = tmp_path / "plan.csv"
+    write_plan_csv(str(p), entries)
+    assert read_plan_csv(str(p)) == entries
+
+
+def test_setrep_script_groups_by_rf(tmp_path):
+    entries = build_plan(
+        [f"/f{i}" for i in range(10)],
+        ["Hot"] * 4 + ["Archival"] * 6, ScoringConfig())
+    p = tmp_path / "apply.sh"
+    n = write_setrep_script(str(p), entries, batch=500)
+    text = p.read_text()
+    # One command per rf group at this size; every path present exactly once.
+    assert n == 2 == text.count("hdfs dfs -setrep")
+    for e in entries:
+        assert f"'{e.path}'" in text
+    # rf groups carry the right factor.
+    cfg = ScoringConfig()
+    assert f"-setrep {cfg.replication_factors['Archival']} " in text
+    assert f"-setrep {cfg.replication_factors['Hot']} " in text
+
+
+def test_setrep_script_batches_and_quotes(tmp_path):
+    entries = [PlanEntry(f"/weird it's {i}", "Hot", 3) for i in range(7)]
+    p = tmp_path / "apply.sh"
+    n = write_setrep_script(str(p), entries, batch=3)
+    assert n == 3  # ceil(7/3)
+    # The script must parse as valid shell (quote-escaping correct).
+    subprocess.run(["sh", "-n", str(p)], check=True)
+
+
+def test_cli_evaluate_emit_plan_round_trip(tmp_path, capsys):
+    """cdrs evaluate --emit_plan/--emit_setrep: plan matches the assignments
+    the evaluation itself applied."""
+    from cdrs_tpu.cli import main
+    from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
+    from cdrs_tpu.sim.access import simulate_access
+    from cdrs_tpu.sim.generator import generate_population
+
+    manifest = generate_population(GeneratorConfig(n_files=30, seed=3))
+    events = simulate_access(manifest,
+                             SimulatorConfig(duration_seconds=30, seed=3))
+    mpath, apath = tmp_path / "m.csv", tmp_path / "a.log"
+    manifest.write_csv(str(mpath))
+    events.write_csv(str(apath), manifest)
+
+    cats = ["Hot", "Shared", "Moderate"]
+    assign = tmp_path / "assign.csv"
+    with open(assign, "w") as f:
+        f.write("path,cluster,category\n")
+        for i, p in enumerate(manifest.paths):
+            f.write(f"{p},0,{cats[i % 3]}\n")
+
+    plan_p, setrep_p = tmp_path / "plan.csv", tmp_path / "apply.sh"
+    rc = main(["evaluate", "--manifest", str(mpath), "--access_log",
+               str(apath), "--assignments_csv", str(assign),
+               "--emit_plan", str(plan_p), "--emit_setrep", str(setrep_p)])
+    assert rc == 0
+    json.loads(capsys.readouterr().out)  # metrics still printed
+
+    cfg = ScoringConfig()
+    entries = read_plan_csv(str(plan_p))
+    assert len(entries) == 30
+    by_path = {e.path: e for e in entries}
+    for i, p in enumerate(manifest.paths):
+        assert by_path[p].category == cats[i % 3]
+        assert by_path[p].rf == cfg.replication_factors[cats[i % 3]]
+    subprocess.run(["sh", "-n", str(setrep_p)], check=True)
