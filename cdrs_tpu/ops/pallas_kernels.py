@@ -21,6 +21,45 @@ are pushed to +inf distance so argmin never selects them).
 
 Reference hot loop being replaced: the (n, k, d) broadcast at
 src/kmeans_plusplus.py:33 (SURVEY.md §3.2 hot loop #4).
+
+Where the config-2 time goes (round-5 issue-rate analysis)
+----------------------------------------------------------
+VERDICT r4 #1 asked for >= 2x at n=1M, d=32, k=128 or a written analysis.
+Measured on v5e, same-process fori-chained 500-iteration windows (the only
+methodology the remote tunnel admits), ~0.65-0.70 ms/iter baseline in the
+measurement process:
+
+* **The kernel is compute-bound, not bandwidth-bound.**  A fixed-tile
+  variant (every grid step reads the same VMEM-resident tile — zero HBM
+  streaming) times IDENTICALLY to the streaming kernel (0.678 vs 0.688
+  ms/iter).  The DMA pipeline fully hides the x stream behind compute.
+  The "~0.21 ms read floor" the round-4 notes compared against is a
+  linear-scan number; the achievable stream rate for this (d=32, T)
+  tile shape is 0.31-0.36 ms — and it is hidden anyway.
+* **Half the compute is the distance matmul.**  Matmul-only: 0.34 ms/iter
+  (~25 TFLOP/s effective — the d=32 contraction fills a quarter of the
+  128-wide MXU reduction dimension).  Casting both operands to bf16 in
+  VMEM does NOT help (0.34 -> 0.34): the cost is contraction-depth-bound,
+  not precision-bound.  Padding d to 128 would 4x the FLOPs for 4x the
+  utilization — a wash — and 4x the HBM stream.
+* **The rest splits between the stats matmul and the argmin chain.**
+  dist+min only: 0.57; + one-hot + stats matmul: 0.57 (the second matmul
+  overlaps the VPU chain almost entirely); + first-match tie resolution +
+  counts colsum: 0.65-0.69.
+* **Variants tried and measured (same process, best-of-N):** packed
+  argmin via bitcast+index-in-mantissa (-3%); multi-hot ``dist == dmin``
+  with fractional tie weights folded into a (d+1)-row stats matmul (-2%);
+  tie handling deleted outright (UNSOUND upper bound: -9%); both-operand
+  bf16 matmuls (0%); pre-blocked fully-contiguous (n/T, d, T) layout (0%
+  — DMA was never the issue); transposed (T, k_pad) block with lane-major
+  argmin (8x WORSE); tiles {1024: +15%, 2048: baseline, 4096: -4%,
+  8192: -4%} — 4096 adopted.
+* **Conclusion:** at ~0.67 ms/iter the fused kernel sits within 2x of its
+  own distance-matmul lower bound (0.34 ms).  Every further win requires
+  either not materializing the (k_pad, T) distance block (exact Lloyd
+  does not admit that) or raising MXU utilization at d=32 (fixed by the
+  problem shape).  The remaining ~0.33 ms is the argmin/one-hot/counts
+  chain whose individual removal attempts each bought < 10%.
 """
 
 from __future__ import annotations
@@ -46,20 +85,21 @@ _LANE = 128
 #: k_pad * tile <= 2^20 elements = 2 x 4 MB blocks.
 _VMEM_ELEMS = 1 << 20
 
-#: Column tile the Lloyd kernel iterates internally.  2048 won the round-4
-#: in-loop v5e sweep at k=128 (1.10 ms/iter vs 1.48 at 4096 / 1.47 at 8192,
-#: n=1M d=32 — the (k_pad, 2048) f32 distance + one-hot pair double-buffers
-#: cleanly at 2x1 MB); at k_pad >= 512 only smaller tiles fit the VMEM
-#: budget and the ladder below takes over (k=1024 measured best at 1024:
-#: 31.7 ms/iter vs 35.0 at 512, n=4M d=128).
-LLOYD_TILE_COLS = 2048
+#: Column tile the Lloyd kernel iterates internally.  4096 won the round-5
+#: interleaved same-process v5e sweep at k=128 (median 0.672 ms/iter vs
+#: 0.699 at 2048 / 0.676 at 8192, n=1M d=32, production Lloyd loop; the
+#: round-4 "2048 best" ranking came from cross-process windows, which the
+#: tunnel makes incomparable).  At k_pad >= 512 only smaller tiles fit the
+#: VMEM budget and the ladder below takes over (k=1024 measured best at
+#: 1024: 31.7 ms/iter vs 35.0 at 512, n=4M d=128).
+LLOYD_TILE_COLS = 4096
 
 
 def lloyd_tile(k: int) -> int | None:
     """Column tile for the fused Lloyd kernel at this k, or None when no
     tile fits the VMEM budget (callers fall back to the XLA matmul path)."""
     k_pad = _pad_to(max(int(k), 8), _LANE)
-    for t in (LLOYD_TILE_COLS, 1024, 512):
+    for t in (LLOYD_TILE_COLS, 2048, 1024, 512):
         if k_pad * t <= _VMEM_ELEMS:
             return t
     return None

@@ -45,6 +45,7 @@ __all__ = [
     "compute_cluster_medians_hist_jax",
     "score_table_jax",
     "classify_jax",
+    "resolve_median_method",
     "HIST_MEDIAN_THRESHOLD",
 ]
 
@@ -452,6 +453,33 @@ def _hist_medians_sharded(x, labels, k: int, bins: int, with_global: bool,
     return fn(x, labels)
 
 
+def resolve_median_method(method: str, ndata: int, n_rows: int) -> str:
+    """Resolve the ``median_method`` knob to a concrete algorithm.
+
+    "auto": exact sort below ``HIST_MEDIAN_THRESHOLD`` rows on a single
+    device; past it (or on any sharded mesh) bisection on a real TPU
+    backend, histogram elsewhere (the r5 flip — sharded auto used to
+    conservatively pick hist; the sharded bisect is parity-tested at
+    atol=0 against single-device bisect).  "sort" raises on a sharded
+    mesh: a distributed exact sort is the wrong shape for the scales
+    that need sharding (SURVEY.md §7.4).
+    """
+    if ndata > 1 and method == "sort":
+        raise ValueError(
+            "median_method='sort' is single-device; sharded scoring "
+            "(mesh_shape data > 1) uses histogram or bisection medians "
+            "— pass median_method='hist', 'bisect', or 'auto'")
+    if method == "auto":
+        if ndata == 1 and n_rows <= HIST_MEDIAN_THRESHOLD:
+            return "sort"
+        from .pallas_kernels import pallas_available
+
+        return "bisect" if pallas_available() else "hist"
+    if method not in ("sort", "hist", "bisect"):
+        raise ValueError(f"unknown median_method {method!r}")
+    return method
+
+
 @jax.jit
 def score_table_jax(
     cluster_medians: jnp.ndarray,   # (k, d)
@@ -523,25 +551,8 @@ def classify_jax(
     labels = jnp.asarray(labels).astype(jnp.int32)
     ndata = int((mesh_shape or {}).get("data", 1))
 
-    method = getattr(cfg, "median_method", "auto")
-    if ndata > 1 and method == "sort":
-        raise ValueError(
-            "median_method='sort' is single-device; sharded scoring "
-            "(mesh_shape data > 1) uses histogram or bisection medians "
-            "— pass median_method='hist', 'bisect', or 'auto'")
-    if method == "auto":
-        if ndata == 1 and x.shape[0] <= HIST_MEDIAN_THRESHOLD:
-            method = "sort"
-        else:
-            # Bisection on a real TPU backend (~5x the psum-histogram path
-            # at 10M x 128, k=1024; the sharded variant is parity-tested at
-            # atol=0 against single-device bisect on the virtual mesh),
-            # histogram elsewhere.
-            from .pallas_kernels import pallas_available
-
-            method = "bisect" if pallas_available() else "hist"
-    if method not in ("sort", "hist", "bisect"):
-        raise ValueError(f"unknown median_method {method!r}")
+    method = resolve_median_method(getattr(cfg, "median_method", "auto"),
+                                   ndata, x.shape[0])
     bins = int(getattr(cfg, "median_bins", 2048))
 
     want_global = global_medians is None and cfg.compute_global_medians_from_data
