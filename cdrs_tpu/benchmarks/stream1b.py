@@ -97,6 +97,8 @@ def run_stream1b(events: int = 1_000_000_000, n_files: int = 1_000_000,
             # producer thread, transfer+fold on the main thread — wall time
             # is ~max of the two, not their sum (the overlap is the point).
             "ingest_parse_prep_seconds": stats.get("producer_seconds"),
+            "ingest_parse_seconds": stats.get("parse_seconds"),
+            "ingest_prep_seconds": stats.get("prep_seconds"),
             "fold_seconds": stats.get("fold_seconds"),
             "ingest_plus_fold_seconds": total,
             "ingest_events_per_sec": n_events / total,

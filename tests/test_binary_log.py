@@ -79,6 +79,38 @@ def test_binary_append_blocks(tmp_path, workload):
     _assert_logs_equal(events, back)
 
 
+def test_binary_chunked_blocks_round_trip(tmp_path, workload):
+    """One write_binary call split into many blocks (the 1B-writer layout):
+    exact round trip incl. the partial final block, and every block
+    boundary is a valid resume offset."""
+    manifest, events = workload
+    p = str(tmp_path / "chunked.cdrsb")
+    n = len(events)
+    block = 7  # forces many blocks + a partial final block (n % 7 != 0)
+    assert n % block != 0
+    events.write_binary(p, manifest, block_rows=block)
+    back = EventLog.read_csv(p, manifest)
+    _assert_logs_equal(events, back)
+
+    # batch_size=block aligns batches with blocks: every batch ends a block
+    # and must carry a resume offset that replays the exact remainder.
+    got = list(EventLog.read_csv_batches(p, manifest, batch_size=block,
+                                         with_offsets=True))
+    assert sum(len(b) for b, _ in got) == n
+    assert all(off is not None for _, off in got)
+    rows = 0
+    for b, off in got[:3]:
+        rows += len(b)
+        resumed = list(EventLog.read_csv_batches(p, manifest,
+                                                 batch_size=None,
+                                                 start_offset=off))
+        np.testing.assert_array_equal(resumed[0].ts, events.ts[rows:])
+
+    with pytest.raises(ValueError, match="block_rows"):
+        events.write_binary(str(tmp_path / "bad.cdrsb"), manifest,
+                            block_rows=0)
+
+
 def test_binary_append_vocab_mismatch_raises(tmp_path, workload):
     manifest, events = workload
     p = str(tmp_path / "bad.cdrsb")
