@@ -556,38 +556,56 @@ def classify_jax(
     bins = int(getattr(cfg, "median_bins", 2048))
 
     want_global = global_medians is None and cfg.compute_global_medians_from_data
-    if ndata > 1:
-        sharded_medians = (_bisect_medians_sharded if method == "bisect"
-                           else _hist_medians_sharded)
-        medians, gmeds = sharded_medians(
-            x, labels, int(k), bins, want_global, ndata,
-            int((mesh_shape or {}).get("model", 1)))
-    elif method == "bisect":
-        medians, gmeds = _bisect_medians(x, labels, int(k), bins, want_global)
-    elif method == "hist":
-        # Global medians (when needed) fall out of the same histograms —
-        # one data pass total.
-        medians, gmeds = _hist_medians(x, labels, int(k), bins, want_global)
+    if global_medians is not None:
+        gm = jnp.asarray(global_medians, dtype=x.dtype)
+    elif want_global:
+        gm = None  # computed on device inside the fused program
     else:
-        medians = compute_cluster_medians_jax(x, labels, int(k))
-    if global_medians is None:
-        if cfg.compute_global_medians_from_data:
-            global_medians = (gmeds if method in ("hist", "bisect")
-                              else jnp.median(x, axis=0))
-        else:
-            global_medians = jnp.asarray(
-                [cfg.global_medians[f] for f in cfg.features], dtype=x.dtype
-            )
-    else:
-        global_medians = jnp.asarray(global_medians, dtype=x.dtype)
+        gm = jnp.asarray([cfg.global_medians[f] for f in cfg.features],
+                         dtype=x.dtype)
 
     W = jnp.asarray(np.array(cfg.weight_matrix(), dtype=np.float64), dtype=x.dtype)
     D = jnp.asarray(np.array(cfg.direction_matrix(), dtype=np.float64), dtype=x.dtype)
     is_mod = jnp.asarray(np.array([c == "Moderate" for c in cfg.categories]))
     rf = jnp.asarray(np.array(cfg.rf_vector(), dtype=np.float64), dtype=x.dtype)
 
-    scores = score_table_jax(
-        medians, global_medians, W, D, is_mod, jnp.asarray(cfg.moderate_band, x.dtype)
-    )
-    winner = _pick_winner(scores, rf)
-    return winner, scores, medians
+    fused = _build_classify(method, int(k), bins, bool(want_global), ndata,
+                            int((mesh_shape or {}).get("model", 1)))
+    return fused(x, labels, gm, W, D, is_mod,
+                 jnp.asarray(cfg.moderate_band, x.dtype), rf)
+
+
+@functools.lru_cache(maxsize=64)
+def _build_classify(method: str, k: int, bins: int, use_data_gm: bool,
+                    ndata: int, nmodel: int):
+    """One jit program for the whole classification tail: medians -> score
+    table -> winner.  Previously three separate dispatches (medians, scores,
+    pick) — on a remote-tunnel backend each dispatch carries ~60-100 ms of
+    fixed latency, a visible slice of the 2.5-3 s config-3/4 e2e paths.
+    The scoring tables arrive as traced arguments, so one compiled program
+    serves every ScoringConfig of the same shape."""
+
+    def fused(x, labels, gm, W, D, is_mod, band, rf):
+        if ndata > 1:
+            sharded_medians = (_bisect_medians_sharded if method == "bisect"
+                               else _hist_medians_sharded)
+            medians, gmeds = sharded_medians(x, labels, k, bins, use_data_gm,
+                                             ndata, nmodel)
+        elif method == "bisect":
+            medians, gmeds = _bisect_medians(x, labels, k, bins, use_data_gm)
+        elif method == "hist":
+            # Global medians (when needed) fall out of the same histograms —
+            # one data pass total.
+            medians, gmeds = _hist_medians(x, labels, k, bins, use_data_gm)
+        else:
+            medians = compute_cluster_medians_jax(x, labels, k)
+            gmeds = jnp.median(x, axis=0) if use_data_gm else None
+        # use_data_gm is static per compiled program: exactly one of the two
+        # sources exists (gm arrives as None — an empty pytree leaf — on the
+        # from-data path, and vice versa).
+        global_medians = gmeds if use_data_gm else gm
+        scores = score_table_jax(medians, global_medians, W, D, is_mod, band)
+        winner = _pick_winner(scores, rf)
+        return winner, scores, medians
+
+    return jax.jit(fused)
