@@ -802,8 +802,17 @@ def kmeans_jax_full(
     init_method: str = "d2",
     init_oversample: float = 2.0,
     init_rounds: int = 5,
+    block_scalars: bool = True,
 ):
     """Sharded KMeans++ + Lloyd.  Returns (centroids, labels, n_iter, shift).
+
+    ``block_scalars=False`` skips the final device->host fetch of
+    ``(n_iter, shift)`` and returns them as device scalars: the call then
+    does not synchronize at all, so a downstream stage (e.g. the fused
+    scoring program) dispatches immediately behind the Lloyd work — on a
+    remote-tunnel backend the skipped fetch is a ~25-100 ms pipeline
+    stall.  Callers needing Python ints fetch after their own final sync
+    (``int(n_iter)`` works on the returned array).
 
     ``iter_offset`` shifts the global iteration index used for the reseed PRNG
     stream — a blocked/checkpointed run passing its completed-iteration count
@@ -921,6 +930,8 @@ def kmeans_jax_full(
         raise ValueError(f"k={k} exceeds number of valid samples {n_valid}")
     centroids, labels, it, shift = fn(
         Xp, c0, key, _device_scalar_i32(int(iter_offset)))
+    if not block_scalars:
+        return centroids, labels[:n_valid], it, shift
     # One host fetch for both scalars — int(it); float(shift) would be two
     # device->host round trips (each ~25-100 ms on remote-tunnel backends).
     it, shift = jax.device_get((it, shift))
