@@ -942,7 +942,11 @@ def kmeans_jax(X, k: int, **kwargs):
     """Reference-shaped API: returns (centroids, labels).
 
     Accepts every ``kmeans_jax_full`` knob (tol, seed, max_iter,
-    init_centroids, mesh_shape, dtype, chunk_rows, update, n_valid).
+    init_centroids, mesh_shape, dtype, chunk_rows, update, n_valid,
+    block_scalars, ...).  Since (n_iter, shift) are discarded, the scalar
+    fetch is skipped by default — this call never synchronizes; the
+    caller's own use of centroids/labels is the sync point.
     """
+    kwargs.setdefault("block_scalars", False)
     centroids, labels, _, _ = kmeans_jax_full(X, k, **kwargs)
     return centroids, labels
