@@ -25,7 +25,9 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--config", type=int, default=None,
                    help="run a single BASELINE config (default: config 2 "
-                        "plus the config-3/config-4-rehearsal capture)")
+                        "plus the config-3/config-4-rehearsal capture; "
+                        "--update/--dtype/--e2e apply to those captures "
+                        "too)")
     p.add_argument("--backend", default=None)
     p.add_argument("--update", default=None,
                    choices=["auto", "matmul", "scatter", "pallas"],
@@ -66,16 +68,23 @@ def main() -> int:
         import jax
 
         if jax.default_backend() == "tpu":
+            # --update/--dtype/--e2e apply to the extra captures too, so a
+            # flagged driver run measures ONE strategy everywhere instead
+            # of silently reverting the k=1024 captures to their defaults.
             try:
-                out["config3"] = run_bench(config=3, quality=False)
+                out["config3"] = run_bench(config=3, quality=False,
+                                           update=args.update, e2e=args.e2e,
+                                           dtype=args.dtype)
             except Exception as e:  # pragma: no cover - depends on host
                 out["config3"] = {"error": f"{type(e).__name__}: {e}"}
             try:
                 # bf16 points double rows/chip: on one chip config 4
                 # downscales to 13.1M rows = the TRUE v5e-8 per-chip shard
-                # (104857600/8).
+                # (104857600/8).  The rehearsal is DEFINED as an e2e bf16
+                # run: --update/--dtype override it, --e2e is already on.
                 out["config4_rehearsal"] = run_bench(
-                    config=4, quality=False, e2e=True, dtype="bfloat16")
+                    config=4, quality=False, e2e=True,
+                    update=args.update, dtype=args.dtype or "bfloat16")
             except Exception as e:  # pragma: no cover - depends on host
                 out["config4_rehearsal"] = {"error": f"{type(e).__name__}: {e}"}
         else:

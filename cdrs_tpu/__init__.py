@@ -15,6 +15,8 @@ Package map (SURVEY.md §7):
   parallel  — mesh construction, shard_map kernels, collectives (multi-chip)
   models    — the flagship ReplicationPolicyModel + streaming variant (L4)
   io        — on-disk contracts (metadata.csv / access.log / features CSV)
+  control   — online replication controller: windowed drift detection,
+              incremental re-cluster, bounded-churn migration (L4+)
   compat    — drop-in reference API (kmeans(), ClusterClassifier)
   runtime   — native C++ runtime bindings (event generation, log parsing)
   cli       — the single `cdrs` CLI (L5)

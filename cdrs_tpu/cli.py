@@ -344,9 +344,6 @@ def _cmd_stream(args) -> int:
         if args.checkpoint:
             print("warning: --checkpoint requires --backend jax; ignored",
                   file=sys.stderr)
-        if args.checkpoint:
-            print("warning: --checkpoint requires --backend jax; ignored",
-                  file=sys.stderr)
         with StageTimer("stream") as t:
             manifest = Manifest.read_csv(args.manifest)
             state = stream_init(len(manifest))
@@ -375,6 +372,51 @@ def _cmd_stream(args) -> int:
             else "full-batch")
     print(f"Cluster centroid assignments ({args.k} clusters, {mode}) saved "
           f"to: {args.output_csv} in {t.elapsed:.2f}s")
+    return 0
+
+
+def _cmd_control(args) -> int:
+    """Online replication controller: consume the log as time windows,
+    drift-gate incremental re-clusters, meter out bounded-churn migrations
+    (control/controller.py)."""
+    from .control import ControllerConfig, ReplicationController
+    from .io.events import Manifest
+
+    cfg = ControllerConfig(
+        window_seconds=args.window_seconds,
+        drift_threshold=args.drift_threshold,
+        full_recluster_drift=args.full_drift,
+        warm_max_iter=args.warm_max_iter,
+        max_bytes_per_window=args.max_bytes,
+        max_files_per_window=args.max_files,
+        hysteresis_windows=args.hysteresis,
+        decay=args.decay,
+        default_rf=args.default_rf,
+        backend=args.backend,
+        kmeans=KMeansConfig(k=args.k, seed=args.seed,
+                            init_method=getattr(args, 'init_method', 'auto'),
+                            dtype=getattr(args, 'dtype', None)),
+        scoring=_load_scoring(args),
+        mesh_shape=_parse_mesh(args.mesh),
+        evaluate=not args.no_evaluate,
+    )
+    manifest = Manifest.read_csv(args.manifest)
+    controller = ReplicationController(manifest, cfg)
+    with StageTimer("control") as t:
+        result = controller.run(
+            args.access_log, metrics_path=args.metrics,
+            checkpoint_path=args.checkpoint,
+            checkpoint_every=args.checkpoint_every,
+            max_windows=args.max_windows, batch_size=args.batch_size)
+    if args.plan_out:
+        from .cluster.plan import write_plan_csv
+
+        write_plan_csv(args.plan_out, result.plan_entries())
+        print(f"plan: {len(manifest)} files -> {args.plan_out}",
+              file=sys.stderr)
+    out = result.summary()
+    out["seconds"] = round(t.elapsed, 3)
+    print(json.dumps(out, indent=2))
     return 0
 
 
@@ -506,6 +548,54 @@ def main(argv: list[str] | None = None) -> int:
     _add_backend_arg(p)
     _add_init_method_arg(p)
     p.set_defaults(fn=_cmd_stream)
+
+    p = sub.add_parser("control", help="online replication controller: "
+                       "windowed drift detection -> incremental re-cluster "
+                       "-> bounded-churn migration")
+    p.add_argument("--manifest", required=True)
+    p.add_argument("--access_log", required=True,
+                   help="globally time-sorted log (CSV access.log or .cdrsb)")
+    p.add_argument("--window_seconds", type=float, default=60.0)
+    p.add_argument("--k", type=int, default=8)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--drift_threshold", type=float, default=0.05,
+                   help="drift score at/above which a re-cluster runs")
+    p.add_argument("--full_drift", type=float, default=0.30, metavar="SCORE",
+                   help="drift at/above which the warm start is abandoned "
+                        "(fresh init, full iteration budget)")
+    p.add_argument("--warm_max_iter", type=int, default=25)
+    p.add_argument("--max_bytes", type=int, default=None, metavar="BYTES",
+                   help="per-window migration byte budget (default: "
+                        "unbounded)")
+    p.add_argument("--max_files", type=int, default=None, metavar="N",
+                   help="per-window migrated-file cap (default: unbounded)")
+    p.add_argument("--hysteresis", type=int, default=1, metavar="WINDOWS",
+                   help="windows a migrated file stays frozen (anti-flap)")
+    p.add_argument("--decay", type=float, default=1.0,
+                   help="per-window feature-counter decay; < 1.0 re-weights "
+                        "toward recent traffic (numpy backend)")
+    p.add_argument("--default_rf", type=int, default=1)
+    p.add_argument("--batch_size", type=int, default=1_000_000,
+                   help="events per log read batch (windows re-slice it)")
+    p.add_argument("--metrics", default=None, metavar="JSONL",
+                   help="append one JSON record per window here")
+    p.add_argument("--plan_out", default=None, metavar="CSV",
+                   help="write the final applied plan (path,category,rf)")
+    p.add_argument("--checkpoint", default=None, metavar="NPZ",
+                   help="snapshot the controller state here every "
+                        "--checkpoint_every windows; rerunning the same "
+                        "command resumes with an identical plan sequence")
+    p.add_argument("--checkpoint_every", type=int, default=1, metavar="W")
+    p.add_argument("--max_windows", type=int, default=None,
+                   help="stop after N processed windows (stepping a live "
+                        "controller)")
+    p.add_argument("--no_evaluate", action="store_true",
+                   help="skip the per-window locality/balance replay")
+    p.add_argument("--medians_from_data", action="store_true")
+    p.add_argument("--scoring_config", default=None, metavar="JSON|validated")
+    _add_backend_arg(p)
+    _add_init_method_arg(p)
+    p.set_defaults(fn=_cmd_control)
 
     p = sub.add_parser("bench", help="benchmark harness (BASELINE.md configs)")
     p.add_argument("--config", type=int, default=1)

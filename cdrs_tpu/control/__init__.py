@@ -1,0 +1,15 @@
+"""Online replication control: windowed drift detection -> incremental
+re-cluster -> bounded-churn migration (see control/controller.py)."""
+
+from .controller import ControllerConfig, ControllerResult, \
+    ReplicationController
+from .drift import DriftReport, detect_drift
+from .migrate import MigrationScheduler, PlanMove, plan_diff
+from .windows import iter_windows
+
+__all__ = [
+    "ControllerConfig", "ControllerResult", "ReplicationController",
+    "DriftReport", "detect_drift",
+    "MigrationScheduler", "PlanMove", "plan_diff",
+    "iter_windows",
+]

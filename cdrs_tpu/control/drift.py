@@ -1,0 +1,70 @@
+"""Cheap windowed drift detection against the last accepted model.
+
+The controller must not pay a full re-cluster per window; this detector
+answers "did the feature distribution move?" with two O(n·k·d) quantities
+computed from the current feature snapshot and the last ACCEPTED model
+(centroids + per-category population fractions):
+
+* **centroid shift** — one Lloyd step from the accepted centroids (assign,
+  then per-cluster means; empty clusters do not move) and the RMS L2 norm of
+  the centroid movement.  Features are min-max normalized to [0, 1]
+  (features/streaming_np.finalize_counters), so the magnitude is comparable
+  across workloads.
+* **population delta** — total-variation distance between the per-category
+  population fractions under the accepted model's (centroid -> category)
+  mapping and the fractions recorded when the model was accepted.
+
+``score = max(centroid_shift, population_delta)``: either signal alone is
+grounds to re-cluster (a category flip can move populations with little
+centroid motion and vice versa).  Everything is plain NumPy — the detector
+runs every window, on host, regardless of the clustering backend.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["DriftReport", "detect_drift"]
+
+
+@dataclass(frozen=True)
+class DriftReport:
+    score: float             # max(centroid_shift, population_delta)
+    centroid_shift: float    # RMS L2 centroid movement of one Lloyd step
+    population_delta: float  # total-variation distance of category fractions
+    fractions: np.ndarray    # (n_categories,) current category fractions
+
+
+def detect_drift(
+    X: np.ndarray,
+    centroids: np.ndarray,
+    category_idx: np.ndarray,
+    accepted_fractions: np.ndarray,
+    n_categories: int,
+) -> DriftReport:
+    """Drift of the feature snapshot ``X`` against the accepted model."""
+    from ..ops.kmeans_np import assign_labels
+
+    X = np.asarray(X, dtype=np.float64)
+    c = np.asarray(centroids, dtype=np.float64)
+    k = c.shape[0]
+    # The clustering path's own tiled assignment kernel — one tie-break/
+    # tiling implementation for both the model and its drift detector.
+    labels = assign_labels(X, c)
+
+    counts = np.bincount(labels, minlength=k).astype(np.float64)
+    sums = np.stack([np.bincount(labels, weights=X[:, j], minlength=k)
+                     for j in range(X.shape[1])], axis=1)
+    nonempty = counts > 0
+    means = np.where(nonempty[:, None], sums / np.maximum(counts, 1.0)[:, None], c)
+    shift = float(np.sqrt((((means - c) ** 2).sum(axis=1)).mean()))
+
+    cat_per_file = np.asarray(category_idx)[labels]
+    frac = np.bincount(cat_per_file, minlength=n_categories).astype(np.float64)
+    frac /= max(len(labels), 1)
+    pop_delta = float(0.5 * np.abs(frac - np.asarray(accepted_fractions)).sum())
+
+    return DriftReport(score=max(shift, pop_delta), centroid_shift=shift,
+                       population_delta=pop_delta, fractions=frac)

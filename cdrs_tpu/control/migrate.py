@@ -1,0 +1,172 @@
+"""Plan diffing and bounded-churn migration scheduling.
+
+A re-cluster produces a NEW target plan (per-file category + replication
+factor); applying it wholesale is exactly the churn storm dynamic replication
+exists to avoid.  This module turns a plan delta into *moves* and meters them
+out:
+
+* ``plan_diff`` — per-file rf up/down moves with their byte-move cost
+  (``size_bytes * max(0, rf_new - rf_old)``: new replicas are copied over the
+  network; dropping a replica is a metadata delete and moves no bytes) and a
+  caller-supplied priority (the controller uses the scoring margin of the new
+  category over the currently applied one).
+* ``MigrationScheduler`` — a backlog keyed by file.  ``submit`` replaces the
+  backlog with the newest plan's moves (a newer plan supersedes pending moves
+  for the same file, and files that no longer differ drop out — this is the
+  anti-flap property a FIFO queue lacks).  ``schedule`` pops up to the
+  per-window churn budget (bytes moved and/or files touched), highest
+  priority first, and enforces **hysteresis**: a file migrated at window w is
+  frozen until ``w + 1 + hysteresis_windows``, so a borderline file cannot
+  oscillate between categories every window.
+
+Everything is deterministic: ties break on file index, and the scheduler's
+whole state round-trips through ``state_arrays``/``load_state_arrays`` for
+the controller's checkpoint.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["PlanMove", "plan_diff", "MigrationScheduler"]
+
+#: ``last_moved`` sentinel: "never moved" must stay eligible at window 0
+#: for any hysteresis setting.
+_NEVER = -(2 ** 40)
+
+
+@dataclass(frozen=True)
+class PlanMove:
+    """One per-file replication change: rf_old -> rf_new (and its category)."""
+
+    file_index: int
+    rf_old: int
+    rf_new: int
+    cat_old: int      # index into config.CATEGORIES; -1 = not yet planned
+    cat_new: int
+    bytes_moved: int  # size_bytes * max(0, rf_new - rf_old)
+    priority: float   # larger = applied earlier
+
+
+def plan_diff(rf_old, rf_new, cat_old, cat_new, size_bytes,
+              priority=None) -> list[PlanMove]:
+    """Moves for every file whose (rf, category) changed between two plans.
+
+    All inputs are (n,) arrays; ``priority`` defaults to zero, so callers
+    that don't score moves get stable file-index ordering.
+    """
+    rf_old = np.asarray(rf_old, dtype=np.int64)
+    rf_new = np.asarray(rf_new, dtype=np.int64)
+    cat_old = np.asarray(cat_old, dtype=np.int64)
+    cat_new = np.asarray(cat_new, dtype=np.int64)
+    size_bytes = np.asarray(size_bytes, dtype=np.int64)
+    n = rf_old.shape[0]
+    for name, a in (("rf_new", rf_new), ("cat_old", cat_old),
+                    ("cat_new", cat_new), ("size_bytes", size_bytes)):
+        if a.shape != (n,):
+            raise ValueError(f"{name} shape {a.shape} != ({n},)")
+    prio = np.zeros(n) if priority is None else np.asarray(priority,
+                                                           dtype=np.float64)
+    changed = np.flatnonzero((rf_new != rf_old) | (cat_new != cat_old))
+    bytes_moved = size_bytes * np.maximum(rf_new - rf_old, 0)
+    return [PlanMove(file_index=int(i), rf_old=int(rf_old[i]),
+                     rf_new=int(rf_new[i]), cat_old=int(cat_old[i]),
+                     cat_new=int(cat_new[i]), bytes_moved=int(bytes_moved[i]),
+                     priority=float(prio[i]))
+            for i in changed]
+
+
+class MigrationScheduler:
+    """Backlog + churn budget + hysteresis (see module docstring)."""
+
+    def __init__(self, n_files: int, max_bytes_per_window: int | None = None,
+                 max_files_per_window: int | None = None,
+                 hysteresis_windows: int = 0):
+        if max_bytes_per_window is not None and max_bytes_per_window < 0:
+            raise ValueError("max_bytes_per_window must be >= 0 or None")
+        if max_files_per_window is not None and max_files_per_window < 1:
+            raise ValueError("max_files_per_window must be >= 1 or None")
+        self.n_files = int(n_files)
+        self.max_bytes = max_bytes_per_window
+        self.max_files = max_files_per_window
+        self.hysteresis = int(hysteresis_windows)
+        self.backlog: dict[int, PlanMove] = {}
+        self.last_moved = np.full(n_files, _NEVER, dtype=np.int64)
+
+    def submit(self, moves: list[PlanMove]) -> None:
+        """Replace the backlog with the newest plan's pending moves."""
+        self.backlog = {m.file_index: m for m in moves}
+
+    def schedule(self, window_index: int) -> list[PlanMove]:
+        """Pop this window's moves (budgeted, prioritized, hysteresis-gated).
+
+        Byte budget: a byte-moving move is admitted while ``bytes_used +
+        move.bytes <= max_bytes`` — except that a single move larger than
+        the whole budget is admitted as the window's first byte-moving move
+        (otherwise the largest file would starve forever; churn stays
+        bounded by one oversized move per window).  ``max_bytes == 0`` is a
+        true freeze: no byte-moving move is admitted at all (the oversized
+        allowance needs a positive budget).  Zero-byte moves (replica
+        drops, category-only changes) are metadata operations the byte
+        budget never blocks; the file cap still counts them and is strict.
+        Scheduled moves leave the backlog and stamp ``last_moved``.
+        """
+        order = sorted(self.backlog.values(),
+                       key=lambda m: (-m.priority, m.file_index))
+        applied: list[PlanMove] = []
+        bytes_used = 0
+        for m in order:
+            if self.max_files is not None and len(applied) >= self.max_files:
+                break
+            if window_index < int(self.last_moved[m.file_index]) \
+                    + 1 + self.hysteresis:
+                continue
+            if self.max_bytes is not None and m.bytes_moved > 0:
+                over = bytes_used + m.bytes_moved > self.max_bytes
+                first = bytes_used == 0 and self.max_bytes > 0
+                if over and not first:
+                    continue
+            applied.append(m)
+            bytes_used += m.bytes_moved
+        for m in applied:
+            del self.backlog[m.file_index]
+            self.last_moved[m.file_index] = window_index
+        return applied
+
+    @property
+    def backlog_bytes(self) -> int:
+        return sum(m.bytes_moved for m in self.backlog.values())
+
+    # -- checkpoint (controller snapshots ride utils/checkpoint npz) -------
+    _MOVE_COLS = ("file_index", "rf_old", "rf_new", "cat_old", "cat_new",
+                  "bytes_moved")
+
+    def state_arrays(self) -> dict[str, np.ndarray]:
+        moves = sorted(self.backlog.values(), key=lambda m: m.file_index)
+        out = {"sched_" + c: np.asarray([getattr(m, c) for m in moves],
+                                        dtype=np.int64)
+               for c in self._MOVE_COLS}
+        out["sched_priority"] = np.asarray([m.priority for m in moves],
+                                           dtype=np.float64)
+        out["sched_last_moved"] = self.last_moved.copy()
+        return out
+
+    def load_state_arrays(self, arrays: dict) -> None:
+        lm = np.asarray(arrays["sched_last_moved"], dtype=np.int64)
+        if lm.shape != (self.n_files,):
+            raise ValueError(
+                f"checkpoint covers {lm.shape[0]} files, scheduler has "
+                f"{self.n_files}")
+        self.last_moved = lm.copy()
+        cols = [np.asarray(arrays["sched_" + c]) for c in self._MOVE_COLS]
+        prio = np.asarray(arrays["sched_priority"], dtype=np.float64)
+        self.backlog = {
+            int(cols[0][i]): PlanMove(
+                file_index=int(cols[0][i]), rf_old=int(cols[1][i]),
+                rf_new=int(cols[2][i]), cat_old=int(cols[3][i]),
+                cat_new=int(cols[4][i]), bytes_moved=int(cols[5][i]),
+                priority=float(prio[i]))
+            for i in range(cols[0].shape[0])
+        }

@@ -1,0 +1,106 @@
+"""Time-window carving over a globally sorted event stream.
+
+The online controller (control/controller.py) consumes the access log as a
+sequence of fixed-width time windows, independent of how the log is batched
+on disk: a window may span several read batches and one batch may span
+several windows.  ``iter_windows`` re-slices any batch stream onto the
+window grid ``[t0 + w*W, t0 + (w+1)*W)`` (``t0`` = floor of the first event
+second, the same origin every replay of the same log derives), yielding
+EMPTY windows too — the controller's migration scheduler drains its backlog
+on every tick, events or not.
+
+Sources accepted: a log path (CSV access.log or binary ``.cdrsb`` — the
+readers auto-detect), an in-memory EventLog, or any iterable of EventLog
+batches.  The stream must be globally time-sorted (the simulator's contract,
+sim/access.py; verified batchwise here) — window carving on an unsorted log
+would silently split seconds across windows.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io.events import EventLog, Manifest
+
+__all__ = ["iter_windows"]
+
+
+def _slice(ev: EventLog, lo: int, hi: int) -> EventLog:
+    return EventLog(ts=ev.ts[lo:hi], path_id=ev.path_id[lo:hi],
+                    op=ev.op[lo:hi], client_id=ev.client_id[lo:hi],
+                    clients=ev.clients)
+
+
+def _concat(parts: list[EventLog], manifest: Manifest) -> EventLog:
+    if not parts:
+        return EventLog(ts=np.zeros(0), path_id=np.zeros(0, dtype=np.int32),
+                        op=np.zeros(0, dtype=np.int8),
+                        client_id=np.zeros(0, dtype=np.int32),
+                        clients=list(manifest.nodes))
+    return EventLog.concat(parts)
+
+
+def iter_windows(source, manifest: Manifest, window_seconds: float, *,
+                 batch_size: int = 1_000_000, t0: float | None = None,
+                 t0_out: dict | None = None):
+    """Yield ``(window_index, EventLog)`` for consecutive time windows.
+
+    Windows are ``[t0 + w*W, t0 + (w+1)*W)``; empty intermediate windows are
+    yielded (with zero-row EventLogs) so every downstream per-window action
+    ticks at a fixed cadence.  The final partial window is yielded; windows
+    after the last event are not.  Deterministic for a given (source, W, t0)
+    regardless of ``batch_size``.
+
+    ``t0_out``, when given, receives the grid origin under key ``"t0"`` as
+    soon as it is known (derived from the stream's first event when ``t0``
+    is None) — the controller checkpoints it so a resumed run replays the
+    identical window grid.
+    """
+    W = float(window_seconds)
+    if W <= 0:
+        raise ValueError(f"window_seconds must be > 0, got {window_seconds}")
+
+    if isinstance(source, EventLog):
+        batches = iter([source])
+    elif isinstance(source, (str, bytes, os.PathLike)):
+        batches = EventLog.read_csv_batches(source, manifest,
+                                            batch_size=batch_size)
+    else:
+        batches = iter(source)
+
+    w = 0
+    parts: list[EventLog] = []
+    last_ts = -np.inf
+    if t0 is not None and t0_out is not None:
+        t0_out["t0"] = float(t0)
+    for ev in batches:
+        n = len(ev)
+        if n == 0:
+            continue
+        if float(ev.ts[0]) < last_ts or not bool(np.all(np.diff(ev.ts) >= 0)):
+            raise ValueError(
+                "window carving requires a globally time-sorted log "
+                "(the simulator's output contract, sim/access.py)")
+        last_ts = float(ev.ts[-1])
+        if t0 is None:
+            t0 = float(np.floor(ev.ts[0]))
+            if t0_out is not None:
+                t0_out["t0"] = t0
+        pos = 0
+        while pos < n:
+            w_end = t0 + (w + 1) * W
+            hi = int(np.searchsorted(ev.ts, w_end, side="left"))
+            if hi >= n:
+                parts.append(_slice(ev, pos, n))
+                pos = n
+            else:
+                if hi > pos:
+                    parts.append(_slice(ev, pos, hi))
+                yield w, _concat(parts, manifest)
+                parts = []
+                w += 1
+                pos = hi
+    if parts:
+        yield w, _concat(parts, manifest)

@@ -173,6 +173,26 @@ class EventLog:
             )
         return batches[0]  # batch_size=None yields exactly one batch
 
+    @classmethod
+    def concat(cls, parts: "list[EventLog]") -> "EventLog":
+        """Concatenate batches into one EventLog.
+
+        The client vocabulary grows monotonically across a batch stream
+        (every reader's contract), so the LAST batch's vocabulary is the
+        union and its ids are valid for every earlier batch.
+        """
+        if not parts:
+            raise ValueError("concat needs at least one batch")
+        if len(parts) == 1:
+            return parts[0]
+        return cls(
+            ts=np.concatenate([b.ts for b in parts]),
+            path_id=np.concatenate([b.path_id for b in parts]),
+            op=np.concatenate([b.op for b in parts]),
+            client_id=np.concatenate([b.client_id for b in parts]),
+            clients=parts[-1].clients,
+        )
+
     #: Rows per internal native chunk when reading "the whole file at once"
     #: (keeps the parse blobs bounded; output batches are concatenated).
     _NATIVE_CHUNK_ROWS = 4_000_000
@@ -241,16 +261,7 @@ class EventLog:
         batches = [b for b, _ in gen]
         if not batches:
             return
-        if len(batches) == 1:
-            out = batches[0]
-        else:
-            out = cls(
-                ts=np.concatenate([b.ts for b in batches]),
-                path_id=np.concatenate([b.path_id for b in batches]),
-                op=np.concatenate([b.op for b in batches]),
-                client_id=np.concatenate([b.client_id for b in batches]),
-                clients=batches[-1].clients,  # vocab grows monotonically
-            )
+        out = cls.concat(batches)
         yield (out, None) if with_offsets else out
 
     @classmethod
@@ -450,10 +461,17 @@ class EventLog:
         ``pid``/``cid`` columns are remapped onto the CALLER's manifest:
         paths absent from it become -1 (the CSV reader's left-join
         semantics) and unknown clients extend the vocabulary past
-        ``manifest.nodes`` in file order.  Blocks larger than
-        ``batch_size`` are sliced (zero-copy views); offsets are reported
-        at block boundaries only (mid-block slices yield None), so any
-        reported offset is a valid later ``start_offset``.
+        ``manifest.nodes`` in file order.  Ids are range-checked against
+        the embedded string tables BEFORE the remap — a corrupt block
+        whose ids are negative or past the table would otherwise wrap
+        through the LUTs via numpy negative indexing into silently wrong
+        rows (ADVICE r5); it raises the same corrupt-block ValueError as
+        a truncated block.  Blocks larger than ``batch_size`` are sliced
+        (zero-copy views); offsets are reported at block boundaries only
+        (mid-block slices yield None), so any reported offset is a valid
+        later ``start_offset``.  ``batch_size=None`` concatenates every
+        block into ONE EventLog (the ``read_csv_batches`` whole-file
+        contract), yielded with offset None.
         """
         size = os.path.getsize(path)
         with open(path, "rb") as f:
@@ -482,7 +500,9 @@ class EventLog:
                     f"start_offset {pos} outside the block region "
                     f"[{first_block}, {size}] of {path!r}")
             f.seek(pos)
+            whole: list[EventLog] = []  # batch_size=None: accumulate blocks
             while pos < size:
+                blk = pos
                 head = np.fromfile(f, dtype=np.int64, count=1)
                 bn = int(head[0]) if head.size == 1 else -1
                 need = 8 + bn * (8 + 4 + 1 + 4)
@@ -496,16 +516,34 @@ class EventLog:
                 pid = np.fromfile(f, dtype=np.int32, count=bn)
                 op = np.fromfile(f, dtype=np.int8, count=bn)
                 cid = np.fromfile(f, dtype=np.int32, count=bn)
+                # Range-check BEFORE the LUT remap: out-of-range ids would
+                # wrap via numpy negative indexing into silently wrong rows.
+                if pid.size and (int(pid.min()) < 0
+                                 or int(pid.max()) >= len(file_paths)):
+                    raise ValueError(
+                        f"truncated/corrupt block at byte {blk} of {path!r}: "
+                        f"path id outside [0, {len(file_paths)})")
+                if cid.size and (int(cid.min()) < 0
+                                 or int(cid.max()) >= len(file_clients)):
+                    raise ValueError(
+                        f"truncated/corrupt block at byte {blk} of {path!r}: "
+                        f"client id outside [0, {len(file_clients)})")
                 if plut is not None:
                     pid = plut[pid]
                 cid = clut[cid]
-                step = bn if batch_size is None else max(1, int(batch_size))
+                if batch_size is None:
+                    whole.append(cls(ts=ts, path_id=pid, op=op,
+                                     client_id=cid, clients=list(clients)))
+                    continue
+                step = max(1, int(batch_size))
                 for lo in range(0, bn, step):
                     hi = min(bn, lo + step)
                     yield cls(ts=ts[lo:hi], path_id=pid[lo:hi],
                               op=op[lo:hi], client_id=cid[lo:hi],
                               clients=list(clients)), \
                         (pos if hi == bn else None)
+            if batch_size is None and whole:
+                yield cls.concat(whole), None
 
     def write_csv(self, path: str, manifest: Manifest) -> None:
         """Emit the reference's access.log format (ts,path,op,client,pid).

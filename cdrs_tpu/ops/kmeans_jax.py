@@ -834,6 +834,12 @@ def kmeans_jax_full(
     by k (``resolve_init_method``: kmeans|| at k >= 256, d2 below, falling
     back to d2 when the oversample exceeds shard rows).
     """
+    from .pallas_kernels import _enforce_pad_env
+
+    # Eager, per-call: already-traced kernels replay without re-executing
+    # the wrapper's Python, so this is where a mid-session
+    # CDRS_TPU_ENFORCE_PAD flip gets its one-time ignored-flip warning.
+    _enforce_pad_env()
     is_device_array = isinstance(X, jax.Array)
     if not is_device_array:
         X = np.asarray(X)

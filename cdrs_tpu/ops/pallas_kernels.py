@@ -80,6 +80,32 @@ __all__ = ["lloyd_assign_reduce_pallas", "lloyd_assign_reduce_pallas_t",
 
 _LANE = 128
 
+#: ``CDRS_TPU_ENFORCE_PAD=1`` read ONCE at import: the guard is baked into
+#: kernels at trace time, so flipping the variable after modules loaded (and
+#: kernels possibly compiled) cannot take effect — a mid-session flip used
+#: to do nothing silently; now ``_enforce_pad_env`` warns once instead.
+#: Compiled kernels replay without re-running the wrapper's Python, so the
+#: Lloyd entry point (kmeans_jax_full) also calls it eagerly per invocation
+#: — the flip is noticed even when every shape is already traced.
+_ENFORCE_PAD = os.environ.get("CDRS_TPU_ENFORCE_PAD") == "1"
+_enforce_pad_warned = False
+
+
+def _enforce_pad_env() -> bool:
+    """The import-time CDRS_TPU_ENFORCE_PAD value, warning (once) when the
+    environment has since been flipped to a different value."""
+    global _enforce_pad_warned
+    now = os.environ.get("CDRS_TPU_ENFORCE_PAD") == "1"
+    if now != _ENFORCE_PAD and not _enforce_pad_warned:
+        _enforce_pad_warned = True
+        warnings.warn(
+            "CDRS_TPU_ENFORCE_PAD changed after cdrs_tpu.ops.pallas_kernels "
+            "was imported; the guard is applied at trace time, so the new "
+            f"value is IGNORED (still using {_ENFORCE_PAD}).  Set the "
+            "variable before importing (or pass enforce_pad=True per call).",
+            RuntimeWarning, stacklevel=3)
+    return _ENFORCE_PAD
+
 #: The fused kernels' two (k_pad, tile) f32 VMEM blocks (distance + one-hot)
 #: must fit comfortably under the 16 MB scoped-VMEM limit:
 #: k_pad * tile <= 2^20 elements = 2 x 4 MB blocks.
@@ -379,9 +405,10 @@ def lloyd_assign_reduce_pallas_t(xt, c, n_valid, tile_cols: int | None = None,
     guarantee the zero-pad must pass ``enforce_pad=True`` (one extra
     ``where`` pass over xt that zeroes the tail) — non-zero pad columns
     otherwise SILENTLY corrupt sums/counts.  ``CDRS_TPU_ENFORCE_PAD=1``
-    in the environment turns the guard on globally (debug aid; read at
-    TRACE time, so it must be set before the first jit-compiled call —
-    already-compiled callers replay without the guard).  Their
+    in the environment turns the guard on globally (debug aid; read ONCE
+    at module import — flipping it afterwards is ignored with a one-time
+    RuntimeWarning, since already-traced kernels replay without the
+    guard).  Their
     labels are produced but meaningless (argmin of ||c||²).  ``c``:
     (k, d).  Returns (labels (n_cols,) int32 or None, sums (k, d) f32,
     counts (k,) f32) — same semantics as ``lloyd_assign_reduce_pallas``
@@ -398,7 +425,7 @@ def lloyd_assign_reduce_pallas_t(xt, c, n_valid, tile_cols: int | None = None,
     if interpret is None:
         interpret = not pallas_available()
     d, n_cols = xt.shape
-    if enforce_pad or os.environ.get("CDRS_TPU_ENFORCE_PAD") == "1":
+    if enforce_pad or _enforce_pad_env():
         keep = jax.lax.iota(jnp.int32, n_cols) < jnp.asarray(n_valid,
                                                              jnp.int32)
         xt = jnp.where(keep[None, :], xt, jnp.zeros((), xt.dtype))

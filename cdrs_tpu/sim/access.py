@@ -30,7 +30,7 @@ import numpy as np
 from ..config import SimulatorConfig
 from ..io.events import EventLog, Manifest
 
-__all__ = ["simulate_access", "jittered_rates"]
+__all__ = ["simulate_access", "simulate_access_with_shift", "jittered_rates"]
 
 
 def jittered_rates(
@@ -127,3 +127,72 @@ def simulate_access(
         client_id=client_id[order].astype(np.int32),
         clients=clients,
     )
+
+
+def simulate_access_with_shift(
+    manifest: Manifest,
+    cfg: SimulatorConfig,
+    shift_at: float,
+    category_flip: dict[str, str],
+    cohort: np.ndarray | None = None,
+    sim_start: float | None = None,
+    engine: str = "numpy",
+) -> tuple[EventLog, np.ndarray]:
+    """Two-phase workload: planted categories flip mid-stream for a cohort.
+
+    The online-controller benchmark scenario: the first ``shift_at`` seconds
+    are simulated from the manifest's planted categories, the remaining
+    ``duration_seconds - shift_at`` from a manifest whose cohort categories
+    were remapped through ``category_flip`` (e.g. ``{"hot": "archival",
+    "archival": "hot"}``).  ``cohort`` (bool mask over files) restricts the
+    flip; None flips every file whose planted category is a key.  Each phase
+    is one ``simulate_access`` call (identical distributional semantics);
+    phase B draws from an independent seed derived from ``cfg.seed`` so the
+    phases are decorrelated yet the whole log stays deterministic.
+
+    Returns ``(events, flipped)``: the concatenated, globally time-sorted log
+    (phase B starts exactly at ``sim_start + shift_at``) and the bool mask of
+    files whose planted category actually changed.
+    """
+    import dataclasses
+
+    duration = float(cfg.duration_seconds)
+    if not 0.0 < float(shift_at) < duration:
+        raise ValueError(
+            f"shift_at must fall inside (0, {duration}), got {shift_at}")
+    unknown = set(category_flip) | set(category_flip.values())
+    unknown -= set(cfg.rate_profiles)
+    if unknown:
+        raise ValueError(
+            f"category_flip names categories without a rate profile: "
+            f"{sorted(unknown)}")
+    if sim_start is None:
+        sim_start = float(np.ceil(manifest.creation_ts.max())) + 1.0
+
+    in_cohort = np.ones(len(manifest), dtype=bool) if cohort is None \
+        else np.asarray(cohort, dtype=bool)
+    if in_cohort.shape != (len(manifest),):
+        raise ValueError(
+            f"cohort mask shape {in_cohort.shape} != ({len(manifest)},)")
+    flipped_cat = list(manifest.category)
+    flipped = np.zeros(len(manifest), dtype=bool)
+    for i, c in enumerate(manifest.category):
+        if in_cohort[i] and c in category_flip and category_flip[c] != c:
+            flipped_cat[i] = category_flip[c]
+            flipped[i] = True
+
+    cfg_a = dataclasses.replace(cfg, duration_seconds=float(shift_at))
+    seed_b = None if cfg.seed is None else int(cfg.seed) + 0x5F17  # decorrelate
+    cfg_b = dataclasses.replace(cfg, duration_seconds=duration - float(shift_at),
+                                seed=seed_b)
+    manifest_b = dataclasses.replace(manifest, category=flipped_cat)
+
+    ev_a = simulate_access(manifest, cfg_a, sim_start=sim_start, engine=engine)
+    ev_b = simulate_access(manifest_b, cfg_b,
+                           sim_start=sim_start + float(shift_at), engine=engine)
+    # Both phases intern clients against the same (manifest nodes, cfg
+    # clients) vocabulary, so the id columns concatenate directly; phase B
+    # starts after phase A ends, so the concatenation is globally sorted.
+    if ev_a.clients != ev_b.clients:  # pragma: no cover - defensive
+        raise AssertionError("phase client vocabularies diverged")
+    return EventLog.concat([ev_a, ev_b]), flipped

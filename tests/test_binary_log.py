@@ -198,6 +198,49 @@ def test_binary_truncated_file_raises_clearly(tmp_path, workload):
         events.write_binary(p, manifest)  # restore
 
 
+def test_binary_out_of_range_ids_raise(tmp_path, workload):
+    """A corrupt block whose pid/cid is negative or past the embedded table
+    must raise the corrupt-block ValueError — numpy negative indexing would
+    otherwise wrap it through the LUT into silently wrong rows (ADVICE r5)."""
+    manifest, events = workload
+    p = str(tmp_path / "r.cdrsb")
+    events.write_binary(p, manifest)
+    with open(p, "rb") as f:
+        _, _, first_block = EventLog._read_binary_header(f)
+    bn = len(events)
+    pid_col = first_block + 8 + 8 * bn          # [count][ts f64]...[pid i32]
+    cid_col = pid_col + 4 * bn + bn             # ...[op i8][cid i32]
+    for off, bad, msg in ((pid_col, -3, "path id"),
+                          (pid_col, len(manifest.paths) + 7, "path id"),
+                          (cid_col, -1, "client id"),
+                          (cid_col, 10 ** 6, "client id")):
+        with open(p, "r+b") as f:
+            f.seek(off)
+            orig = f.read(4)
+            f.seek(off)
+            f.write(np.int32(bad).tobytes())
+        with pytest.raises(ValueError, match=msg):
+            EventLog.read_csv(p, manifest)
+        with open(p, "r+b") as f:        # restore
+            f.seek(off)
+            f.write(orig)
+    _assert_logs_equal(events, EventLog.read_csv(p, manifest))
+
+
+def test_binary_batches_none_is_one_batch(tmp_path, workload):
+    """batch_size=None concatenates every block into ONE EventLog — the
+    read_csv_batches whole-file contract, now honored by the public
+    read_binary_batches classmethod itself."""
+    manifest, events = workload
+    p = str(tmp_path / "one.cdrsb")
+    events.write_binary(p, manifest, block_rows=17)  # many small blocks
+    got = list(EventLog.read_binary_batches(p, manifest, batch_size=None))
+    assert len(got) == 1
+    log, off = got[0]
+    assert off is None
+    _assert_logs_equal(events, log)
+
+
 def test_binary_foreign_manifest_left_join(tmp_path, workload):
     """Reading with a manifest missing some paths maps them to -1 (the CSV
     reader's left-join semantics) and extends the client vocabulary."""

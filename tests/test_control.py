@@ -1,0 +1,414 @@
+"""Online replication controller (control/): windows, drift, migration
+scheduling, loop determinism, and checkpoint kill/resume bit-equality."""
+
+import json
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.config import (
+    CATEGORIES,
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from cdrs_tpu.control import (
+    ControllerConfig,
+    MigrationScheduler,
+    PlanMove,
+    ReplicationController,
+    detect_drift,
+    iter_windows,
+    plan_diff,
+)
+from cdrs_tpu.io.events import EventLog
+from cdrs_tpu.sim.access import simulate_access, simulate_access_with_shift
+from cdrs_tpu.sim.generator import generate_population
+
+
+@pytest.fixture(scope="module")
+def workload():
+    manifest = generate_population(GeneratorConfig(n_files=200, seed=21))
+    events = simulate_access(manifest,
+                             SimulatorConfig(duration_seconds=600.0, seed=22))
+    return manifest, events
+
+
+def _cfg(**kw):
+    base = dict(window_seconds=120.0,
+                kmeans=KMeansConfig(k=8, seed=42),
+                scoring=validated_scoring_config())
+    base.update(kw)
+    return ControllerConfig(**base)
+
+
+# -- windows ----------------------------------------------------------------
+
+def test_iter_windows_batch_size_invariant(workload):
+    manifest, events = workload
+
+    def collect(batches):
+        return list(iter_windows(batches, manifest, 60.0))
+
+    whole = collect(events)
+    # Re-batch the same log at an awkward size; windows must be identical.
+    split = [EventLog(ts=events.ts[i:i + 777],
+                      path_id=events.path_id[i:i + 777],
+                      op=events.op[i:i + 777],
+                      client_id=events.client_id[i:i + 777],
+                      clients=events.clients)
+             for i in range(0, len(events), 777)]
+    rebatched = collect(split)
+    assert [w for w, _ in whole] == [w for w, _ in rebatched]
+    for (_, a), (_, b) in zip(whole, rebatched):
+        np.testing.assert_array_equal(a.ts, b.ts)
+        np.testing.assert_array_equal(a.path_id, b.path_id)
+    # Consecutive indices from 0, each window inside its time span.
+    t0 = float(np.floor(events.ts[0]))
+    for w, win in whole:
+        if len(win):
+            assert t0 + w * 60.0 <= win.ts[0] and win.ts[-1] < t0 + (w + 1) * 60.0
+    assert [w for w, _ in whole] == list(range(len(whole)))
+
+
+def test_iter_windows_yields_empty_gap_windows(workload):
+    manifest, events = workload
+    # Splice a 5-window silence into the middle of the log.
+    half = len(events) // 2
+    ts = events.ts.copy()
+    ts[half:] += 600.0
+    gappy = EventLog(ts=ts, path_id=events.path_id, op=events.op,
+                     client_id=events.client_id, clients=events.clients)
+    wins = list(iter_windows(gappy, manifest, 120.0))
+    empty = [w for w, win in wins if len(win) == 0]
+    assert empty, "the silence must surface as empty windows"
+    assert [w for w, _ in wins] == list(range(len(wins)))
+
+
+def test_iter_windows_rejects_unsorted(workload):
+    manifest, events = workload
+    bad = EventLog(ts=events.ts[::-1].copy(), path_id=events.path_id,
+                   op=events.op, client_id=events.client_id,
+                   clients=events.clients)
+    with pytest.raises(ValueError, match="time-sorted"):
+        list(iter_windows(bad, manifest, 60.0))
+
+
+# -- drift ------------------------------------------------------------------
+
+def test_drift_zero_on_unchanged_features():
+    rng = np.random.default_rng(0)
+    X = np.concatenate([rng.normal(0.2, 0.02, (150, 5)),
+                        rng.normal(0.8, 0.02, (150, 5))]).clip(0, 1)
+    from cdrs_tpu.ops.kmeans_np import kmeans
+
+    centroids, labels = kmeans(X, 2, random_state=0)
+    cat_idx = np.asarray([0, 3])
+    frac = np.bincount(cat_idx[labels], minlength=len(CATEGORIES)) / len(X)
+    rep = detect_drift(X, centroids, cat_idx, frac, len(CATEGORIES))
+    assert rep.score < 1e-3  # converged model over the same data: no drift
+    # Shift half the population: both signals must fire.
+    X2 = X.copy()
+    X2[:150] = (X2[:150] + 0.6).clip(0, 1)
+    rep2 = detect_drift(X2, centroids, cat_idx, frac, len(CATEGORIES))
+    assert rep2.score > 0.1
+    assert rep2.centroid_shift > 0.0 and rep2.population_delta > 0.0
+
+
+# -- plan diff + scheduler --------------------------------------------------
+
+def test_plan_diff_moves_and_byte_cost():
+    rf_old = np.asarray([1, 1, 3, 4])
+    rf_new = np.asarray([3, 1, 1, 4])
+    cat_old = np.asarray([2, 2, 0, 3])
+    cat_new = np.asarray([0, 2, 2, 3])
+    sizes = np.asarray([100, 200, 300, 400])
+    moves = plan_diff(rf_old, rf_new, cat_old, cat_new, sizes,
+                      priority=[5.0, 0.0, 1.0, 0.0])
+    assert [m.file_index for m in moves] == [0, 2]
+    up, down = moves
+    assert up.bytes_moved == 100 * 2      # rf 1 -> 3: two new replicas
+    assert down.bytes_moved == 0          # rf 3 -> 1: drops are free
+    assert up.priority == 5.0
+
+
+def test_scheduler_budget_and_hysteresis():
+    moves = [PlanMove(i, 1, 3, 2, 0, bytes_moved=100, priority=float(10 - i))
+             for i in range(10)]
+    s = MigrationScheduler(10, max_bytes_per_window=250,
+                           max_files_per_window=None, hysteresis_windows=2)
+    s.submit(moves)
+    first = s.schedule(0)
+    # Priority order, byte budget: two 100-byte moves fit under 250.
+    assert [m.file_index for m in first] == [0, 1]
+    # Files 0/1 moved at window 0 + hysteresis 2 -> frozen until window 3.
+    s.submit(moves)  # resubmit everything, including just-moved files
+    second = s.schedule(1)
+    assert all(m.file_index not in (0, 1) for m in second)
+    assert [m.file_index for m in second] == [2, 3]
+    assert 0 not in {m.file_index for m in s.schedule(2)}
+    assert {m.file_index for m in s.schedule(3)} <= {0, 1, 6, 7, 8, 9}
+
+
+def test_scheduler_oversized_move_does_not_starve():
+    s = MigrationScheduler(3, max_bytes_per_window=50)
+    s.submit([PlanMove(0, 1, 3, 2, 0, bytes_moved=500, priority=1.0)])
+    assert [m.file_index for m in s.schedule(0)] == [0]  # sole oversized move
+    s.submit([PlanMove(1, 1, 3, 2, 0, bytes_moved=500, priority=1.0),
+              PlanMove(0, 1, 2, 2, 0, bytes_moved=40, priority=2.0)])
+    s.last_moved[:] = -(2 ** 40)
+    got = s.schedule(1)
+    # The small move fits first; the oversized one must then wait.
+    assert [m.file_index for m in got] == [0]
+
+
+def test_scheduler_zero_budget_freezes_byte_moves():
+    """max_bytes_per_window=0 is a true freeze: no byte-moving move runs
+    (the oversized allowance needs a positive budget); metadata-only
+    moves still drain."""
+    s = MigrationScheduler(2, max_bytes_per_window=0)
+    s.submit([PlanMove(0, 1, 3, 2, 0, bytes_moved=100, priority=9.0),
+              PlanMove(1, 4, 1, 3, 2, bytes_moved=0, priority=1.0)])
+    for w in range(3):
+        assert all(m.bytes_moved == 0 for m in s.schedule(w))
+    assert 0 in s.backlog and 1 not in s.backlog
+
+
+def test_scheduler_zero_byte_moves_never_byte_blocked():
+    """Replica drops are metadata operations: the byte budget must not
+    defer them, even after an oversized move overdrew the window."""
+    s = MigrationScheduler(3, max_bytes_per_window=50)
+    s.submit([PlanMove(0, 1, 3, 2, 0, bytes_moved=500, priority=3.0),
+              PlanMove(1, 4, 1, 3, 2, bytes_moved=0, priority=2.0),
+              PlanMove(2, 3, 1, 0, 2, bytes_moved=0, priority=1.0)])
+    got = s.schedule(0)
+    assert [m.file_index for m in got] == [0, 1, 2]
+
+
+def test_scheduler_file_cap_under_full_population_flip(workload):
+    """Churn cap honored while a forced full-population flip drains."""
+    manifest, events = workload
+    cap = 23
+    cfg = _cfg(max_files_per_window=cap, hysteresis_windows=0,
+               drift_threshold=10.0)  # only the cold start re-clusters
+    ctl = ReplicationController(manifest, cfg)
+    res = ctl.run(events)
+    assert all(r["moves_applied"] <= cap for r in res.records)
+    # The cold-start plan covers every file; the backlog must drain at the
+    # cap's pace, never faster.
+    applied = np.cumsum([r["moves_applied"] for r in res.records])
+    # Exactly at the cap's pace: the backlog is deep enough to saturate
+    # every window of this log.
+    assert applied[-1] == min(len(manifest), cap * len(res.records))
+    assert all(a <= cap * (i + 1) for i, a in enumerate(applied))
+
+
+def test_controller_byte_cap_respected(workload):
+    manifest, events = workload
+    # Cap safely above the largest single move (max size x rf delta <= 3)
+    # so the oversized-move allowance can never fire.
+    cap = int(np.max(manifest.size_bytes)) * 3 + 1
+    cfg = _cfg(max_bytes_per_window=cap, hysteresis_windows=0)
+    res = ReplicationController(manifest, cfg).run(events)
+    assert all(r["bytes_migrated"] <= cap for r in res.records)
+    assert sum(r["moves_applied"] for r in res.records) > 0
+
+
+# -- the loop ---------------------------------------------------------------
+
+def test_controller_deterministic(workload):
+    manifest, events = workload
+    runs = []
+    for _ in range(2):
+        res = ReplicationController(manifest, _cfg(decay=0.8)).run(events)
+        runs.append([r["plan_hash"] for r in res.records])
+    assert runs[0] == runs[1]
+
+
+def test_controller_stationary_log_drift_noop(workload):
+    """On a stationary workload only the cold start re-clusters (the drift
+    detector reports scores under the threshold for every later window)."""
+    manifest, events = workload
+    res = ReplicationController(manifest, _cfg(drift_threshold=0.15)).run(
+        events)
+    assert res.records[0]["recluster_mode"] == "full"  # cold start
+    later = res.records[1:]
+    assert later and all(not r["recluster"] for r in later)
+    assert all(r["drift"] < 0.15 for r in later if r["drift"] is not None)
+
+
+def test_controller_kill_resume_bit_identical(tmp_path, workload):
+    manifest, events = workload
+    cfg = dict(decay=0.8, max_files_per_window=40, hysteresis_windows=1)
+
+    ref = ReplicationController(manifest, _cfg(**cfg)).run(events)
+    ref_hashes = [r["plan_hash"] for r in ref.records]
+    assert len(ref_hashes) >= 4
+
+    ck = str(tmp_path / "ctl.npz")
+    a = ReplicationController(manifest, _cfg(**cfg)).run(
+        events, checkpoint_path=ck, max_windows=2)  # "killed" after 2 windows
+    b = ReplicationController(manifest, _cfg(**cfg)).run(
+        events, checkpoint_path=ck)                 # resumes from snapshot
+    assert [r["window"] for r in b.records] == \
+        list(range(2, len(ref_hashes)))
+    got = [r["plan_hash"] for r in a.records] + \
+        [r["plan_hash"] for r in b.records]
+    assert got == ref_hashes
+    np.testing.assert_array_equal(b.rf, ref.rf)
+    np.testing.assert_array_equal(b.category_idx, ref.category_idx)
+
+
+def test_controller_resume_over_grown_log_folds_tail(tmp_path, workload):
+    """Resuming over a grown append-only log must fold the events that
+    landed in the previously-final partial window — no silent undercount
+    in the carried feature state."""
+    from cdrs_tpu.features.streaming_np import stream_init_np, \
+        stream_update_np
+
+    manifest, events = workload
+    t0 = float(np.floor(events.ts[0]))
+    # Truncate mid-way through the final 120 s window of the 600 s log.
+    cut = int(np.searchsorted(events.ts, t0 + 540.0))
+    assert 0 < cut < len(events)
+    first = EventLog(ts=events.ts[:cut], path_id=events.path_id[:cut],
+                     op=events.op[:cut], client_id=events.client_id[:cut],
+                     clients=events.clients)
+
+    ck = str(tmp_path / "grow.npz")
+    ctl = ReplicationController(manifest, _cfg())
+    ctl.run(first, checkpoint_path=ck)
+
+    resumed = ReplicationController(manifest, _cfg())
+    res = resumed.run(events, checkpoint_path=ck)
+    assert res.records == []  # no new complete window: fold-only resume
+    assert resumed._events_total == len(events)
+    pure = stream_update_np(stream_init_np(len(manifest)), events, manifest)
+    np.testing.assert_array_equal(resumed._state.access_freq,
+                                  pure.access_freq)
+    np.testing.assert_array_equal(resumed._state.conc_max, pure.conc_max)
+    # The tail fold was snapshotted: a THIRD run over the same log is a
+    # clean no-op, not a re-fold.
+    third = ReplicationController(manifest, _cfg())
+    third.run(events, checkpoint_path=ck)
+    assert third._events_total == len(events)
+    np.testing.assert_array_equal(third._state.access_freq, pure.access_freq)
+
+
+def test_controller_max_windows_zero_is_a_noop(tmp_path, workload):
+    """max_windows=0 must mutate nothing — the state-inspection call."""
+    manifest, events = workload
+    ck = str(tmp_path / "noop.npz")
+    ctl = ReplicationController(manifest, _cfg())
+    res = ctl.run(events, checkpoint_path=ck, max_windows=0)
+    assert res.records == [] and ctl._events_total == 0
+    assert ctl.window_index == 0
+    import os
+
+    assert not os.path.exists(ck)  # nothing processed, nothing snapshotted
+
+
+def test_controller_checkpoint_config_mismatch(tmp_path, workload):
+    manifest, events = workload
+    ck = str(tmp_path / "ctl.npz")
+    ReplicationController(manifest, _cfg()).run(events, checkpoint_path=ck,
+                                                max_windows=1)
+    other = _cfg(kmeans=KMeansConfig(k=12, seed=42))
+    with pytest.raises(ValueError, match="stale checkpoint"):
+        ReplicationController(manifest, other).run(events,
+                                                   checkpoint_path=ck)
+
+
+def test_controller_metrics_jsonl_sink(tmp_path, workload):
+    manifest, events = workload
+    mp = str(tmp_path / "metrics.jsonl")
+    res = ReplicationController(manifest, _cfg()).run(events,
+                                                      metrics_path=mp)
+    lines = [json.loads(l) for l in open(mp)]
+    assert len(lines) == len(res.records)
+    assert lines[0]["window"] == 0 and "plan_hash" in lines[-1]
+    assert set(lines[0]["seconds"]) >= {"fold", "drift", "recluster",
+                                        "schedule", "evaluate", "total"}
+
+
+def test_controller_decay_adapts_to_shift():
+    """After a hot<->archival cohort flip the decayed controller re-plans the
+    cohort toward its new categories (the control loop's reason to exist)."""
+    from cdrs_tpu.config import PLANTED_TO_CATEGORY
+
+    manifest = generate_population(GeneratorConfig(n_files=300, seed=7))
+    flip = {"hot": "archival", "archival": "hot"}
+    events, flipped = simulate_access_with_shift(
+        manifest, SimulatorConfig(duration_seconds=1200.0, seed=8),
+        shift_at=600.0, category_flip=flip)
+    assert bool(np.all(np.diff(events.ts) >= 0)) and flipped.sum() > 10
+    cfg = ControllerConfig(window_seconds=120.0, decay=0.7,
+                           drift_threshold=0.02, hysteresis_windows=1,
+                           kmeans=KMeansConfig(k=12, seed=42),
+                           scoring=validated_scoring_config())
+    res = ReplicationController(manifest, cfg).run(events)
+    target = np.asarray([CATEGORIES.index(PLANTED_TO_CATEGORY[flip[c]])
+                         if f else -1
+                         for c, f in zip(manifest.category, flipped)])
+    cohort = flipped.nonzero()[0]
+    match = (res.category_idx[cohort] == target[cohort]).mean()
+    assert match >= 0.5, f"cohort majority not re-planned (match={match})"
+
+
+def test_controller_plan_entries_export(workload):
+    manifest, events = workload
+    res = ReplicationController(manifest, _cfg()).run(events)
+    entries = res.plan_entries()
+    assert len(entries) == len(manifest)
+    planned = [e for e in entries if e.category != "Unplanned"]
+    assert planned
+    rf_table = validated_scoring_config().replication_factors
+    assert all(e.rf == rf_table[e.category] for e in planned)
+
+
+def test_simulate_access_with_shift_contract():
+    manifest = generate_population(GeneratorConfig(n_files=100, seed=3))
+    ev1, fl1 = simulate_access_with_shift(
+        manifest, SimulatorConfig(duration_seconds=200.0, seed=4),
+        shift_at=100.0, category_flip={"hot": "archival"})
+    ev2, fl2 = simulate_access_with_shift(
+        manifest, SimulatorConfig(duration_seconds=200.0, seed=4),
+        shift_at=100.0, category_flip={"hot": "archival"})
+    np.testing.assert_array_equal(ev1.ts, ev2.ts)        # deterministic
+    np.testing.assert_array_equal(ev1.path_id, ev2.path_id)
+    np.testing.assert_array_equal(fl1, fl2)
+    assert bool(np.all(np.diff(ev1.ts) >= 0))            # globally sorted
+    want = np.asarray([c == "hot" for c in manifest.category])
+    np.testing.assert_array_equal(fl1, want)
+    with pytest.raises(ValueError, match="shift_at"):
+        simulate_access_with_shift(
+            manifest, SimulatorConfig(duration_seconds=200.0, seed=4),
+            shift_at=300.0, category_flip={"hot": "archival"})
+
+
+def test_control_bench_small_scenario(tmp_path):
+    """The shifted-workload bench harness end to end at toy scale: both
+    criteria fields present, artifact JSON round-trips, windows consistent."""
+    from cdrs_tpu.benchmarks.control_bench import run_control_bench
+
+    out = run_control_bench(n_files=150, seed=7, duration=800.0,
+                            n_windows=8, k=8)
+    assert set(out) == {"scenario", "controller", "baseline", "criteria"}
+    c, b = out["controller"], out["baseline"]
+    assert len(c["cohort_match_per_window"]) == 8
+    assert len(b["bytes_migrated_per_window"]) == 8
+    assert c["bytes_migrated_total"] == sum(c["bytes_migrated_per_window"])
+    p = tmp_path / "cb.json"
+    p.write_text(json.dumps(out))
+    assert json.loads(p.read_text())["criteria"] == out["criteria"]
+
+
+def test_controller_jax_backend_runs(workload):
+    pytest.importorskip("jax")
+    manifest, events = workload
+    cfg = _cfg(backend="jax")
+    res = ReplicationController(manifest, cfg).run(events)
+    assert res.records and res.records[0]["recluster_mode"] == "full"
+    with pytest.raises(ValueError, match="decay"):
+        _cfg(backend="jax", decay=0.5)

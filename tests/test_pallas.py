@@ -104,6 +104,47 @@ def test_pallas_feature_major_enforce_pad():
                                atol=0)
 
 
+def test_enforce_pad_env_read_once_and_warns_on_flip(monkeypatch):
+    """CDRS_TPU_ENFORCE_PAD is read ONCE at import; flipping it afterwards
+    is ignored with a one-time RuntimeWarning (it used to do nothing
+    silently — traced kernels replay without the guard)."""
+    import warnings
+
+    from cdrs_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "_enforce_pad_warned", False)
+    flipped = "0" if pk._ENFORCE_PAD else "1"
+    monkeypatch.setenv("CDRS_TPU_ENFORCE_PAD", flipped)
+    with pytest.warns(RuntimeWarning, match="IGNORED"):
+        assert pk._enforce_pad_env() is pk._ENFORCE_PAD
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # second call must NOT warn again
+        assert pk._enforce_pad_env() is pk._ENFORCE_PAD
+    # Matching value: no warning, flag returned.
+    monkeypatch.setattr(pk, "_enforce_pad_warned", False)
+    monkeypatch.setenv("CDRS_TPU_ENFORCE_PAD",
+                       "1" if pk._ENFORCE_PAD else "0")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert pk._enforce_pad_env() is pk._ENFORCE_PAD
+
+
+def test_enforce_pad_flip_warns_via_kmeans_entry(monkeypatch):
+    """The flip warning fires from the EAGER Lloyd entry even when every
+    kernel shape is already compiled (traced wrappers replay without
+    re-running their Python)."""
+    from cdrs_tpu.ops import pallas_kernels as pk
+    from cdrs_tpu.ops.kmeans_jax import kmeans_jax_full
+
+    X = np.random.default_rng(0).normal(size=(32, 4)).astype(np.float32)
+    kmeans_jax_full(X, 4, max_iter=1, seed=0)  # trace + compile first
+    monkeypatch.setattr(pk, "_enforce_pad_warned", False)
+    monkeypatch.setenv("CDRS_TPU_ENFORCE_PAD",
+                       "0" if pk._ENFORCE_PAD else "1")
+    with pytest.warns(RuntimeWarning, match="IGNORED"):
+        kmeans_jax_full(X, 4, max_iter=1, seed=0)
+
+
 def test_pallas_feature_major_no_labels():
     from cdrs_tpu.ops.pallas_kernels import lloyd_assign_reduce_pallas_t
 
