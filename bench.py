@@ -37,12 +37,18 @@ def main() -> int:
                    help="wall-clock time-to-categories instead of iter/s")
     p.add_argument("--dtype", default=None,
                    choices=["float32", "bfloat16", "float64"])
+    p.add_argument("--metrics", default=None, metavar="JSONL",
+                   help="emit telemetry (spans, kmeans convergence traces, "
+                        "recompile counters) here; inspect with "
+                        "'cdrs metrics summarize'")
     args = p.parse_args()
 
+    import contextlib
     import os
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from cdrs_tpu.benchmarks.harness import run_bench
+    from cdrs_tpu.obs import run_metadata
 
     def emit_line(out):
         print(json.dumps({
@@ -52,46 +58,66 @@ def main() -> int:
             "vs_baseline": out["vs_baseline"],
         }), flush=True)
 
-    out = run_bench(config=2 if args.config is None else args.config,
-                    backend=args.backend, update=args.update, e2e=args.e2e,
-                    dtype=args.dtype)
-    # Contract line FIRST: the k=1024 captures below add ~30 min on the
-    # tunnel host, and a driver timeout must not lose the headline.
-    emit_line(out)
-    if args.config is None:
-        # The k=1024 headline configs, captured in the same driver run —
-        # on a real TPU only (on a CPU-only host the 10M x 128 workloads
-        # would hang the previously-fast default for hours; the driver's
-        # bench host has the chip).  Failures are recorded, not fatal —
-        # the config-2 contract line must survive a config-3 OOM on an
-        # unexpected host.
-        import jax
+    stack = contextlib.ExitStack()
+    if args.metrics:
+        from cdrs_tpu.obs import JsonlSink, Telemetry
 
-        if jax.default_backend() == "tpu":
-            # --update/--dtype/--e2e apply to the extra captures too, so a
-            # flagged driver run measures ONE strategy everywhere instead
-            # of silently reverting the k=1024 captures to their defaults.
-            try:
-                out["config3"] = run_bench(config=3, quality=False,
-                                           update=args.update, e2e=args.e2e,
-                                           dtype=args.dtype)
-            except Exception as e:  # pragma: no cover - depends on host
-                out["config3"] = {"error": f"{type(e).__name__}: {e}"}
-            try:
-                # bf16 points double rows/chip: on one chip config 4
-                # downscales to 13.1M rows = the TRUE v5e-8 per-chip shard
-                # (104857600/8).  The rehearsal is DEFINED as an e2e bf16
-                # run: --update/--dtype override it, --e2e is already on.
-                out["config4_rehearsal"] = run_bench(
-                    config=4, quality=False, e2e=True,
-                    update=args.update, dtype=args.dtype or "bfloat16")
-            except Exception as e:  # pragma: no cover - depends on host
-                out["config4_rehearsal"] = {"error": f"{type(e).__name__}: {e}"}
-        else:
-            note = "skipped: no TPU backend (run bench.py --config N to force)"
-            out["config3"] = {"skipped": note}
-            out["config4_rehearsal"] = {"skipped": note}
+        # kmeans_trace=False: tracing swaps in the convergence-traced
+        # program (and the matmul strategy) — it must not perturb the
+        # kernels this harness exists to time.  Spans/counters only.
+        tel = stack.enter_context(Telemetry(JsonlSink(args.metrics),
+                                            kmeans_trace=False))
+        stack.enter_context(tel.span("bench"))
 
+    with stack:  # exception-safe: a failing capture still closes the sink
+        out = run_bench(config=2 if args.config is None else args.config,
+                        backend=args.backend, update=args.update,
+                        e2e=args.e2e, dtype=args.dtype)
+        # Contract line FIRST: the k=1024 captures below add ~30 min on the
+        # tunnel host, and a driver timeout must not lose the headline.
+        emit_line(out)
+        if args.config is None:
+            # The k=1024 headline configs, captured in the same driver run —
+            # on a real TPU only (on a CPU-only host the 10M x 128 workloads
+            # would hang the previously-fast default for hours; the driver's
+            # bench host has the chip).  Failures are recorded, not fatal —
+            # the config-2 contract line must survive a config-3 OOM on an
+            # unexpected host.
+            import jax
+
+            if jax.default_backend() == "tpu":
+                # --update/--dtype/--e2e apply to the extra captures too, so
+                # a flagged driver run measures ONE strategy everywhere
+                # instead of silently reverting the k=1024 captures to their
+                # defaults.
+                try:
+                    out["config3"] = run_bench(config=3, quality=False,
+                                               update=args.update,
+                                               e2e=args.e2e,
+                                               dtype=args.dtype)
+                except Exception as e:  # pragma: no cover - depends on host
+                    out["config3"] = {"error": f"{type(e).__name__}: {e}"}
+                try:
+                    # bf16 points double rows/chip: on one chip config 4
+                    # downscales to 13.1M rows = the TRUE v5e-8 per-chip
+                    # shard (104857600/8).  The rehearsal is DEFINED as an
+                    # e2e bf16 run: --update/--dtype override it, --e2e is
+                    # already on.
+                    out["config4_rehearsal"] = run_bench(
+                        config=4, quality=False, e2e=True,
+                        update=args.update, dtype=args.dtype or "bfloat16")
+                except Exception as e:  # pragma: no cover - depends on host
+                    out["config4_rehearsal"] = {
+                        "error": f"{type(e).__name__}: {e}"}
+            else:
+                note = ("skipped: no TPU backend (run bench.py --config N "
+                        "to force)")
+                out["config3"] = {"skipped": note}
+                out["config4_rehearsal"] = {"skipped": note}
+
+    # Environment stamp: makes BENCH_*.json trajectory files comparable
+    # across machines (jax/numpy versions, backend, device count, x64).
+    out["run_meta"] = run_metadata()
     # Full detail to stderr so the one-line stdout contract stays clean.
     print(json.dumps(out), file=sys.stderr)
     return 0

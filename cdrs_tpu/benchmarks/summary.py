@@ -28,6 +28,92 @@ def _step(out: dict, key: str, fn):
         print(f"[summary] {key} FAILED: {e}", file=sys.stderr)
 
 
+#: Telemetry overhead budget on the config-1-scale pipeline path
+#: (ISSUE 2 acceptance: ≤ 5% wall-clock vs --metrics disabled).
+TELEMETRY_OVERHEAD_BUDGET = 1.05
+
+
+def telemetry_overhead(n_files: int = 10_000, duration: float = 120.0,
+                       repeats: int = 15, runs_per_window: int = 2) -> dict:
+    """Wall-clock cost of the telemetry layer on the config-1-scale
+    pipeline path (numpy backend, 10K files): the full instrumented
+    surface — stage spans, gauges, per-Lloyd-iteration convergence
+    traces, the JSONL sink — against the identical run with telemetry
+    off.  The two variants run *interleaved*; the headline ratio compares
+    the best window of each side (the repo's standard methodology — noise
+    on a shared single-core host is strictly additive, so the fastest
+    window is the closest observation of the true cost) and every window
+    plus the per-round paired ratios are disclosed so a reviewer sees the
+    spread.  ``within_budget`` asserts the ≤ 5% acceptance bound.
+    Recorded by the sweep, not CI-timed.
+    """
+    import os
+    import tempfile
+    import time
+
+    from ..config import (GeneratorConfig, KMeansConfig, PipelineConfig,
+                          SimulatorConfig, validated_scoring_config)
+    from ..obs import JsonlSink, Telemetry
+    from ..pipeline import run_pipeline
+
+    cfg = PipelineConfig(
+        backend="numpy",
+        generator=GeneratorConfig(n_files=n_files, seed=5),
+        simulator=SimulatorConfig(duration_seconds=duration, seed=6),
+        kmeans=KMeansConfig(k=8, seed=42),
+        scoring=validated_scoring_config(),
+        evaluate=False,
+    )
+
+    def timed() -> float:
+        # One window = several back-to-back runs: a single ~0.2 s run is
+        # smaller than this class of host's scheduling jitter.
+        t0 = time.perf_counter()
+        for _ in range(max(1, runs_per_window)):
+            run_pipeline(cfg)
+        return time.perf_counter() - t0
+
+    timed()  # warmup (imports, BLAS spin-up) outside both measurements
+    plain_windows: list[float] = []
+    instr_windows: list[float] = []
+    ratios: list[float] = []
+    events = 0
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "telemetry.jsonl")
+        for r in range(max(1, repeats)):
+            # Paired, order-alternated rounds: machine drift on a shared
+            # single-core host moves both sides of a pair together, so the
+            # per-round ratio is robust where absolute windows are not.
+            def instr() -> float:
+                with Telemetry(JsonlSink(path)):
+                    return timed()
+
+            if r % 2 == 0:
+                p, i = timed(), instr()
+            else:
+                i, p = instr(), timed()
+            plain_windows.append(p)
+            instr_windows.append(i)
+            ratios.append(i / p)
+        with open(path) as f:
+            events = sum(1 for _ in f)
+    ratios.sort()
+    ratio = min(instr_windows) / min(plain_windows)
+    return {
+        "n_files": n_files,
+        "plain_seconds": min(plain_windows),
+        "telemetry_seconds": min(instr_windows),
+        "plain_windows": plain_windows,
+        "telemetry_windows": instr_windows,
+        "paired_ratios": ratios,
+        "paired_ratio_median": ratios[len(ratios) // 2],
+        "overhead_ratio": ratio,
+        "events_emitted": events,
+        "budget": TELEMETRY_OVERHEAD_BUDGET,
+        "within_budget": ratio <= TELEMETRY_OVERHEAD_BUDGET,
+    }
+
+
 def run_summary(quality: bool = True) -> dict:
     import jax
 
@@ -67,6 +153,10 @@ def run_summary(quality: bool = True) -> dict:
         return bench_ingest()
 
     _step(out, "ingestion", ingest)
+    if quality:
+        # Rides the quality flag: like the decision-quality runs this is a
+        # real pipeline workload (~10 s), skipped by --no_quality sweeps.
+        _step(out, "telemetry_overhead", telemetry_overhead)
     return out
 
 

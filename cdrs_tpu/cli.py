@@ -9,7 +9,15 @@ subcommand of ``python -m cdrs_tpu`` (or the ``cdrs`` console script):
   features  manifest+log -> features CSV               (reference: compute_features.py)
   cluster   features CSV -> final_categories.csv       (reference: main.py)
   pipeline  all of the above end-to-end                (reference: run_pipeline.sh + main.py)
+            (alias: run)
   bench     benchmark harness                          (new; BASELINE.md configs)
+  metrics   inspect telemetry JSONL streams            (new; obs/metrics_cli.py)
+
+``--metrics out.jsonl`` on pipeline/cluster/stream/control/bench activates
+the unified telemetry layer (cdrs_tpu/obs): hierarchical stage spans,
+counters/histograms, per-iteration kmeans convergence traces, and a JIT
+recompile counter, all as one JSONL event stream consumed by
+``cdrs metrics summarize|tail|export``.
 
 ``--backend {numpy,jax}`` selects the execution backend per the BASELINE.json
 north star; the numpy path preserves reference behaviour (minus crash bugs),
@@ -37,6 +45,35 @@ from .config import (
 from .utils.logging import StageTimer
 
 __all__ = ["main"]
+
+
+def _add_metrics_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--metrics", default=None, metavar="JSONL",
+                   help="emit telemetry here (spans, counters, kmeans "
+                        "convergence traces); inspect with "
+                        "'cdrs metrics summarize'")
+    p.add_argument("--device_memory", action="store_true",
+                   help="with --metrics: sample per-device memory_stats "
+                        "gauges at every span exit (TPU backends)")
+
+
+def _open_telemetry(args, stack, root_span: str):
+    """Activate a Telemetry over a JSONL sink when --metrics was given.
+
+    Returns the instrument (or None).  ``stack`` is a contextlib.ExitStack
+    owning the activation and a root span named ``root_span`` so every
+    stage span nests under one tree."""
+    path = getattr(args, "metrics", None)
+    if not path:
+        return None
+    from .obs import JsonlSink, Telemetry
+
+    tel = Telemetry(JsonlSink(path),
+                    device_memory=getattr(args, "device_memory", False))
+    stack.enter_context(tel)
+    stack.enter_context(tel.span(root_span,
+                                 backend=getattr(args, "backend", None)))
+    return tel
 
 
 def _add_backend_arg(p: argparse.ArgumentParser, mesh: bool = True,
@@ -193,18 +230,24 @@ def _cmd_cluster(args) -> int:
         backend=args.backend,
         mesh_shape=_parse_mesh(args.mesh),
     )
-    with StageTimer("cluster") as t:
-        X, paths = load_feature_matrix(args.input_path)
-        decision = model.run(X)
-        decision.write_csv(args.output_csv)
-        if args.assignments_csv:
-            decision.write_assignments_csv(args.assignments_csv, paths)
+    import contextlib
+
+    with contextlib.ExitStack() as stack:
+        _open_telemetry(args, stack, "cluster_cmd")
+        with StageTimer("cluster") as t:
+            X, paths = load_feature_matrix(args.input_path)
+            decision = model.run(X)
+            decision.write_csv(args.output_csv)
+            if args.assignments_csv:
+                decision.write_assignments_csv(args.assignments_csv, paths)
     print(f"Cluster centroid assignments ({args.k} clusters) saved to: "
           f"{args.output_csv} in {t.elapsed:.2f}s")
     return 0
 
 
 def _cmd_pipeline(args) -> int:
+    import contextlib
+
     from .pipeline import run_pipeline
 
     cfg = PipelineConfig(
@@ -221,8 +264,10 @@ def _cmd_pipeline(args) -> int:
     )
     from .utils.profiling import trace_region
 
-    with trace_region(args.profile):
-        result = run_pipeline(cfg, outdir=args.outdir)
+    with contextlib.ExitStack() as stack:
+        _open_telemetry(args, stack, "pipeline")
+        with trace_region(args.profile):
+            result = run_pipeline(cfg, outdir=args.outdir)
     print(json.dumps(result.summary(), indent=2))
     return 0
 
@@ -296,6 +341,14 @@ def _cmd_stream(args) -> int:
     ``--kmeans_batch`` additionally makes the clustering itself incremental
     (mini-batch KMeans, ops/kmeans_stream.py — the BASELINE config-5 mode).
     """
+    import contextlib
+
+    with contextlib.ExitStack() as stack:
+        _open_telemetry(args, stack, "stream_cmd")
+        return _cmd_stream_inner(args)
+
+
+def _cmd_stream_inner(args) -> int:
     from .io.events import EventLog, Manifest
     from .models.replication import ReplicationPolicyModel
 
@@ -355,6 +408,15 @@ def _cmd_stream(args) -> int:
             table = stream_finalize(state, manifest)
     print(f"Streamed {state.n_events} events in {n_batches} batches "
           f"({t.elapsed:.2f}s)")
+    from .obs import current as _obs_current
+
+    tel = _obs_current()
+    if tel is not None:
+        # Ingest rate: the streaming layer's headline operational number.
+        if t.elapsed > 0:
+            tel.gauge("stream.events_per_sec", state.n_events / t.elapsed)
+        tel.counter_inc("stream.events", int(state.n_events))
+        tel.counter_inc("stream.batches", int(n_batches))
 
     model = ReplicationPolicyModel(
         kmeans_cfg=KMeansConfig(k=args.k, seed=args.seed,
@@ -400,14 +462,22 @@ def _cmd_control(args) -> int:
         mesh_shape=_parse_mesh(args.mesh),
         evaluate=not args.no_evaluate,
     )
+    import contextlib
+
     manifest = Manifest.read_csv(args.manifest)
     controller = ReplicationController(manifest, cfg)
-    with StageTimer("control") as t:
-        result = controller.run(
-            args.access_log, metrics_path=args.metrics,
-            checkpoint_path=args.checkpoint,
-            checkpoint_every=args.checkpoint_every,
-            max_windows=args.max_windows, batch_size=args.batch_size)
+    with contextlib.ExitStack() as stack:
+        # One stream, two producers: the controller appends its per-window
+        # records (kill/resume-safe, one line each) while the activated
+        # Telemetry interleaves counters/histograms/kmeans traces — both
+        # through obs/sink.JsonlSink, atomic per line.
+        _open_telemetry(args, stack, "control_cmd")
+        with StageTimer("control") as t:
+            result = controller.run(
+                args.access_log, metrics_path=args.metrics,
+                checkpoint_path=args.checkpoint,
+                checkpoint_every=args.checkpoint_every,
+                max_windows=args.max_windows, batch_size=args.batch_size)
     if args.plan_out:
         from .cluster.plan import write_plan_csv
 
@@ -421,18 +491,36 @@ def _cmd_control(args) -> int:
 
 
 def _cmd_bench(args) -> int:
+    import contextlib
+
     try:
         from .benchmarks.harness import run_bench
     except ImportError as e:
         print(f"benchmark harness not available: {e}", file=sys.stderr)
         return 1
-    out = run_bench(config=args.config, backend=args.backend,
-                    mesh_shape=_parse_mesh(args.mesh),
-                    update=getattr(args, "update", None),
-                    e2e=getattr(args, "e2e", False),
-                    dtype=getattr(args, "dtype", None))
+    with contextlib.ExitStack() as stack:
+        tel = _open_telemetry(args, stack, f"bench.config{args.config}")
+        if tel is not None:
+            # Tracing would swap the timed kernels for their traced
+            # variants — benches carry spans/counters only.
+            tel.kmeans_trace = False
+        out = run_bench(config=args.config, backend=args.backend,
+                        mesh_shape=_parse_mesh(args.mesh),
+                        update=getattr(args, "update", None),
+                        e2e=getattr(args, "e2e", False),
+                        dtype=getattr(args, "dtype", None))
+    from .obs import run_metadata
+
+    out["run_meta"] = run_metadata()
     print(json.dumps(out))
     return 0
+
+
+def _cmd_metrics(args) -> int:
+    """Inspect a telemetry JSONL stream (obs/metrics_cli.py)."""
+    from .obs.metrics_cli import main as metrics_main
+
+    return metrics_main(args.rest)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -487,9 +575,11 @@ def main(argv: list[str] | None = None) -> int:
                         "'validated' for the built-in workload-tuned tables")
     _add_backend_arg(p)
     _add_init_method_arg(p)
+    _add_metrics_arg(p)
     p.set_defaults(fn=_cmd_cluster)
 
-    p = sub.add_parser("pipeline", help="end-to-end: gen -> sim -> features -> cluster")
+    p = sub.add_parser("pipeline", aliases=["run"],
+                       help="end-to-end: gen -> sim -> features -> cluster")
     p.add_argument("--n", type=int, default=200)
     p.add_argument("--duration_seconds", type=float, default=600.0)
     p.add_argument("--k", type=int, default=4)
@@ -504,6 +594,7 @@ def main(argv: list[str] | None = None) -> int:
                    help="write a jax.profiler trace (TensorBoard/Perfetto)")
     _add_backend_arg(p)
     _add_init_method_arg(p)
+    _add_metrics_arg(p)
     p.set_defaults(fn=_cmd_pipeline)
 
     p = sub.add_parser("evaluate", help="apply replication factors on the "
@@ -547,6 +638,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--checkpoint_every", type=int, default=25, metavar="B")
     _add_backend_arg(p)
     _add_init_method_arg(p)
+    _add_metrics_arg(p)
     p.set_defaults(fn=_cmd_stream)
 
     p = sub.add_parser("control", help="online replication controller: "
@@ -577,8 +669,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--default_rf", type=int, default=1)
     p.add_argument("--batch_size", type=int, default=1_000_000,
                    help="events per log read batch (windows re-slice it)")
-    p.add_argument("--metrics", default=None, metavar="JSONL",
-                   help="append one JSON record per window here")
+    _add_metrics_arg(p)  # window records interleave with the telemetry
     p.add_argument("--plan_out", default=None, metavar="CSV",
                    help="write the final applied plan (path,category,rf)")
     p.add_argument("--checkpoint", default=None, metavar="NPZ",
@@ -613,7 +704,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="points dtype override (jax configs; bfloat16 halves "
                         "the HBM stream — centroids/stats stay float32)")
     _add_backend_arg(p, default=None)  # None = the config's own backend
+    _add_metrics_arg(p)
     p.set_defaults(fn=_cmd_bench)
+
+    p = sub.add_parser("metrics", help="inspect a telemetry JSONL stream: "
+                       "summarize | tail | export --format prometheus")
+    p.add_argument("rest", nargs=argparse.REMAINDER,
+                   help="summarize FILE | tail FILE [-n N] | "
+                        "export FILE --format prometheus [--out FILE]")
+    p.set_defaults(fn=_cmd_metrics)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -43,7 +43,6 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import json
 import os
 import time
 from dataclasses import dataclass, field
@@ -335,6 +334,8 @@ class ReplicationController:
         rec["bytes_migrated"] = int(sum(m.bytes_moved for m in applied))
         rec["backlog_files"] = len(self.scheduler.backlog)
         rec["backlog_bytes"] = int(self.scheduler.backlog_bytes)
+        rec["deferred_hysteresis"] = self.scheduler.last_deferred_hysteresis
+        rec["deferred_budget"] = self.scheduler.last_deferred_budget
 
         t0 = time.perf_counter()
         rec["locality_before"] = rec["locality_after"] = None
@@ -353,7 +354,37 @@ class ReplicationController:
         rec["plan_hash"] = _plan_hash(self.current_rf, self.current_cat)
         seconds["total"] = time.perf_counter() - t_start
         rec["seconds"] = {k: round(v, 6) for k, v in seconds.items()}
+        self._instrument_window(rec, seconds)
         return rec
+
+    def _instrument_window(self, rec: dict, seconds: dict) -> None:
+        """Route the window's observations through the active telemetry
+        instrument (obs/), when one is installed: migration counters
+        (bytes/files moved, hysteresis/budget deferrals), re-cluster
+        counters, and per-stage wall-clock histograms (p50/p95 in
+        ``cdrs metrics summarize``).  No-op without an instrument."""
+        from ..obs import current as _obs_current
+
+        tel = _obs_current()
+        if tel is None:
+            return
+        tel.counter_inc("controller.windows")
+        if rec["n_events"]:
+            tel.counter_inc("controller.events_folded", rec["n_events"])
+        if rec["recluster"]:
+            tel.counter_inc(f"controller.reclusters.{rec['recluster_mode']}")
+        if rec["moves_applied"]:
+            tel.counter_inc("migrate.files_moved", rec["moves_applied"])
+        if rec["bytes_migrated"]:
+            tel.counter_inc("migrate.bytes_moved", rec["bytes_migrated"])
+        if rec["deferred_hysteresis"]:
+            tel.counter_inc("migrate.deferred_hysteresis",
+                            rec["deferred_hysteresis"])
+        if rec["deferred_budget"]:
+            tel.counter_inc("migrate.deferred_budget",
+                            rec["deferred_budget"])
+        for stage, secs in seconds.items():
+            tel.histogram(f"controller.{stage}.seconds", secs)
 
     def _accept(self, decision) -> None:
         """Adopt a new model + plan: diff against the APPLIED plan, rebuild
@@ -516,10 +547,17 @@ class ReplicationController:
         ``start_offset``/``with_offsets`` hooks fold_stream already uses)
         is the known follow-up that would make it O(new data).
 
-        ``metrics_path``: append one JSON line per window.  The sink is
-        append-only; after a crash the tail may repeat the windows between
-        the last snapshot and the crash — consumers take the last record
-        per window index.
+        ``metrics_path``: append one JSON line per window through the
+        telemetry layer's thread-safe sink (obs/sink.JsonlSink: one
+        ``write()`` + flush per line, atomic from a tailing reader's view).
+        The stream is append-only; after a crash the tail may repeat the
+        windows between the last snapshot and the crash — consumers take
+        the last record per window index.  Each line is the window record
+        with ``"kind": "window"`` stamped, so ``cdrs metrics summarize``
+        digests the stream alongside full telemetry output.  When an
+        ``obs.Telemetry`` is additionally active (``with Telemetry(...)``),
+        migration/re-cluster counters and per-stage histograms flow through
+        it as well.
 
         ``max_windows`` stops after that many windows are PROCESSED this
         call (resume-skipped windows don't count) — the kill/resume test
@@ -528,7 +566,11 @@ class ReplicationController:
         if checkpoint_path and os.path.exists(checkpoint_path):
             self.load_checkpoint(checkpoint_path)
         records: list[dict] = []
-        sink = open(metrics_path, "a") if metrics_path else None
+        sink = None
+        if metrics_path:
+            from ..obs import JsonlSink
+
+            sink = JsonlSink(metrics_path)
         processed = 0
         since_ckpt = 0
         t0_box: dict = {}
@@ -562,8 +604,7 @@ class ReplicationController:
                 self._last_window_events = len(events)
                 records.append(rec)
                 if sink:
-                    sink.write(json.dumps(rec) + "\n")
-                    sink.flush()
+                    sink.emit({"kind": "window", **rec})
                 processed += 1
                 since_ckpt += 1
                 if checkpoint_path and since_ckpt >= max(1, checkpoint_every):

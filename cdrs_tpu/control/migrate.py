@@ -94,6 +94,11 @@ class MigrationScheduler:
         self.hysteresis = int(hysteresis_windows)
         self.backlog: dict[int, PlanMove] = {}
         self.last_moved = np.full(n_files, _NEVER, dtype=np.int64)
+        #: Telemetry of the most recent ``schedule`` call: moves skipped by
+        #: the hysteresis freeze vs by the byte budget.  Plain attributes —
+        #: per-window observations, deliberately NOT checkpointed state.
+        self.last_deferred_hysteresis = 0
+        self.last_deferred_budget = 0
 
     def submit(self, moves: list[PlanMove]) -> None:
         """Replace the backlog with the newest plan's pending moves."""
@@ -117,16 +122,20 @@ class MigrationScheduler:
                        key=lambda m: (-m.priority, m.file_index))
         applied: list[PlanMove] = []
         bytes_used = 0
+        self.last_deferred_hysteresis = 0
+        self.last_deferred_budget = 0
         for m in order:
             if self.max_files is not None and len(applied) >= self.max_files:
                 break
             if window_index < int(self.last_moved[m.file_index]) \
                     + 1 + self.hysteresis:
+                self.last_deferred_hysteresis += 1
                 continue
             if self.max_bytes is not None and m.bytes_moved > 0:
                 over = bytes_used + m.bytes_moved > self.max_bytes
                 first = bytes_used == 0 and self.max_bytes > 0
                 if over and not first:
+                    self.last_deferred_budget += 1
                     continue
             applied.append(m)
             bytes_used += m.bytes_moved

@@ -55,7 +55,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..io.events import EventLog, Manifest
-from ..parallel.mesh import DATA_AXIS, make_mesh
+from ..parallel.mesh import DATA_AXIS, make_mesh, shard_map_compat
 from .numpy_backend import FeatureTable
 
 __all__ = ["compute_features_jax", "features_kernel"]
@@ -200,7 +200,7 @@ def _build_features_sharded(n: int, ndata: int):
         return _features_local(pid, sec, op, client, primary_node_id,
                                age_seconds, n=n, sharded=True)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         local_fn,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),

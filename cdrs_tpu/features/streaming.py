@@ -47,7 +47,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..io.events import EventLog, Manifest
-from ..parallel.mesh import DATA_AXIS, make_mesh
+from ..parallel.mesh import DATA_AXIS, make_mesh, shard_map_compat
 from .jax_backend import _concurrency_local
 from .numpy_backend import FeatureTable
 
@@ -200,7 +200,7 @@ def _build_update(e: int, n: int, ndata: int = 1, wire: str = "cols"):
         base = jax.jit(local_fn)
     else:
         mesh = make_mesh(n_data=ndata)
-        base = jax.jit(jax.shard_map(
+        base = jax.jit(shard_map_compat(
             local_fn,
             mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),

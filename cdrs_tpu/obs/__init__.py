@@ -1,0 +1,43 @@
+"""Unified telemetry layer (spans, counters/gauges/histograms, JSONL sink).
+
+One subsystem for every observability question the framework previously
+answered with ad-hoc means — the per-stage ``StageTimer``s and flat
+``MetricsLog`` dict (utils/logging.py, now thin shims over this layer), the
+controller's inline JSONL writer (control/controller.py), and nothing at all
+for the questions that mattered most at speed: did ``kmeans_jax_full``
+recompile?  How many Lloyd iterations did each re-cluster take?  Where did
+the wall-clock go inside a window?
+
+Pieces:
+
+* ``Telemetry`` (telemetry.py) — hierarchical spans (nested timers with
+  attributes, monotonic clocks), counters, gauges, histograms; activates as
+  the ambient instrument via a context manager so call sites deep in the
+  stack (``ops/kmeans_jax.py``) emit without threading a handle through
+  every layer.
+* ``JsonlSink`` (sink.py) — thread-safe line-buffered append; each event is
+  one ``write()`` call under a lock, so the stream stays parseable under
+  the controller's kill/resume semantics (consumers take the last record
+  per key).
+* ``jaxtools`` — the JIT recompile detector (abstract-aval signature per
+  wrapped kernel; counter increments on a first-seen signature) and
+  optional ``jax.local_devices()`` memory-stats gauges.
+* ``metrics_cli`` — the ``cdrs metrics`` subcommand: ``summarize`` (span
+  wall-clock tree, p50/p95 histograms, convergence traces), ``tail``, and
+  ``export --format prometheus``.
+
+The core imports neither jax nor pandas: a base install can produce and
+read telemetry.
+"""
+
+from .sink import JsonlSink, read_events
+from .telemetry import Span, Telemetry, current, run_metadata
+
+__all__ = [
+    "JsonlSink",
+    "Span",
+    "Telemetry",
+    "current",
+    "read_events",
+    "run_metadata",
+]

@@ -1,0 +1,53 @@
+"""jax-facing telemetry helpers: aval signatures + device memory gauges.
+
+Kept apart from telemetry.py so the core instrument never imports jax (a
+base numpy-only install can produce and read telemetry).
+"""
+
+from __future__ import annotations
+
+__all__ = ["aval_signature", "device_memory_gauges"]
+
+
+def aval_signature(*arrays, static=()) -> tuple:
+    """Hashable signature of a call's abstract values: (shape, dtype) per
+    array plus the static-argument tuple — the same information jax keys
+    its compilation caches on, so a first-seen signature marks a compile.
+    Accepts numpy arrays, jax arrays, and tracers alike (anything with
+    ``.shape``/``.dtype``)."""
+    parts = []
+    for a in arrays:
+        shape = tuple(getattr(a, "shape", ()))
+        dtype = str(getattr(a, "dtype", type(a).__name__))
+        parts.append((shape, dtype))
+    return tuple(parts) + (tuple(static),)
+
+
+def device_memory_gauges(tel, stage: str | None = None) -> None:
+    """Gauge ``device.mem.bytes_in_use{.<stage>}`` per local device.
+
+    ``memory_stats()`` is backend-dependent (None on CPU, populated on
+    TPU); absent stats emit nothing — the gauges are strictly additive
+    information, never a failure source."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # pragma: no cover - no jax / no backend
+        return
+    suffix = f".{stage}" if stage else ""
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # pragma: no cover - backend without the API
+            continue
+        if not stats:
+            continue
+        in_use = stats.get("bytes_in_use")
+        if in_use is not None:
+            tel.gauge(f"device.mem.bytes_in_use.d{d.id}{suffix}",
+                      float(in_use))
+        peak = stats.get("peak_bytes_in_use")
+        if peak is not None:
+            tel.gauge(f"device.mem.peak_bytes_in_use.d{d.id}{suffix}",
+                      float(peak))

@@ -1,0 +1,316 @@
+"""``cdrs metrics`` — human and scraper consumption of telemetry JSONL.
+
+Subcommands:
+
+* ``summarize FILE`` — per-span wall-clock tree (aggregated over repeated
+  spans), counters, gauges, histogram p50/p95, kmeans convergence traces,
+  and a controller-window digest.
+* ``tail FILE [-n N]`` — the last N events, one compact line each.
+* ``export FILE --format prometheus [--out FILE]`` — Prometheus textfile
+  exposition (node_exporter textfile-collector compatible): counters,
+  gauges, and histogram summaries.
+
+The reader is resilient by construction: unknown ``kind``s are ignored
+(forward compatibility) and a torn final line from a killed writer is
+skipped (sink contract, obs/sink.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+from .sink import read_events
+
+__all__ = ["main", "summarize_events", "prometheus_lines"]
+
+
+def _percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile on a sorted copy (no numpy dependency)."""
+    s = sorted(values)
+    if not s:
+        return float("nan")
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+# -- summarize ---------------------------------------------------------------
+
+
+def _span_forest(events: list[dict]):
+    """Aggregate span events by their name-path.
+
+    Returns ``{path_tuple: {"count": int, "total": float}}`` where the path
+    is the chain of span names from the root — repeated spans (e.g. one per
+    window) aggregate into one node.  Span ids restart per process, so ids
+    are scoped by the event's ``run`` stamp: appended streams from several
+    runs aggregate instead of shadowing each other.
+    """
+    by_id = {(e.get("run"), e["id"]): e for e in events
+             if e.get("kind") == "span"}
+    agg: dict[tuple, dict] = {}
+    for e in by_id.values():
+        run = e.get("run")
+        path = [e["name"]]
+        parent = e.get("parent")
+        depth = 0
+        while parent is not None and depth < 100:
+            pe = by_id.get((run, parent))
+            if pe is None:
+                break
+            path.append(pe["name"])
+            parent = pe.get("parent")
+            depth += 1
+        key = tuple(reversed(path))
+        node = agg.setdefault(key, {"count": 0, "total": 0.0})
+        node["count"] += 1
+        node["total"] += float(e.get("dur", 0.0))
+    return agg
+
+
+def _dedup_windows(events: list[dict]) -> list[dict]:
+    """Controller window records, last-wins per window index.
+
+    The controller's sink contract (control/controller.py): after a crash
+    the append-only tail may repeat the windows between the last snapshot
+    and the kill — consumers take the last record per window index."""
+    by_index: dict = {}
+    for e in events:
+        if e.get("kind") == "window":
+            by_index[e.get("window")] = e
+    return [by_index[w] for w in sorted(by_index, key=lambda x: (x is None,
+                                                                 x))]
+
+
+def _final_counters(events: list[dict]) -> dict[str, float]:
+    """Final counter values, summed across runs sharing the stream.
+
+    Each counter event carries its run's *cumulative* value; within one run
+    the last event wins, and separate runs (which each restart at zero)
+    add.  Caveat: a kill/resume pair counts a crashed run's partial tail in
+    both runs' counters — the deduplicated window digest (not the counter
+    sums) is the authoritative per-window accounting."""
+    per_run: dict[tuple, float] = {}
+    for e in events:
+        if e.get("kind") == "counter":
+            per_run[(e.get("run"), e["name"])] = e["value"]
+    totals: dict[str, float] = {}
+    for (_, name), v in per_run.items():
+        totals[name] = totals.get(name, 0.0) + v
+    return totals
+
+
+def _render_span_tree(agg, out) -> None:
+    paths = sorted(agg, key=lambda p: (len(p), -agg[p]["total"]))
+    # Stable depth-first ordering: parents before children, siblings by
+    # total descending.
+    ordered: list[tuple] = []
+
+    def add_children(prefix):
+        kids = [p for p in paths if len(p) == len(prefix) + 1
+                and p[:len(prefix)] == prefix]
+        for p in sorted(kids, key=lambda p: -agg[p]["total"]):
+            ordered.append(p)
+            add_children(p)
+
+    add_children(())
+    # Orphans (parent span missing from the stream) still print, flat.
+    for p in paths:
+        if p not in ordered:
+            ordered.append(p)
+    for path in ordered:
+        node = agg[path]
+        indent = "  " * (len(path) - 1)
+        calls = f" x{node['count']}" if node["count"] > 1 else ""
+        print(f"  {indent}{path[-1]:<{max(1, 28 - len(indent))}} "
+              f"{node['total']:>9.3f}s{calls}", file=out)
+
+
+def summarize_events(events: list[dict], out=None) -> None:
+    out = out or sys.stdout
+    spans = [e for e in events if e.get("kind") == "span"]
+    if spans:
+        print("Span tree (wall-clock, aggregated):", file=out)
+        _render_span_tree(_span_forest(events), out)
+
+    counters = _final_counters(events)
+    if counters:
+        print("\nCounters:", file=out)
+        for name in sorted(counters):
+            v = counters[name]
+            print(f"  {name:<40} {v:g}", file=out)
+
+    gauges: dict[str, float] = {}
+    for e in events:
+        if e.get("kind") == "gauge":
+            gauges[e["name"]] = e["value"]
+    if gauges:
+        print("\nGauges (last value):", file=out)
+        for name in sorted(gauges):
+            print(f"  {name:<40} {gauges[name]:g}", file=out)
+
+    hists: dict[str, list[float]] = {}
+    for e in events:
+        if e.get("kind") == "hist":
+            hists.setdefault(e["name"], []).append(float(e["value"]))
+    if hists:
+        print("\nHistograms:", file=out)
+        for name in sorted(hists):
+            vs = hists[name]
+            print(f"  {name:<34} n={len(vs):<5} p50={_percentile(vs, 0.5):g} "
+                  f"p95={_percentile(vs, 0.95):g} max={max(vs):g}", file=out)
+
+    traces: dict[tuple, list[dict]] = {}
+    for e in events:
+        if e.get("kind") == "kmeans_iter":
+            traces.setdefault((str(e.get("run")), int(e.get("call", 0))),
+                              []).append(e)
+    if traces:
+        print("\nKMeans convergence traces:", file=out)
+        # Display index is stream-wide; grouping stays per (run, call) so
+        # appended runs never merge their traces.
+        for call, key in enumerate(sorted(traces), start=1):
+            steps = sorted(traces[key], key=lambda e: e["step"])
+            first, last = steps[0], steps[-1]
+            backend = first.get("backend", "?")
+            k = first.get("k", "?")
+            inertia = ""
+            if first.get("inertia") is not None:
+                inertia = (f", inertia {first['inertia']:.6g} -> "
+                           f"{last['inertia']:.6g}")
+            print(f"  call {call} [{first.get('kernel', '?')} backend="
+                  f"{backend} k={k}]: {len(steps)} iterations"
+                  f"{inertia}, final shift {last['shift']:.3g}", file=out)
+
+    windows = _dedup_windows(events)
+    if windows:
+        n_events = sum(int(w.get("n_events", 0)) for w in windows)
+        recl = [w for w in windows if w.get("recluster")]
+        moved = sum(int(w.get("bytes_migrated", 0)) for w in windows)
+        print(f"\nController windows: {len(windows)} ({n_events} events, "
+              f"{len(recl)} reclusters, {moved} bytes migrated)", file=out)
+
+
+# -- export ------------------------------------------------------------------
+
+
+def _prom_name(name: str) -> str:
+    return "cdrs_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def prometheus_lines(events: list[dict]) -> list[str]:
+    """Prometheus textfile exposition of the stream's final aggregates."""
+    lines: list[str] = []
+    counters = _final_counters(events)
+    gauges: dict[str, float] = {}
+    hists: dict[str, list[float]] = {}
+    for e in events:
+        kind = e.get("kind")
+        if kind == "gauge":
+            gauges[e["name"]] = e["value"]
+        elif kind == "hist":
+            hists.setdefault(e["name"], []).append(float(e["value"]))
+        elif kind == "span":
+            hists.setdefault(f"span.{e['name']}.seconds", []).append(
+                float(e.get("dur", 0.0)))
+    for name in sorted(counters):
+        m = _prom_name(name)
+        lines += [f"# TYPE {m} counter", f"{m} {counters[name]:g}"]
+    for name in sorted(gauges):
+        m = _prom_name(name)
+        lines += [f"# TYPE {m} gauge", f"{m} {gauges[name]:g}"]
+    for name in sorted(hists):
+        vs = hists[name]
+        m = _prom_name(name)
+        lines += [
+            f"# TYPE {m} summary",
+            f'{m}{{quantile="0.5"}} {_percentile(vs, 0.5):g}',
+            f'{m}{{quantile="0.95"}} {_percentile(vs, 0.95):g}',
+            f"{m}_sum {sum(vs):g}",
+            f"{m}_count {len(vs)}",
+        ]
+    return lines
+
+
+# -- tail --------------------------------------------------------------------
+
+
+def _tail_line(e: dict) -> str:
+    kind = e.get("kind", "?")
+    if kind == "span":
+        return f"span {e['name']} dur={e['dur']:.6f}s id={e['id']}" + (
+            f" parent={e['parent']}" if e.get("parent") is not None else "")
+    if kind in ("counter", "gauge", "hist"):
+        return f"{kind} {e['name']} = {e['value']:g}"
+    if kind == "kmeans_iter":
+        inertia = e.get("inertia")
+        istr = "" if inertia is None else f" inertia={inertia:.6g}"
+        return (f"kmeans_iter call={e.get('call')} step={e['step']}"
+                f"{istr} shift={e['shift']:.3g}")
+    if kind == "window":
+        return (f"window {e.get('window')} events={e.get('n_events')} "
+                f"recluster={e.get('recluster')} "
+                f"moves={e.get('moves_applied')}")
+    return json.dumps(e)
+
+
+# -- entry -------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cdrs metrics", description="inspect a telemetry JSONL stream")
+    sub = parser.add_subparsers(dest="action", required=True)
+
+    p = sub.add_parser("summarize", help="span tree, counters, p50/p95, "
+                                         "convergence traces")
+    p.add_argument("file")
+
+    p = sub.add_parser("tail", help="print the last N events")
+    p.add_argument("file")
+    p.add_argument("-n", type=int, default=20)
+
+    p = sub.add_parser("export", help="export aggregates for scrapers")
+    p.add_argument("file")
+    p.add_argument("--format", choices=["prometheus"], default="prometheus")
+    p.add_argument("--out", default=None,
+                   help="write here (default stdout); point your "
+                        "node_exporter textfile collector at it")
+
+    args = parser.parse_args(argv)
+    try:
+        events = read_events(args.file)
+    except OSError as e:
+        print(f"error: cannot read {args.file}: {e}", file=sys.stderr)
+        return 1
+
+    try:
+        if args.action == "summarize":
+            if not events:
+                print(f"{args.file}: no events", file=sys.stderr)
+                return 1
+            summarize_events(events)
+            return 0
+        if args.action == "tail":
+            if args.n > 0:  # [-0:] would be the whole stream
+                for e in events[-args.n:]:
+                    print(_tail_line(e))
+            return 0
+        # export
+        text = "\n".join(prometheus_lines(events)) + "\n"
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
+    except BrokenPipeError:
+        # `cdrs metrics ... | head` closing the pipe is a clean exit, not
+        # a traceback.
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0

@@ -1,0 +1,81 @@
+"""JSONL event sink — thread-safe, line-buffered, append-only.
+
+The contract the controller's kill/resume semantics need (control/
+controller.py): the file is opened in append mode, every event is exactly
+one line written with a single ``write()`` call under a lock and flushed
+immediately, and a crashed writer leaves at worst a repeated tail —
+consumers take the last record per logical key (e.g. window index).  A
+torn final line (the process died mid-``write``) is skipped by
+``read_events`` rather than poisoning the stream.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+__all__ = ["JsonlSink", "read_events"]
+
+
+class JsonlSink:
+    """Append one JSON object per line; safe to share across threads."""
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(path, "a")
+
+    def emit(self, event: dict) -> None:
+        # One write() + flush per event: the line lands atomically from the
+        # point of view of a tailing reader, and a kill between events loses
+        # nothing already emitted.
+        line = json.dumps(event, default=_coerce) + "\n"
+        with self._lock:
+            if self._f is None:
+                return  # emitted after close (e.g. a late worker thread)
+            self._f.write(line)
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def __enter__(self) -> "JsonlSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _coerce(obj):
+    """JSON fallback for numpy scalars/arrays without importing numpy.
+
+    ``tolist`` first: arrays need it, and on numpy scalars it returns the
+    python scalar (``item`` would raise on a size > 1 array)."""
+    fn = getattr(obj, "tolist", None)
+    if callable(fn):
+        return fn()
+    return str(obj)
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse a telemetry JSONL stream; a torn final line is skipped."""
+    events: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                # Torn tail from a killed writer — by the sink's contract
+                # only the final line can be affected.
+                continue
+    return events

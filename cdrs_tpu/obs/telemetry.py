@@ -1,0 +1,247 @@
+"""Core telemetry instrument: hierarchical spans + counters/gauges/histograms.
+
+Design points:
+
+* **Ambient activation.**  ``with Telemetry(...)`` installs the instance as
+  the process-wide active instrument; ``current()`` returns it (or None)
+  from any depth of the stack.  Deep call sites — the kmeans kernel wrapper,
+  the ``StageTimer`` shim — instrument themselves against ``current()`` so
+  no handle threads through every layer, and an inactive process pays one
+  ``is None`` check.
+* **Hierarchical spans.**  Each thread carries its own span stack
+  (``threading.local``), so a producer thread's spans nest under its own
+  root rather than corrupting the main thread's tree.  Durations use
+  ``time.perf_counter`` (monotonic); wall timestamps use ``time.time``.
+  Span events are emitted on *exit* (children before parents in the
+  stream); the ``id``/``parent`` fields let readers rebuild the tree.
+* **In-memory aggregates.**  Counters/gauges/histograms also accumulate on
+  the instance, so in-process consumers (tests, the pipeline summary)
+  read final values without re-parsing the stream.
+* **Recompile detector.**  ``record_kernel_call(kernel, signature)`` keeps
+  one *process-level* set of seen abstract signatures per kernel — the
+  same lifetime as jax's compilation caches — and bumps
+  ``jit.recompiles.<kernel>`` only on a first-seen signature, so a
+  repeated same-shape call counts zero and a shape change counts one.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from .sink import JsonlSink
+
+__all__ = ["Telemetry", "Span", "current", "run_metadata"]
+
+#: Active instrument (module-global, not a contextvar: worker threads must
+#: see the same instrument as the thread that activated it).
+_ACTIVE: list["Telemetry"] = []
+
+#: Process-level seen-signature registry per wrapped kernel.  Lives at
+#: module scope — not per-Telemetry — because it mirrors the lifetime of
+#: the process's actual compilation caches (ops/kmeans_jax._build_kmeans
+#: is ``lru_cache``d for the life of the process).
+_KERNEL_SIGS: dict[str, set] = {}
+
+
+def current() -> "Telemetry | None":
+    """The active instrument, or None when telemetry is off."""
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+def run_metadata() -> dict:
+    """Environment stamp making emitted artifacts comparable across
+    machines: interpreter, numpy, and — when jax is already loaded —
+    jax version, backend, device count, and the x64 flag.  Never *imports*
+    jax itself: a numpy-backend run must not pay (or fail) the import."""
+    meta: dict = {
+        "python": sys.version.split()[0],
+        "platform": sys.platform,
+    }
+    np = sys.modules.get("numpy")
+    if np is not None:
+        meta["numpy"] = getattr(np, "__version__", None)
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            meta.update({
+                "jax": jax.__version__,
+                "jax_backend": jax.default_backend(),
+                "jax_device_count": len(jax.devices()),
+                "jax_enable_x64": bool(jax.config.jax_enable_x64),
+            })
+        except Exception:  # pragma: no cover - partially initialized jax
+            meta["jax"] = getattr(jax, "__version__", None)
+    return meta
+
+
+class Span:
+    """One timed region; context manager handed out by ``Telemetry.span``."""
+
+    __slots__ = ("tel", "name", "attrs", "id", "parent", "t_wall", "_t0",
+                 "dur")
+
+    def __init__(self, tel: "Telemetry", name: str, attrs: dict):
+        self.tel = tel
+        self.name = name
+        self.attrs = attrs
+        self.id = tel._next_id()
+        self.parent: int | None = None
+        self.dur = 0.0
+
+    def __enter__(self) -> "Span":
+        stack = self.tel._stack()
+        self.parent = stack[-1].id if stack else None
+        stack.append(self)
+        self.t_wall = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.dur = time.perf_counter() - self._t0
+        stack = self.tel._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        event = {
+            "kind": "span",
+            "name": self.name,
+            "id": self.id,
+            "parent": self.parent,
+            "t": self.t_wall,
+            "dur": self.dur,
+        }
+        if self.attrs:
+            event["attrs"] = self.attrs
+        self.tel._emit(event)
+        if self.tel.device_memory:
+            from .jaxtools import device_memory_gauges
+
+            device_memory_gauges(self.tel, stage=self.name)
+
+
+class Telemetry:
+    """The instrument.  ``with Telemetry(sink=JsonlSink(path)):`` activates
+    it; everything instrumented against ``obs.current()`` then emits."""
+
+    def __init__(self, sink: JsonlSink | None = None, *,
+                 kmeans_trace: bool = True, device_memory: bool = False,
+                 meta: bool = True):
+        self.sink = sink
+        #: Unique per-instrument id stamped on every event: span ids and
+        #: trace-call numbers restart per process, and the sink appends —
+        #: readers disambiguate runs sharing one file by this field.
+        self.run_id = f"{os.getpid():x}-{time.monotonic_ns():x}"
+        #: Emit per-Lloyd-iteration convergence records from the kmeans
+        #: kernels (ops/kmeans_jax.py carries them in the while_loop state;
+        #: ops/kmeans_np.py computes them inline).
+        self.kmeans_trace = kmeans_trace
+        #: Sample jax.local_devices() memory_stats at every span exit.
+        self.device_memory = device_memory
+        self._meta = meta
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.histograms: dict[str, list[float]] = {}
+        self._local = threading.local()
+        self._id_lock = threading.Lock()
+        self._ids = 0
+        self._agg_lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def __enter__(self) -> "Telemetry":
+        _ACTIVE.append(self)
+        if self._meta:
+            self._emit({"kind": "meta", "t": time.time(),
+                        "run": run_metadata()})
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self in _ACTIVE:
+            _ACTIVE.remove(self)
+        if self.sink is not None:
+            self.sink.close()
+
+    # -- plumbing ----------------------------------------------------------
+    def _stack(self) -> list[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _next_id(self) -> int:
+        with self._id_lock:
+            self._ids += 1
+            return self._ids
+
+    def _emit(self, event: dict) -> None:
+        if self.sink is not None:
+            event.setdefault("run", self.run_id)
+            self.sink.emit(event)
+
+    def current_span_id(self) -> int | None:
+        stack = self._stack()
+        return stack[-1].id if stack else None
+
+    # -- instruments -------------------------------------------------------
+    def span(self, name: str, **attrs) -> Span:
+        return Span(self, name, attrs)
+
+    def counter_inc(self, name: str, delta: float = 1.0) -> float:
+        with self._agg_lock:
+            value = self.counters.get(name, 0.0) + float(delta)
+            self.counters[name] = value
+        self._emit({"kind": "counter", "name": name, "t": time.time(),
+                    "delta": float(delta), "value": value})
+        return value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._agg_lock:
+            self.gauges[name] = float(value)
+        self._emit({"kind": "gauge", "name": name, "t": time.time(),
+                    "value": float(value)})
+
+    def histogram(self, name: str, value: float) -> None:
+        with self._agg_lock:
+            self.histograms.setdefault(name, []).append(float(value))
+        self._emit({"kind": "hist", "name": name, "t": time.time(),
+                    "value": float(value)})
+
+    # -- jax kernel hooks --------------------------------------------------
+    def record_kernel_call(self, kernel: str, signature,
+                           compiled: bool | None = None) -> bool:
+        """Count a wrapped-kernel call and its recompiles.
+
+        ``compiled`` is the wrapper's authoritative signal (e.g. an
+        lru_cache miss delta around the program build — exact even when
+        the kernel was compiled before telemetry activated).  When the
+        wrapper has no such signal, a first-seen abstract-aval
+        ``signature`` (shape/dtype/static-config tuple) in this process
+        stands in.  Returns True when the call is counted as compiling."""
+        seen = _KERNEL_SIGS.setdefault(kernel, set())
+        new = signature not in seen
+        if new:
+            seen.add(signature)
+        if compiled is not None:
+            new = compiled
+        self.counter_inc(f"jit.calls.{kernel}")
+        if new:
+            self.counter_inc(f"jit.recompiles.{kernel}")
+        return new
+
+    def emit_kmeans_trace(self, kernel: str, *, inertia, shift,
+                          **attrs) -> None:
+        """Per-Lloyd-iteration convergence records (one event per step),
+        plus the ``kmeans.iterations`` histogram for p50/p95 over calls."""
+        call = int(self.counter_inc("kmeans.trace_calls"))
+        span = self.current_span_id()
+        n_iter = len(shift)
+        for i in range(n_iter):
+            self._emit({
+                "kind": "kmeans_iter", "kernel": kernel, "call": call,
+                "span": span, "step": i,
+                "inertia": None if inertia is None else float(inertia[i]),
+                "shift": float(shift[i]),
+                **attrs,
+            })
+        self.histogram("kmeans.iterations", float(n_iter))

@@ -42,7 +42,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import (DATA_AXIS, MODEL_AXIS, make_mesh, pad_rows,
-                             prefix_mask)
+                             prefix_mask, shard_map_compat)
 
 __all__ = [
     "pairwise_sq_dists_jax",
@@ -221,7 +221,9 @@ def _pick_row_global(x: jnp.ndarray, scores: jnp.ndarray,
     if not sharded:
         return x[jnp.argmax(scores)]
     rank = lax.axis_index(DATA_AXIS)
-    ndev = lax.axis_size(DATA_AXIS)
+    # lax.axis_size is missing from older jax releases; psum(1) over the
+    # axis is the portable spelling and folds to a constant at trace time.
+    ndev = lax.psum(jnp.int32(1), DATA_AXIS)
     local_max = jnp.max(scores)
     local_arg = jnp.argmax(scores)
     gmax = lax.pmax(local_max, DATA_AXIS)
@@ -427,7 +429,7 @@ def _weighted_cluster_stats(xc, wc, lab, k, update):
 
 
 def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None,
-                   xt=None, sharded=True):
+                   xt=None, sharded=True, with_inertia=False):
     """Fused assignment + per-cluster (sum, count) reduction for one shard.
 
     ``chunk_rows=None`` materializes the full (n_loc, k) distance block — fast
@@ -435,8 +437,17 @@ def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None,
     (chunk_rows × k) while accumulating the (k, d) sums in-place — the tiling
     the reference's dense (n, k, d) broadcast lacks (SURVEY.md §3.2 hot loop #4,
     §7.4 "memory at 100M×128").
+
+    ``with_inertia=True`` (telemetry convergence traces, obs/) additionally
+    returns the shard-local weighted inertia Σ w·‖x − c_label‖² as a fourth
+    output, recovered from the distance block the assignment already
+    computes plus one O(n·d) ‖x‖² pass — not supported on the pallas path
+    (the fused kernel never exposes distances; ``kmeans_jax_full`` resolves
+    traced runs to the matmul strategy).
     """
     if update == "pallas":
+        if with_inertia:
+            raise ValueError("inertia traces unavailable on the pallas path")
         # Fused VMEM-resident feature-major kernel (ops/pallas_kernels.py).
         # The shard-local valid count is derived exactly from the static
         # global n_valid (a float mask sum would saturate at 2**24 rows in
@@ -456,10 +467,20 @@ def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None,
         acc = _stat_dtype(x.dtype)
         return labels, sums.astype(acc), counts.astype(acc)
 
+    acc = _stat_dtype(x.dtype)
     if chunk_rows is None:
-        labels = assign_labels_jax(x, c)
+        if not with_inertia:
+            labels = assign_labels_jax(x, c)
+            sums, counts = _weighted_cluster_stats(x, w, labels, k, update)
+            return labels, sums, counts
+        c_sq = jnp.sum(c * c, axis=1)
+        dist = c_sq[None, :] - 2.0 * (x @ c.T)     # ‖x‖² dropped for argmin
+        labels = jnp.argmin(dist, axis=1).astype(jnp.int32)
+        x_sq = jnp.sum((x * x).astype(acc), axis=1)
+        min_sq = jnp.maximum(dist.min(axis=1).astype(acc) + x_sq, 0.0)
+        inertia = jnp.sum(w.astype(acc) * min_sq)
         sums, counts = _weighted_cluster_stats(x, w, labels, k, update)
-        return labels, sums, counts
+        return labels, sums, counts, inertia
 
     n_loc, d = x.shape
     nch = n_loc // chunk_rows
@@ -468,19 +489,25 @@ def _assign_reduce(x, w, c, k, chunk_rows, update="matmul", n_valid=None,
     c_sq = jnp.sum(c * c, axis=1)
 
     def step(carry, xw):
-        sums, counts = carry
+        sums, counts, inertia = carry
         xc, wc = xw
         dist = c_sq[None, :] - 2.0 * (xc @ c.T)
         lab = jnp.argmin(dist, axis=1).astype(jnp.int32)
         s, cnt = _weighted_cluster_stats(xc, wc, lab, k, update)
-        return (sums + s, counts + cnt), lab
+        if with_inertia:
+            x_sq = jnp.sum((xc * xc).astype(acc), axis=1)
+            min_sq = jnp.maximum(dist.min(axis=1).astype(acc) + x_sq, 0.0)
+            inertia = inertia + jnp.sum(wc.astype(acc) * min_sq)
+        return (sums + s, counts + cnt, inertia), lab
 
-    acc = _stat_dtype(x.dtype)
-    (sums, counts), labels = lax.scan(
+    (sums, counts, inertia), labels = lax.scan(
         step,
-        (jnp.zeros((k, d), acc), jnp.zeros((k,), acc)),
+        (jnp.zeros((k, d), acc), jnp.zeros((k,), acc),
+         jnp.zeros((), acc)),
         (xr, wr),
     )
+    if with_inertia:
+        return labels.reshape(n_loc), sums, counts, inertia
     return labels.reshape(n_loc), sums, counts
 
 
@@ -514,8 +541,11 @@ def _assign_only(x, c, chunk_rows, update="matmul", xt=None, k=None):
 
 
 def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
-                 max_iter, chunk_rows=None, update="matmul", sharded=True):
-    """Lloyd loop, shard-local view.  Returns (centroids, labels, iters, shift).
+                 max_iter, chunk_rows=None, update="matmul", sharded=True,
+                 trace=False):
+    """Lloyd loop, shard-local view.  Returns (centroids, labels, iters, shift)
+    — plus ``(trace_inertia, trace_shift)`` (max_iter,)-shaped buffers when
+    ``trace`` is set.
 
     Labels are the assignment against the centroids *before* the final update
     (reference loop order, kmeans_plusplus.py:33-48) — computed in one extra
@@ -523,6 +553,14 @@ def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
     buffer in the while_loop carry blocks XLA from fusing the
     argmin/one-hot/matmul chain and costs ~3x per iteration (measured on
     v5e: 24 ms vs 7 ms per iteration at n=1M, k=128).
+
+    ``trace`` (telemetry convergence traces, obs/) carries two (max_iter,)
+    f32 buffers through the loop — per-iteration inertia (against the
+    pre-update centroids, the standard convention) and centroid shift —
+    written at index ``it`` and emitted post-hoc by the caller; entries past
+    the converged iteration stay zero.  The scalars ride the existing
+    reduction pass, so tracing costs one O(n·d) ‖x‖² pass per iteration,
+    not a second assignment.
     """
     n_loc = x.shape[0]
     offset = lax.axis_index(DATA_AXIS) * n_loc if sharded else 0
@@ -533,15 +571,26 @@ def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
     xt = x.T if update == "pallas" else None
 
     def cond(carry):
-        _, _, it, shift = carry
+        it, shift = carry[2], carry[3]
         return (it < max_iter) & ((it == 0) | (shift >= tol))
 
     def body(carry):
-        c, _, it, _ = carry
-        _, sums, counts = _assign_reduce(x, w, c, k, chunk_rows, update,
-                                         n_valid=n_valid, xt=xt,
-                                         sharded=sharded)
-        return _update_step(c, sums, counts, it)
+        c, _, it = carry[0], carry[1], carry[2]
+        if not trace:
+            _, sums, counts = _assign_reduce(x, w, c, k, chunk_rows, update,
+                                             n_valid=n_valid, xt=xt,
+                                             sharded=sharded)
+            return _update_step(c, sums, counts, it)
+        _, sums, counts, inertia = _assign_reduce(
+            x, w, c, k, chunk_rows, update, n_valid=n_valid, xt=xt,
+            sharded=sharded, with_inertia=True)
+        if sharded:
+            inertia = lax.psum(inertia, DATA_AXIS)
+        tr_inertia, tr_shift = carry[4], carry[5]
+        tr_inertia = tr_inertia.at[it].set(inertia.astype(tr_inertia.dtype))
+        new_c, c_prev, it1, shift = _update_step(c, sums, counts, it)
+        tr_shift = tr_shift.at[it].set(shift.astype(tr_shift.dtype))
+        return new_c, c_prev, it1, shift, tr_inertia, tr_shift
 
     def _update_step(c, sums, counts, it):
         if sharded:
@@ -585,16 +634,21 @@ def _lloyd_local(x, w, centroids, key, iter_offset, *, k, n_valid, tol,
         jnp.array(0, jnp.int32),
         jnp.array(jnp.inf, centroids.dtype),
     )
+    if trace:
+        init = init + (jnp.zeros((max_iter,), jnp.float32),
+                       jnp.zeros((max_iter,), jnp.float32))
     if tol <= 0:
         # Fixed iteration budget (tol disabled): a static-trip fori_loop —
         # identical iteration count (shift >= 0 keeps the while cond true)
         # but ~0.4 ms/iter cheaper on v5e, where the dynamic trip count
         # blocks XLA's cross-iteration scheduling.
-        c, c_prev, it, shift = lax.fori_loop(
-            0, max_iter, lambda _, carry: body(carry), init)
+        out = lax.fori_loop(0, max_iter, lambda _, carry: body(carry), init)
     else:
-        c, c_prev, it, shift = lax.while_loop(cond, body, init)
+        out = lax.while_loop(cond, body, init)
+    c, c_prev, it, shift = out[:4]
     labels = _assign_only(x, c_prev, chunk_rows, update=update, xt=xt, k=k)
+    if trace:
+        return c, labels, it, shift, out[4], out[5]
     return c, labels, it, shift
 
 
@@ -726,8 +780,14 @@ def _lloyd_local_2d(x, w, c_loc, key, iter_offset, *, k, n_valid, tol,
 @functools.lru_cache(maxsize=32)
 def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
                   dtype_name, chunk_rows=None, update="matmul",
-                  init_method="d2", init_rounds=5, init_per_round=0):
-    """Compile the full sharded kmeans for one (shape, mesh, config) point."""
+                  init_method="d2", init_rounds=5, init_per_round=0,
+                  with_trace=False):
+    """Compile the full sharded kmeans for one (shape, mesh, config) point.
+
+    ``with_trace`` compiles the convergence-traced variant (two extra
+    (max_iter,) outputs; telemetry, obs/) — a separate cache entry, so
+    flipping telemetry on does not evict or perturb the production
+    program."""
     k_loc = k // nmodel
     # Single-device bypass: a 1x1 mesh still pays shard_map's collective
     # plumbing (~0.9 ms/iter at config 2 on v5e — the raw fused kernel runs
@@ -759,6 +819,7 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
                 x, w, centroids, lloyd_key, iter_offset,
                 k=k, n_valid=n_valid, tol=tol, max_iter=max_iter,
                 chunk_rows=chunk_rows, update=update, sharded=sharded,
+                trace=with_trace,
             )
         c_loc = lax.dynamic_slice_in_dim(
             centroids, lax.axis_index(MODEL_AXIS) * k_loc, k_loc
@@ -776,11 +837,14 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
         c_spec = P()
     else:
         c_spec = P(MODEL_AXIS, None)
-    mapped = jax.shard_map(
+    out_specs = (c_spec, P(DATA_AXIS), P(), P())
+    if with_trace:
+        out_specs = out_specs + (P(), P())  # psum-replicated trace buffers
+    mapped = shard_map_compat(
         local_fn,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(), P(), P()),
-        out_specs=(c_spec, P(DATA_AXIS), P(), P()),
+        out_specs=out_specs,
         check_vma=False,
     )
     return jax.jit(mapped)
@@ -856,6 +920,19 @@ def kmeans_jax_full(
         raise ValueError(f"unknown update strategy {update!r}")
     update = resolve_update(update, nmodel, dtype, k=k)
 
+    # Telemetry (obs/): when an instrument is active with kmeans tracing on,
+    # run the convergence-traced program (per-iteration inertia + shift
+    # carried in the loop state, emitted post-hoc).  The fused pallas kernel
+    # never exposes distances, so traced runs resolve to the matmul
+    # strategy — a documented diagnostic-mode substitution.  Model-sharded
+    # meshes stay untraced (the 2D loop has no traced variant).
+    from ..obs import current as _obs_current
+
+    _tel = _obs_current()
+    with_trace = (_tel is not None and _tel.kmeans_trace and nmodel == 1)
+    if with_trace and update == "pallas":
+        update = "matmul"
+
     # pallas tiles rows internally (pallas_kernels.lloyd_tile), so shards
     # must divide it.
     multiple = padding_multiple(ndata, chunk_rows, update, k=k)
@@ -927,15 +1004,38 @@ def kmeans_jax_full(
                 raise ValueError(
                     f"kmeans|| needs per-round sample {init_per_round} <= "
                     f"shard rows {n_loc}; use init_method='d2' at this scale")
-    fn = _build_kmeans(
+    build_args = (
         n_valid, d, int(k), ndata, nmodel, int(max_iter), float(tol),
         with_init, np.dtype(dtype).name, chunk_rows, update,
-        init_method, int(init_rounds), init_per_round,
+        init_method, int(init_rounds), init_per_round, with_trace,
     )
+    _misses_before = _build_kmeans.cache_info().misses
+    fn = _build_kmeans(*build_args)
+    if _tel is not None:
+        # Recompile detector: the aval signature (input shape/dtype plus
+        # _build_kmeans's static cache key) names the program; the actual
+        # recompile verdict is the lru_cache miss delta — exact even when
+        # the kernel was warm before telemetry activated.
+        from ..obs.jaxtools import aval_signature
+
+        _tel.record_kernel_call(
+            "kmeans_jax_full", aval_signature(Xp, static=build_args),
+            compiled=_build_kmeans.cache_info().misses > _misses_before)
     if k > n_valid:
         raise ValueError(f"k={k} exceeds number of valid samples {n_valid}")
-    centroids, labels, it, shift = fn(
-        Xp, c0, key, _device_scalar_i32(int(iter_offset)))
+    out = fn(Xp, c0, key, _device_scalar_i32(int(iter_offset)))
+    centroids, labels, it, shift = out[:4]
+    if with_trace:
+        # Trace emission synchronizes (the buffers must come to host);
+        # telemetry-off runs keep the fetch-free block_scalars=False path.
+        it, shift = jax.device_get((it, shift))
+        n_iter = int(it)
+        _tel.emit_kmeans_trace(
+            "kmeans_jax_full",
+            inertia=np.asarray(out[4])[:n_iter],
+            shift=np.asarray(out[5])[:n_iter],
+            backend="jax", k=int(k), n=int(n_valid), update=update)
+        return centroids, labels[:n_valid], n_iter, float(shift)
     if not block_scalars:
         return centroids, labels[:n_valid], it, shift
     # One host fetch for both scalars — int(it); float(shift) would be two

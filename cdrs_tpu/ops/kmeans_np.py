@@ -183,9 +183,40 @@ def kmeans(
 
         max_iter = default_max_iter(number_of_files)
 
+    # Telemetry (obs/): per-iteration convergence trace when an instrument
+    # with kmeans tracing is active.  Inertia is measured against the
+    # pre-update centroids (the assignment ``labels`` was computed on),
+    # matching the jax backend's traced convention.  Expanded as
+    # Σ‖x‖² − 2·Σ_j n_j⟨mean_j, c_j⟩ + Σ_j n_j‖c_j‖² so the per-iteration
+    # cost is one bincount + k·d flops — never an (n, d) residual temp
+    # (the naive form costs ~60% of the whole config-1 pipeline).
+    from ..obs import current as _obs_current
+
+    tel = _obs_current()
+    tracing = tel is not None and tel.kmeans_trace
+    tr_inertia: list[float] = []
+    tr_shift: list[float] = []
+    x_sq_total = float(np.einsum("nd,nd->", X, X)) if tracing else 0.0
+
     labels = np.zeros(n, dtype=np.int64)
     for _ in range(max_iter):
+        prev = centroids
         centroids, labels, shift = lloyd_step(X, centroids, rng)
+        if tracing:
+            counts = np.bincount(labels, minlength=k).astype(np.float64)
+            nz = counts > 0
+            # For non-empty clusters lloyd_step's update IS sums/counts, so
+            # the cluster sum s_j = mean_j · n_j; empty clusters contribute
+            # no points (their reseeded row is irrelevant to inertia).
+            cross = np.einsum("kd,kd->k", centroids[nz], prev[nz])
+            prev_sq = np.einsum("kd,kd->k", prev[nz], prev[nz])
+            tr_inertia.append(max(0.0, x_sq_total + float(
+                np.dot(counts[nz], prev_sq - 2.0 * cross))))
+            tr_shift.append(float(shift))
         if shift < tol:
             break
+    if tracing:
+        tel.emit_kmeans_trace("kmeans_np", inertia=tr_inertia,
+                              shift=tr_shift, backend="numpy", k=int(k),
+                              n=int(n))
     return centroids, labels

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXIS, make_mesh, pad_rows, prefix_mask
+from ..parallel.mesh import (DATA_AXIS, make_mesh, pad_rows, prefix_mask,
+                             shard_map_compat)
 from .kmeans_jax import _d2_init_local, _weighted_cluster_stats, assign_labels_jax
 
 __all__ = ["MiniBatchState", "minibatch_init", "minibatch_update", "MiniBatchKMeans"]
@@ -53,7 +54,7 @@ def _build_init(n_rows, n_valid, d, k, ndata, dtype_name):
     def local_fn(x, key):
         return _d2_init_local(x, prefix_mask(x, n_valid), key, k=k)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         local_fn, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P()),
         out_specs=P(),
@@ -81,7 +82,7 @@ def _build_update(n_rows, n_valid, d, k, ndata, dtype_name, update):
         new_c = centroids + eta[:, None] * (bmean - centroids)
         return new_c, new_counts, labels
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         local_fn, mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(), P()),
         out_specs=(P(), P(), P(DATA_AXIS)),

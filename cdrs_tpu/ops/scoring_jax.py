@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..config import ScoringConfig
-from ..parallel.mesh import DATA_AXIS
+from ..parallel.mesh import DATA_AXIS, shard_map_compat
 
 __all__ = [
     "compute_cluster_medians_jax",
@@ -340,7 +340,7 @@ def _build_bisect_medians_sharded(k: int, bins: int, with_global: bool,
         x_p, lab_p = _bisect_pad(x_loc, lab, k)
         return _bisect_core(x_p, lab_p, k, bins, with_global, sharded=True)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         local_fn,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),
@@ -409,7 +409,7 @@ def _build_hist_medians_sharded(k: int, bins: int, with_global: bool,
         meds, gmeds = lax.map(one_feature, (x_loc.T, lo, hi))
         return meds.T, gmeds
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map_compat(
         local_fn,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS)),

@@ -22,10 +22,29 @@ import jax
 from jax.sharding import Mesh
 
 __all__ = ["make_mesh", "mesh_from_shape", "pad_rows", "prefix_mask",
-           "DATA_AXIS", "MODEL_AXIS"]
+           "shard_map_compat", "DATA_AXIS", "MODEL_AXIS"]
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs,
+                     check_vma: bool = False):
+    """``jax.shard_map`` across jax versions.
+
+    The top-level ``jax.shard_map`` (and its ``check_vma`` kwarg) only
+    exists in newer jax releases; older ones ship it as
+    ``jax.experimental.shard_map.shard_map`` with the kwarg named
+    ``check_rep``.  Every shard_map in the framework goes through here so
+    the supported-version window is one function wide.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=check_vma)
 
 
 def make_mesh(n_data: int = 1, n_model: int = 1, devices=None) -> Mesh:

@@ -1,9 +1,12 @@
-"""Structured per-stage timing and metrics.
+"""Structured per-stage timing and metrics — thin shims over ``obs``.
 
-The reference's only observability is bare ``print()`` calls (SURVEY.md §5
-"Metrics/logging").  Here every pipeline stage runs under a ``StageTimer`` and
-metrics accumulate into a ``MetricsLog`` that serializes to JSON — the same
-records the benchmark harness emits.
+Historically this module WAS the observability layer (per-stage timers and
+a flat dict serialized into the benchmark records).  The real instrument
+now lives in ``cdrs_tpu/obs`` (hierarchical spans, counters/histograms,
+JSONL sink); ``StageTimer``/``MetricsLog`` keep their API so existing call
+sites and the benchmark harness are untouched, while transparently
+emitting through the active ``obs.Telemetry`` when one is installed
+(``cdrs ... --metrics out.jsonl``).
 """
 
 from __future__ import annotations
@@ -12,31 +15,75 @@ import json
 import time
 from dataclasses import dataclass, field
 
+from ..obs import current as _current_telemetry
+
 __all__ = ["StageTimer", "MetricsLog"]
 
 
 class StageTimer:
+    """Wall-clock a stage; opens an obs span when telemetry is active."""
+
     def __init__(self, name: str, metrics: "MetricsLog | None" = None):
         self.name = name
         self.metrics = metrics
         self.elapsed = 0.0
+        self._span = None
 
     def __enter__(self) -> "StageTimer":
+        tel = _current_telemetry()
+        if tel is not None:
+            self._span = tel.span(self.name)
+            self._span.__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc) -> None:
         self.elapsed = time.perf_counter() - self._t0
+        if self._span is not None:
+            self._span.__exit__(*exc)
+            self._span = None
         if self.metrics is not None:
             self.metrics.record(f"{self.name}.seconds", self.elapsed)
 
 
 @dataclass
 class MetricsLog:
-    records: dict[str, float] = field(default_factory=dict)
+    """Flat metric record dict (the benchmark-harness serialization shape).
 
-    def record(self, key: str, value: float) -> None:
-        self.records[key] = float(value)
+    A repeated key no longer silently overwrites: the value becomes a list
+    and later records append (two ``stream`` timers in one process keep
+    both timings).  ``increment`` gives counter semantics on top.
+    """
+
+    records: dict[str, float | list[float]] = field(default_factory=dict)
+
+    def record(self, key: str, value) -> None:
+        value = value if value is None else float(value)
+        if key in self.records:
+            old = self.records[key]
+            if isinstance(old, list):
+                old.append(value)
+            else:
+                self.records[key] = [old, value]
+        else:
+            self.records[key] = value
+        tel = _current_telemetry()
+        if tel is not None and value is not None:
+            tel.gauge(key, value)
+
+    def increment(self, key: str, delta: float = 1.0) -> float:
+        """Counter semantics: add ``delta`` to the key (0 when absent).
+        A key previously recorded as a list cannot be incremented."""
+        old = self.records.get(key, 0.0)
+        if isinstance(old, list):
+            raise TypeError(
+                f"cannot increment {key!r}: it holds a list of records")
+        value = float(old) + float(delta)
+        self.records[key] = value
+        tel = _current_telemetry()
+        if tel is not None:
+            tel.counter_inc(key, delta)
+        return value
 
     def timer(self, name: str) -> StageTimer:
         return StageTimer(name, metrics=self)

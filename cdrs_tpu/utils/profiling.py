@@ -23,10 +23,13 @@ def trace_region(trace_dir: str | None):
     try:
         import jax
     except ImportError:
-        import sys
+        import warnings
 
-        print("warning: --profile requested but jax is not installed; "
-              "no trace will be written", file=sys.stderr)
+        # warnings.warn (not a bare stderr print) so callers and tests can
+        # assert on / filter the degradation.
+        warnings.warn("--profile requested but jax is not installed; "
+                      "no trace will be written", RuntimeWarning,
+                      stacklevel=2)
         yield
         return
     with jax.profiler.trace(trace_dir):

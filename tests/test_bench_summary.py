@@ -55,3 +55,34 @@ def test_summary_cli_writes_json(stubbed, tmp_path, capsys):
     assert on_disk["lloyd"]["config1"]["value"] == 1.0
     # stdout carries the same JSON
     assert json.loads(capsys.readouterr().out)["lloyd"]["config1"]["value"] == 1.0
+
+
+def test_step_error_isolation_unit(capsys):
+    """_step in isolation: result lands under the key on success, the
+    error string (with the exception type) replaces it on failure, and
+    the failure never propagates."""
+    from cdrs_tpu.benchmarks.summary import _step
+
+    out = {}
+    _step(out, "ok", lambda: {"v": 1})
+    _step(out, "boom", lambda: (_ for _ in ()).throw(KeyError("nope")))
+    assert out["ok"] == {"v": 1}
+    assert out["boom"]["error"].startswith("KeyError")
+    assert "boom FAILED" in capsys.readouterr().err
+
+
+def test_telemetry_overhead_structure():
+    """The ISSUE-2 overhead record at toy scale: all fields present and
+    internally consistent.  The ≤5% budget itself is asserted by the real
+    sweep on the bench host, not CI-timed — here only the bookkeeping."""
+    from cdrs_tpu.benchmarks.summary import telemetry_overhead
+
+    out = telemetry_overhead(n_files=300, duration=60.0, repeats=1)
+    assert set(out) >= {"plain_seconds", "telemetry_seconds",
+                        "overhead_ratio", "within_budget", "budget",
+                        "events_emitted"}
+    assert out["plain_seconds"] > 0 and out["telemetry_seconds"] > 0
+    assert out["overhead_ratio"] == pytest.approx(
+        out["telemetry_seconds"] / out["plain_seconds"])
+    assert out["events_emitted"] > 0  # spans + kmeans trace landed
+    assert out["within_budget"] == (out["overhead_ratio"] <= out["budget"])

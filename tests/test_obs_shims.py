@@ -1,0 +1,89 @@
+"""The utils shims over the telemetry layer: MetricsLog/StageTimer
+(utils/logging.py) and the profiling warning path (utils/profiling.py)."""
+
+import sys
+
+import pytest
+
+from cdrs_tpu.obs import JsonlSink, Telemetry, read_events
+from cdrs_tpu.utils.logging import MetricsLog, StageTimer
+
+
+def test_metricslog_repeated_key_keeps_both_values():
+    """Regression (ISSUE 2 satellite): two timers under the same name in one
+    process used to silently overwrite — e.g. two ``stream`` stages."""
+    m = MetricsLog()
+    with m.timer("stream"):
+        pass
+    with m.timer("stream"):
+        pass
+    rec = m.records["stream.seconds"]
+    assert isinstance(rec, list) and len(rec) == 2
+    assert all(v >= 0 for v in rec)
+    # A third repetition appends rather than re-nesting.
+    with m.timer("stream"):
+        pass
+    assert len(m.records["stream.seconds"]) == 3
+
+
+def test_metricslog_increment_semantics():
+    m = MetricsLog()
+    assert m.increment("counter") == 1.0
+    assert m.increment("counter", 2.5) == 3.5
+    assert m.records["counter"] == 3.5
+    m.record("listy", 1.0)
+    m.record("listy", 2.0)
+    with pytest.raises(TypeError, match="list"):
+        m.increment("listy")
+
+
+def test_metricslog_to_json_with_lists_and_none():
+    import json
+
+    m = MetricsLog()
+    m.record("a", 1)
+    m.record("a", 2)
+    m.record("accuracy", None)  # planted_accuracy=None must serialize
+    assert json.loads(m.to_json()) == {"a": [1.0, 2.0], "accuracy": None}
+
+
+def test_stage_timer_opens_span_under_active_telemetry(tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    with Telemetry(JsonlSink(p), meta=False) as tel:
+        with tel.span("root"):
+            m = MetricsLog()
+            with m.timer("stage_x"):
+                pass
+    spans = {e["name"]: e for e in read_events(p) if e["kind"] == "span"}
+    assert "stage_x" in spans
+    assert spans["stage_x"]["parent"] == spans["root"]["id"]
+    # the shim's flat record still works
+    assert m.records["stage_x.seconds"] >= 0
+
+
+def test_stage_timer_without_telemetry_is_plain():
+    with StageTimer("solo") as t:
+        pass
+    assert t.elapsed >= 0
+
+
+def test_trace_region_warns_without_jax(tmp_path, monkeypatch):
+    """The no-jax fallback must degrade through warnings.warn (assertable),
+    not a bare stderr print (ISSUE 2 satellite)."""
+    from cdrs_tpu.utils.profiling import trace_region
+
+    monkeypatch.setitem(sys.modules, "jax", None)  # import jax -> ImportError
+    ran = False
+    with pytest.warns(RuntimeWarning, match="no trace will be written"):
+        with trace_region(str(tmp_path / "prof")):
+            ran = True
+    assert ran  # the body still executes — degradation, not failure
+
+
+def test_trace_region_noop_without_dir(recwarn):
+    from cdrs_tpu.utils.profiling import trace_region
+
+    with trace_region(None):
+        pass
+    assert not [w for w in recwarn.list if issubclass(w.category,
+                                                      RuntimeWarning)]
