@@ -465,11 +465,11 @@ def _time_jax_lloyd(X, k: int, init: np.ndarray, iters: int,
     # fetch IS the sync; fetching centroids again here would add a second
     # ~25 ms tunnel round trip per window (~0.25 ms/iter of fake cost at
     # 100 iters).
-    c, l, it, _ = kmeans_jax_full(X, k, **kwargs)
+    c, lab, it, _ = kmeans_jax_full(X, k, **kwargs)
     windows = []
     for _ in range(max(1, repeats)):
         t0 = time.perf_counter()
-        c, l, it, _ = kmeans_jax_full(X, k, **kwargs)
+        c, lab, it, _ = kmeans_jax_full(X, k, **kwargs)
         windows.append((time.perf_counter() - t0) / iters)
         assert it == iters
     return min(windows), windows

@@ -19,8 +19,6 @@ import os
 import tempfile
 import time
 
-import numpy as np
-
 __all__ = ["bench_ingest"]
 
 

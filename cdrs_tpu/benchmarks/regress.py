@@ -1,0 +1,373 @@
+"""Bench trajectory regression gate — ``cdrs metrics regress``.
+
+The per-round driver captures (``BENCH_r0*.json``) are five disconnected
+files nothing reads; this module makes the trajectory *enforceable*:
+
+* **ingest** — ``BENCH_r*.json`` driver captures (and raw ``bench.py``
+  detail JSON) flatten into one canonical append-only history,
+  ``data/bench_history.jsonl`` — one line per (round, metric) with the
+  value, unit, direction, and the platform it was measured on.  Robust to
+  the drivers' truncation: a capture whose ``parsed`` is null is scraped
+  from its ``tail`` text (the r05 file holds only the last 2000 bytes of
+  the detail JSON; the metric/value fragments and the nested config blocks
+  survive).
+* **check** — a fresh bench run is compared per metric against a tolerance
+  band anchored at the BEST of the trailing ``window`` history values
+  (compare-against-recent-best; ± ``tolerance``).  Bands only form
+  between runs on the SAME
+  platform (``jax_platform``): a CPU CI runner is never judged against the
+  TPU trajectory — it reports ``no_baseline`` and passes, which is the
+  report-only posture .github/workflows/ci.yml runs until a stable runner
+  baseline exists.  A regression (worse than the band in the metric's bad
+  direction — ``iter/s`` down, ``seconds`` up) exits nonzero so CI can
+  gate on it; an improvement is reported as such, not flagged.
+
+No jax import anywhere: the gate must run on any host that can read JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+__all__ = ["extract_records", "ingest_files", "load_history", "check_run",
+           "main"]
+
+#: Units where smaller is better (wall-clock style metrics); everything
+#: else (iter/s, files/s, events/s) is throughput, larger is better.
+_LOWER_BETTER_UNITS = {"s", "seconds", "ms"}
+
+_NESTED_BLOCKS = ("config3", "config4_rehearsal")
+
+
+def _direction(metric: str, unit: str | None) -> str:
+    if (unit or "") in _LOWER_BETTER_UNITS or metric.startswith("e2e_"):
+        return "lower"
+    return "higher"
+
+
+def _record_from(detail: dict, source: str, round_no: int | None
+                 ) -> dict | None:
+    """One history record from a bench detail dict (driver ``parsed`` or a
+    nested config block); None when it is not a completed capture."""
+    if not isinstance(detail, dict) or "metric" not in detail \
+            or "value" not in detail:
+        return None
+    if "error" in detail or "skipped" in detail:
+        return None
+    rec = {
+        "round": round_no,
+        "source": source,
+        "metric": detail["metric"],
+        "value": float(detail["value"]),
+        "unit": detail.get("unit"),
+        "direction": _direction(detail["metric"], detail.get("unit")),
+        "platform": detail.get("jax_platform")
+        or ("numpy" if detail.get("backend") == "numpy" else None),
+        "devices": detail.get("jax_devices"),
+        "backend": detail.get("backend"),
+    }
+    if detail.get("vs_baseline") is not None:
+        rec["vs_baseline"] = float(detail["vs_baseline"])
+    return rec
+
+
+_METRIC_RE = re.compile(
+    r'"metric":\s*"(?P<metric>[^"]+)",\s*"value":\s*'
+    r'(?P<value>[-+0-9.eE]+),\s*"unit":\s*"(?P<unit>[^"]+)"'
+    r'(?:,\s*"vs_baseline":\s*(?P<vsb>[-+0-9.eE]+))?')
+_PLATFORM_RE = re.compile(r'"jax_platform":\s*"(\w+)"')
+
+
+def _scrape_tail(tail: str, source: str, round_no: int | None
+                 ) -> list[dict]:
+    """Records regex-scraped from a truncated driver ``tail``.
+
+    The stdout contract line and the detail JSON both carry the
+    metric/value/unit(/vs_baseline) quadruple; nested config blocks carry
+    their own.  Platform association: the detail JSON stamps
+    ``jax_platform`` after each metric's fields, so each match takes the
+    first platform occurrence following it.  Duplicate (metric, value)
+    pairs (contract line + detail line) collapse to one record.
+    """
+    platforms = [(m.start(), m.group(1))
+                 for m in _PLATFORM_RE.finditer(tail)]
+    seen: set[tuple] = set()
+    records = []
+    for m in _METRIC_RE.finditer(tail):
+        key = (m.group("metric"), m.group("value"))
+        if key in seen:
+            continue
+        seen.add(key)
+        platform = next((p for pos, p in platforms if pos > m.end()), None)
+        rec = {
+            "round": round_no,
+            "source": source,
+            "metric": m.group("metric"),
+            "value": float(m.group("value")),
+            "unit": m.group("unit"),
+            "direction": _direction(m.group("metric"), m.group("unit")),
+            "platform": platform,
+            "scraped": True,
+        }
+        if m.group("vsb") is not None:
+            rec["vs_baseline"] = float(m.group("vsb"))
+        records.append(rec)
+    return records
+
+
+def extract_records(doc, source: str) -> list[dict]:
+    """Flatten one bench artifact (driver capture or raw detail JSON) into
+    history records: the headline metric plus completed nested config
+    blocks (``config3``, ``config4_rehearsal``)."""
+    round_no = None
+    m = re.search(r"r(\d+)", os.path.basename(source))
+    if m:
+        round_no = int(m.group(1))
+    if isinstance(doc, dict) and "n" in doc and "cmd" in doc:
+        round_no = int(doc["n"])
+        detail = doc.get("parsed")
+        if detail is None:
+            return _scrape_tail(doc.get("tail") or "", source, round_no)
+    else:
+        detail = doc
+    if not isinstance(detail, dict):
+        return []
+    records = []
+    rec = _record_from(detail, source, round_no)
+    if rec:
+        records.append(rec)
+    for block in _NESTED_BLOCKS:
+        rec = _record_from(detail.get(block), source, round_no)
+        if rec:
+            records.append(rec)
+    return records
+
+
+def ingest_files(paths: list[str]) -> list[dict]:
+    records = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        # Basename only: the history must not bake in the ingesting
+        # machine's directory layout.
+        records.extend(extract_records(doc, os.path.basename(path)))
+    records.sort(key=lambda r: ((r.get("round") is None, r.get("round")),
+                                str(r.get("metric"))))
+    return records
+
+
+def write_history(path: str, records: list[dict]) -> None:
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        for r in records:
+            f.write(json.dumps(r, sort_keys=True) + "\n")
+
+
+def load_history(path: str) -> list[dict]:
+    records = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+def _parse_run_text(text: str):
+    """A run artifact as JSON, tolerating surrounding noise.
+
+    ``bench.py`` prints the one-line stdout contract (metric/value only)
+    and the FULL detail record — the one carrying ``backend``/
+    ``jax_platform`` the banding needs — to stderr, where jax warnings
+    interleave.  A clean JSON document parses directly; otherwise the
+    LAST line holding a JSON object with a ``metric`` key wins (the
+    detail record is printed after the contract line)."""
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict) and ("metric" in doc or "parsed" in doc):
+            return doc
+    raise ValueError("no JSON bench record found in the run artifact")
+
+
+def check_run(run_records: list[dict], history: list[dict], *,
+              tolerance: float = 0.15, window: int = 3) -> list[dict]:
+    """Per-metric verdicts of a fresh run against the history bands.
+
+    Band anchor: the BEST of the trailing ``window`` same-(metric,
+    platform) history values — "do not regress from what the trajectory
+    recently demonstrated", the same posture as pytest-benchmark's
+    compare-against-best.  A trajectory mid-improvement (the recorded
+    config-2 history quadruples over three rounds) makes a mean or median
+    anchor uselessly loose; ``tolerance`` (default 15%) absorbs the
+    observed ~6% round-to-round noise.  Statuses: ``regression`` (outside
+    the band, bad side), ``improved`` (beyond the anchor by the same
+    margin, good side), ``pass`` (inside), ``no_baseline`` (no comparable
+    history — different platform or a new metric; always passes).
+    """
+    by_key: dict[tuple, list[dict]] = {}
+    for h in history:
+        by_key.setdefault((h.get("metric"), h.get("platform")),
+                          []).append(h)
+    verdicts = []
+    for rec in run_records:
+        key = (rec.get("metric"), rec.get("platform"))
+        hist = by_key.get(key, [])
+        v: dict = {"metric": rec.get("metric"),
+                   "platform": rec.get("platform"),
+                   "value": rec.get("value"), "unit": rec.get("unit")}
+        if not hist:
+            v["status"] = "no_baseline"
+            verdicts.append(v)
+            continue
+        hist = sorted(hist, key=lambda h: (h.get("round") is None,
+                                           h.get("round")))
+        recent = [float(h["value"]) for h in hist[-max(1, window):]]
+        direction = rec.get("direction") or _direction(
+            rec.get("metric", ""), rec.get("unit"))
+        baseline = max(recent) if direction == "higher" else min(recent)
+        value = float(rec["value"])
+        v.update({"baseline": baseline, "direction": direction,
+                  "n_history": len(hist), "tolerance": tolerance})
+        if direction == "higher":
+            band_low = baseline * (1.0 - tolerance)
+            v["band_low"] = band_low
+            if value < band_low:
+                v["status"] = "regression"
+            elif value > baseline * (1.0 + tolerance):
+                v["status"] = "improved"
+            else:
+                v["status"] = "pass"
+        else:
+            band_high = baseline * (1.0 + tolerance)
+            v["band_high"] = band_high
+            if value > band_high:
+                v["status"] = "regression"
+            elif value < baseline * (1.0 - tolerance):
+                v["status"] = "improved"
+            else:
+                v["status"] = "pass"
+        verdicts.append(v)
+    return verdicts
+
+
+def _print_verdicts(verdicts: list[dict], out=None) -> None:
+    out = out or sys.stdout
+    for v in verdicts:
+        status = v["status"]
+        line = f"  [{status:<11}] {v['metric']} = {v['value']:g}"
+        if "baseline" in v:
+            arrow = "<" if "band_low" in v else ">"
+            band = v.get("band_low", v.get("band_high"))
+            line += (f" {v.get('unit', '')} (baseline {v['baseline']:g}, "
+                     f"regression when {arrow} {band:g}, "
+                     f"{v['n_history']} rounds of history)")
+        else:
+            line += (f" {v.get('unit', '')} (no comparable history on "
+                     f"platform {v.get('platform')!r})")
+        print(line, file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cdrs metrics regress",
+        description="compare a bench run against the recorded trajectory "
+                    "bands (nonzero exit on regression)")
+    parser.add_argument("run", nargs="?", default=None,
+                        help="fresh bench artifact (driver capture or raw "
+                             "bench.py detail JSON); '-' reads stdin")
+    parser.add_argument("--history", default="data/bench_history.jsonl",
+                        metavar="JSONL",
+                        help="canonical trajectory history "
+                             "(default: data/bench_history.jsonl)")
+    parser.add_argument("--ingest", nargs="+", default=None,
+                        metavar="JSON",
+                        help="(re)build the history from these BENCH "
+                             "artifacts instead of checking a run")
+    parser.add_argument("--tolerance", type=float, default=0.15,
+                        help="band half-width as a fraction of the "
+                             "baseline (default 0.15)")
+    parser.add_argument("--window", type=int, default=3,
+                        help="trailing history rounds whose BEST value "
+                             "anchors the band (default 3)")
+    parser.add_argument("--report-only", action="store_true",
+                        help="print verdicts but exit 0 even on "
+                             "regression (CI before a stable runner "
+                             "baseline exists)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the verdicts as JSON")
+    args = parser.parse_args(argv)
+
+    if args.ingest:
+        records = ingest_files(args.ingest)
+        if not records:
+            print("error: no bench records found in the given files",
+                  file=sys.stderr)
+            return 2
+        write_history(args.history, records)
+        rounds = sorted({r.get("round") for r in records})
+        print(f"ingested {len(records)} records from "
+              f"{len(args.ingest)} files (rounds {rounds}) -> "
+              f"{args.history}")
+        return 0
+
+    if not args.run:
+        parser.error("a RUN.json to check (or --ingest) is required")
+    try:
+        if args.run == "-":
+            text = sys.stdin.read()
+        else:
+            with open(args.run, encoding="utf-8") as f:
+                text = f.read()
+        doc = _parse_run_text(text)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"error: cannot read run {args.run}: {e}", file=sys.stderr)
+        return 2
+    run_records = extract_records(doc, args.run if args.run != "-"
+                                  else "stdin")
+    if not run_records:
+        print("error: no metric records in the run artifact",
+              file=sys.stderr)
+        return 2
+    try:
+        history = load_history(args.history)
+    except OSError as e:
+        print(f"error: cannot read history {args.history}: {e}",
+              file=sys.stderr)
+        return 2
+
+    verdicts = check_run(run_records, history, tolerance=args.tolerance,
+                         window=args.window)
+    if args.json:
+        print(json.dumps(verdicts, indent=2))
+    else:
+        print(f"bench regression check vs {args.history} "
+              f"(tolerance {args.tolerance:g}, window {args.window}):")
+        _print_verdicts(verdicts)
+    regressions = [v for v in verdicts if v["status"] == "regression"]
+    if regressions:
+        print(f"REGRESSION: {len(regressions)} metric(s) below the "
+              f"trajectory band", file=sys.stderr)
+        if not args.report_only:
+            return 1
+        print("(report-only mode: exiting 0)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

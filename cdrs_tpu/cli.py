@@ -8,7 +8,7 @@ subcommand of ``python -m cdrs_tpu`` (or the ``cdrs`` console script):
   simulate  Poisson access events -> access.log        (reference: access_simulator.py)
   features  manifest+log -> features CSV               (reference: compute_features.py)
   cluster   features CSV -> final_categories.csv       (reference: main.py)
-  pipeline  all of the above end-to-end                (reference: run_pipeline.sh + main.py)
+  pipeline  all of the above end-to-end      (reference: run_pipeline.sh)
             (alias: run)
   bench     benchmark harness                          (new; BASELINE.md configs)
   metrics   inspect telemetry JSONL streams            (new; obs/metrics_cli.py)
@@ -30,7 +30,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -708,10 +707,13 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=_cmd_bench)
 
     p = sub.add_parser("metrics", help="inspect a telemetry JSONL stream: "
-                       "summarize | tail | export --format prometheus")
+                       "summarize | tail | export | report | watch | "
+                       "regress")
     p.add_argument("rest", nargs=argparse.REMAINDER,
                    help="summarize FILE | tail FILE [-n N] | "
-                        "export FILE --format prometheus [--out FILE]")
+                        "export FILE --format prometheus [--out FILE] | "
+                        "report FILE [-o HTML] | watch FILE | "
+                        "regress RUN.json [--report-only]")
     p.set_defaults(fn=_cmd_metrics)
 
     args = parser.parse_args(argv)

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass, field
-from typing import Mapping, Sequence
+from typing import Mapping
 
 # ---------------------------------------------------------------------------
 # Canonical category/feature vocabulary
@@ -96,13 +96,15 @@ def _default_replication_factors() -> dict[str, int]:
 
 @dataclass
 class ScoringConfig:
-    """Weighted directional-deviation scoring rules (reference: src/scoring.py:57-109)."""
+    """Weighted directional-deviation scoring rules (reference:
+    src/scoring.py:57-109)."""
 
     features: tuple[str, ...] = CLUSTERING_FEATURES
     global_medians: dict[str, float] = field(default_factory=_default_global_medians)
     weights: dict[str, dict[str, float]] = field(default_factory=_default_weights)
     directions: dict[str, dict[str, int]] = field(default_factory=_default_directions)
-    replication_factors: dict[str, int] = field(default_factory=_default_replication_factors)
+    replication_factors: dict[str, int] = field(
+        default_factory=_default_replication_factors)
     #: Moderate's "minimal deviation" band (reference: src/scoring.py:78 |delta| < 0.1).
     moderate_band: float = 0.1
     #: When True the pipeline replaces ``global_medians`` with medians computed
@@ -276,11 +278,13 @@ class GeneratorConfig:
 
 @dataclass
 class SimulatorConfig:
-    """Poisson access-pattern simulator knobs (reference: src/access_simulator.py:16-76)."""
+    """Poisson access-pattern simulator knobs (reference:
+    src/access_simulator.py:16-76)."""
 
     duration_seconds: float = 300.0
     clients: tuple[str, ...] = ("dn1", "dn2", "dn3", "dn4")
-    rate_profiles: dict[str, dict[str, float]] = field(default_factory=_default_rate_profiles)
+    rate_profiles: dict[str, dict[str, float]] = field(
+        default_factory=_default_rate_profiles)
     #: Per-file Gaussian jitter of the rates (reference: src/access_simulator.py:55-57):
     #: read_rate  ~ N(mu, max(1e-4, 0.2*mu)), write_rate ~ N(mu, max(1e-4, 0.5*mu)),
     #: locality_bias ~ N(mu, 0.2) clipped to [0, 1].

@@ -53,7 +53,7 @@ from ..config import CATEGORIES, KMeansConfig, ScoringConfig
 from ..io.events import EventLog, Manifest
 from ..models.replication import ReplicationPolicyModel
 from .drift import detect_drift
-from .migrate import MigrationScheduler, PlanMove, plan_diff
+from .migrate import MigrationScheduler, plan_diff
 from .windows import iter_windows
 
 __all__ = ["ControllerConfig", "ControllerResult", "ReplicationController"]
@@ -204,6 +204,9 @@ class ReplicationController:
             hysteresis_windows=cfg.hysteresis_windows)
         self._placement_key: bytes | None = None
         self._placement = None
+        #: Lazy decision-quality auditor (obs/audit.py); created at the
+        #: first audited window so telemetry-off runs never import it.
+        self._auditor = None
         self.window_index = 0
         #: Events folded from the FINAL processed window — lets a resume
         #: over a grown (append-only) log fold that window's late tail
@@ -354,20 +357,35 @@ class ReplicationController:
         rec["plan_hash"] = _plan_hash(self.current_rf, self.current_cat)
         seconds["total"] = time.perf_counter() - t_start
         rec["seconds"] = {k: round(v, 6) for k, v in seconds.items()}
-        self._instrument_window(rec, seconds)
+        self._instrument_window(rec, seconds, X)
         return rec
 
-    def _instrument_window(self, rec: dict, seconds: dict) -> None:
+    def _instrument_window(self, rec: dict, seconds: dict,
+                           X: np.ndarray | None = None) -> None:
         """Route the window's observations through the active telemetry
         instrument (obs/), when one is installed: migration counters
         (bytes/files moved, hysteresis/budget deferrals), re-cluster
-        counters, and per-stage wall-clock histograms (p50/p95 in
-        ``cdrs metrics summarize``).  No-op without an instrument."""
+        counters, per-stage wall-clock histograms (p50/p95 in
+        ``cdrs metrics summarize``), and — unless ``Telemetry(audit=False)``
+        — the per-window decision-quality audit (obs/audit.py: silhouette/
+        Davies-Bouldin proxies over the window's feature snapshot ``X``
+        when the loop already computed one, population entropy/TV,
+        replication byte cost, anomaly flags).  No-op without an
+        instrument; the audit observes and never mutates the plan."""
         from ..obs import current as _obs_current
 
         tel = _obs_current()
         if tel is None:
             return
+        if getattr(tel, "audit", False):
+            if self._auditor is None:
+                from ..obs.audit import DecisionAuditor
+
+                self._auditor = DecisionAuditor(self._sizes, len(CATEGORIES))
+            self._auditor.audit_window(
+                tel, window=rec["window"], rec=rec, X=X,
+                centroids=self._accepted_centroids,
+                rf=self.current_rf, cat=self.current_cat)
         tel.counter_inc("controller.windows")
         if rec["n_events"]:
             tel.counter_inc("controller.events_folded", rec["n_events"])

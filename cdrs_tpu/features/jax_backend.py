@@ -266,6 +266,22 @@ def compute_features_jax(
     op = np.asarray(events.op)
     client = np.asarray(events.client_id, dtype=np.int32)
 
+    def _run_kernel(kernel_name, fn, args, static_args, n_static_trailing):
+        """Dispatch through the XLA cost capture (obs/xprof.py) when an
+        instrument with xprof is active; the plain jit call otherwise."""
+        from ..obs import current as _obs_current
+
+        tel = _obs_current()
+        if tel is not None and tel.xprof:
+            from ..obs.jaxtools import aval_signature
+            from ..obs.xprof import instrumented_call
+
+            return instrumented_call(
+                kernel_name, fn, args,
+                signature=aval_signature(*args[:4], static=static_args),
+                n_static_trailing=n_static_trailing)
+        return fn(*args)
+
     if ndata > 1:
         if check_sorted and not bool(np.all(np.diff(events.ts) >= 0)):
             raise ValueError(
@@ -275,19 +291,23 @@ def compute_features_jax(
             )
         pid, sec, op, client = _pad_events(pid, sec, op, client, ndata)
         fn = _build_features_sharded(n, ndata)
-        raw, norm, writes, reads = fn(
-            jnp.asarray(pid), jnp.asarray(sec), jnp.asarray(op),
-            jnp.asarray(client),
-            jnp.asarray(manifest.primary_node_id, dtype=jnp.int32),
-            jnp.asarray(age),
+        raw, norm, writes, reads = _run_kernel(
+            "features_sharded", fn,
+            (jnp.asarray(pid), jnp.asarray(sec), jnp.asarray(op),
+             jnp.asarray(client),
+             jnp.asarray(manifest.primary_node_id, dtype=jnp.int32),
+             jnp.asarray(age)),
+            (n, ndata), 0,
         )
     else:
-        raw, norm, writes, reads = features_kernel(
-            jnp.asarray(pid), jnp.asarray(sec), jnp.asarray(op),
-            jnp.asarray(client),
-            jnp.asarray(manifest.primary_node_id, dtype=jnp.int32),
-            jnp.asarray(age),
-            n,
+        raw, norm, writes, reads = _run_kernel(
+            "features_kernel", features_kernel,
+            (jnp.asarray(pid), jnp.asarray(sec), jnp.asarray(op),
+             jnp.asarray(client),
+             jnp.asarray(manifest.primary_node_id, dtype=jnp.int32),
+             jnp.asarray(age),
+             n),
+            (n,), 1,
         )
     if as_device:
         return FeatureTable(paths=list(manifest.paths), raw=raw, norm=norm,

@@ -105,7 +105,8 @@ def compute_features(
     is_local = (client == manifest.primary_node_id[pid]).astype(np.float64)
     local_accesses = np.bincount(pid, weights=is_local, minlength=n)
     with np.errstate(divide="ignore", invalid="ignore"):
-        locality = np.where(access_freq > 0, local_accesses / np.maximum(access_freq, 1), 1.0)
+        locality = np.where(access_freq > 0,
+                            local_accesses / np.maximum(access_freq, 1), 1.0)
 
     # Two-level concurrency: count per (path, second) then max per path
     # (reference: compute_features.py:44-46).  Composite key over the observed
@@ -126,7 +127,8 @@ def compute_features(
         mean_writes = 1.0  # reference: compute_features.py:64-65
     write_ratio = writes / mean_writes
 
-    raw = np.stack([access_freq, age_seconds, write_ratio, locality, concurrency], axis=1)
+    raw = np.stack([access_freq, age_seconds, write_ratio, locality,
+                    concurrency], axis=1)
     norm = np.stack([minmax_normalize(raw[:, j]) for j in range(raw.shape[1])], axis=1)
     return FeatureTable(paths=list(manifest.paths), raw=raw, norm=norm,
                         writes=writes, reads=reads)

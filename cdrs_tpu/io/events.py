@@ -122,7 +122,8 @@ class Manifest:
     def write_csv(self, path: str) -> None:
         with open(path, "w", newline="") as f:
             w = csv.writer(f)
-            w.writerow(["path", "creation_ts", "primary_node", "size_bytes", "category"])
+            w.writerow(["path", "creation_ts", "primary_node",
+                        "size_bytes", "category"])
             for i, p in enumerate(self.paths):
                 ts = datetime.fromtimestamp(float(self.creation_ts[i]), tz=timezone.utc)
                 w.writerow([

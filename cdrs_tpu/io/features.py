@@ -50,5 +50,6 @@ def load_feature_matrix(
     if missing:
         raise ValueError(f"features CSV {path} missing columns: {missing}")
     X = df[list(features)].to_numpy(dtype=dtype)
-    paths = df["path"].tolist() if "path" in df.columns else [str(i) for i in range(len(df))]
+    paths = df["path"].tolist() if "path" in df.columns \
+        else [str(i) for i in range(len(df))]
     return X, paths

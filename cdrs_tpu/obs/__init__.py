@@ -30,7 +30,7 @@ The core imports neither jax nor pandas: a base install can produce and
 read telemetry.
 """
 
-from .sink import JsonlSink, read_events
+from .sink import JsonlSink, iter_events, read_events
 from .telemetry import Span, Telemetry, current, run_metadata
 
 __all__ = [
@@ -38,6 +38,7 @@ __all__ = [
     "Span",
     "Telemetry",
     "current",
+    "iter_events",
     "read_events",
     "run_metadata",
 ]

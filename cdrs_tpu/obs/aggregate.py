@@ -1,0 +1,231 @@
+"""Stream aggregation shared by every telemetry consumer.
+
+``cdrs metrics summarize`` (text), ``cdrs metrics report`` (HTML) and
+``cdrs metrics watch`` (live terminal) must agree on what a stream *means* —
+span-tree aggregation, last-wins window/audit dedup, cross-run counter
+summing, roofline arithmetic.  This module is that single meaning; the
+consumers only render.
+
+The reader is resilient by construction: unknown ``kind``s are ignored
+(forward compatibility) and a torn final line from a killed writer is
+skipped upstream (sink contract, obs/sink.py).
+"""
+
+from __future__ import annotations
+
+__all__ = ["collect", "span_forest", "ordered_span_paths", "percentile",
+           "dedup_windows", "final_counters", "roofline_rows", "fmt_bytes"]
+
+
+def fmt_bytes(b, sep: str = " ") -> str:
+    """Human-readable byte count shared by every renderer (``sep`` is the
+    value/unit separator: the HTML report spaces it, the terminal views
+    pack it)."""
+    if b is None:
+        return "—"
+    b = float(b)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(b) < 1024 or unit == "GiB":
+            return f"{b:.3g}{sep}{unit}"
+        b /= 1024
+    return f"{b:g}{sep}B"  # pragma: no cover - loop always returns
+
+
+def percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile on a sorted copy (no numpy dependency)."""
+    s = sorted(values)
+    if not s:
+        return float("nan")
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[idx]
+
+
+def span_forest(events: list[dict]):
+    """Aggregate span events by their name-path.
+
+    Returns ``{path_tuple: {"count": int, "total": float}}`` where the path
+    is the chain of span names from the root — repeated spans (e.g. one per
+    window) aggregate into one node.  Span ids restart per process, so ids
+    are scoped by the event's ``run`` stamp: appended streams from several
+    runs aggregate instead of shadowing each other.
+    """
+    by_id = {(e.get("run"), e["id"]): e for e in events
+             if e.get("kind") == "span"}
+    agg: dict[tuple, dict] = {}
+    for e in by_id.values():
+        run = e.get("run")
+        path = [e["name"]]
+        parent = e.get("parent")
+        depth = 0
+        while parent is not None and depth < 100:
+            pe = by_id.get((run, parent))
+            if pe is None:
+                break
+            path.append(pe["name"])
+            parent = pe.get("parent")
+            depth += 1
+        key = tuple(reversed(path))
+        node = agg.setdefault(key, {"count": 0, "total": 0.0})
+        node["count"] += 1
+        node["total"] += float(e.get("dur", 0.0))
+    return agg
+
+
+def ordered_span_paths(agg) -> list[tuple]:
+    """Stable depth-first ordering of a span forest: parents before
+    children, siblings by total descending, orphans (parent missing from
+    the stream) appended flat."""
+    paths = sorted(agg, key=lambda p: (len(p), -agg[p]["total"]))
+    ordered: list[tuple] = []
+
+    def add_children(prefix):
+        kids = [p for p in paths if len(p) == len(prefix) + 1
+                and p[:len(prefix)] == prefix]
+        for p in sorted(kids, key=lambda p: -agg[p]["total"]):
+            ordered.append(p)
+            add_children(p)
+
+    add_children(())
+    for p in paths:
+        if p not in ordered:
+            ordered.append(p)
+    return ordered
+
+
+def dedup_windows(events: list[dict], kind: str = "window") -> list[dict]:
+    """Per-window records, last-wins per window index.
+
+    The controller's sink contract (control/controller.py): after a crash
+    the append-only tail may repeat the windows between the last snapshot
+    and the kill — consumers take the last record per window index.  The
+    same contract covers the ``audit`` stream (one record per window)."""
+    by_index: dict = {}
+    for e in events:
+        if e.get("kind") == kind:
+            by_index[e.get("window")] = e
+    return [by_index[w] for w in sorted(by_index, key=lambda x: (x is None,
+                                                                 x))]
+
+
+def final_counters(events: list[dict]) -> dict[str, float]:
+    """Final counter values, summed across runs sharing the stream.
+
+    Each counter event carries its run's *cumulative* value; within one run
+    the last event wins, and separate runs (which each restart at zero)
+    add.  Caveat: a kill/resume pair counts a crashed run's partial tail in
+    both runs' counters — the deduplicated window digest (not the counter
+    sums) is the authoritative per-window accounting."""
+    per_run: dict[tuple, float] = {}
+    for e in events:
+        if e.get("kind") == "counter":
+            per_run[(e.get("run"), e["name"])] = e["value"]
+    totals: dict[str, float] = {}
+    for (_, name), v in per_run.items():
+        totals[name] = totals.get(name, 0.0) + v
+    return totals
+
+
+def collect(events: list[dict]) -> dict:
+    """One structured digest of a telemetry stream.
+
+    Keys: ``spans`` (span forest), ``counters`` (final values),
+    ``gauges`` (last value), ``gauge_series`` (every observation, stream
+    order), ``hists``, ``traces`` ({(run, call): [kmeans_iter events]}),
+    ``windows`` / ``audits`` (last-wins per window), ``xla`` (one row per
+    (kernel, sig) merging compile and exec events), ``meta`` (last run
+    metadata seen).
+    """
+    gauges: dict[str, float] = {}
+    gauge_series: dict[str, list[float]] = {}
+    hists: dict[str, list[float]] = {}
+    traces: dict[tuple, list[dict]] = {}
+    xla: dict[tuple, dict] = {}
+    meta: dict = {}
+    for e in events:
+        kind = e.get("kind")
+        if kind == "gauge":
+            gauges[e["name"]] = e["value"]
+            gauge_series.setdefault(e["name"], []).append(float(e["value"]))
+        elif kind == "hist":
+            hists.setdefault(e["name"], []).append(float(e["value"]))
+        elif kind == "kmeans_iter":
+            traces.setdefault((str(e.get("run")), int(e.get("call", 0))),
+                              []).append(e)
+        elif kind == "xla":
+            row = xla.setdefault((e.get("kernel"), e.get("sig")),
+                                 {"kernel": e.get("kernel"),
+                                  "sig": e.get("sig")})
+            if e.get("event") == "exec":
+                # Keep the fastest observed execution: later same-signature
+                # captures (fresh process appending to the stream) can only
+                # add noise on top of the true cost.
+                s = float(e.get("seconds", 0.0))
+                if "exec_seconds" not in row or s < row["exec_seconds"]:
+                    row["exec_seconds"] = s
+            else:
+                for key in ("flops", "bytes_accessed", "transcendentals",
+                            "argument_bytes", "output_bytes", "temp_bytes",
+                            "generated_code_bytes", "lower_seconds",
+                            "compile_seconds"):
+                    if key in e:
+                        row[key] = e[key]
+        elif kind == "meta" and isinstance(e.get("run"), dict):
+            meta = e["run"]
+    return {
+        "spans": span_forest(events),
+        "counters": final_counters(events),
+        "gauges": gauges,
+        "gauge_series": gauge_series,
+        "hists": hists,
+        "traces": traces,
+        "windows": dedup_windows(events, "window"),
+        "audits": dedup_windows(events, "audit"),
+        "xla": [xla[k] for k in sorted(xla, key=lambda t: (str(t[0]),
+                                                           str(t[1])))],
+        "meta": meta,
+    }
+
+
+def roofline_rows(digest: dict, peak_flops: float | None = None,
+                  peak_gbps: float | None = None) -> list[dict]:
+    """Roofline verdict per captured XLA program.
+
+    Each row extends the ``xla`` cost row with ``intensity`` (flops/byte),
+    achieved ``gflops``/``gbps`` when an execution sample exists, and —
+    when the chip's peaks are known (obs/xprof.DEVICE_PEAKS via the
+    stream's run metadata, or the explicit overrides) — the roofline-
+    attainable FLOP/s ``min(peak_flops, intensity · peak_bw)``, the
+    achieved fraction of it, and the ``bound`` classification
+    (memory/compute side of the ridge point).
+    """
+    from .xprof import resolve_peaks
+
+    peaks = resolve_peaks(digest.get("meta", {}).get("jax_device_kind"))
+    # Explicit overrides win per side; the known-chip table fills whichever
+    # side was not given (a single --peak_flops on a known chip must not
+    # silently disable the whole verdict).
+    if peak_flops is None and peaks:
+        peak_flops = peaks[0]
+    peak_bw = peak_gbps * 1e9 if peak_gbps else (peaks[1] if peaks
+                                                 else None)
+    rows = []
+    for x in digest.get("xla", []):
+        row = dict(x)
+        flops = x.get("flops")
+        bytes_acc = x.get("bytes_accessed")
+        if flops and bytes_acc:
+            row["intensity"] = flops / bytes_acc
+        secs = x.get("exec_seconds")
+        if secs and flops:
+            row["gflops"] = flops / secs / 1e9
+        if secs and bytes_acc:
+            row["gbps"] = bytes_acc / secs / 1e9
+        if peak_flops and peak_bw and "intensity" in row:
+            attainable = min(peak_flops, row["intensity"] * peak_bw)
+            row["attainable_gflops"] = attainable / 1e9
+            row["bound"] = ("compute" if row["intensity"] * peak_bw
+                            >= peak_flops else "memory")
+            if "gflops" in row:
+                row["peak_fraction"] = row["gflops"] * 1e9 / attainable
+        rows.append(row)
+    return rows

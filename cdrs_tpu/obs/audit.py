@@ -1,0 +1,223 @@
+"""Decision-quality audit — per-window "is the controller's output good?".
+
+The controller's window records (control/controller.py) account for what it
+*did* (folds, drifts, re-clusters, moves); this auditor scores what it
+*decided*, per window, from quantities the loop already holds:
+
+* **Clustering quality** — a simplified silhouette (per point: distance to
+  its own accepted centroid vs the nearest other centroid; one (n, k)
+  distance block, the same cost class as the drift detector that already
+  runs every window) and a Davies-Bouldin index over the same block
+  (per-cluster mean dispersion vs centroid separation; lower is better).
+  Both are centroid-based proxies of their exact forms — the O(n²)
+  pairwise silhouette is not a per-window quantity at any real n.
+* **Population health** — normalized entropy of the per-category population
+  (0 = everything in one category, 1 = uniform) and the total-variation
+  distance against the PREVIOUS window's fractions (the drift detector's TV
+  is against the last accepted model; this one sees window-to-window churn
+  even between re-clusters).
+* **Cost/benefit** — the applied plan's replication byte cost
+  (Σ rf·size_bytes) and its delta vs the previous window, next to the
+  window's measured locality hit ratio (cluster/evaluate.py replay).
+
+Threshold-based anomaly flags turn the metrics into verdicts:
+
+* ``drift_no_gain`` — a re-cluster ran this window and silhouette still
+  dropped by more than ``silhouette_drop`` vs the previous audited window:
+  the drift alarm fired but acting on it bought nothing (tuning signal for
+  ``drift_threshold``).
+* ``budget_saturated`` — the migration byte/file budget deferred moves
+  ``budget_windows`` windows running: the backlog is structurally larger
+  than the budget lets through (churn cap too tight, or the plan is
+  flapping).
+* ``locality_regressed`` — the window's applied moves measurably lowered
+  the replayed locality (before/after gap beyond ``locality_drop``).
+
+One ``{"kind": "audit", ...}`` event per window rides the same JSONL stream
+as everything else, plus ``audit.*`` gauges (silhouette, entropy, byte
+cost) and an ``audit.flags.<name>`` counter per raised flag.  The auditor
+is pure observation: it never touches the plan, and with telemetry off (or
+``Telemetry(audit=False)``) the controller skips it entirely.  Its
+window-to-window carry (previous fractions/silhouette/flag streaks) is
+deliberately NOT checkpointed — a resumed controller restarts the audit
+baseline at its first processed window; the plan sequence, which IS
+checkpoint-covered, is unaffected.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["AuditConfig", "DecisionAuditor", "silhouette_db_proxy"]
+
+
+@dataclass(frozen=True)
+class AuditConfig:
+    """Anomaly thresholds (see module docstring for flag semantics)."""
+
+    #: Silhouette drop (absolute, silhouette is in [-1, 1]) vs the previous
+    #: audited window that makes a same-window re-cluster "no gain".
+    silhouette_drop: float = 0.02
+    #: Consecutive windows with budget-deferred moves before the budget
+    #: counts as saturated.
+    budget_windows: int = 3
+    #: Before/after locality gap (absolute ratio points) that flags a
+    #: window's applied moves as a regression.
+    locality_drop: float = 0.01
+    #: Row cap for the silhouette/Davies-Bouldin geometry (deterministic
+    #: stride sample; None = all rows).  The metrics are means over rows,
+    #: so a few thousand samples pin them to the third decimal while
+    #: keeping the per-window audit cost flat in n — the audit must stay
+    #: inside the telemetry budget at any population size.
+    sample_rows: int | None = 4096
+
+
+def silhouette_db_proxy(X: np.ndarray, centroids: np.ndarray,
+                        labels: np.ndarray | None = None
+                        ) -> tuple[float, float]:
+    """(simplified silhouette, Davies-Bouldin) of X under ``centroids``.
+
+    One (n, k) squared-distance block serves both: silhouette compares each
+    point's own-centroid distance with its nearest-other-centroid distance;
+    Davies-Bouldin compares per-cluster mean dispersion with centroid
+    separation.  ``labels`` defaults to the nearest-centroid assignment
+    (the accepted model's own rule).  Degenerate inputs (k < 2, or all
+    points on one centroid) return (0.0, inf-free 0.0) rather than raising —
+    the auditor records, it does not crash the control loop.
+    """
+    X = np.asarray(X, dtype=np.float64)
+    c = np.asarray(centroids, dtype=np.float64)
+    n, k = X.shape[0], c.shape[0]
+    if n == 0 or k < 2:
+        return 0.0, 0.0
+    # ‖x−c‖² via the matmul expansion; clamp the cancellation negatives.
+    d2 = np.maximum(
+        (X * X).sum(1)[:, None] - 2.0 * (X @ c.T) + (c * c).sum(1)[None, :],
+        0.0)
+    if labels is None:
+        labels = np.argmin(d2, axis=1)
+    else:
+        labels = np.asarray(labels)
+    # Only the own-centroid and nearest-other distances are needed per row:
+    # square-root two (n,) vectors, never the (n, k) block.
+    rows = np.arange(n)
+    own = np.sqrt(d2[rows, labels])
+    d2[rows, labels] = np.inf       # d2 is local; no copy needed
+    other = np.sqrt(d2.min(axis=1))
+    denom = np.maximum(np.maximum(own, other), 1e-12)
+    sil = float(np.mean((other - own) / denom))
+
+    counts = np.bincount(labels, minlength=k).astype(np.float64)
+    disp = np.bincount(labels, weights=own, minlength=k)
+    disp = np.where(counts > 0, disp / np.maximum(counts, 1.0), 0.0)
+    cd = np.sqrt(np.maximum(
+        (c * c).sum(1)[:, None] - 2.0 * (c @ c.T) + (c * c).sum(1)[None, :],
+        0.0))
+    np.fill_diagonal(cd, np.inf)
+    nonempty = counts > 0
+    if nonempty.sum() < 2:
+        return sil, 0.0
+    # R_ij = (S_i + S_j) / M_ij over nonempty pairs; DB = mean_i max_j R_ij.
+    r = (disp[:, None] + disp[None, :]) / np.maximum(cd, 1e-12)
+    r[:, ~nonempty] = -np.inf
+    per_i = r.max(axis=1)[nonempty]
+    db = float(np.mean(np.where(np.isfinite(per_i), per_i, 0.0)))
+    return sil, db
+
+
+class DecisionAuditor:
+    """Carries window-to-window audit state for one controller instance."""
+
+    def __init__(self, sizes: np.ndarray, n_categories: int,
+                 cfg: AuditConfig | None = None):
+        self._sizes = np.asarray(sizes, dtype=np.int64)
+        self._n_categories = int(n_categories)
+        self.cfg = cfg or AuditConfig()
+        self._prev_fractions: np.ndarray | None = None
+        self._prev_silhouette: float | None = None
+        self._prev_byte_cost: int | None = None
+        self._budget_streak = 0
+
+    def audit_window(self, tel, *, window: int, rec: dict,
+                     X: np.ndarray | None,
+                     centroids: np.ndarray | None,
+                     rf: np.ndarray, cat: np.ndarray) -> dict | None:
+        """Score one processed window and emit the audit event through
+        ``tel``.  ``X`` is the window's feature snapshot when the loop
+        already computed one (drift/re-cluster ran); None skips the
+        geometry metrics but still audits population/cost/flags.  Returns
+        the audit record (also appended to the stream)."""
+        import time
+
+        cfg = self.cfg
+        event: dict = {"kind": "audit", "window": int(window),
+                       "t": time.time()}
+
+        sil = db = None
+        if X is not None and centroids is not None and len(centroids) >= 2:
+            cap = cfg.sample_rows
+            if cap is not None and len(X) > cap:
+                # Deterministic stride sample: same rows every window, so
+                # the window-to-window silhouette TREND (what the flags
+                # compare) carries no sampling jitter.
+                X = X[::max(1, len(X) // cap)][:cap]
+            sil, db = silhouette_db_proxy(X, centroids)
+            event["silhouette"] = sil
+            event["davies_bouldin"] = db
+
+        planned = cat >= 0
+        frac = np.bincount(cat[planned].astype(np.int64),
+                           minlength=self._n_categories).astype(np.float64)
+        total = max(int(planned.sum()), 1)
+        frac /= total
+        nz = frac[frac > 0]
+        # + 0.0 normalizes the -0.0 a one-category population produces.
+        entropy = float(-(nz * np.log(nz)).sum() /
+                        np.log(max(self._n_categories, 2)) + 0.0)
+        event["category_entropy"] = entropy
+        event["category_fractions"] = [round(float(f), 6) for f in frac]
+        if self._prev_fractions is not None:
+            event["population_tv"] = float(
+                0.5 * np.abs(frac - self._prev_fractions).sum())
+
+        byte_cost = int((rf.astype(np.int64) * self._sizes).sum())
+        event["replication_bytes"] = byte_cost
+        if self._prev_byte_cost is not None:
+            event["replication_bytes_delta"] = byte_cost - self._prev_byte_cost
+
+        if rec.get("locality_after") is not None:
+            event["locality"] = rec["locality_after"]
+
+        flags: list[str] = []
+        if (rec.get("recluster") and sil is not None
+                and self._prev_silhouette is not None
+                and sil < self._prev_silhouette - cfg.silhouette_drop):
+            flags.append("drift_no_gain")
+        if rec.get("deferred_budget"):
+            self._budget_streak += 1
+        else:
+            self._budget_streak = 0
+        if self._budget_streak >= cfg.budget_windows:
+            flags.append("budget_saturated")
+        before, after = rec.get("locality_before"), rec.get("locality_after")
+        if (before is not None and after is not None
+                and after < before - cfg.locality_drop):
+            flags.append("locality_regressed")
+        event["flags"] = flags
+
+        self._prev_fractions = frac
+        if sil is not None:
+            self._prev_silhouette = sil
+        self._prev_byte_cost = byte_cost
+
+        tel._emit(event)
+        if sil is not None:
+            tel.gauge("audit.silhouette", sil)
+            tel.gauge("audit.davies_bouldin", db)
+        tel.gauge("audit.category_entropy", entropy)
+        tel.gauge("audit.replication_bytes", float(byte_cost))
+        for f in flags:
+            tel.counter_inc(f"audit.flags.{f}")
+        return event

@@ -4,13 +4,21 @@ Subcommands:
 
 * ``summarize FILE`` — per-span wall-clock tree (aggregated over repeated
   spans), counters, gauges, histogram p50/p95, kmeans convergence traces,
-  and a controller-window digest.
+  XLA cost/roofline lines (obs/xprof.py captures), the decision-quality
+  audit digest, and a controller-window digest.
 * ``tail FILE [-n N]`` — the last N events, one compact line each.
 * ``export FILE --format prometheus [--out FILE]`` — Prometheus textfile
   exposition (node_exporter textfile-collector compatible): counters,
   gauges, and histogram summaries.
+* ``report FILE [-o HTML]`` — self-contained static HTML report
+  (obs/report.py): span tree, gauge sparklines, audit timeline, roofline
+  table.
+* ``watch FILE`` — live terminal view tailing a running producer's stream
+  (obs/sink.iter_events).
+* ``regress RUN.json`` — compare a fresh bench run against the recorded
+  trajectory bands (benchmarks/regress.py); nonzero exit on regression.
 
-The reader is resilient by construction: unknown ``kind``s are ignored
+The readers are resilient by construction: unknown ``kind``s are ignored
 (forward compatibility) and a torn final line from a killed writer is
 skipped (sink contract, obs/sink.py).
 """
@@ -22,105 +30,32 @@ import json
 import re
 import sys
 
+from .aggregate import (
+    collect,
+    dedup_windows,
+    final_counters,
+    fmt_bytes,
+    ordered_span_paths,
+    percentile,
+    roofline_rows,
+    span_forest,
+)
 from .sink import read_events
 
 __all__ = ["main", "summarize_events", "prometheus_lines"]
 
-
-def _percentile(values: list[float], q: float) -> float:
-    """Nearest-rank percentile on a sorted copy (no numpy dependency)."""
-    s = sorted(values)
-    if not s:
-        return float("nan")
-    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
-    return s[idx]
+# Backwards-compatible aliases (the aggregation moved to obs/aggregate.py).
+_percentile = percentile
+_span_forest = span_forest
+_dedup_windows = dedup_windows
+_final_counters = final_counters
 
 
 # -- summarize ---------------------------------------------------------------
 
 
-def _span_forest(events: list[dict]):
-    """Aggregate span events by their name-path.
-
-    Returns ``{path_tuple: {"count": int, "total": float}}`` where the path
-    is the chain of span names from the root — repeated spans (e.g. one per
-    window) aggregate into one node.  Span ids restart per process, so ids
-    are scoped by the event's ``run`` stamp: appended streams from several
-    runs aggregate instead of shadowing each other.
-    """
-    by_id = {(e.get("run"), e["id"]): e for e in events
-             if e.get("kind") == "span"}
-    agg: dict[tuple, dict] = {}
-    for e in by_id.values():
-        run = e.get("run")
-        path = [e["name"]]
-        parent = e.get("parent")
-        depth = 0
-        while parent is not None and depth < 100:
-            pe = by_id.get((run, parent))
-            if pe is None:
-                break
-            path.append(pe["name"])
-            parent = pe.get("parent")
-            depth += 1
-        key = tuple(reversed(path))
-        node = agg.setdefault(key, {"count": 0, "total": 0.0})
-        node["count"] += 1
-        node["total"] += float(e.get("dur", 0.0))
-    return agg
-
-
-def _dedup_windows(events: list[dict]) -> list[dict]:
-    """Controller window records, last-wins per window index.
-
-    The controller's sink contract (control/controller.py): after a crash
-    the append-only tail may repeat the windows between the last snapshot
-    and the kill — consumers take the last record per window index."""
-    by_index: dict = {}
-    for e in events:
-        if e.get("kind") == "window":
-            by_index[e.get("window")] = e
-    return [by_index[w] for w in sorted(by_index, key=lambda x: (x is None,
-                                                                 x))]
-
-
-def _final_counters(events: list[dict]) -> dict[str, float]:
-    """Final counter values, summed across runs sharing the stream.
-
-    Each counter event carries its run's *cumulative* value; within one run
-    the last event wins, and separate runs (which each restart at zero)
-    add.  Caveat: a kill/resume pair counts a crashed run's partial tail in
-    both runs' counters — the deduplicated window digest (not the counter
-    sums) is the authoritative per-window accounting."""
-    per_run: dict[tuple, float] = {}
-    for e in events:
-        if e.get("kind") == "counter":
-            per_run[(e.get("run"), e["name"])] = e["value"]
-    totals: dict[str, float] = {}
-    for (_, name), v in per_run.items():
-        totals[name] = totals.get(name, 0.0) + v
-    return totals
-
-
 def _render_span_tree(agg, out) -> None:
-    paths = sorted(agg, key=lambda p: (len(p), -agg[p]["total"]))
-    # Stable depth-first ordering: parents before children, siblings by
-    # total descending.
-    ordered: list[tuple] = []
-
-    def add_children(prefix):
-        kids = [p for p in paths if len(p) == len(prefix) + 1
-                and p[:len(prefix)] == prefix]
-        for p in sorted(kids, key=lambda p: -agg[p]["total"]):
-            ordered.append(p)
-            add_children(p)
-
-    add_children(())
-    # Orphans (parent span missing from the stream) still print, flat.
-    for p in paths:
-        if p not in ordered:
-            ordered.append(p)
-    for path in ordered:
+    for path in ordered_span_paths(agg):
         node = agg[path]
         indent = "  " * (len(path) - 1)
         calls = f" x{node['count']}" if node["count"] > 1 else ""
@@ -128,45 +63,91 @@ def _render_span_tree(agg, out) -> None:
               f"{node['total']:>9.3f}s{calls}", file=out)
 
 
-def summarize_events(events: list[dict], out=None) -> None:
-    out = out or sys.stdout
-    spans = [e for e in events if e.get("kind") == "span"]
-    if spans:
-        print("Span tree (wall-clock, aggregated):", file=out)
-        _render_span_tree(_span_forest(events), out)
+def _fmt_bytes(b) -> str:
+    return fmt_bytes(b, sep="")
 
-    counters = _final_counters(events)
+
+def _render_roofline(digest, out, peak_flops=None, peak_gbps=None) -> None:
+    rows = roofline_rows(digest, peak_flops, peak_gbps)
+    if not rows:
+        return
+    print("\nXLA kernel costs (roofline):", file=out)
+    for r in rows:
+        parts = [f"  {r['kernel']:<22}"]
+        if "flops" in r:
+            parts.append(f"flops={r['flops']:.4g}")
+        if "bytes_accessed" in r:
+            parts.append(f"bytes={_fmt_bytes(r['bytes_accessed'])}")
+        if "intensity" in r:
+            parts.append(f"I={r['intensity']:.2f}f/B")
+        if "temp_bytes" in r:
+            parts.append(f"temp={_fmt_bytes(r['temp_bytes'])}")
+        if "compile_seconds" in r:
+            parts.append(f"compile={r['compile_seconds']:.3g}s")
+        if "gflops" in r:
+            parts.append(f"achieved={r['gflops']:.3g}GF/s")
+        if "peak_fraction" in r:
+            parts.append(f"{100 * r['peak_fraction']:.1f}% of "
+                         f"{r['attainable_gflops']:.4g}GF/s "
+                         f"({r['bound']}-bound)")
+        print(" ".join(parts), file=out)
+
+
+def _render_audit(audits: list[dict], out) -> None:
+    if not audits:
+        return
+    flagged = [a for a in audits if a.get("flags")]
+    sils = [a["silhouette"] for a in audits if a.get("silhouette")
+            is not None]
+    line = f"\nAudit: {len(audits)} windows"
+    if sils:
+        line += (f", silhouette {sils[0]:.3f} -> {sils[-1]:.3f}"
+                 f" (min {min(sils):.3f})")
+    last = audits[-1]
+    if last.get("category_entropy") is not None:
+        line += f", entropy {last['category_entropy']:.3f}"
+    print(line, file=out)
+    if flagged:
+        print(f"  anomalies in {len(flagged)} windows:", file=out)
+        for a in flagged:
+            print(f"    window {a.get('window')}: "
+                  f"{', '.join(a['flags'])}", file=out)
+    else:
+        print("  no anomalies flagged", file=out)
+
+
+def summarize_events(events: list[dict], out=None, peak_flops=None,
+                     peak_gbps=None) -> None:
+    out = out or sys.stdout
+    digest = collect(events)
+    if digest["spans"]:
+        print("Span tree (wall-clock, aggregated):", file=out)
+        _render_span_tree(digest["spans"], out)
+
+    counters = digest["counters"]
     if counters:
         print("\nCounters:", file=out)
         for name in sorted(counters):
             v = counters[name]
             print(f"  {name:<40} {v:g}", file=out)
 
-    gauges: dict[str, float] = {}
-    for e in events:
-        if e.get("kind") == "gauge":
-            gauges[e["name"]] = e["value"]
+    gauges = digest["gauges"]
     if gauges:
         print("\nGauges (last value):", file=out)
         for name in sorted(gauges):
             print(f"  {name:<40} {gauges[name]:g}", file=out)
 
-    hists: dict[str, list[float]] = {}
-    for e in events:
-        if e.get("kind") == "hist":
-            hists.setdefault(e["name"], []).append(float(e["value"]))
+    hists = digest["hists"]
     if hists:
         print("\nHistograms:", file=out)
         for name in sorted(hists):
             vs = hists[name]
-            print(f"  {name:<34} n={len(vs):<5} p50={_percentile(vs, 0.5):g} "
-                  f"p95={_percentile(vs, 0.95):g} max={max(vs):g}", file=out)
+            print(f"  {name:<34} n={len(vs):<5} p50={percentile(vs, 0.5):g} "
+                  f"p95={percentile(vs, 0.95):g} max={max(vs):g}", file=out)
 
-    traces: dict[tuple, list[dict]] = {}
-    for e in events:
-        if e.get("kind") == "kmeans_iter":
-            traces.setdefault((str(e.get("run")), int(e.get("call", 0))),
-                              []).append(e)
+    _render_roofline(digest, out, peak_flops, peak_gbps)
+
+    traces = digest["traces"]
     if traces:
         print("\nKMeans convergence traces:", file=out)
         # Display index is stream-wide; grouping stays per (run, call) so
@@ -184,7 +165,9 @@ def summarize_events(events: list[dict], out=None) -> None:
                   f"{backend} k={k}]: {len(steps)} iterations"
                   f"{inertia}, final shift {last['shift']:.3g}", file=out)
 
-    windows = _dedup_windows(events)
+    _render_audit(digest["audits"], out)
+
+    windows = digest["windows"]
     if windows:
         n_events = sum(int(w.get("n_events", 0)) for w in windows)
         recl = [w for w in windows if w.get("recluster")]
@@ -196,14 +179,24 @@ def summarize_events(events: list[dict], out=None) -> None:
 # -- export ------------------------------------------------------------------
 
 
-def _prom_name(name: str) -> str:
-    return "cdrs_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+def _prom_name(name: str, prefix: str = "cdrs_") -> str:
+    """Sanitize an event name into a valid Prometheus metric name.
+
+    Valid names match ``[a-zA-Z_:][a-zA-Z0-9_:]*``: every other character
+    maps to ``_``, and a digit-leading result is escaped with ``_`` so the
+    name stays valid even with an empty prefix (exporters that strip or
+    configure away the ``cdrs_`` namespace)."""
+    s = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    full = prefix + s
+    if full and full[0].isdigit():
+        full = "_" + full
+    return full
 
 
 def prometheus_lines(events: list[dict]) -> list[str]:
     """Prometheus textfile exposition of the stream's final aggregates."""
     lines: list[str] = []
-    counters = _final_counters(events)
+    counters = final_counters(events)
     gauges: dict[str, float] = {}
     hists: dict[str, list[float]] = {}
     for e in events:
@@ -226,8 +219,8 @@ def prometheus_lines(events: list[dict]) -> list[str]:
         m = _prom_name(name)
         lines += [
             f"# TYPE {m} summary",
-            f'{m}{{quantile="0.5"}} {_percentile(vs, 0.5):g}',
-            f'{m}{{quantile="0.95"}} {_percentile(vs, 0.95):g}',
+            f'{m}{{quantile="0.5"}} {percentile(vs, 0.5):g}',
+            f'{m}{{quantile="0.95"}} {percentile(vs, 0.95):g}',
             f"{m}_sum {sum(vs):g}",
             f"{m}_count {len(vs)}",
         ]
@@ -253,7 +246,101 @@ def _tail_line(e: dict) -> str:
         return (f"window {e.get('window')} events={e.get('n_events')} "
                 f"recluster={e.get('recluster')} "
                 f"moves={e.get('moves_applied')}")
+    if kind == "audit":
+        sil = e.get("silhouette")
+        sil = "" if sil is None else f" silhouette={sil:.3f}"
+        flags = f" flags={','.join(e['flags'])}" if e.get("flags") else ""
+        return f"audit window={e.get('window')}{sil}{flags}"
+    if kind == "xla":
+        if e.get("event") == "exec":
+            return (f"xla exec {e.get('kernel')} "
+                    f"seconds={e.get('seconds', 0):.4g}")
+        return (f"xla compile {e.get('kernel')} "
+                f"flops={e.get('flops', 0):.4g} "
+                f"compile={e.get('compile_seconds', 0):.3g}s")
     return json.dumps(e)
+
+
+# -- watch -------------------------------------------------------------------
+
+
+def watch(path: str, *, interval: float = 1.0, max_seconds: float | None =
+          None, once: bool = False, out=None) -> int:
+    """Live terminal view of a growing stream.
+
+    Tails ``path`` through ``obs.sink.iter_events`` and redraws a compact
+    dashboard — windows processed, re-clusters, migrated bytes, last audit
+    verdict, top counters, event rate — every ``interval`` seconds while
+    the producer (e.g. ``cdrs control --metrics``) appends.  ``once``
+    renders the current state a single time (no follow); ``max_seconds``
+    bounds a follow session (tests, CI).  Ctrl-C exits cleanly.
+    """
+    import time as _time
+
+    from .sink import iter_events
+
+    out = out or sys.stdout
+    t0 = _time.monotonic()
+    events: list[dict] = []
+    rendered_at = -1
+    #: Retained-event cap: the dashboard is a live view, not an archive —
+    #: a multi-hour controller stream must not grow the re-aggregated
+    #: list (and each redraw's cost) without bound.  Past the cap the
+    #: oldest half is dropped; last-wins window/audit dedup means the
+    #: digest of the trailing stream stays correct for everything the
+    #: dashboard shows except all-time totals, which fall back to
+    #: trailing-window totals.
+    cap = 200_000
+    interactive = (not once) and getattr(out, "isatty", lambda: False)()
+
+    def render():
+        digest = collect(events)
+        lines = [f"cdrs metrics watch — {path}  "
+                 f"({len(events)} events, "
+                 f"{_time.monotonic() - t0:.0f}s)"]
+        windows = digest["windows"]
+        if windows:
+            recl = sum(1 for w in windows if w.get("recluster"))
+            moved = sum(int(w.get("bytes_migrated", 0)) for w in windows)
+            last = windows[-1]
+            lines.append(
+                f"windows: {len(windows)} (last #{last.get('window')}, "
+                f"{recl} reclusters, {_fmt_bytes(moved)} migrated)")
+        audits = digest["audits"]
+        if audits:
+            lines.append("audit:   " + _tail_line(audits[-1]))
+        for name in sorted(digest["gauges"])[:6]:
+            lines.append(f"gauge:   {name} = {digest['gauges'][name]:g}")
+        flagged = [a for a in audits if a.get("flags")]
+        if flagged:
+            lines.append(f"flags:   {len(flagged)} windows flagged "
+                         f"(last: {', '.join(flagged[-1]['flags'])})")
+        if interactive:
+            print("\x1b[2J\x1b[H" + "\n".join(lines), file=out, flush=True)
+        else:
+            print("\n".join(lines) + "\n", file=out, flush=True)
+
+    def stop() -> bool:
+        nonlocal rendered_at
+        if len(events) != rendered_at:  # redraw only on new data
+            render()
+            rendered_at = len(events)
+        return max_seconds is not None \
+            and _time.monotonic() - t0 >= max_seconds
+
+    try:
+        for e in iter_events(path, follow=not once, poll=interval,
+                             stop=stop):
+            events.append(e)
+            if len(events) > cap:
+                del events[:cap // 2]
+    except KeyboardInterrupt:
+        pass
+    except FileNotFoundError:
+        print(f"error: no such stream {path}", file=sys.stderr)
+        return 1
+    render()
+    return 0
 
 
 # -- entry -------------------------------------------------------------------
@@ -265,8 +352,13 @@ def main(argv: list[str] | None = None) -> int:
     sub = parser.add_subparsers(dest="action", required=True)
 
     p = sub.add_parser("summarize", help="span tree, counters, p50/p95, "
-                                         "convergence traces")
+                                         "roofline, audit digest, traces")
     p.add_argument("file")
+    p.add_argument("--peak_flops", type=float, default=None,
+                   help="chip peak FLOP/s for the roofline lines "
+                        "(default: known TPU table via run metadata)")
+    p.add_argument("--peak_gbps", type=float, default=None,
+                   help="chip peak HBM GB/s for the roofline lines")
 
     p = sub.add_parser("tail", help="print the last N events")
     p.add_argument("file")
@@ -279,7 +371,41 @@ def main(argv: list[str] | None = None) -> int:
                    help="write here (default stdout); point your "
                         "node_exporter textfile collector at it")
 
+    p = sub.add_parser("report", help="self-contained static HTML report "
+                                      "(span tree, sparklines, audit "
+                                      "timeline, roofline table)")
+    p.add_argument("file")
+    p.add_argument("-o", "--out", default=None,
+                   help="output path (default: <file>.html)")
+    p.add_argument("--title", default=None)
+
+    p = sub.add_parser("watch", help="live terminal view tailing a running "
+                                     "producer's stream")
+    p.add_argument("file")
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--max_seconds", type=float, default=None,
+                   help="stop after this long (default: until Ctrl-C)")
+    p.add_argument("--once", action="store_true",
+                   help="render the current state once and exit")
+
+    sub.add_parser("regress", add_help=False,
+                   help="compare a bench run against the recorded "
+                        "trajectory bands; nonzero exit on regression")
+
+    # Delegate regress wholesale (its options would otherwise be eaten by
+    # this parser — argparse.REMAINDER does not capture leading options).
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "regress":
+        from ..benchmarks.regress import main as regress_main
+
+        return regress_main(list(argv[1:]))
+
     args = parser.parse_args(argv)
+    if args.action == "watch":
+        return watch(args.file, interval=args.interval,
+                     max_seconds=args.max_seconds, once=args.once)
+
     try:
         events = read_events(args.file)
     except OSError as e:
@@ -291,17 +417,28 @@ def main(argv: list[str] | None = None) -> int:
             if not events:
                 print(f"{args.file}: no events", file=sys.stderr)
                 return 1
-            summarize_events(events)
+            summarize_events(events, peak_flops=args.peak_flops,
+                             peak_gbps=args.peak_gbps)
             return 0
         if args.action == "tail":
             if args.n > 0:  # [-0:] would be the whole stream
                 for e in events[-args.n:]:
                     print(_tail_line(e))
             return 0
+        if args.action == "report":
+            from .report import render_html
+
+            out_path = args.out or (args.file + ".html")
+            html = render_html(events, title=args.title
+                               or f"cdrs report — {args.file}")
+            with open(out_path, "w", encoding="utf-8") as f:
+                f.write(html)
+            print(f"wrote {out_path}", file=sys.stderr)
+            return 0
         # export
         text = "\n".join(prometheus_lines(events)) + "\n"
         if args.out:
-            with open(args.out, "w") as f:
+            with open(args.out, "w", encoding="utf-8") as f:
                 f.write(text)
         else:
             sys.stdout.write(text)

@@ -1,0 +1,369 @@
+"""Static HTML report of a telemetry stream — ``cdrs metrics report``.
+
+One self-contained file (inline CSS, inline SVG, zero external requests) a
+reviewer can open from a bench artifact directory or attach to a PR: the
+span wall-clock tree with duration bars, counters/gauges with sparklines of
+every observation, histogram p50/p95, the XLA cost/roofline table
+(obs/xprof.py captures), the per-window decision-quality audit timeline
+with anomaly flags (obs/audit.py), and the controller window digest.  All
+aggregation comes from obs/aggregate.py — the HTML agrees with ``cdrs
+metrics summarize`` by construction.
+
+Rendering is **deterministic for a given event stream** (dict iteration is
+sorted, floats are rounded, no generation timestamp is stamped), which is
+what lets tests/test_observatory.py golden-file the output.
+
+Visual conventions follow the repo-neutral dataviz defaults: single-hue
+marks for data (blue series ramp), text in ink tokens rather than series
+color, status colors reserved for audit flags and always paired with a
+text label, light and dark mode both selected from the same palette.
+"""
+
+from __future__ import annotations
+
+import html as _html
+
+from .aggregate import (
+    collect,
+    fmt_bytes,
+    ordered_span_paths,
+    percentile,
+    roofline_rows,
+)
+
+__all__ = ["render_html"]
+
+_CSS = """
+:root {
+  color-scheme: light;
+  --surface-1: #fcfcfb; --surface-2: #f1f0ee;
+  --text-primary: #0b0b0b; --text-secondary: #52514e;
+  --grid: #e3e2df;
+  --series-1: #2a78d6; --series-1-soft: #cde2fb;
+  --status-good: #0ca30c; --status-serious: #ec835a;
+  --status-critical: #d03b3b;
+}
+@media (prefers-color-scheme: dark) {
+  :root:where(:not([data-theme="light"])) {
+    color-scheme: dark;
+    --surface-1: #1a1a19; --surface-2: #242422;
+    --text-primary: #ffffff; --text-secondary: #c3c2b7;
+    --grid: #3a3936;
+    --series-1: #3987e5; --series-1-soft: #1c5cab;
+  }
+}
+body { background: var(--surface-1); color: var(--text-primary);
+  font: 14px/1.45 system-ui, sans-serif; margin: 2rem auto;
+  max-width: 72rem; padding: 0 1rem; }
+h1 { font-size: 1.3rem; } h2 { font-size: 1.05rem; margin-top: 2rem; }
+table { border-collapse: collapse; width: 100%; }
+th, td { text-align: left; padding: .3rem .6rem;
+  border-bottom: 1px solid var(--grid); font-variant-numeric: tabular-nums; }
+th { color: var(--text-secondary); font-weight: 600; }
+td.num, th.num { text-align: right; }
+.tiles { display: flex; flex-wrap: wrap; gap: .8rem; margin: 1rem 0; }
+.tile { background: var(--surface-2); border-radius: 8px;
+  padding: .6rem 1rem; min-width: 8rem; }
+.tile .v { font-size: 1.4rem; font-weight: 650; }
+.tile .l { color: var(--text-secondary); font-size: .8rem; }
+.bar { display: inline-block; height: 8px; border-radius: 0 4px 4px 0;
+  background: var(--series-1); vertical-align: middle; }
+.spark polyline { fill: none; stroke: var(--series-1); stroke-width: 2; }
+.spark { vertical-align: middle; }
+.indent { color: var(--text-secondary); }
+.flag { font-weight: 600; }
+.flag.serious { color: var(--status-serious); }
+.flag.critical { color: var(--status-critical); }
+.ok { color: var(--status-good); }
+.muted { color: var(--text-secondary); }
+code { background: var(--surface-2); padding: 0 .25rem; border-radius: 4px; }
+"""
+
+
+def _esc(x) -> str:
+    return _html.escape(str(x))
+
+
+def _fmt(v, digits: int = 4) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.{digits}g}"
+    return str(v)
+
+
+def _fmt_bytes(b) -> str:
+    # \u202f: narrow no-break space keeps value+unit on one line in cells.
+    return fmt_bytes(b, sep="\u202f")
+
+
+def _sparkline(values: list[float], width: int = 120, height: int = 26
+               ) -> str:
+    """Inline single-series SVG sparkline (2px line, no axes; the row's
+    text cells carry the numbers).  Hover shows the min/max range."""
+    vs = [float(v) for v in values]
+    if len(vs) < 2:
+        return '<span class="muted">—</span>'
+    lo, hi = min(vs), max(vs)
+    span = (hi - lo) or 1.0
+    pad = 2
+    pts = []
+    for i, v in enumerate(vs):
+        x = pad + i * (width - 2 * pad) / (len(vs) - 1)
+        y = height - pad - (v - lo) / span * (height - 2 * pad)
+        pts.append(f"{x:.1f},{y:.1f}")
+    return (f'<svg class="spark" width="{width}" height="{height}" '
+            f'role="img" aria-label="{len(vs)} samples, '
+            f'{_fmt(lo)} to {_fmt(hi)}">'
+            f'<title>{len(vs)} samples, min {_fmt(lo)}, max {_fmt(hi)}'
+            f'</title><polyline points="{" ".join(pts)}"/></svg>')
+
+
+def _tiles(digest: dict, n_events: int) -> str:
+    windows = digest["windows"]
+    audits = digest["audits"]
+    tiles = [("events in stream", f"{n_events}")]
+    if windows:
+        tiles.append(("controller windows", f"{len(windows)}"))
+        tiles.append(("reclusters",
+                      f"{sum(1 for w in windows if w.get('recluster'))}"))
+        tiles.append(("bytes migrated", _fmt_bytes(
+            sum(int(w.get("bytes_migrated", 0)) for w in windows))))
+    if audits:
+        flagged = sum(1 for a in audits if a.get("flags"))
+        tiles.append(("flagged windows", f"{flagged}"))
+        sils = [a["silhouette"] for a in audits
+                if a.get("silhouette") is not None]
+        if sils:
+            tiles.append(("final silhouette", _fmt(sils[-1], 3)))
+    if digest["xla"]:
+        tiles.append(("XLA programs captured", f"{len(digest['xla'])}"))
+    cells = "".join(
+        f'<div class="tile"><div class="v">{_esc(v)}</div>'
+        f'<div class="l">{_esc(label)}</div></div>'
+        for label, v in tiles)
+    return f'<div class="tiles">{cells}</div>'
+
+
+def _span_section(digest: dict) -> str:
+    agg = digest["spans"]
+    if not agg:
+        return ""
+    total = max((n["total"] for n in agg.values()), default=0.0) or 1.0
+    rows = []
+    for path in ordered_span_paths(agg):
+        node = agg[path]
+        indent = "&nbsp;" * 4 * (len(path) - 1)
+        bar_w = max(2, int(round(160 * node["total"] / total)))
+        calls = f' <span class="muted">&times;{node["count"]}</span>' \
+            if node["count"] > 1 else ""
+        rows.append(
+            f'<tr><td>{indent}<span class="indent"></span>'
+            f'{_esc(path[-1])}{calls}</td>'
+            f'<td class="num">{node["total"]:.3f} s</td>'
+            f'<td><span class="bar" style="width:{bar_w}px"></span></td>'
+            f"</tr>")
+    return ("<h2>Span tree (wall-clock, aggregated)</h2><table>"
+            "<tr><th>span</th><th class=num>total</th><th></th></tr>"
+            + "".join(rows) + "</table>")
+
+
+def _counter_section(digest: dict) -> str:
+    counters = digest["counters"]
+    if not counters:
+        return ""
+    rows = "".join(
+        f"<tr><td><code>{_esc(n)}</code></td>"
+        f'<td class="num">{counters[n]:g}</td></tr>'
+        for n in sorted(counters))
+    return ("<h2>Counters</h2><table><tr><th>counter</th>"
+            "<th class=num>value</th></tr>" + rows + "</table>")
+
+
+def _gauge_section(digest: dict) -> str:
+    gauges = digest["gauges"]
+    if not gauges:
+        return ""
+    rows = []
+    for name in sorted(gauges):
+        series = digest["gauge_series"].get(name, [])
+        rows.append(
+            f"<tr><td><code>{_esc(name)}</code></td>"
+            f'<td class="num">{gauges[name]:g}</td>'
+            f"<td>{_sparkline(series)}</td></tr>")
+    return ("<h2>Gauges</h2><table><tr><th>gauge</th><th class=num>last"
+            "</th><th>trend</th></tr>" + "".join(rows) + "</table>")
+
+
+def _hist_section(digest: dict) -> str:
+    hists = digest["hists"]
+    if not hists:
+        return ""
+    rows = []
+    for name in sorted(hists):
+        vs = hists[name]
+        rows.append(
+            f"<tr><td><code>{_esc(name)}</code></td>"
+            f'<td class="num">{len(vs)}</td>'
+            f'<td class="num">{percentile(vs, 0.5):g}</td>'
+            f'<td class="num">{percentile(vs, 0.95):g}</td>'
+            f'<td class="num">{max(vs):g}</td>'
+            f"<td>{_sparkline(vs)}</td></tr>")
+    return ("<h2>Histograms</h2><table><tr><th>histogram</th>"
+            "<th class=num>n</th><th class=num>p50</th><th class=num>p95"
+            "</th><th class=num>max</th><th>observations</th></tr>"
+            + "".join(rows) + "</table>")
+
+
+def _xla_section(digest: dict) -> str:
+    rows_data = roofline_rows(digest)
+    if not rows_data:
+        return ""
+    have_peaks = any("peak_fraction" in r for r in rows_data)
+    head = ("<tr><th>kernel</th><th class=num>flops</th>"
+            "<th class=num>bytes</th><th class=num>intensity (f/B)</th>"
+            "<th class=num>temp</th><th class=num>compile</th>"
+            "<th class=num>exec</th><th class=num>achieved GF/s</th>"
+            + ("<th class=num>% of attainable</th><th>bound</th>"
+               if have_peaks else "") + "</tr>")
+    rows = []
+    for r in rows_data:
+        cells = [
+            f"<td><code>{_esc(r['kernel'])}</code></td>",
+            f'<td class="num">{_fmt(r.get("flops"))}</td>',
+            f'<td class="num">{_fmt_bytes(r.get("bytes_accessed"))}</td>',
+            f'<td class="num">{_fmt(r.get("intensity"), 3)}</td>',
+            f'<td class="num">{_fmt_bytes(r.get("temp_bytes"))}</td>',
+            f'<td class="num">{_fmt(r.get("compile_seconds"), 3)}'
+            f' s</td>',
+            f'<td class="num">{_fmt(r.get("exec_seconds"), 3)} s</td>',
+            f'<td class="num">{_fmt(r.get("gflops"), 3)}</td>',
+        ]
+        if have_peaks:
+            pf = r.get("peak_fraction")
+            cells.append(f'<td class="num">'
+                         f'{_fmt(100 * pf, 3) if pf is not None else "—"}'
+                         f"</td>")
+            cells.append(f"<td>{_esc(r.get('bound', '—'))}</td>")
+        rows.append("<tr>" + "".join(cells) + "</tr>")
+    note = ("" if have_peaks else
+            '<p class="muted">No known chip peaks in the stream metadata — '
+            "attainable-fraction columns omitted (pass --peak_flops/"
+            "--peak_gbps to <code>cdrs metrics summarize</code> for the "
+            "text view).</p>")
+    return ("<h2>XLA kernel costs (roofline)</h2><table>" + head
+            + "".join(rows) + "</table>" + note)
+
+
+def _audit_flag_html(flags: list[str]) -> str:
+    if not flags:
+        return '<span class="ok">✓ clean</span>'
+    spans = [f'<span class="flag {"critical" if f == "drift_no_gain" else "serious"}">'  # noqa: E501
+             f"⚠ {_esc(f)}</span>" for f in flags]
+    return " ".join(spans)
+
+
+def _audit_section(digest: dict) -> str:
+    audits = digest["audits"]
+    if not audits:
+        return ""
+    sils = [a.get("silhouette") for a in audits]
+    sil_series = [s for s in sils if s is not None]
+    spark = (f"<p>silhouette trend {_sparkline(sil_series)}</p>"
+             if len(sil_series) >= 2 else "")
+    rows = []
+    for a in audits:
+        rows.append(
+            f"<tr><td>{_esc(a.get('window'))}</td>"
+            f'<td class="num">{_fmt(a.get("silhouette"), 3)}</td>'
+            f'<td class="num">{_fmt(a.get("davies_bouldin"), 3)}</td>'
+            f'<td class="num">{_fmt(a.get("category_entropy"), 3)}</td>'
+            f'<td class="num">{_fmt(a.get("population_tv"), 3)}</td>'
+            f'<td class="num">{_fmt(a.get("locality"), 3)}</td>'
+            f'<td class="num">'
+            f'{_fmt_bytes(a.get("replication_bytes"))}</td>'
+            f"<td>{_audit_flag_html(a.get('flags', []))}</td></tr>")
+    return ("<h2>Decision-quality audit timeline</h2>" + spark
+            + "<table><tr><th>window</th><th class=num>silhouette</th>"
+            "<th class=num>Davies-Bouldin</th><th class=num>entropy</th>"
+            "<th class=num>pop. TV</th><th class=num>locality</th>"
+            "<th class=num>repl. bytes</th><th>flags</th></tr>"
+            + "".join(rows) + "</table>")
+
+
+def _window_section(digest: dict) -> str:
+    windows = digest["windows"]
+    if not windows:
+        return ""
+    rows = []
+    for w in windows:
+        rows.append(
+            f"<tr><td>{_esc(w.get('window'))}</td>"
+            f'<td class="num">{_fmt(w.get("n_events"))}</td>'
+            f'<td class="num">{_fmt(w.get("drift"), 3)}</td>'
+            f"<td>{_esc(w.get('recluster_mode') or '—')}</td>"
+            f'<td class="num">{_fmt(w.get("moves_applied"))}</td>'
+            f'<td class="num">{_fmt_bytes(w.get("bytes_migrated"))}</td>'
+            f'<td class="num">{_fmt(w.get("locality_after"), 3)}</td>'
+            f"</tr>")
+    return ("<h2>Controller windows</h2><table><tr><th>window</th>"
+            "<th class=num>events</th><th class=num>drift</th>"
+            "<th>recluster</th><th class=num>moves</th>"
+            "<th class=num>migrated</th><th class=num>locality</th></tr>"
+            + "".join(rows) + "</table>")
+
+
+def _trace_section(digest: dict) -> str:
+    traces = digest["traces"]
+    if not traces:
+        return ""
+    rows = []
+    for i, key in enumerate(sorted(traces), start=1):
+        steps = sorted(traces[key], key=lambda e: e["step"])
+        first, last = steps[0], steps[-1]
+        inertias = [e["inertia"] for e in steps
+                    if e.get("inertia") is not None]
+        rows.append(
+            f"<tr><td>{i}</td><td><code>{_esc(first.get('kernel', '?'))}"
+            f"</code></td><td>{_esc(first.get('backend', '?'))}</td>"
+            f'<td class="num">{_esc(first.get("k", "?"))}</td>'
+            f'<td class="num">{len(steps)}</td>'
+            f'<td class="num">{_fmt(last.get("shift"), 3)}</td>'
+            f"<td>{_sparkline(inertias)}</td></tr>")
+    return ("<h2>KMeans convergence traces</h2><table><tr><th>call</th>"
+            "<th>kernel</th><th>backend</th><th class=num>k</th>"
+            "<th class=num>iterations</th><th class=num>final shift</th>"
+            "<th>inertia</th></tr>" + "".join(rows) + "</table>")
+
+
+def _meta_section(digest: dict) -> str:
+    meta = digest["meta"]
+    if not meta:
+        return ""
+    items = " · ".join(f"{_esc(k)}=<code>{_esc(v)}</code>"
+                       for k, v in sorted(meta.items()))
+    return f'<p class="muted">{items}</p>'
+
+
+def render_html(events: list[dict], title: str = "cdrs telemetry report"
+                ) -> str:
+    """The whole report as one self-contained HTML string."""
+    digest = collect(events)
+    body = (
+        f"<h1>{_esc(title)}</h1>"
+        + _meta_section(digest)
+        + _tiles(digest, len(events))
+        + _span_section(digest)
+        + _xla_section(digest)
+        + _audit_section(digest)
+        + _window_section(digest)
+        + _trace_section(digest)
+        + _gauge_section(digest)
+        + _hist_section(digest)
+        + _counter_section(digest)
+    )
+    return ("<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>{_esc(title)}</title>"
+            "<meta name='viewport' content='width=device-width, "
+            "initial-scale=1'>"
+            f"<style>{_CSS}</style></head><body>{body}</body></html>")

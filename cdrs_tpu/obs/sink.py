@@ -15,7 +15,7 @@ import json
 import os
 import threading
 
-__all__ = ["JsonlSink", "read_events"]
+__all__ = ["JsonlSink", "read_events", "iter_events"]
 
 
 class JsonlSink:
@@ -27,13 +27,17 @@ class JsonlSink:
         if parent:
             os.makedirs(parent, exist_ok=True)
         self._lock = threading.Lock()
-        self._f = open(path, "a")
+        # Explicit encoding: telemetry must round-trip identically across
+        # platform default encodings (read_events/iter_events match).
+        self._f = open(path, "a", encoding="utf-8")
 
     def emit(self, event: dict) -> None:
         # One write() + flush per event: the line lands atomically from the
         # point of view of a tailing reader, and a kill between events loses
         # nothing already emitted.
-        line = json.dumps(event, default=_coerce) + "\n"
+        # ensure_ascii=False writes real UTF-8 (the file's pinned encoding)
+        # instead of \uXXXX escapes — half the bytes on non-ASCII names.
+        line = json.dumps(event, default=_coerce, ensure_ascii=False) + "\n"
         with self._lock:
             if self._f is None:
                 return  # emitted after close (e.g. a late worker thread)
@@ -67,7 +71,11 @@ def _coerce(obj):
 def read_events(path: str) -> list[dict]:
     """Parse a telemetry JSONL stream; a torn final line is skipped."""
     events: list[dict] = []
-    with open(path) as f:
+    # errors="replace": a writer killed mid-write can tear a multi-byte
+    # UTF-8 character; the mangled line then fails JSON parsing and is
+    # skipped like any other torn tail, instead of UnicodeDecodeError
+    # poisoning the whole stream.
+    with open(path, encoding="utf-8", errors="replace") as f:
         for line in f:
             line = line.strip()
             if not line:
@@ -79,3 +87,63 @@ def read_events(path: str) -> list[dict]:
                 # only the final line can be affected.
                 continue
     return events
+
+
+def iter_events(path: str, *, follow: bool = False, poll: float = 0.5,
+                stop=None):
+    """Incrementally yield events from a (possibly still-growing) stream.
+
+    The live-tailing counterpart of :func:`read_events` (``cdrs metrics
+    watch``): reads whatever the file currently holds, yields each complete
+    line's event, and — with ``follow=True`` — sleeps ``poll`` seconds and
+    continues from the same offset when the writer appends more.  A partial
+    final line (the writer is mid-``write``, or the process died there) is
+    buffered until its newline arrives, so a tailing consumer never parses a
+    torn record; the file is read in BINARY and only complete lines are
+    decoded, so a poll landing inside a multi-byte UTF-8 character buffers
+    the raw bytes instead of mangling them (text-mode ``read()`` would
+    flush U+FFFD at EOF).  Without ``follow`` a torn tail is skipped
+    exactly like ``read_events``.  ``stop`` is an optional zero-argument
+    callable checked once per poll round — return True to end a follow
+    loop cleanly (tests, bounded watch sessions).  A missing file under
+    ``follow`` is waited for, not raised: the watcher may start before the
+    controller.
+    """
+    import time as _time
+
+    buf = b""
+    pos = 0
+    while True:
+        try:
+            with open(path, "rb") as f:
+                if os.fstat(f.fileno()).st_size < pos:
+                    # Truncated or recreated (rm + fresh producer): the
+                    # old offset points past EOF and would read b""
+                    # forever — restart from the top of the new stream.
+                    pos = 0
+                    buf = b""
+                f.seek(pos)
+                chunk = f.read()
+                pos = f.tell()
+        except FileNotFoundError:
+            if not follow:
+                raise
+            chunk = b""
+        buf += chunk
+        while True:
+            nl = buf.find(b"\n")
+            if nl < 0:
+                break
+            raw, buf = buf[:nl], buf[nl + 1:]
+            line = raw.decode("utf-8", errors="replace").strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue  # corrupt mid-stream line: skip, keep tailing
+        if not follow:
+            return
+        if stop is not None and stop():
+            return
+        _time.sleep(poll)

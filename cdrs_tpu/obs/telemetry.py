@@ -66,10 +66,13 @@ def run_metadata() -> dict:
     jax = sys.modules.get("jax")
     if jax is not None:
         try:
+            devices = jax.devices()
             meta.update({
                 "jax": jax.__version__,
                 "jax_backend": jax.default_backend(),
-                "jax_device_count": len(jax.devices()),
+                "jax_device_count": len(devices),
+                "jax_device_kind": devices[0].device_kind if devices
+                else None,
                 "jax_enable_x64": bool(jax.config.jax_enable_x64),
             })
         except Exception:  # pragma: no cover - partially initialized jax
@@ -127,6 +130,7 @@ class Telemetry:
 
     def __init__(self, sink: JsonlSink | None = None, *,
                  kmeans_trace: bool = True, device_memory: bool = False,
+                 xprof: bool = True, audit: bool = True,
                  meta: bool = True):
         self.sink = sink
         #: Unique per-instrument id stamped on every event: span ids and
@@ -139,6 +143,12 @@ class Telemetry:
         self.kmeans_trace = kmeans_trace
         #: Sample jax.local_devices() memory_stats at every span exit.
         self.device_memory = device_memory
+        #: Capture XLA cost/memory analysis + compile wall-clock per kernel
+        #: signature (obs/xprof.py) at the wrapped kernel entry points.
+        self.xprof = xprof
+        #: Emit per-window decision-quality audit events from the online
+        #: controller (obs/audit.py wired in control/controller.py).
+        self.audit = audit
         self._meta = meta
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
@@ -159,6 +169,14 @@ class Telemetry:
     def __exit__(self, *exc) -> None:
         if self in _ACTIVE:
             _ACTIVE.remove(self)
+        if self._meta:
+            # Second stamp at exit: activation happens before the command
+            # imports jax, so the entry stamp lacks the jax fields
+            # (backend, device kind — what the roofline peak lookup needs).
+            # Readers take the LAST meta event; a killed run keeps the
+            # entry stamp.
+            self._emit({"kind": "meta", "t": time.time(),
+                        "run": run_metadata()})
         if self.sink is not None:
             self.sink.close()
 

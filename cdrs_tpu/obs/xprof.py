@@ -1,0 +1,230 @@
+"""XLA cost capture — per-kernel flops/bytes/memory + compile wall-clock.
+
+The telemetry layer (PR 2) answers *where the wall-clock went*; this module
+answers *what the hardware was asked to do*.  When an instrument with
+``xprof`` enabled is active, the wrapped kernel entry points
+(``ops/kmeans_jax.kmeans_jax_full``, ``ops/scoring_jax.classify_jax``,
+``features/jax_backend.compute_features_jax``) route their program build
+through :func:`instrumented_call`, which — once per (kernel, abstract
+signature) —
+
+* lowers and compiles the program explicitly (``jit.lower(...).compile()``)
+  with the lowering and compile phases individually wall-clocked,
+* reads XLA's own ``cost_analysis()`` (flops, bytes accessed, transcendental
+  count — the numbers the roofline model needs) and
+  ``memory_analysis()`` (argument/output/temp/code bytes — the numbers an
+  HBM budget needs),
+* emits one ``{"kind": "xla", "event": "compile", ...}`` telemetry event
+  plus an ``xla.compiles.<kernel>`` counter and an ``xla.compile.seconds``
+  histogram,
+* times the first execution of the compiled program (one deliberate
+  ``block_until_ready`` — diagnostic mode pays one sync per signature) and
+  emits ``{"kind": "xla", "event": "exec", ...}`` with the achieved
+  seconds, from which ``cdrs metrics summarize|report`` derive achieved
+  FLOP/s and bytes/s for the roofline table.
+
+Steady-state calls reuse the AOT-compiled executable, so telemetry-on runs
+compile each program exactly once (same as telemetry-off); the only repeated
+cost is Python dispatch instead of jit's C++ fast path — noise next to any
+kernel this module is worth pointing at.  Every capture step is
+fail-soft: an XLA backend without the analysis APIs falls back to the plain
+jit call and never raises.
+
+Roofline peaks for known TPU generations live in :data:`DEVICE_PEAKS`
+(per-chip dense peak FLOP/s at the native matmul precision and HBM
+bandwidth, from published specs); ``cdrs metrics summarize --peak_flops /
+--peak_gbps`` overrides them for unlisted hardware.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .telemetry import current
+
+__all__ = [
+    "instrumented_call",
+    "clear_cache",
+    "DEVICE_PEAKS",
+    "resolve_peaks",
+]
+
+#: Per-chip (peak dense FLOP/s, peak HBM bytes/s) for device kinds jax
+#: reports; the roofline lines in ``cdrs metrics`` use these when the
+#: stream's run metadata names a known chip.  bf16/f32 MXU peak — the
+#: precision the kernels here issue.
+DEVICE_PEAKS: dict[str, tuple[float, float]] = {
+    "TPU v4": (275e12, 1228e9),
+    "TPU v5 lite": (197e12, 819e9),
+    "TPU v5e": (197e12, 819e9),
+    "TPU v5p": (459e12, 2765e9),
+    "TPU v6 lite": (918e12, 1640e9),
+    "TPU v6e": (918e12, 1640e9),
+}
+
+
+def resolve_peaks(device_kind: str | None) -> tuple[float, float] | None:
+    """(peak_flops, peak_bytes_per_sec) for a jax ``device_kind``, or None
+    when the chip is not in the table (CPU hosts, new hardware)."""
+    if not device_kind:
+        return None
+    return DEVICE_PEAKS.get(device_kind)
+
+
+#: (kernel, signature) -> AOT-compiled executable, or _FALLBACK when this
+#: signature's capture failed once (never retried: a backend without the
+#: AOT/analysis APIs would fail identically every call).
+_COMPILED: dict[tuple, object] = {}
+_FALLBACK = object()
+_LOCK = threading.Lock()
+#: Per-key capture guard: concurrent first calls must not each pay (and
+#: double-report) the multi-second lower+compile.
+_INFLIGHT: dict[tuple, threading.Lock] = {}
+
+
+def clear_cache() -> None:
+    """Drop captured executables (tests; mirrors jax.clear_caches)."""
+    with _LOCK:
+        _COMPILED.clear()
+        _INFLIGHT.clear()
+
+
+def _first_costs(cost) -> dict:
+    """Normalize ``cost_analysis()`` output: jax returns a dict from
+    ``Lowered`` and a single-element list of dicts from ``Compiled``."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return cost or {}
+
+
+def _cost_event(kernel: str, compiled, lower_s: float, compile_s: float,
+                sig_id: int) -> dict:
+    event: dict = {
+        "kind": "xla",
+        "event": "compile",
+        "kernel": kernel,
+        "sig": sig_id,
+        "t": time.time(),
+        "lower_seconds": lower_s,
+        "compile_seconds": compile_s,
+    }
+    try:
+        cost = _first_costs(compiled.cost_analysis())
+        for key, out in (("flops", "flops"),
+                         ("bytes accessed", "bytes_accessed"),
+                         ("transcendentals", "transcendentals")):
+            if key in cost:
+                event[out] = float(cost[key])
+    except Exception:  # pragma: no cover - backend without the API
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        for attr, out in (
+            ("argument_size_in_bytes", "argument_bytes"),
+            ("output_size_in_bytes", "output_bytes"),
+            ("temp_size_in_bytes", "temp_bytes"),
+            ("generated_code_size_in_bytes", "generated_code_bytes"),
+        ):
+            v = getattr(mem, attr, None)
+            if v is not None:
+                event[out] = int(v)
+    except Exception:  # pragma: no cover - backend without the API
+        pass
+    return event
+
+
+def _sig_id(kernel: str, signature) -> int:
+    """Small stable-by-content id for a signature; events carry this instead
+    of the (long, tuple-of-tuples) signature itself.  Content-hashed (not
+    ``hash()``, which is salted per process for strings): two processes
+    appending to one stream must stamp the identical program with the same
+    id, or readers would show duplicate roofline rows per kernel."""
+    import hashlib
+
+    digest = hashlib.blake2b(repr((kernel, signature)).encode(),
+                             digest_size=4).digest()
+    return int.from_bytes(digest, "big")
+
+
+def instrumented_call(kernel: str, jitted, args: tuple, *, signature,
+                      n_static_trailing: int = 0):
+    """Invoke ``jitted(*args)``, capturing XLA cost analysis on the way.
+
+    With no active instrument (or ``xprof`` off) this IS ``jitted(*args)``.
+    Otherwise the program for ``signature`` (the caller's hashable abstract
+    signature — shapes/dtypes + static config, obs/jaxtools.aval_signature)
+    is lowered and compiled explicitly once, its cost/memory analyses are
+    emitted as ``xla`` events, its first execution is timed (one
+    ``block_until_ready``), and the AOT executable is cached for steady-state
+    calls.  ``n_static_trailing`` names how many trailing entries of ``args``
+    are jit-static (the AOT executable is invoked without them).
+    """
+    tel = current()
+    if tel is None or not getattr(tel, "xprof", False):
+        return jitted(*args)
+    key = (kernel, signature)
+    call_args = args[:len(args) - n_static_trailing] \
+        if n_static_trailing else args
+    with _LOCK:
+        compiled = _COMPILED.get(key)
+        guard = _INFLIGHT.setdefault(key, threading.Lock())
+    if compiled is None:
+        # One capture per key: a concurrent first call waits on the
+        # winner instead of paying (and double-reporting) the compile.
+        with guard:
+            with _LOCK:
+                compiled = _COMPILED.get(key)
+            if compiled is None:
+                return _capture_and_run(key, kernel, signature, jitted,
+                                        args, call_args, tel)
+    if compiled is _FALLBACK:
+        return jitted(*args)
+    try:
+        return compiled(*call_args)
+    except Exception:
+        # The aval signature does not capture everything jit's own
+        # dispatch does (device placement, sharding context): inputs
+        # the AOT executable rejects would have simply recompiled
+        # under jit.  Diagnostics must never fail a call jit accepts.
+        with _LOCK:
+            _COMPILED[key] = _FALLBACK
+        return jitted(*args)
+
+
+def _capture_and_run(key, kernel, signature, jitted, args, call_args, tel):
+    """Winner path of the per-key capture: lower+compile (wall-clocked),
+    emit the cost events, cache the executable, time the first run."""
+    sig_id = _sig_id(kernel, signature)
+    try:
+        t0 = time.perf_counter()
+        lowered = jitted.lower(*args)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+    except Exception:
+        with _LOCK:
+            _COMPILED[key] = _FALLBACK
+        return jitted(*args)
+    with _LOCK:
+        _COMPILED[key] = compiled
+    tel._emit(_cost_event(kernel, compiled, t1 - t0, t2 - t1, sig_id))
+    tel.counter_inc(f"xla.compiles.{kernel}")
+    tel.histogram("xla.compile.seconds", t2 - t1)
+
+    # First execution, deliberately synchronized: the achieved-seconds
+    # sample the roofline summary pairs with the program's flops/bytes.
+    import jax
+
+    t0 = time.perf_counter()
+    try:
+        out = compiled(*call_args)
+        out = jax.block_until_ready(out)
+    except Exception:
+        with _LOCK:
+            _COMPILED[key] = _FALLBACK  # same rationale as the hit path
+        return jitted(*args)
+    exec_s = time.perf_counter() - t0
+    tel._emit({"kind": "xla", "event": "exec", "kernel": kernel,
+               "sig": sig_id, "t": time.time(), "seconds": exec_s})
+    return out

@@ -1011,6 +1011,7 @@ def kmeans_jax_full(
     )
     _misses_before = _build_kmeans.cache_info().misses
     fn = _build_kmeans(*build_args)
+    _sig = None
     if _tel is not None:
         # Recompile detector: the aval signature (input shape/dtype plus
         # _build_kmeans's static cache key) names the program; the actual
@@ -1018,12 +1019,23 @@ def kmeans_jax_full(
         # the kernel was warm before telemetry activated.
         from ..obs.jaxtools import aval_signature
 
+        _sig = aval_signature(Xp, static=build_args)
         _tel.record_kernel_call(
-            "kmeans_jax_full", aval_signature(Xp, static=build_args),
+            "kmeans_jax_full", _sig,
             compiled=_build_kmeans.cache_info().misses > _misses_before)
     if k > n_valid:
         raise ValueError(f"k={k} exceeds number of valid samples {n_valid}")
-    out = fn(Xp, c0, key, _device_scalar_i32(int(iter_offset)))
+    call_args = (Xp, c0, key, _device_scalar_i32(int(iter_offset)))
+    if _tel is not None and _tel.xprof:
+        # XLA cost capture (obs/xprof.py): lower+compile explicitly once
+        # per signature, emit flops/bytes/memory + compile wall-clock as
+        # xla.* events, reuse the AOT executable afterwards.
+        from ..obs.xprof import instrumented_call
+
+        out = instrumented_call("kmeans_jax_full", fn, call_args,
+                                signature=_sig)
+    else:
+        out = fn(*call_args)
     centroids, labels, it, shift = out[:4]
     if with_trace:
         # Trace emission synchronizes (the buffers must come to host);

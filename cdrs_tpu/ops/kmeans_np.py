@@ -98,7 +98,8 @@ def kmeans_plusplus_init(
     return centroids
 
 
-def assign_labels(X: np.ndarray, centroids: np.ndarray, tile: int = _TILE) -> np.ndarray:
+def assign_labels(X: np.ndarray, centroids: np.ndarray,
+                  tile: int = _TILE) -> np.ndarray:
     """Nearest-centroid assignment, computed tile-by-tile so the (n, k)
     distance matrix is never materialized (peak temp = tile × k)."""
     n = X.shape[0]
@@ -139,7 +140,8 @@ def lloyd_step(
 
     new_centroids = np.empty_like(centroids)
     nonempty = counts > 0
-    new_centroids[nonempty] = (sums[nonempty] / counts[nonempty, None]).astype(centroids.dtype)
+    new_centroids[nonempty] = (sums[nonempty]
+                               / counts[nonempty, None]).astype(centroids.dtype)
     for j in np.flatnonzero(~nonempty):
         new_centroids[j] = X[int(rng.integers(0, n))]
 

@@ -68,8 +68,6 @@ import functools
 import os
 import warnings
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl

@@ -56,7 +56,8 @@ from .scoring_np import HIST_MEDIAN_THRESHOLD  # noqa: E402  (re-export)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
-def compute_cluster_medians_jax(x: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:
+def compute_cluster_medians_jax(x: jnp.ndarray, labels: jnp.ndarray,
+                                k: int) -> jnp.ndarray:
     """(k, d) per-cluster per-feature medians; NaN rows for empty clusters."""
     n = x.shape[0]
     ones = jnp.ones((n,), x.dtype)
@@ -569,10 +570,25 @@ def classify_jax(
     is_mod = jnp.asarray(np.array([c == "Moderate" for c in cfg.categories]))
     rf = jnp.asarray(np.array(cfg.rf_vector(), dtype=np.float64), dtype=x.dtype)
 
-    fused = _build_classify(method, int(k), bins, bool(want_global), ndata,
-                            int((mesh_shape or {}).get("model", 1)))
-    return fused(x, labels, gm, W, D, is_mod,
-                 jnp.asarray(cfg.moderate_band, x.dtype), rf)
+    static = (method, int(k), bins, bool(want_global), ndata,
+              int((mesh_shape or {}).get("model", 1)))
+    fused = _build_classify(*static)
+    args = (x, labels, gm, W, D, is_mod,
+            jnp.asarray(cfg.moderate_band, x.dtype), rf)
+    from ..obs import current as _obs_current
+
+    _tel = _obs_current()
+    if _tel is not None and _tel.xprof:
+        # XLA cost capture for the fused classification program (medians ->
+        # score table -> winner): flops/bytes/compile-seconds as xla.*
+        # events, once per abstract signature (obs/xprof.py).
+        from ..obs.jaxtools import aval_signature
+        from ..obs.xprof import instrumented_call
+
+        return instrumented_call(
+            "classify_jax", fused, args,
+            signature=aval_signature(x, labels, gm, static=static))
+    return fused(*args)
 
 
 @functools.lru_cache(maxsize=64)

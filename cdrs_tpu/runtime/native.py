@@ -23,7 +23,8 @@ import numpy as np
 __all__ = ["load", "native_available", "simulate_events_native",
            "parse_log_chunk_native", "write_access_log_native", "InternMap"]
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libcdrs_native.so")
 

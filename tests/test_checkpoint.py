@@ -185,19 +185,19 @@ def test_parity_labels_match_uninterrupted(tmp_path):
     want_c, want_l, want_it, _ = kmeans_jax_full(
         X, 5, tol=1e-4, seed=3, max_iter=40)
 
-    c, l, it = kmeans_jax_checkpointed(
+    c, lab, it = kmeans_jax_checkpointed(
         X, 5, ck, tol=1e-4, seed=3, max_iter=40, block_iters=7,
         labels="parity")
     assert it == want_it
     np.testing.assert_allclose(c, np.asarray(want_c), atol=0)
-    np.testing.assert_array_equal(l, np.asarray(want_l))
+    np.testing.assert_array_equal(lab, np.asarray(want_l))
 
     # Resume of the already-complete run returns the stored parity labels.
     c2, l2, it2 = kmeans_jax_checkpointed(
         X, 5, ck, tol=1e-4, seed=3, max_iter=40, block_iters=7,
         labels="parity")
     assert it2 == it
-    np.testing.assert_array_equal(l2, l)
+    np.testing.assert_array_equal(l2, lab)
 
 
 def test_parity_labels_old_checkpoint_raises(tmp_path):

@@ -325,7 +325,7 @@ def test_controller_metrics_jsonl_sink(tmp_path, workload):
     mp = str(tmp_path / "metrics.jsonl")
     res = ReplicationController(manifest, _cfg()).run(events,
                                                       metrics_path=mp)
-    lines = [json.loads(l) for l in open(mp)]
+    lines = [json.loads(ln) for ln in open(mp)]
     assert len(lines) == len(res.records)
     assert lines[0]["window"] == 0 and "plan_hash" in lines[-1]
     assert set(lines[0]["seconds"]) >= {"fold", "drift", "recluster",

@@ -172,7 +172,8 @@ def test_empty_cluster_reseed_deterministic():
     """k=4 on 4 distinct points with a far-away init forces reseeds; results
     must be reproducible from the seed (fixes reference quirk §6.1.2)."""
     X = np.array([[0.0, 0], [10, 0], [0, 10], [10, 10]])
-    init = np.full((4, 2), 100.0) + np.arange(4)[:, None]  # all points -> cluster argmin ties
+    # all points -> cluster argmin ties
+    init = np.full((4, 2), 100.0) + np.arange(4)[:, None]
     r1 = kmeans_jax(X, 4, seed=5, max_iter=50, init_centroids=init)
     r2 = kmeans_jax(X, 4, seed=5, max_iter=50, init_centroids=init)
     np.testing.assert_array_equal(np.asarray(r1[0]), np.asarray(r2[0]))

@@ -46,7 +46,8 @@ def test_init_spreads_on_separated_clusters():
     # With 3 well-separated blobs and k=3, D^2 sampling must pick one point
     # from each blob (probability of failure is astronomically small).
     rng = np.random.default_rng(3)
-    blobs = [rng.normal(loc=c, scale=0.01, size=(50, 2)) for c in ((0, 0), (50, 0), (0, 50))]
+    blobs = [rng.normal(loc=c, scale=0.01, size=(50, 2))
+             for c in ((0, 0), (50, 0), (0, 50))]
     X = np.concatenate(blobs)
     C = kmeans_plusplus_init(X, 3, random_state=0)
     owners = {int(np.argmin([np.linalg.norm(c - b.mean(0)) for b in blobs])) for c in C}

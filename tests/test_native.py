@@ -370,7 +370,6 @@ def test_native_python_writer_byte_parity(tmp_path, monkeypatch):
     """Native and python log writers emit byte-identical files: both truncate
     the millisecond field as (t - floor(t)) * 1000.0 with the same IEEE
     double ops (ADVICE r3 — the native writer used to round)."""
-    from cdrs_tpu.io import events as ev_mod
     from cdrs_tpu.io.events import EventLog
 
     manifest, log = _make_workload(tmp_path, n_files=20, duration=60.0)

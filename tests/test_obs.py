@@ -367,7 +367,7 @@ def test_prometheus_lines_shapes():
     assert "cdrs_a_b 3" in lines
     assert "# TYPE cdrs_g gauge" in lines
     assert "cdrs_h_count 2" in lines
-    assert any(l.startswith('cdrs_h{quantile="0.95"}') for l in lines)
+    assert any(ln.startswith('cdrs_h{quantile="0.95"}') for ln in lines)
 
 
 def test_summarize_aggregates_appended_runs(tmp_path, capsys):

@@ -1,0 +1,513 @@
+"""Observatory layer (ISSUE 3): XLA cost capture (obs/xprof), decision-
+quality audit (obs/audit), HTML report + live watch (obs/report,
+obs/metrics_cli, obs/sink.iter_events)."""
+
+import io
+import json
+import os
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.obs import JsonlSink, Telemetry, iter_events, read_events
+from cdrs_tpu.obs.metrics_cli import (
+    _prom_name,
+    main as metrics_main,
+    watch,
+)
+from cdrs_tpu.obs.report import render_html
+
+# -- canned stream shared by the report tests --------------------------------
+
+CANNED = [
+    {"kind": "meta", "t": 1000.0, "run": {"python": "3.10.0",
+                                          "jax_device_kind": "TPU v5e"}},
+    {"kind": "span", "name": "fold", "id": 2, "parent": 1, "t": 1000.1,
+     "dur": 0.25, "run": "r1"},
+    {"kind": "span", "name": "window", "id": 1, "parent": None, "t": 1000.0,
+     "dur": 1.5, "run": "r1"},
+    {"kind": "counter", "name": "controller.windows", "t": 1000.2,
+     "delta": 1.0, "value": 2.0, "run": "r1"},
+    {"kind": "gauge", "name": "audit.silhouette", "t": 1000.3, "value": 0.41,
+     "run": "r1"},
+    {"kind": "gauge", "name": "audit.silhouette", "t": 1000.4, "value": 0.47,
+     "run": "r1"},
+    {"kind": "hist", "name": "controller.total.seconds", "t": 1000.5,
+     "value": 0.8, "run": "r1"},
+    {"kind": "hist", "name": "controller.total.seconds", "t": 1000.6,
+     "value": 1.2, "run": "r1"},
+    {"kind": "xla", "event": "compile", "kernel": "kmeans_jax_full",
+     "sig": 42, "t": 1000.7, "lower_seconds": 0.1, "compile_seconds": 1.75,
+     "flops": 2.0e12, "bytes_accessed": 4.0e10, "temp_bytes": 1 << 20,
+     "argument_bytes": 1 << 22, "output_bytes": 1 << 14, "run": "r1"},
+    {"kind": "xla", "event": "exec", "kernel": "kmeans_jax_full", "sig": 42,
+     "t": 1000.8, "seconds": 0.05, "run": "r1"},
+    {"kind": "kmeans_iter", "kernel": "kmeans_jax_full", "call": 1,
+     "step": 0, "inertia": 40.0, "shift": 1.0, "backend": "jax", "k": 4,
+     "run": "r1"},
+    {"kind": "kmeans_iter", "kernel": "kmeans_jax_full", "call": 1,
+     "step": 1, "inertia": 22.0, "shift": 0.0, "backend": "jax", "k": 4,
+     "run": "r1"},
+    {"kind": "audit", "window": 0, "t": 1000.9, "silhouette": 0.41,
+     "davies_bouldin": 1.2, "category_entropy": 0.8,
+     "replication_bytes": 1000, "locality": 0.7, "flags": [], "run": "r1"},
+    {"kind": "audit", "window": 1, "t": 1001.0, "silhouette": 0.30,
+     "davies_bouldin": 1.6, "category_entropy": 0.7, "population_tv": 0.2,
+     "replication_bytes": 1400, "replication_bytes_delta": 400,
+     "locality": 0.6, "flags": ["drift_no_gain", "budget_saturated"],
+     "run": "r1"},
+    {"kind": "window", "window": 0, "n_events": 100, "recluster": True,
+     "recluster_mode": "full", "drift": None, "moves_applied": 5,
+     "bytes_migrated": 5000, "locality_after": 0.7, "run": "r1"},
+    {"kind": "window", "window": 1, "n_events": 120, "recluster": True,
+     "recluster_mode": "warm", "drift": 0.21, "moves_applied": 3,
+     "bytes_migrated": 3000, "locality_after": 0.6, "run": "r1"},
+]
+
+_GOLDEN = os.path.join(os.path.dirname(__file__), "data",
+                       "report_golden.html")
+
+
+# -- xprof -------------------------------------------------------------------
+
+def test_instrumented_call_captures_and_matches():
+    pytest.importorskip("jax")
+    import jax
+    import jax.numpy as jnp
+
+    from cdrs_tpu.obs import xprof
+
+    xprof.clear_cache()
+    fn = jax.jit(lambda x: (x @ x.T).sum())
+    x = jnp.arange(12.0, dtype=jnp.float32).reshape(3, 4)
+    plain = fn(x)
+    events = []
+    with Telemetry() as tel:
+        tel._emit = events.append
+        out1 = xprof.instrumented_call("toy", fn, (x,), signature=("toy",))
+        out2 = xprof.instrumented_call("toy", fn, (x,), signature=("toy",))
+    assert float(out1) == float(plain) == float(out2)
+    xla = [e for e in events if e.get("kind") == "xla"]
+    kinds = [(e["event"]) for e in xla]
+    assert kinds == ["compile", "exec"]  # second call: cached, no re-capture
+    compile_ev = xla[0]
+    assert compile_ev["kernel"] == "toy"
+    assert compile_ev["compile_seconds"] > 0
+    assert compile_ev.get("flops", 0) > 0
+    assert tel.counters["xla.compiles.toy"] == 1
+
+
+def test_instrumented_call_off_without_instrument():
+    pytest.importorskip("jax")
+    import jax
+    import jax.numpy as jnp
+
+    from cdrs_tpu.obs import xprof
+
+    xprof.clear_cache()
+    fn = jax.jit(lambda x: x * 2)
+    x = jnp.ones((4,))
+    out = xprof.instrumented_call("toy2", fn, (x,), signature=("toy2",))
+    np.testing.assert_array_equal(np.asarray(out), 2 * np.ones(4))
+    assert not xprof._COMPILED  # nothing captured with telemetry off
+
+
+def test_kmeans_xprof_events_and_parity(tmp_path):
+    pytest.importorskip("jax")
+    from cdrs_tpu.ops.kmeans_jax import kmeans_jax_full
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(173, 5)).astype(np.float32)
+    ref = kmeans_jax_full(X, 3, seed=0, max_iter=5)
+    p = str(tmp_path / "x.jsonl")
+    with Telemetry(JsonlSink(p)):
+        got = kmeans_jax_full(X, 3, seed=0, max_iter=5)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(ref[0]),
+                               atol=1e-6)
+    xla = [e for e in read_events(p) if e.get("kind") == "xla"
+           and e.get("kernel") == "kmeans_jax_full"]
+    assert {e["event"] for e in xla} == {"compile", "exec"}
+    comp = next(e for e in xla if e["event"] == "compile")
+    for key in ("flops", "bytes_accessed", "compile_seconds",
+                "temp_bytes", "output_bytes"):
+        assert key in comp, key
+
+
+# -- report ------------------------------------------------------------------
+
+def test_report_html_golden():
+    """The HTML report of a canned stream is byte-stable (deterministic
+    rendering is what makes it reviewable as a diff)."""
+    html = render_html(CANNED, title="golden")
+    with open(_GOLDEN, encoding="utf-8") as f:
+        golden = f.read()
+    assert html == golden, (
+        "report HTML drifted from tests/data/report_golden.html; if the "
+        "change is intentional, regenerate with: python -c \"import json;"
+        "from tests.test_observatory import CANNED, _GOLDEN;"
+        "from cdrs_tpu.obs.report import render_html;"
+        "open(_GOLDEN,'w').write(render_html(CANNED, title='golden'))\"")
+
+
+def test_report_html_structure():
+    html = render_html(CANNED, title="structure")
+    for required in (
+        "<!doctype html",
+        "Span tree (wall-clock, aggregated)",
+        "XLA kernel costs (roofline)",
+        "Decision-quality audit timeline",
+        "Controller windows",
+        "KMeans convergence traces",
+        "drift_no_gain",
+        "class=\"spark\"",          # sparklines present
+        "kmeans_jax_full",
+        "% of attainable",          # peaks known (TPU v5e in canned meta)
+    ):
+        assert required in html, required
+    # flags are never color-alone: the label text rides the status color
+    assert "⚠ drift_no_gain" in html
+    # one audit row per window, last-wins dedup intact
+    assert html.count("✓ clean") == 1
+
+
+def test_report_cli_roundtrip(tmp_path, capsys):
+    p = tmp_path / "s.jsonl"
+    p.write_text("".join(json.dumps(e) + "\n" for e in CANNED),
+                 encoding="utf-8")
+    out = tmp_path / "r.html"
+    assert metrics_main(["report", str(p), "-o", str(out)]) == 0
+    html = out.read_text(encoding="utf-8")
+    assert "Decision-quality audit timeline" in html
+    # default output path: <file>.html
+    assert metrics_main(["report", str(p)]) == 0
+    assert (tmp_path / "s.jsonl.html").exists()
+
+
+def test_summarize_shows_roofline_and_audit(tmp_path, capsys):
+    p = tmp_path / "s.jsonl"
+    p.write_text("".join(json.dumps(e) + "\n" for e in CANNED),
+                 encoding="utf-8")
+    assert metrics_main(["summarize", str(p)]) == 0
+    text = capsys.readouterr().out
+    assert "XLA kernel costs (roofline)" in text
+    assert "compile=1.75s" in text
+    # 2e12 flops / 0.05 s = 40 TF/s achieved; v5e peaks known -> verdict
+    assert "% of" in text and "bound" in text
+    assert "Audit: 2 windows" in text
+    assert "drift_no_gain" in text
+
+
+# -- iter_events / watch -----------------------------------------------------
+
+def test_iter_events_buffers_partial_line(tmp_path):
+    p = tmp_path / "t.jsonl"
+    p.write_text('{"a": 1}\n{"b": 2', encoding="utf-8")
+    # non-follow: the torn tail is skipped (read_events contract)
+    assert [e for e in iter_events(str(p))] == [{"a": 1}]
+    # follow: the partial line is buffered until its newline arrives
+    got = []
+
+    def consume():
+        for e in iter_events(str(p), follow=True, poll=0.01,
+                             stop=lambda: len(got) >= 2):
+            got.append(e)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.08)
+    with open(p, "a", encoding="utf-8") as f:
+        f.write('2}\n')
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got == [{"a": 1}, {"b": 22}]
+
+
+def test_iter_events_waits_for_missing_file(tmp_path):
+    p = tmp_path / "late.jsonl"
+    got = []
+
+    def consume():
+        for e in iter_events(str(p), follow=True, poll=0.01,
+                             stop=lambda: len(got) >= 1):
+            got.append(e)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)
+    with open(p, "w", encoding="utf-8") as f:
+        f.write('{"x": 9}\n')
+    t.join(timeout=5)
+    assert got == [{"x": 9}]
+
+
+def test_iter_events_recovers_from_truncation(tmp_path):
+    """rm + fresh producer while a watcher follows: the stale offset must
+    reset instead of reading b'' forever."""
+    p = tmp_path / "s.jsonl"
+    p.write_text('{"a": 1}\n{"a": 2}\n', encoding="utf-8")
+    got = []
+
+    def consume():
+        for e in iter_events(str(p), follow=True, poll=0.02,
+                             stop=lambda: len(got) >= 3):
+            got.append(e)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.1)
+    os.remove(p)
+    p.write_text('{"b": 9}\n', encoding="utf-8")  # recreated, smaller
+    t.join(timeout=5)
+    assert got == [{"a": 1}, {"a": 2}, {"b": 9}]
+
+
+def test_instrumented_call_concurrent_first_calls_compile_once():
+    pytest.importorskip("jax")
+    import jax
+    import jax.numpy as jnp
+
+    from cdrs_tpu.obs import xprof
+
+    xprof.clear_cache()
+    fn = jax.jit(lambda x: (x @ x.T).sum())
+    x = jnp.arange(20.0).reshape(4, 5)
+    events = []
+    with Telemetry() as tel:
+        tel._emit = events.append
+        threads = [threading.Thread(
+            target=lambda: xprof.instrumented_call(
+                "race", fn, (x,), signature=("race",)))
+            for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        compiles = [e for e in events if e.get("kind") == "xla"
+                    and e.get("event") == "compile"]
+        assert len(compiles) == 1
+        assert tel.counters["xla.compiles.race"] == 1
+
+
+def test_watch_once_renders_dashboard(tmp_path):
+    p = tmp_path / "w.jsonl"
+    p.write_text("".join(json.dumps(e) + "\n" for e in CANNED),
+                 encoding="utf-8")
+    buf = io.StringIO()
+    assert watch(str(p), once=True, out=buf) == 0
+    text = buf.getvalue()
+    assert "windows: 2" in text
+    assert "audit" in text
+    assert "flags:" in text and "budget_saturated" in text
+
+
+# -- audit: controller integration + schema ----------------------------------
+
+def test_controller_emits_audit_event_per_window(tmp_path):
+    from cdrs_tpu.config import (GeneratorConfig, KMeansConfig,
+                                 SimulatorConfig, validated_scoring_config)
+    from cdrs_tpu.control import ControllerConfig, ReplicationController
+    from cdrs_tpu.sim.access import simulate_access
+    from cdrs_tpu.sim.generator import generate_population
+
+    manifest = generate_population(GeneratorConfig(n_files=120, seed=21))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=240.0, seed=22))
+    cfg = ControllerConfig(window_seconds=120.0,
+                           kmeans=KMeansConfig(k=6, seed=42),
+                           scoring=validated_scoring_config())
+    mp = str(tmp_path / "m.jsonl")
+    with Telemetry(JsonlSink(mp), meta=False):
+        res = ReplicationController(manifest, cfg).run(events,
+                                                       metrics_path=mp)
+    assert len(res.records) >= 2
+    stream = read_events(mp)
+    audits = [e for e in stream if e.get("kind") == "audit"]
+    # one audit record per processed window, window indices aligned
+    assert [a["window"] for a in audits] == \
+        [r["window"] for r in res.records]
+    for a in audits:
+        for key in ("category_entropy", "replication_bytes", "flags"):
+            assert key in a, key
+        assert isinstance(a["flags"], list)
+        assert 0.0 <= a["category_entropy"] <= 1.0
+    # windows that computed a feature snapshot carry the geometry metrics
+    assert any("silhouette" in a and "davies_bouldin" in a for a in audits)
+    sil = [a["silhouette"] for a in audits if "silhouette" in a]
+    assert all(-1.0 <= s <= 1.0 for s in sil)
+    # the same stream also grew audit gauges
+    assert any(e.get("kind") == "gauge"
+               and e["name"] == "audit.silhouette" for e in stream)
+
+
+def test_audit_off_flag(tmp_path):
+    from cdrs_tpu.config import (GeneratorConfig, KMeansConfig,
+                                 SimulatorConfig, validated_scoring_config)
+    from cdrs_tpu.control import ControllerConfig, ReplicationController
+    from cdrs_tpu.sim.access import simulate_access
+    from cdrs_tpu.sim.generator import generate_population
+
+    manifest = generate_population(GeneratorConfig(n_files=60, seed=23))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=120.0, seed=24))
+    cfg = ControllerConfig(window_seconds=120.0,
+                           kmeans=KMeansConfig(k=4, seed=42),
+                           scoring=validated_scoring_config())
+    mp = str(tmp_path / "m.jsonl")
+    with Telemetry(JsonlSink(mp), meta=False, audit=False):
+        ReplicationController(manifest, cfg).run(events, metrics_path=mp)
+    assert not [e for e in read_events(mp) if e.get("kind") == "audit"]
+
+
+def test_audit_flags_fire():
+    from cdrs_tpu.obs.audit import AuditConfig, DecisionAuditor
+
+    class Cap:
+        def __init__(self):
+            self.events = []
+            self.counters = {}
+
+        def _emit(self, e):
+            self.events.append(e)
+
+        def gauge(self, *a):
+            pass
+
+        def counter_inc(self, name, delta=1.0):
+            self.counters[name] = self.counters.get(name, 0) + delta
+
+    rng = np.random.default_rng(0)
+    tight = np.concatenate([rng.normal(0, 0.02, (40, 3)),
+                            rng.normal(1, 0.02, (40, 3))])
+    loose = rng.uniform(-1, 2, (80, 3))
+    cents = np.array([[0.0, 0, 0], [1.0, 1, 1]])
+    sizes = np.full(80, 100)
+    cap = Cap()
+    aud = DecisionAuditor(sizes, 4, AuditConfig(budget_windows=2))
+    rf = np.ones(80, dtype=np.int64)
+    cat = np.zeros(80, dtype=np.int64)
+    base = {"recluster": False, "deferred_budget": 0}
+    aud.audit_window(cap, window=0, rec=dict(base), X=tight,
+                     centroids=cents, rf=rf, cat=cat)
+    # window 1: re-cluster ran, quality collapsed, budget deferred
+    aud.audit_window(cap, window=1,
+                     rec={"recluster": True, "deferred_budget": 3,
+                          "locality_before": 0.8, "locality_after": 0.5},
+                     X=loose, centroids=cents, rf=rf, cat=cat)
+    # window 2: budget still deferred -> saturation streak reached
+    e2 = aud.audit_window(cap, window=2,
+                          rec={"recluster": False, "deferred_budget": 1},
+                          X=loose, centroids=cents, rf=rf, cat=cat)
+    flags1 = cap.events[1]["flags"]
+    assert "drift_no_gain" in flags1
+    assert "locality_regressed" in flags1
+    assert "budget_saturated" in e2["flags"]
+    assert cap.counters["audit.flags.drift_no_gain"] == 1
+
+
+def test_silhouette_proxy_orders_quality():
+    from cdrs_tpu.obs.audit import silhouette_db_proxy
+
+    rng = np.random.default_rng(1)
+    cents = np.array([[0.0, 0], [5.0, 5]])
+    tight = np.concatenate([rng.normal(0, 0.05, (50, 2)),
+                            rng.normal(5, 0.05, (50, 2))])
+    loose = np.concatenate([rng.normal(0, 2.5, (50, 2)),
+                            rng.normal(5, 2.5, (50, 2))])
+    sil_t, db_t = silhouette_db_proxy(tight, cents)
+    sil_l, db_l = silhouette_db_proxy(loose, cents)
+    assert sil_t > sil_l          # tighter clusters score higher
+    assert db_t < db_l            # ...and lower Davies-Bouldin
+    assert sil_t > 0.9
+    # degenerate inputs never raise
+    assert silhouette_db_proxy(tight[:0], cents) == (0.0, 0.0)
+    assert silhouette_db_proxy(tight, cents[:1]) == (0.0, 0.0)
+
+
+# -- prometheus name escaping (satellite) ------------------------------------
+
+_PROM_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+
+
+def test_prom_name_digit_leading_and_punctuation():
+    # digit-leading event name stays valid with AND without the namespace
+    assert _prom_name("9p99.latency") == "cdrs_9p99_latency"
+    assert _prom_name("9p99.latency", prefix="") == "_9p99_latency"
+    for name in ("9p99.latency", "jit.recompiles.kmeans_jax_full",
+                 "a b/c-d{e}", "@#!", "0", "p50"):
+        for prefix in ("cdrs_", ""):
+            got = _prom_name(name, prefix=prefix)
+            assert _PROM_NAME_RE.fullmatch(got), (name, prefix, got)
+
+
+def test_read_events_survives_torn_multibyte_tail(tmp_path):
+    """A writer killed mid-multi-byte-character must not poison the
+    stream: the mangled final line is skipped, not a UnicodeDecodeError."""
+    p = tmp_path / "t.jsonl"
+    good = json.dumps({"name": "π"}, ensure_ascii=False).encode("utf-8")
+    torn = json.dumps({"name": "catégorie"},
+                      ensure_ascii=False).encode("utf-8")
+    p.write_bytes(good + b"\n" + torn[:-3])  # cut inside the é sequence
+    events = read_events(str(p))
+    assert events == [{"name": "π"}]
+    assert list(iter_events(str(p))) == [{"name": "π"}]
+
+
+def test_sig_id_stable_across_processes(tmp_path):
+    """xla event sig ids key cross-run aggregation, so they must be
+    content hashes, not the per-process-salted builtin hash()."""
+    import subprocess
+    import sys as _sys
+
+    code = ("from cdrs_tpu.obs.xprof import _sig_id;"
+            "print(_sig_id('kern', ((128, 5), 'float32', ('a', 1))))")
+    outs = {
+        subprocess.run(
+            [_sys.executable, "-c", code], text=True, capture_output=True,
+            env={**os.environ, "PYTHONHASHSEED": seed,
+                 "PYTHONPATH": os.path.dirname(os.path.dirname(
+                     os.path.abspath(__file__)))},
+        ).stdout.strip()
+        for seed in ("1", "2")
+    }
+    assert len(outs) == 1 and outs != {""}
+
+
+def test_exit_meta_carries_jax_fields(tmp_path):
+    """Telemetry stamps run metadata again at exit: activation happens
+    before a command imports jax, so only the exit stamp can carry the
+    device kind the roofline peak lookup needs."""
+    pytest.importorskip("jax")
+    p = str(tmp_path / "t.jsonl")
+    with Telemetry(JsonlSink(p)):
+        pass
+    metas = [e for e in read_events(p) if e.get("kind") == "meta"]
+    assert len(metas) == 2
+    assert "jax_device_kind" in metas[-1]["run"]  # jax imported by now
+    from cdrs_tpu.obs.aggregate import collect
+
+    # collect() takes the last stamp — the enriched one
+    assert "jax_device_kind" in collect(read_events(p))["meta"]
+
+
+def test_roofline_partial_peak_override():
+    from cdrs_tpu.obs.aggregate import collect, roofline_rows
+
+    digest = collect(CANNED)  # meta names TPU v5e (819 GB/s table bw)
+    [row] = roofline_rows(digest, peak_flops=100e12, peak_gbps=None)
+    # the device table must fill the side the user did not override
+    assert row["bound"] in ("memory", "compute")
+    assert "attainable_gflops" in row
+
+
+def test_sink_utf8_roundtrip(tmp_path):
+    p = str(tmp_path / "u.jsonl")
+    with JsonlSink(p) as s:
+        s.emit({"name": "catégorie.ñ", "note": "π≈3.14159"})
+    e = read_events(p)[0]
+    assert e["name"] == "catégorie.ñ" and e["note"] == "π≈3.14159"
+    # the bytes on disk are utf-8 regardless of platform default
+    raw = open(p, "rb").read().decode("utf-8")
+    assert "catégorie" in raw

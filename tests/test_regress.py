@@ -1,0 +1,199 @@
+"""Bench trajectory regression gate (benchmarks/regress.py,
+``cdrs metrics regress``)."""
+
+import json
+
+from cdrs_tpu.benchmarks.regress import (
+    check_run,
+    extract_records,
+    ingest_files,
+    load_history,
+    main as regress_main,
+    write_history,
+)
+
+
+def _hist(values, metric="lloyd_iters_per_sec_n1_d1_k1", unit="iter/s",
+          platform="tpu"):
+    return [{"round": i + 1, "metric": metric, "value": v, "unit": unit,
+             "direction": "higher" if unit == "iter/s" else "lower",
+             "platform": platform}
+            for i, v in enumerate(values)]
+
+
+def _run(value, metric="lloyd_iters_per_sec_n1_d1_k1", unit="iter/s",
+         platform="tpu"):
+    return [{"metric": metric, "value": value, "unit": unit,
+             "direction": "higher" if unit == "iter/s" else "lower",
+             "platform": platform}]
+
+
+# -- banding -----------------------------------------------------------------
+
+def test_improvement_passes():
+    hist = _hist([100.0, 110.0, 120.0])
+    [v] = check_run(_run(200.0), hist)
+    assert v["status"] == "improved"
+    assert v["baseline"] == 120.0
+
+
+def test_within_band_noise_passes():
+    hist = _hist([100.0, 110.0, 120.0])
+    for value in (115.0, 108.0, 120.0, 103.0):  # >= 120 * 0.85
+        [v] = check_run(_run(value), hist)
+        assert v["status"] == "pass", value
+
+
+def test_injected_slowdown_fails():
+    hist = _hist([100.0, 110.0, 120.0])
+    [v] = check_run(_run(120.0 * 0.8), hist)   # 20% below the recent best
+    assert v["status"] == "regression"
+    assert v["band_low"] == 120.0 * 0.85
+
+
+def test_steep_trajectory_catches_slowdown_from_latest():
+    # The recorded config-2 history shape: a big jump late in the series.
+    # A mean/median anchor would let a 20% drop from the latest round
+    # through; the recent-best anchor must not.
+    hist = _hist([103.0, 288.0, 1773.0, 1888.0])
+    [v] = check_run(_run(1888.0 * 0.8), hist)
+    assert v["status"] == "regression"
+
+
+def test_lower_better_direction():
+    hist = _hist([3.2, 3.0, 2.9], metric="e2e_seconds_to_categories_n1",
+                 unit="s")
+    [v] = check_run(_run(2.9 * 1.2, metric="e2e_seconds_to_categories_n1",
+                         unit="s"), hist)
+    assert v["status"] == "regression"
+    [v] = check_run(_run(3.0, metric="e2e_seconds_to_categories_n1",
+                         unit="s"), hist)
+    assert v["status"] == "pass"
+    [v] = check_run(_run(2.0, metric="e2e_seconds_to_categories_n1",
+                         unit="s"), hist)
+    assert v["status"] == "improved"
+
+
+def test_platform_mismatch_is_no_baseline():
+    hist = _hist([100.0, 110.0])
+    [v] = check_run(_run(1.0, platform="cpu"), hist)
+    assert v["status"] == "no_baseline"
+
+
+def test_window_limits_history():
+    # Only the trailing 3 rounds form the band: the ancient 10000 must not.
+    hist = _hist([10000.0, 100.0, 110.0, 120.0])
+    [v] = check_run(_run(118.0), hist, window=3)
+    assert v["status"] == "pass"
+
+
+# -- ingestion ---------------------------------------------------------------
+
+def test_extract_driver_capture_with_nested_blocks():
+    doc = {"n": 4, "cmd": "python bench.py", "rc": 0, "tail": "...",
+           "parsed": {"metric": "m1", "value": 10.0, "unit": "iter/s",
+                      "vs_baseline": 2.0, "backend": "jax",
+                      "jax_platform": "tpu", "jax_devices": 1,
+                      "config3": {"metric": "m3", "value": 5.0,
+                                  "unit": "iter/s", "jax_platform": "tpu"},
+                      "config4_rehearsal": {"skipped": "no TPU"}}}
+    recs = extract_records(doc, "BENCH_r04.json")
+    assert [(r["metric"], r["value"], r["round"]) for r in recs] == \
+        [("m1", 10.0, 4), ("m3", 5.0, 4)]
+    assert all(r["platform"] == "tpu" for r in recs)
+
+
+def test_extract_scrapes_truncated_tail():
+    # parsed: null + a tail holding only the end of the detail JSON — the
+    # BENCH_r05.json shape.  The repeated headline (stdout contract line +
+    # detail line) must collapse to one record.
+    tail = ('... truncated ..., "metric": "m_head", "value": 42.5, '
+            '"unit": "iter/s", "vs_baseline": 7.0, "backend": "jax", '
+            '"jax_devices": 1, "jax_platform": "tpu", "config3": '
+            '{"metric": "m_c3", "value": 3.25, "unit": "s", '
+            '"jax_platform": "tpu"}, "metric": "m_head", "value": 42.5, '
+            '"unit": "iter/s"')
+    doc = {"n": 5, "cmd": "c", "rc": 0, "parsed": None, "tail": tail}
+    recs = extract_records(doc, "BENCH_r05.json")
+    by_metric = {r["metric"]: r for r in recs}
+    assert set(by_metric) == {"m_head", "m_c3"}
+    assert by_metric["m_head"]["value"] == 42.5
+    assert by_metric["m_head"]["platform"] == "tpu"
+    assert by_metric["m_c3"]["direction"] == "lower"
+    assert all(r["round"] == 5 for r in recs)
+
+
+def test_ingest_real_bench_files_builds_history(tmp_path):
+    """The checked-in data/bench_history.jsonl is exactly the ingest of the
+    five BENCH_r0*.json drivers captures."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(repo, f"BENCH_r0{i}.json") for i in range(1, 6)]
+    if not all(os.path.exists(p) for p in paths):  # pragma: no cover
+        import pytest
+
+        pytest.skip("BENCH_r0*.json not present")
+    records = ingest_files(paths)
+    assert len(records) >= 5
+    rounds = {r["round"] for r in records}
+    assert rounds == {1, 2, 3, 4, 5}
+    # config-2 headline metric is present for every round
+    headline = [r for r in records
+                if r["metric"] == "lloyd_iters_per_sec_n1048576_d32_k128"]
+    assert len(headline) == 5
+    out = str(tmp_path / "h.jsonl")
+    write_history(out, records)
+    assert load_history(out) == records
+    canonical = os.path.join(repo, "data", "bench_history.jsonl")
+    if os.path.exists(canonical):
+        assert load_history(canonical) == records
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_regress_cli_exit_codes(tmp_path, capsys):
+    hist = str(tmp_path / "h.jsonl")
+    write_history(hist, _hist([100.0, 110.0, 120.0]))
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"metric": "lloyd_iters_per_sec_n1_d1_k1",
+                                "value": 119.0, "unit": "iter/s",
+                                "jax_platform": "tpu"}))
+    slow = tmp_path / "slow.json"
+    slow.write_text(json.dumps({"metric": "lloyd_iters_per_sec_n1_d1_k1",
+                                "value": 96.0, "unit": "iter/s",
+                                "jax_platform": "tpu"}))
+    assert regress_main([str(good), "--history", hist]) == 0
+    assert regress_main([str(slow), "--history", hist]) == 1
+    assert regress_main([str(slow), "--history", hist,
+                         "--report-only"]) == 0
+    capsys.readouterr()
+    assert regress_main([str(slow), "--history", hist, "--json",
+                         "--report-only"]) == 0
+    verdicts = json.loads(capsys.readouterr().out)
+    assert verdicts[0]["status"] == "regression"
+    # missing run / history files are usage errors, not tracebacks
+    assert regress_main([str(tmp_path / "nope.json"),
+                         "--history", hist]) == 2
+    assert regress_main([str(good), "--history",
+                         str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_regress_cli_via_metrics_subcommand(tmp_path):
+    from cdrs_tpu.obs.metrics_cli import main as metrics_main
+
+    hist = str(tmp_path / "h.jsonl")
+    b1 = tmp_path / "b1.json"
+    b1.write_text(json.dumps({"n": 1, "cmd": "c", "rc": 0, "tail": "",
+                              "parsed": {"metric": "m", "value": 100.0,
+                                         "unit": "iter/s",
+                                         "jax_platform": "tpu"}}))
+    assert metrics_main(["regress", "--ingest", str(b1),
+                         "--history", hist]) == 0
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps({"metric": "m", "value": 99.0,
+                               "unit": "iter/s", "jax_platform": "tpu"}))
+    assert metrics_main(["regress", str(run), "--history", hist]) == 0
+    run.write_text(json.dumps({"metric": "m", "value": 50.0,
+                               "unit": "iter/s", "jax_platform": "tpu"}))
+    assert metrics_main(["regress", str(run), "--history", hist]) == 1
