@@ -1,0 +1,291 @@
+"""Durability baseline: kill one datanode, measure the self-healing loop.
+
+The chaos counterpart of control_bench.py: a stationary workload on a
+5-node topology settles into its category plan, then one node crashes at a
+fixed window and never returns.  The fault-injected controller
+(control/controller.py + faults/) must re-replicate every under-replicated
+file back to its (effective) target rf through the SAME per-window churn
+budget drift migrations use.  Reported:
+
+* **windows to full re-replication** — windows after the kill until zero
+  lost / at-risk / under-replicated files (the acceptance bound);
+* **repair traffic** — bytes of re-replication copies, and per-window
+  proof that repair + migration traffic stayed inside the budget;
+* **files lost** — must be zero: the scenario runs a min-rf-2 scoring
+  table (Moderate 1 -> 2), because any rf=1 category trivially loses a
+  node's singleton replicas on a kill — a true statement about rf=1, but
+  not the re-replication property this baseline pins;
+* **kill/resume bit-identity** — a controller killed mid-outage and
+  resumed from its checkpoint reproduces the uninterrupted run's record
+  stream exactly;
+* **telemetry overhead** — the PR-2 ≤ 1.05x wall-clock budget re-checked
+  with fault accounting + repair planning enabled (interleaved paired
+  rounds, best-window ratio — the repo's standard methodology).
+
+``python -m cdrs_tpu.benchmarks.chaos_bench`` writes the JSON artifact to
+``data/chaos_bench.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import time
+
+import numpy as np
+
+from ..config import (
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from ..control import ControllerConfig, ReplicationController
+from ..faults import FaultSchedule
+from ..sim.access import simulate_access
+from ..sim.generator import generate_population
+
+__all__ = ["run_chaos_bench", "chaos_overhead"]
+
+_NODES = ("dn1", "dn2", "dn3", "dn4", "dn5")
+
+
+def _min_rf2_scoring():
+    """validated scoring with Moderate raised 1 -> 2 (module docstring)."""
+    base = validated_scoring_config()
+    rf = dict(base.replication_factors)
+    rf["Moderate"] = max(2, rf["Moderate"])
+    return dataclasses.replace(base, replication_factors=rf)
+
+
+def _strip(records: list[dict]) -> list[dict]:
+    """Records minus wall-clock noise: the bit-identity comparison key."""
+    return [{k: v for k, v in r.items() if k != "seconds"} for r in records]
+
+
+def run_chaos_bench(
+    n_files: int = 400,
+    seed: int = 11,
+    duration: float = 1800.0,
+    n_windows: int = 15,
+    kill_window: int = 6,
+    k: int = 12,
+    max_bytes_frac: float = 0.25,
+    resume_check: bool = True,
+    overhead: bool = True,
+    overhead_repeats: int = 9,
+) -> dict:
+    """Run the kill-one-node scenario; returns the artifact dict."""
+    window_seconds = duration / n_windows
+    manifest = generate_population(
+        GeneratorConfig(n_files=n_files, seed=seed, nodes=_NODES))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=duration, seed=seed + 1))
+    scoring = _min_rf2_scoring()
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+    max_bytes = int(max_bytes_frac * float(sizes.sum()))
+    schedule = FaultSchedule.from_specs([f"crash:dn2@{kill_window}"])
+
+    def mk() -> ReplicationController:
+        cfg = ControllerConfig(
+            window_seconds=window_seconds, default_rf=2,
+            max_bytes_per_window=max_bytes, hysteresis_windows=1,
+            kmeans=KMeansConfig(k=k, seed=42), scoring=scoring,
+            fault_schedule=FaultSchedule(schedule.events))
+        return ReplicationController(manifest, cfg)
+
+    t0 = time.perf_counter()
+    res = mk().run(events)
+    run_seconds = time.perf_counter() - t0
+
+    timeline = []
+    recover_at = None
+    for r in res.records:
+        d = r["durability"]
+        degraded = d["lost"] + d["at_risk"] + d["under_replicated"]
+        timeline.append({
+            "window": r["window"], "fault_events": r["fault_events"],
+            "nodes_up": d["nodes_up"], "lost": d["lost"],
+            "at_risk": d["at_risk"],
+            "under_replicated": d["under_replicated"],
+            "repair_moves": r["repair_moves"],
+            "repair_bytes": r["repair_bytes"],
+            "repair_backlog": r["repair_backlog"],
+            "bytes_migrated": r["bytes_migrated"],
+            "locality_after": None if r["locality_after"] is None
+            else round(r["locality_after"], 4),
+        })
+        if (r["window"] >= kill_window and degraded == 0
+                and recover_at is None):
+            recover_at = r["window"]
+    lost_max = max(t["lost"] for t in timeline)
+    budget_ok = all(t["repair_bytes"] + t["bytes_migrated"] <= max_bytes
+                    for t in timeline)
+
+    out: dict = {
+        "scenario": {
+            "n_files": n_files, "seed": seed, "nodes": list(_NODES),
+            "duration_seconds": duration, "n_windows": n_windows,
+            "window_seconds": window_seconds, "k": k,
+            "kill": f"dn2@{kill_window}", "default_rf": 2,
+            "replication_factors": scoring.replication_factors,
+            "max_bytes_per_window": max_bytes,
+            "max_bytes_frac": max_bytes_frac,
+        },
+        "timeline": timeline,
+        "recovery": {
+            "windows_to_full_re_replication":
+                None if recover_at is None else recover_at - kill_window,
+            "files_lost_max": lost_max,
+            "repair_bytes_total": int(sum(t["repair_bytes"]
+                                          for t in timeline)),
+            "repair_moves_total": int(sum(t["repair_moves"]
+                                          for t in timeline)),
+            "unavailable_reads": res.summary()["durability"][
+                "unavailable_reads"],
+            "run_seconds": round(run_seconds, 3),
+        },
+    }
+
+    if resume_check:
+        import os
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            ck = os.path.join(td, "chaos.npz")
+            a = mk().run(events, checkpoint_path=ck,
+                         max_windows=kill_window + 2)  # killed mid-outage
+            b = mk().run(events, checkpoint_path=ck)
+            identical = (_strip(a.records) + _strip(b.records)
+                         == _strip(res.records)
+                         and bool(np.array_equal(b.rf, res.rf))
+                         and bool(np.array_equal(b.category_idx,
+                                                 res.category_idx)))
+        out["kill_resume"] = {"killed_after_window": kill_window + 1,
+                              "bit_identical": identical}
+
+    if overhead:
+        out["overhead"] = chaos_overhead(repeats=overhead_repeats)
+
+    out["criteria"] = {
+        "recovered_within_run": recover_at is not None,
+        "zero_files_lost": lost_max == 0,
+        "budget_respected": budget_ok,
+        **({"kill_resume_bit_identical": out["kill_resume"][
+            "bit_identical"]} if resume_check else {}),
+        **({"overhead_within_budget": out["overhead"]["within_budget"]}
+           if overhead else {}),
+    }
+    return out
+
+
+def chaos_overhead(n_files: int = 8000, duration: float = 480.0,
+                   window_seconds: float = 60.0,
+                   repeats: int = 9) -> dict:
+    """Telemetry wall-clock ratio on the FAULT-MODE controller path.
+
+    Same interleaved paired methodology as
+    benchmarks/summary.telemetry_overhead_control, with the fault feed,
+    durability accounting and repair planning active on BOTH sides — the
+    instrumented side additionally streams window records, fault/
+    durability/repair counters+gauges and audit events through the sink.
+    Pins the ISSUE-4 acceptance: fault accounting keeps telemetry inside
+    the ≤ 1.05x budget."""
+    import os
+    import tempfile
+
+    from ..benchmarks.summary import TELEMETRY_OVERHEAD_BUDGET
+    from ..obs import JsonlSink, Telemetry
+
+    manifest = generate_population(
+        GeneratorConfig(n_files=n_files, seed=7, nodes=_NODES))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=duration, seed=8))
+    n_windows = int(duration // window_seconds)
+    schedule = FaultSchedule.from_specs(
+        [f"crash:dn2@{n_windows // 3}-{2 * n_windows // 3}"])
+
+    def mk() -> ReplicationController:
+        cfg = ControllerConfig(window_seconds=window_seconds, default_rf=2,
+                               kmeans=KMeansConfig(k=8, seed=42),
+                               scoring=_min_rf2_scoring(),
+                               fault_schedule=FaultSchedule(schedule.events))
+        return ReplicationController(manifest, cfg)
+
+    def run_plain() -> float:
+        t0 = time.perf_counter()
+        mk().run(events)
+        return time.perf_counter() - t0
+
+    def run_instr(path: str) -> float:
+        if os.path.exists(path):
+            os.remove(path)
+        t0 = time.perf_counter()
+        with Telemetry(JsonlSink(path)):
+            mk().run(events, metrics_path=path)
+        return time.perf_counter() - t0
+
+    run_plain()  # warmup
+    plain_windows: list[float] = []
+    instr_windows: list[float] = []
+    ratios: list[float] = []
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "t.jsonl")
+        for r in range(max(1, repeats)):
+            if r % 2 == 0:
+                p, i = run_plain(), run_instr(path)
+            else:
+                i, p = run_instr(path), run_plain()
+            plain_windows.append(p)
+            instr_windows.append(i)
+            ratios.append(i / p)
+    ratios.sort()
+    ratio = min(instr_windows) / min(plain_windows)
+    return {
+        "n_files": n_files,
+        "windows_per_run": n_windows,
+        "plain_seconds": min(plain_windows),
+        "telemetry_seconds": min(instr_windows),
+        "plain_windows": plain_windows,
+        "telemetry_windows": instr_windows,
+        "paired_ratios": ratios,
+        "paired_ratio_median": ratios[len(ratios) // 2],
+        "overhead_ratio": ratio,
+        "budget": TELEMETRY_OVERHEAD_BUDGET,
+        "within_budget": ratio <= TELEMETRY_OVERHEAD_BUDGET,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--out", default="data/chaos_bench.json")
+    p.add_argument("--n_files", type=int, default=400)
+    p.add_argument("--seed", type=int, default=11)
+    p.add_argument("--duration", type=float, default=1800.0)
+    p.add_argument("--windows", type=int, default=15)
+    p.add_argument("--kill_window", type=int, default=6)
+    p.add_argument("--k", type=int, default=12)
+    p.add_argument("--no_overhead", action="store_true",
+                   help="skip the paired telemetry-overhead rounds")
+    args = p.parse_args(argv)
+
+    out = run_chaos_bench(n_files=args.n_files, seed=args.seed,
+                          duration=args.duration, n_windows=args.windows,
+                          kill_window=args.kill_window, k=args.k,
+                          overhead=not args.no_overhead)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"out": args.out, **out["criteria"],
+                      "windows_to_full_re_replication": out["recovery"][
+                          "windows_to_full_re_replication"],
+                      "repair_bytes_total": out["recovery"][
+                          "repair_bytes_total"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -436,14 +436,11 @@ def _cmd_stream_inner(args) -> int:
     return 0
 
 
-def _cmd_control(args) -> int:
-    """Online replication controller: consume the log as time windows,
-    drift-gate incremental re-clusters, meter out bounded-churn migrations
-    (control/controller.py)."""
-    from .control import ControllerConfig, ReplicationController
-    from .io.events import Manifest
+def _controller_cfg(args, fault_schedule=None):
+    """ControllerConfig from the shared control/chaos argument set."""
+    from .control import ControllerConfig
 
-    cfg = ControllerConfig(
+    return ControllerConfig(
         window_seconds=args.window_seconds,
         drift_threshold=args.drift_threshold,
         full_recluster_drift=args.full_drift,
@@ -460,18 +457,29 @@ def _cmd_control(args) -> int:
         scoring=_load_scoring(args),
         mesh_shape=_parse_mesh(args.mesh),
         evaluate=not args.no_evaluate,
+        fault_schedule=fault_schedule,
+        repair_seed=getattr(args, "repair_seed", 0),
     )
+
+
+def _run_controller(args, cfg, root_span: str, manifest=None) -> int:
+    """Shared control/chaos driver: run the loop, export the plan, print
+    the summary (chaos runs additionally carry a ``durability`` block)."""
     import contextlib
 
-    manifest = Manifest.read_csv(args.manifest)
+    from .control import ReplicationController
+    from .io.events import Manifest
+
+    if manifest is None:
+        manifest = Manifest.read_csv(args.manifest)
     controller = ReplicationController(manifest, cfg)
     with contextlib.ExitStack() as stack:
         # One stream, two producers: the controller appends its per-window
         # records (kill/resume-safe, one line each) while the activated
         # Telemetry interleaves counters/histograms/kmeans traces — both
         # through obs/sink.JsonlSink, atomic per line.
-        _open_telemetry(args, stack, "control_cmd")
-        with StageTimer("control") as t:
+        _open_telemetry(args, stack, root_span)
+        with StageTimer(root_span) as t:
             result = controller.run(
                 args.access_log, metrics_path=args.metrics,
                 checkpoint_path=args.checkpoint,
@@ -487,6 +495,51 @@ def _cmd_control(args) -> int:
     out["seconds"] = round(t.elapsed, 3)
     print(json.dumps(out, indent=2))
     return 0
+
+
+def _cmd_control(args) -> int:
+    """Online replication controller: consume the log as time windows,
+    drift-gate incremental re-clusters, meter out bounded-churn migrations
+    (control/controller.py)."""
+    return _run_controller(args, _controller_cfg(args), "control_cmd")
+
+
+def _cmd_chaos(args) -> int:
+    """Fault-injected controller run: the control loop plus a seeded
+    FaultSchedule (node crash/recover/decommission/flaky), durability
+    accounting per window, and the repair planner competing with drift
+    migrations for the same churn budget (faults/)."""
+    from .faults import FaultSchedule
+    from .io.events import Manifest
+
+    manifest = Manifest.read_csv(args.manifest)
+    events = []
+    for kind, flag in (("crash", args.kill), ("recover", args.recover),
+                       ("decommission", args.decommission),
+                       ("flaky", args.flaky)):
+        for spec in flag or ():
+            events.extend(FaultSchedule.from_specs([f"{kind}:{spec}"]))
+    if args.schedule:
+        with open(args.schedule, encoding="utf-8") as f:
+            events.extend(FaultSchedule.from_json(json.load(f)))
+    if args.random_faults:
+        events.extend(FaultSchedule.random(
+            manifest.nodes, n_windows=args.random_faults,
+            seed=args.fault_seed))
+    if not events:
+        print("error: chaos needs at least one fault (--kill/--recover/"
+              "--decommission/--flaky/--schedule/--random_faults)",
+              file=sys.stderr)
+        return 1
+    schedule = FaultSchedule(events)
+    if args.schedule_out:
+        with open(args.schedule_out, "w", encoding="utf-8") as f:
+            json.dump(schedule.to_json(), f, indent=2)
+            f.write("\n")
+        print(f"schedule: {len(schedule)} events -> {args.schedule_out}",
+              file=sys.stderr)
+    return _run_controller(args, _controller_cfg(args, schedule),
+                           "chaos_cmd", manifest=manifest)
 
 
 def _cmd_bench(args) -> int:
@@ -640,52 +693,99 @@ def main(argv: list[str] | None = None) -> int:
     _add_metrics_arg(p)
     p.set_defaults(fn=_cmd_stream)
 
+    def _add_control_args(p: argparse.ArgumentParser) -> None:
+        """Options shared by the control and chaos subcommands."""
+        p.add_argument("--manifest", required=True)
+        p.add_argument("--access_log", required=True,
+                       help="globally time-sorted log (CSV access.log or "
+                            ".cdrsb)")
+        p.add_argument("--window_seconds", type=float, default=60.0)
+        p.add_argument("--k", type=int, default=8)
+        p.add_argument("--seed", type=int, default=42)
+        p.add_argument("--drift_threshold", type=float, default=0.05,
+                       help="drift score at/above which a re-cluster runs")
+        p.add_argument("--full_drift", type=float, default=0.30,
+                       metavar="SCORE",
+                       help="drift at/above which the warm start is "
+                            "abandoned (fresh init, full iteration budget)")
+        p.add_argument("--warm_max_iter", type=int, default=25)
+        p.add_argument("--max_bytes", type=int, default=None, metavar="BYTES",
+                       help="per-window migration byte budget (default: "
+                            "unbounded); chaos runs charge repair traffic "
+                            "against the same budget first")
+        p.add_argument("--max_files", type=int, default=None, metavar="N",
+                       help="per-window migrated-file cap (default: "
+                            "unbounded)")
+        p.add_argument("--hysteresis", type=int, default=1, metavar="WINDOWS",
+                       help="windows a migrated file stays frozen "
+                            "(anti-flap)")
+        p.add_argument("--decay", type=float, default=1.0,
+                       help="per-window feature-counter decay; < 1.0 "
+                            "re-weights toward recent traffic (numpy "
+                            "backend)")
+        p.add_argument("--default_rf", type=int, default=1)
+        p.add_argument("--batch_size", type=int, default=1_000_000,
+                       help="events per log read batch (windows re-slice "
+                            "it)")
+        _add_metrics_arg(p)  # window records interleave with the telemetry
+        p.add_argument("--plan_out", default=None, metavar="CSV",
+                       help="write the final applied plan "
+                            "(path,category,rf)")
+        p.add_argument("--checkpoint", default=None, metavar="NPZ",
+                       help="snapshot the controller state here every "
+                            "--checkpoint_every windows; rerunning the same "
+                            "command resumes with an identical plan "
+                            "sequence")
+        p.add_argument("--checkpoint_every", type=int, default=1,
+                       metavar="W")
+        p.add_argument("--max_windows", type=int, default=None,
+                       help="stop after N processed windows (stepping a "
+                            "live controller)")
+        p.add_argument("--no_evaluate", action="store_true",
+                       help="skip the per-window locality/balance replay")
+        p.add_argument("--medians_from_data", action="store_true")
+        p.add_argument("--scoring_config", default=None,
+                       metavar="JSON|validated")
+        _add_backend_arg(p)
+        _add_init_method_arg(p)
+
     p = sub.add_parser("control", help="online replication controller: "
                        "windowed drift detection -> incremental re-cluster "
                        "-> bounded-churn migration")
-    p.add_argument("--manifest", required=True)
-    p.add_argument("--access_log", required=True,
-                   help="globally time-sorted log (CSV access.log or .cdrsb)")
-    p.add_argument("--window_seconds", type=float, default=60.0)
-    p.add_argument("--k", type=int, default=8)
-    p.add_argument("--seed", type=int, default=42)
-    p.add_argument("--drift_threshold", type=float, default=0.05,
-                   help="drift score at/above which a re-cluster runs")
-    p.add_argument("--full_drift", type=float, default=0.30, metavar="SCORE",
-                   help="drift at/above which the warm start is abandoned "
-                        "(fresh init, full iteration budget)")
-    p.add_argument("--warm_max_iter", type=int, default=25)
-    p.add_argument("--max_bytes", type=int, default=None, metavar="BYTES",
-                   help="per-window migration byte budget (default: "
-                        "unbounded)")
-    p.add_argument("--max_files", type=int, default=None, metavar="N",
-                   help="per-window migrated-file cap (default: unbounded)")
-    p.add_argument("--hysteresis", type=int, default=1, metavar="WINDOWS",
-                   help="windows a migrated file stays frozen (anti-flap)")
-    p.add_argument("--decay", type=float, default=1.0,
-                   help="per-window feature-counter decay; < 1.0 re-weights "
-                        "toward recent traffic (numpy backend)")
-    p.add_argument("--default_rf", type=int, default=1)
-    p.add_argument("--batch_size", type=int, default=1_000_000,
-                   help="events per log read batch (windows re-slice it)")
-    _add_metrics_arg(p)  # window records interleave with the telemetry
-    p.add_argument("--plan_out", default=None, metavar="CSV",
-                   help="write the final applied plan (path,category,rf)")
-    p.add_argument("--checkpoint", default=None, metavar="NPZ",
-                   help="snapshot the controller state here every "
-                        "--checkpoint_every windows; rerunning the same "
-                        "command resumes with an identical plan sequence")
-    p.add_argument("--checkpoint_every", type=int, default=1, metavar="W")
-    p.add_argument("--max_windows", type=int, default=None,
-                   help="stop after N processed windows (stepping a live "
-                        "controller)")
-    p.add_argument("--no_evaluate", action="store_true",
-                   help="skip the per-window locality/balance replay")
-    p.add_argument("--medians_from_data", action="store_true")
-    p.add_argument("--scoring_config", default=None, metavar="JSON|validated")
-    _add_backend_arg(p)
-    _add_init_method_arg(p)
+    _add_control_args(p)
     p.set_defaults(fn=_cmd_control)
+
+    p = sub.add_parser("chaos", help="fault-injected controller run: node "
+                       "crash/recover/decommission/flaky events, durability "
+                       "accounting, self-healing re-replication under the "
+                       "migration churn budget")
+    _add_control_args(p)
+    p.add_argument("--kill", action="append", metavar="NODE@W[-W2]",
+                   help="crash NODE at window W (optionally recovering "
+                        "after W2, e.g. dn2@3-7); repeatable")
+    p.add_argument("--recover", action="append", metavar="NODE@W",
+                   help="recover a crashed NODE at window W; repeatable")
+    p.add_argument("--decommission", action="append", metavar="NODE@W",
+                   help="permanently remove NODE at window W (replicas "
+                        "destroyed); repeatable")
+    p.add_argument("--flaky", action="append", metavar="NODE@W[-W2][:P]",
+                   help="repair copies to NODE fail with probability P "
+                        "(default 0.5) over windows W..W2, e.g. "
+                        "dn1@2-6:0.5; repeatable")
+    p.add_argument("--schedule", default=None, metavar="JSON",
+                   help="load additional fault events from a JSON file "
+                        "(the --schedule_out format)")
+    p.add_argument("--schedule_out", default=None, metavar="JSON",
+                   help="write the expanded schedule here (replayable via "
+                        "--schedule)")
+    p.add_argument("--random_faults", type=int, default=None, metavar="W",
+                   help="add a seeded random schedule spanning W windows "
+                        "(never downs the last node)")
+    p.add_argument("--fault_seed", type=int, default=0,
+                   help="seed of --random_faults")
+    p.add_argument("--repair_seed", type=int, default=0,
+                   help="seed of the deterministic flaky-failure rolls")
+    p.set_defaults(fn=_cmd_chaos)
 
     p = sub.add_parser("bench", help="benchmark harness (BASELINE.md configs)")
     p.add_argument("--config", type=int, default=1)

@@ -9,6 +9,7 @@ minus rack topology).  Deterministic given (manifest, rf, seed).
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -16,6 +17,11 @@ import numpy as np
 from ..io.events import Manifest
 
 __all__ = ["ClusterTopology", "PlacementResult", "place_replicas"]
+
+#: One warning per process: the cap itself is HDFS behaviour and placement
+#: runs per window in the controller — the *first* silent downgrade is the
+#: operator-relevant event (e.g. Archival rf=4 on a 3-node topology).
+_RF_CAP_WARNED = False
 
 
 @dataclass
@@ -76,7 +82,24 @@ def place_replicas(
     ], dtype=np.int32)
     primary = lut[manifest.primary_node_id]
 
-    rf = np.minimum(np.asarray(rf_per_file, dtype=np.int32), n_nodes)
+    rf_want = np.asarray(rf_per_file, dtype=np.int32)
+    n_capped = int((rf_want > n_nodes).sum())
+    if n_capped:
+        global _RF_CAP_WARNED
+        if not _RF_CAP_WARNED:
+            _RF_CAP_WARNED = True
+            warnings.warn(
+                f"replication factor capped at the node count for "
+                f"{n_capped} files (requested up to {int(rf_want.max())}, "
+                f"topology has {n_nodes} nodes) — replicas are "
+                f"distinct-per-node, so e.g. Archival rf=4 on a 3-node "
+                f"topology places 3", stacklevel=2)
+        from ..obs import current as _obs_current
+
+        tel = _obs_current()
+        if tel is not None:
+            tel.counter_inc("placement.rf_capped", n_capped)
+    rf = np.minimum(rf_want, n_nodes)
     rf = np.maximum(rf, 1)
     max_rf = int(rf.max())
 

@@ -90,6 +90,15 @@ class ControllerConfig:
     #: Replay window events against the simulated cluster before/after the
     #: window's moves (cluster/evaluate.py).
     evaluate: bool = True
+    #: Fault feed (faults/schedule.FaultSchedule): node crash/recover/
+    #: decommission/flaky events keyed to window indices.  When set the
+    #: controller maintains a mutable ClusterState, accounts durability
+    #: tiers per window, and runs the repair planner against the SAME
+    #: byte/file churn budget as drift migrations (repairs first).
+    fault_schedule: object | None = None
+    #: Seed of the deterministic flaky-target failure rolls
+    #: (faults/repair.py) — stateless, so kill/resume replays them.
+    repair_seed: int = 0
 
     def __post_init__(self):
         if self.window_seconds <= 0:
@@ -127,7 +136,7 @@ class ControllerResult:
 
     def summary(self) -> dict:
         recl = [r for r in self.records if r["recluster"]]
-        return {
+        out = {
             "windows": len(self.records),
             "events": int(sum(r["n_events"] for r in self.records)),
             "reclusters": len(recl),
@@ -141,6 +150,28 @@ class ControllerResult:
             # processed zero new windows still reports the real plan.
             "final_plan_hash": _plan_hash(self.rf, self.category_idx),
         }
+        dur = [r for r in self.records if r.get("durability")]
+        if dur:
+            last = dur[-1]["durability"]
+            out["durability"] = {
+                "fault_events": sum(len(r.get("fault_events") or ())
+                                    for r in self.records),
+                "files_lost_max": max(r["durability"]["lost"]
+                                      for r in dur),
+                "lost_final": last["lost"],
+                "at_risk_final": last["at_risk"],
+                "under_replicated_final": last["under_replicated"],
+                "nodes_up_final": last["nodes_up"],
+                "repair_moves_total": int(sum(r.get("repair_moves", 0)
+                                              for r in self.records)),
+                "repair_bytes_total": int(sum(r.get("repair_bytes", 0)
+                                              for r in self.records)),
+                "repair_failed_total": int(sum(r.get("repair_failed", 0)
+                                               for r in self.records)),
+                "unavailable_reads": int(sum(
+                    r.get("unavailable_reads", 0) for r in self.records)),
+            }
+        return out
 
 
 def _plan_hash(rf: np.ndarray, cat: np.ndarray) -> str:
@@ -184,13 +215,8 @@ class ReplicationController:
             self._dec_obs_end: float | None = None
         self._events_total = 0
 
-        self._model_full = ReplicationPolicyModel(
-            kmeans_cfg=cfg.kmeans, scoring_cfg=cfg.scoring,
-            backend=cfg.backend, mesh_shape=cfg.mesh_shape)
-        warm_km = dataclasses.replace(cfg.kmeans, max_iter=cfg.warm_max_iter)
-        self._model_warm = ReplicationPolicyModel(
-            kmeans_cfg=warm_km, scoring_cfg=cfg.scoring,
-            backend=cfg.backend, mesh_shape=cfg.mesh_shape)
+        self._model_full = self._make_model(warm=False)
+        self._model_warm = self._make_model(warm=True)
 
         self._accepted_centroids: np.ndarray | None = None
         self._accepted_category_idx: np.ndarray | None = None
@@ -204,6 +230,24 @@ class ReplicationController:
             hysteresis_windows=cfg.hysteresis_windows)
         self._placement_key: bytes | None = None
         self._placement = None
+        #: Fault-tolerance state (faults/): only when a schedule is set.
+        self._cluster_state = None
+        self._repairs = None
+        if cfg.fault_schedule is not None:
+            from ..cluster import ClusterTopology, place_replicas
+            from ..faults import ClusterState, RepairScheduler
+
+            topology = ClusterTopology(nodes=tuple(manifest.nodes))
+            cfg.fault_schedule.validate_nodes(topology.nodes)
+            placement = place_replicas(manifest, self.current_rf, topology,
+                                       seed=0)
+            self._cluster_state = ClusterState(placement, self._sizes)
+            self._repairs = RepairScheduler(seed=cfg.repair_seed)
+        #: One warning per controller when the jax kernel path degrades to
+        #: the numpy fallback (fault-tolerance part 4).
+        self._kernel_fallback_warned = False
+        #: Lazy numpy fallback models, built at the first kernel failure.
+        self._fallback_models: dict[bool, ReplicationPolicyModel] = {}
         #: Lazy decision-quality auditor (obs/audit.py); created at the
         #: first audited window so telemetry-off runs never import it.
         self._auditor = None
@@ -213,6 +257,19 @@ class ReplicationController:
         #: instead of silently dropping it.
         self._last_window_events = 0
         self._t0: float | None = None
+
+    def _make_model(self, warm: bool,
+                    backend: str | None = None) -> ReplicationPolicyModel:
+        """The full or warm-start policy model (warm = the bounded
+        ``warm_max_iter`` Lloyd budget).  ``backend`` overrides the
+        configured one — the degraded numpy fallback's only difference."""
+        cfg = self.cfg
+        km = cfg.kmeans if not warm else dataclasses.replace(
+            cfg.kmeans, max_iter=cfg.warm_max_iter)
+        backend = backend or cfg.backend
+        return ReplicationPolicyModel(
+            kmeans_cfg=km, scoring_cfg=cfg.scoring, backend=backend,
+            mesh_shape=cfg.mesh_shape if backend == cfg.backend else None)
 
     # -- feature fold ------------------------------------------------------
     def _fold_window(self, events: EventLog, new_window: bool = True) -> None:
@@ -292,6 +349,15 @@ class ReplicationController:
         seconds["fold"] = time.perf_counter() - t0
         rec["events_total"] = int(self._events_total)
 
+        if self._cluster_state is not None:
+            t0 = time.perf_counter()
+            fault_events = cfg.fault_schedule.for_window(w)
+            for ev in fault_events:
+                self._cluster_state.apply_event(ev)
+            rec["fault_events"] = [ev.spec() for ev in fault_events]
+            rec["nodes_up"] = self._cluster_state.n_available
+            seconds["faults"] = time.perf_counter() - t0
+
         X = None
         drift = None
         t0 = time.perf_counter()
@@ -319,19 +385,61 @@ class ReplicationController:
             rec["recluster_mode"] = "warm" if warm else "full"
             if X is None:
                 X = self._feature_snapshot()
+            init = self._accepted_centroids if warm else None
             model = self._model_warm if warm else self._model_full
-            decision = model.run(
-                X, init_centroids=self._accepted_centroids if warm else None)
+            try:
+                decision = model.run(X, init_centroids=init)
+            except Exception as e:
+                if cfg.backend != "jax":
+                    raise
+                decision = self._degraded_recluster(warm, X, init, e)
+                rec["degraded_kernel"] = True
             self._accept(decision)
             rec["plan_moves_pending"] = len(self.scheduler.backlog)
         seconds["recluster"] = time.perf_counter() - t0
 
-        t0 = time.perf_counter()
+        # Pre-mutation placement snapshot for the before/after replay (the
+        # fault path's placement is the mutable ClusterState, so "before"
+        # must be rendered now, not re-derived from an rf vector later).
+        view_before = None
+        ver_before = -1
+        want_eval = cfg.evaluate and len(events) > 0
+        if self._cluster_state is not None and want_eval:
+            view_before = self._cluster_state.placement_view()
+            ver_before = self._cluster_state.version
         rf_before = self.current_rf.copy() if cfg.evaluate else None
-        applied = self.scheduler.schedule(w)
+
+        # Repairs run FIRST and pre-charge the churn budget: re-replication
+        # traffic outranks drift migrations for the same per-window
+        # byte/file allowance (faults/repair.py module docstring).
+        bytes_reserved = files_reserved = 0
+        if self._cluster_state is not None:
+            t0 = time.perf_counter()
+            self._repairs.sync(self._cluster_state, self.current_rf)
+            rr = self._repairs.schedule(
+                w, self._cluster_state, self.current_rf, self.current_cat,
+                max_bytes=cfg.max_bytes_per_window,
+                max_files=cfg.max_files_per_window)
+            seconds["repair"] = time.perf_counter() - t0
+            rec["repair_moves"] = len(rr.applied)
+            rec["repair_bytes"] = int(rr.bytes_used)
+            rec["repair_failed"] = rr.failed
+            rec["repair_backlog"] = len(self._repairs.backlog)
+            rec["repair_deferred_budget"] = rr.deferred_budget
+            rec["repair_deferred_backoff"] = rr.deferred_backoff
+            rec["repair_deferred_no_source"] = rr.deferred_no_source
+            rec["repair_deferred_no_target"] = rr.deferred_no_target
+            bytes_reserved = rr.bytes_used
+            files_reserved = rr.files_touched
+
+        t0 = time.perf_counter()
+        applied = self.scheduler.schedule(w, bytes_reserved=bytes_reserved,
+                                          files_reserved=files_reserved)
         for m in applied:
             self.current_rf[m.file_index] = m.rf_new
             self.current_cat[m.file_index] = m.cat_new
+            if self._cluster_state is not None:
+                self._cluster_state.apply_rf_target(m.file_index, m.rf_new)
         seconds["schedule"] = time.perf_counter() - t0
         rec["moves_applied"] = len(applied)
         rec["bytes_migrated"] = int(sum(m.bytes_moved for m in applied))
@@ -340,18 +448,49 @@ class ReplicationController:
         rec["deferred_hysteresis"] = self.scheduler.last_deferred_hysteresis
         rec["deferred_budget"] = self.scheduler.last_deferred_budget
 
+        if self._cluster_state is not None:
+            rec["durability"] = self._cluster_state.durability(
+                self.current_rf, self.current_cat, CATEGORIES)
+            if len(events):
+                # Reads the outage actually refused this window: reads of
+                # files with zero live replicas.
+                lost = self._cluster_state.lost_mask()
+                keep = events.path_id >= 0
+                pid = events.path_id[keep]
+                reads = np.asarray(events.op)[keep] == 0
+                rec["unavailable_reads"] = int(lost[pid[reads]].sum())
+            else:
+                rec["unavailable_reads"] = 0
+
         t0 = time.perf_counter()
         rec["locality_before"] = rec["locality_after"] = None
         rec["balance_before"] = rec["balance_after"] = None
-        if cfg.evaluate and len(events):
-            rec["locality_before"], rec["balance_before"] = \
-                self._evaluate(events, rf_before)
-            if applied:
-                rec["locality_after"], rec["balance_after"] = \
-                    self._evaluate(events, self.current_rf)
+        if want_eval:
+            if self._cluster_state is not None:
+                from ..cluster import evaluate_placement
+
+                mb = evaluate_placement(self.manifest, events, view_before,
+                                        seed=0)
+                rec["locality_before"] = float(mb.read_locality)
+                rec["balance_before"] = float(mb.load_balance)
+                if self._cluster_state.version != ver_before:
+                    ma = evaluate_placement(
+                        self.manifest, events,
+                        self._cluster_state.placement_view(), seed=0)
+                    rec["locality_after"] = float(ma.read_locality)
+                    rec["balance_after"] = float(ma.load_balance)
+                else:
+                    rec["locality_after"] = rec["locality_before"]
+                    rec["balance_after"] = rec["balance_before"]
             else:
-                rec["locality_after"] = rec["locality_before"]
-                rec["balance_after"] = rec["balance_before"]
+                rec["locality_before"], rec["balance_before"] = \
+                    self._evaluate(events, rf_before)
+                if applied:
+                    rec["locality_after"], rec["balance_after"] = \
+                        self._evaluate(events, self.current_rf)
+                else:
+                    rec["locality_after"] = rec["locality_before"]
+                    rec["balance_after"] = rec["balance_before"]
         seconds["evaluate"] = time.perf_counter() - t0
 
         rec["plan_hash"] = _plan_hash(self.current_rf, self.current_cat)
@@ -401,8 +540,63 @@ class ReplicationController:
         if rec["deferred_budget"]:
             tel.counter_inc("migrate.deferred_budget",
                             rec["deferred_budget"])
+        if rec.get("fault_events"):
+            tel.counter_inc("fault.events", len(rec["fault_events"]))
+        dur = rec.get("durability")
+        if dur is not None:
+            tel.gauge("durability.under_replicated",
+                      dur["under_replicated"])
+            tel.gauge("durability.at_risk", dur["at_risk"])
+            tel.gauge("durability.lost", dur["lost"])
+            tel.gauge("durability.nodes_up", dur["nodes_up"])
+            if rec.get("unavailable_reads"):
+                tel.counter_inc("fault.unavailable_reads",
+                                rec["unavailable_reads"])
+        if rec.get("repair_moves"):
+            tel.counter_inc("repair.files_replicated", rec["repair_moves"])
+        if rec.get("repair_bytes"):
+            tel.counter_inc("repair.bytes", rec["repair_bytes"])
+        if rec.get("repair_failed"):
+            tel.counter_inc("repair.failed", rec["repair_failed"])
+        if rec.get("repair_deferred_budget"):
+            tel.counter_inc("repair.deferred_budget",
+                            rec["repair_deferred_budget"])
+        if rec.get("repair_deferred_no_source"):
+            tel.counter_inc("repair.deferred_no_source",
+                            rec["repair_deferred_no_source"])
+        if rec.get("repair_deferred_no_target"):
+            tel.counter_inc("repair.deferred_no_target",
+                            rec["repair_deferred_no_target"])
         for stage, secs in seconds.items():
             tel.histogram(f"controller.{stage}.seconds", secs)
+
+    def _degraded_recluster(self, warm: bool, X, init, err: Exception):
+        """Degraded mode: the jax kernel path failed (device lost, OOM,
+        compile error) — re-cluster on the numpy backend instead of
+        crashing the control loop.  The decision is equivalent in kind
+        (same Lloyd/scoring semantics, ops/kmeans_np.py is the golden
+        model) if not bit-identical; the ``degraded.kernel_fallback``
+        counter and a one-time warning record that it happened."""
+        import warnings
+
+        if not self._kernel_fallback_warned:
+            self._kernel_fallback_warned = True
+            warnings.warn(
+                f"jax kernel failed ({type(err).__name__}: {err}); "
+                f"falling back to the numpy backend for re-clustering",
+                RuntimeWarning, stacklevel=2)
+        from ..obs import current as _obs_current
+
+        tel = _obs_current()
+        if tel is not None:
+            tel.counter_inc("degraded.kernel_fallback")
+        if warm not in self._fallback_models:
+            self._fallback_models[warm] = self._make_model(
+                warm, backend="numpy")
+        X64 = np.asarray(X, dtype=np.float64)
+        init64 = None if init is None else np.asarray(init,
+                                                      dtype=np.float64)
+        return self._fallback_models[warm].run(X64, init_centroids=init64)
 
     def _accept(self, decision) -> None:
         """Adopt a new model + plan: diff against the APPLIED plan, rebuild
@@ -474,6 +668,9 @@ class ReplicationController:
             arrays["accepted_category_idx"] = self._accepted_category_idx
             arrays["accepted_fractions"] = self._accepted_fractions
         arrays.update(self.scheduler.state_arrays())
+        if self._cluster_state is not None:
+            arrays.update(self._cluster_state.state_arrays())
+            arrays.update(self._repairs.state_arrays())
         meta = {
             "window_index": self.window_index,
             "last_window_events": self._last_window_events,
@@ -489,6 +686,7 @@ class ReplicationController:
             "k": int(self.cfg.kmeans.k),
             "backend": self.cfg.backend,
             "n_files": len(self.manifest),
+            "faults": self._cluster_state is not None,
         }
         if self.cfg.backend == "jax":
             meta["pad_events"] = self._state.pad_events
@@ -508,6 +706,15 @@ class ReplicationController:
                     f"checkpoint {path!r} has {key}={meta.get(key)!r} but "
                     f"the controller expects {want!r} — stale checkpoint? "
                     f"delete it to start over")
+        # Fault-mode flag checked separately: pre-fault checkpoints carry
+        # no "faults" key and must keep loading in non-fault controllers.
+        if bool(meta.get("faults", False)) != (self._cluster_state
+                                               is not None):
+            raise ValueError(
+                f"checkpoint {path!r} has faults="
+                f"{bool(meta.get('faults', False))} but the controller "
+                f"expects {self._cluster_state is not None} — stale "
+                f"checkpoint? delete it to start over")
         if self.cfg.backend == "jax":
             import jax.numpy as jnp
 
@@ -536,10 +743,62 @@ class ReplicationController:
             self._accepted_category_idx = arrays["accepted_category_idx"]
             self._accepted_fractions = arrays["accepted_fractions"]
         self.scheduler.load_state_arrays(arrays)
+        if self._cluster_state is not None:
+            self._cluster_state.load_state_arrays(arrays)
+            self._repairs.load_state_arrays(arrays)
         self.window_index = int(meta["window_index"])
         self._last_window_events = int(meta.get("last_window_events", 0))
         self._t0 = meta.get("t0")
         self._events_total = int(meta.get("events_total", 0))
+
+    def _load_checkpoint_with_fallback(self, path: str) -> None:
+        """Resume from ``path``; a corrupt/truncated snapshot (power cut
+        mid-write, disk fault) degrades to the retained last-good
+        ``<path>.prev`` copy (utils/checkpoint.save_state) instead of
+        crashing — the fallback window is one checkpoint interval older,
+        and the deterministic loop re-processes forward from it to the
+        identical state.  Config-mismatch ValueErrors still raise: a
+        *stale* checkpoint is an operator error, not a fault."""
+        import warnings
+
+        from ..utils.checkpoint import CheckpointError
+
+        prev = path + ".prev"
+        if not os.path.exists(path):
+            # A deleted checkpoint always means "start over" — save_state
+            # retains .prev by hardlink, so path only vanishes by hand.
+            return
+        try:
+            self.load_checkpoint(path)
+            return
+        except CheckpointError as e:
+            if not os.path.exists(prev):
+                raise
+            warnings.warn(
+                f"{e}; falling back to the retained last-good "
+                f"snapshot {prev!r}", RuntimeWarning, stacklevel=2)
+        from ..obs import current as _obs_current
+
+        tel = _obs_current()
+        if tel is not None:
+            tel.counter_inc("degraded.checkpoint_fallback")
+        self.load_checkpoint(prev)
+        # Promote the good snapshot back over the corrupt ``path``:
+        # otherwise the next save_state would retain the corrupt file as
+        # the new ``.prev``, destroying the very snapshot just resumed
+        # from.  Prefer a link so ``.prev`` survives too.
+        tmp = prev + ".promote"
+        try:
+            if os.path.exists(tmp):  # leftover from a crashed promotion
+                os.unlink(tmp)
+            os.link(prev, tmp)
+            os.replace(tmp, path)
+        except OSError:
+            import shutil
+
+            # No hardlinks: promote by copy so ``.prev`` survives too.
+            shutil.copyfile(prev, tmp)
+            os.replace(tmp, path)
 
     # -- the loop ----------------------------------------------------------
     def run(self, source, *, metrics_path: str | None = None,
@@ -581,8 +840,8 @@ class ReplicationController:
         call (resume-skipped windows don't count) — the kill/resume test
         hook, also useful for stepping a live controller.
         """
-        if checkpoint_path and os.path.exists(checkpoint_path):
-            self.load_checkpoint(checkpoint_path)
+        if checkpoint_path:
+            self._load_checkpoint_with_fallback(checkpoint_path)
         records: list[dict] = []
         sink = None
         if metrics_path:

@@ -104,7 +104,8 @@ class MigrationScheduler:
         """Replace the backlog with the newest plan's pending moves."""
         self.backlog = {m.file_index: m for m in moves}
 
-    def schedule(self, window_index: int) -> list[PlanMove]:
+    def schedule(self, window_index: int, *, bytes_reserved: int = 0,
+                 files_reserved: int = 0) -> list[PlanMove]:
         """Pop this window's moves (budgeted, prioritized, hysteresis-gated).
 
         Byte budget: a byte-moving move is admitted while ``bytes_used +
@@ -117,15 +118,24 @@ class MigrationScheduler:
         drops, category-only changes) are metadata operations the byte
         budget never blocks; the file cap still counts them and is strict.
         Scheduled moves leave the backlog and stamp ``last_moved``.
+
+        ``bytes_reserved``/``files_reserved`` pre-charge the window's
+        budget with traffic another producer already spent — the
+        controller's repair pass (faults/repair.py) runs first and hands
+        its consumption here, so repair and drift-migration traffic
+        compete for ONE churn allowance.  A nonzero reservation also
+        disables the oversized-move allowance for this window (the first
+        byte-moving operation was the reserver's).
         """
         order = sorted(self.backlog.values(),
                        key=lambda m: (-m.priority, m.file_index))
         applied: list[PlanMove] = []
-        bytes_used = 0
+        bytes_used = int(bytes_reserved)
         self.last_deferred_hysteresis = 0
         self.last_deferred_budget = 0
         for m in order:
-            if self.max_files is not None and len(applied) >= self.max_files:
+            if self.max_files is not None \
+                    and len(applied) + int(files_reserved) >= self.max_files:
                 break
             if window_index < int(self.last_moved[m.file_index]) \
                     + 1 + self.hysteresis:
@@ -163,14 +173,44 @@ class MigrationScheduler:
         return out
 
     def load_state_arrays(self, arrays: dict) -> None:
-        lm = np.asarray(arrays["sched_last_moved"], dtype=np.int64)
+        """Restore the backlog + freeze stamps, validating shapes/dtypes
+        against ``n_files`` up front — a truncated or foreign checkpoint
+        must fail here with a message, not later with an opaque
+        IndexError deep in ``schedule``."""
+        missing = [k for k in ("sched_last_moved", "sched_priority",
+                               *("sched_" + c for c in self._MOVE_COLS))
+                   if k not in arrays]
+        if missing:
+            raise ValueError(
+                f"checkpoint is missing scheduler arrays {missing} — "
+                f"not a controller snapshot?")
+        lm = np.asarray(arrays["sched_last_moved"])
         if lm.shape != (self.n_files,):
             raise ValueError(
-                f"checkpoint covers {lm.shape[0]} files, scheduler has "
-                f"{self.n_files}")
-        self.last_moved = lm.copy()
+                f"checkpoint covers {lm.shape[0] if lm.ndim == 1 else lm.shape} "
+                f"files, scheduler has {self.n_files}")
+        if not np.issubdtype(lm.dtype, np.integer):
+            raise ValueError(
+                f"sched_last_moved dtype {lm.dtype} is not integral")
+        self.last_moved = lm.astype(np.int64).copy()
         cols = [np.asarray(arrays["sched_" + c]) for c in self._MOVE_COLS]
         prio = np.asarray(arrays["sched_priority"], dtype=np.float64)
+        n_moves = cols[0].shape[0] if cols[0].ndim == 1 else -1
+        for name, a in zip((*self._MOVE_COLS, "priority"), (*cols, prio)):
+            if a.ndim != 1 or a.shape[0] != n_moves:
+                raise ValueError(
+                    f"scheduler backlog column sched_{name} has shape "
+                    f"{a.shape}, expected ({n_moves},)")
+            if name != "priority" and not np.issubdtype(a.dtype,
+                                                        np.integer):
+                raise ValueError(
+                    f"scheduler backlog column sched_{name} dtype "
+                    f"{a.dtype} is not integral")
+        if n_moves and ((cols[0] < 0) | (cols[0] >= self.n_files)).any():
+            bad = cols[0][(cols[0] < 0) | (cols[0] >= self.n_files)]
+            raise ValueError(
+                f"scheduler backlog names file indices outside "
+                f"[0, {self.n_files}): {bad[:5].tolist()}")
         self.backlog = {
             int(cols[0][i]): PlanMove(
                 file_index=int(cols[0][i]), rf_old=int(cols[1][i]),

@@ -1,0 +1,32 @@
+"""Fault injection & self-healing: the durability axis the paper's
+category -> replication-factor mapping was designed for.
+
+Pieces:
+
+* ``FaultSchedule`` (schedule.py) — seeded, deterministic node events
+  (crash/recover/decommission/flaky) keyed to controller windows.
+* ``ClusterState`` (state.py) — the mutable cluster: node liveness, the
+  evolving replica map, vectorized durability tiers (under-replicated /
+  at-risk / lost), and the ``placement_view`` bridge back into the
+  immutable evaluation world.
+* ``RepairScheduler`` (repair.py) — HDFS-style re-replication under the
+  same per-window churn budget as drift migrations, with deterministic
+  flaky-failure rolls + exponential backoff.
+
+The online controller (control/controller.py) wires these into its window
+loop when ``ControllerConfig.fault_schedule`` is set; ``cdrs chaos`` is
+the CLI entry and ``benchmarks/chaos_bench.py`` the durability baseline.
+"""
+
+from .repair import RepairReport, RepairScheduler, RepairTask
+from .schedule import FaultEvent, FaultSchedule
+from .state import ClusterState
+
+__all__ = [
+    "ClusterState",
+    "FaultEvent",
+    "FaultSchedule",
+    "RepairReport",
+    "RepairScheduler",
+    "RepairTask",
+]

@@ -1,0 +1,197 @@
+"""Repair planning: re-replication moves under the shared churn budget.
+
+The HDFS namenode's re-replication queue (Shvachko et al. MSST 2010) in the
+controller's vocabulary: every window the scheduler re-derives the work
+list from ``ClusterState`` (files below their effective target rf), orders
+it **lost > at-risk > under-replicated** (tie-broken by category rf
+descending, then file index — the highest-durability categories heal
+first), and admits replica copies against the SAME per-window byte/file
+budget the migration scheduler uses: the controller runs repairs first and
+hands the consumed budget to ``MigrationScheduler.schedule`` as a
+reservation, so repair traffic and drift-migration traffic genuinely
+compete for one churn allowance instead of stacking two.
+
+Failure handling: a copy targeting a flaky node (ClusterState
+``node_fail_prob``) fails with that probability — decided by a *stateless*
+seeded roll keyed on (seed, window, file, attempt), so a killed/resumed
+controller replays identical outcomes without carrying RNG state.  A
+failed file backs off exponentially (``window + 2^attempts``, capped) and
+its retry rotates to a different candidate node.  Failed copies still
+consume byte budget — the traffic was spent on the wire.
+
+Lost files (0 live replicas) cannot be repaired — there is no source to
+copy from; they sit at the head of the queue and heal the moment a crashed
+holder recovers (recovery makes them merely under-replicated).  The
+scheduler reports them as ``deferred_no_source`` so the degraded-mode
+accounting (controller + obs/audit.py ``durability_lost`` flag) sees them
+every window.
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["RepairTask", "RepairReport", "RepairScheduler"]
+
+#: Backoff cap: a permanently failing target must not push the retry past
+#: the horizon of any realistic run.
+_MAX_BACKOFF = 64
+
+
+@dataclass
+class RepairTask:
+    """One under-replicated file's pending repair."""
+
+    file_index: int
+    attempts: int = 0
+    #: First window the task is eligible again (exponential backoff).
+    next_window: int = 0
+
+
+@dataclass
+class RepairReport:
+    """What one window's repair pass did (per-window observation)."""
+
+    applied: list[tuple[int, int, int]] = field(default_factory=list)
+    #: Byte budget consumed, INCLUDING failed copies (traffic was spent).
+    bytes_used: int = 0
+    files_touched: int = 0
+    failed: int = 0
+    deferred_budget: int = 0
+    deferred_backoff: int = 0
+    deferred_no_source: int = 0
+    deferred_no_target: int = 0
+
+
+def _fail_roll(seed: int, window: int, fid: int, attempt: int,
+               copy: int = 0) -> float:
+    """Deterministic uniform [0, 1) — stateless, so resume replays it.
+    ``copy`` is the file's in-window copy index: a file missing several
+    replicas draws an independent roll per copy."""
+    key = np.asarray([seed, window, fid, attempt, copy], dtype=np.int64)
+    return zlib.crc32(key.tobytes()) / 2.0 ** 32
+
+
+class RepairScheduler:
+    """Backlog of RepairTasks + the budgeted per-window repair pass."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.backlog: dict[int, RepairTask] = {}
+
+    def sync(self, state, target_rf: np.ndarray) -> None:
+        """Re-derive the backlog from the cluster's current gaps: newly
+        damaged files enter, files healed by a recover/migration leave
+        (and their attempt counters reset with them), files still damaged
+        keep their backoff state.  Also prunes excess replicas a recovered
+        node resurfaced (free)."""
+        state.trim_excess(target_rf)
+        fids, _live, _eff = state.repair_needs(target_rf)
+        self.backlog = {int(f): self.backlog.get(int(f), RepairTask(int(f)))
+                        for f in fids}
+
+    def schedule(self, window: int, state, target_rf: np.ndarray,
+                 cat: np.ndarray, *, max_bytes: int | None = None,
+                 max_files: int | None = None) -> RepairReport:
+        """One window's repair pass; mutates ``state`` and the backlog.
+
+        Budget semantics mirror MigrationScheduler: a copy is admitted
+        while ``bytes_used + size <= max_bytes`` except that a single copy
+        larger than the whole budget is admitted as the window's first
+        byte-moving operation (the largest file must not starve);
+        ``max_bytes == 0`` is a true freeze.  ``max_files`` caps distinct
+        files repaired this window.
+        """
+        rep = RepairReport()
+        if not self.backlog:
+            return rep
+        live = state.live_counts()
+        eff = state.effective_target(target_rf)
+        cat = np.asarray(cat)
+        rf_vec = np.asarray(target_rf, dtype=np.int64)
+
+        def prio(t: RepairTask):
+            f = t.file_index
+            tier = 0 if live[f] == 0 else (1 if live[f] == 1 else 2)
+            return (tier, -int(rf_vec[f]), f)
+
+        order = sorted(self.backlog.values(), key=prio)
+        touched: set[int] = set()
+        healed: list[int] = []
+        for task in order:
+            f = task.file_index
+            if task.next_window > window:
+                rep.deferred_backoff += 1
+                continue
+            if live[f] == 0:
+                rep.deferred_no_source += 1
+                continue
+            if max_files is not None and f not in touched \
+                    and len(touched) >= max_files:
+                rep.deferred_budget += 1
+                continue
+            size = int(state.sizes[f])
+            copy = 0
+            while live[f] < eff[f]:
+                if max_bytes is not None:
+                    over = rep.bytes_used + size > max_bytes
+                    first = rep.bytes_used == 0 and max_bytes > 0
+                    if over and not first:
+                        rep.deferred_budget += 1
+                        break
+                target = state.pick_repair_target(
+                    f, rotate=task.attempts + copy)
+                if target < 0:
+                    rep.deferred_no_target += 1
+                    break
+                p = float(state.node_fail_prob[target])
+                if p > 0.0 and _fail_roll(self.seed, window, f,
+                                          task.attempts, copy) < p:
+                    # Mid-window target failure: traffic spent, copy lost.
+                    task.attempts += 1
+                    task.next_window = window + min(2 ** task.attempts,
+                                                    _MAX_BACKOFF)
+                    rep.failed += 1
+                    rep.bytes_used += size
+                    touched.add(f)
+                    break
+                state.add_replica(f, target)
+                live[f] += 1
+                rep.bytes_used += size
+                rep.applied.append((f, int(target), size))
+                touched.add(f)
+            if live[f] >= eff[f]:
+                healed.append(f)
+        for f in healed:
+            self.backlog.pop(f, None)
+        rep.files_touched = len(touched)
+        return rep
+
+    # -- checkpoint (rides the controller's utils/checkpoint npz) -----------
+    def state_arrays(self) -> dict[str, np.ndarray]:
+        tasks = sorted(self.backlog.values(), key=lambda t: t.file_index)
+        return {
+            "repair_file_index": np.asarray(
+                [t.file_index for t in tasks], dtype=np.int64),
+            "repair_attempts": np.asarray(
+                [t.attempts for t in tasks], dtype=np.int64),
+            "repair_next_window": np.asarray(
+                [t.next_window for t in tasks], dtype=np.int64),
+        }
+
+    def load_state_arrays(self, arrays: dict) -> None:
+        fid = np.asarray(arrays["repair_file_index"], dtype=np.int64)
+        att = np.asarray(arrays["repair_attempts"], dtype=np.int64)
+        nxt = np.asarray(arrays["repair_next_window"], dtype=np.int64)
+        if not (fid.shape == att.shape == nxt.shape):
+            raise ValueError(
+                f"repair backlog arrays disagree on length: "
+                f"{fid.shape} vs {att.shape} vs {nxt.shape}")
+        self.backlog = {
+            int(fid[i]): RepairTask(int(fid[i]), attempts=int(att[i]),
+                                    next_window=int(nxt[i]))
+            for i in range(fid.shape[0])
+        }

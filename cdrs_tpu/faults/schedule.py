@@ -1,0 +1,213 @@
+"""Seeded, deterministic fault schedules keyed to controller windows.
+
+The paper's category -> replication-factor mapping (Hot=3, Shared=2,
+Moderate=1, Archival=4) exists to survive datanode failures, yet nothing in
+the batch pipeline or the online controller ever loses a node.  A
+``FaultSchedule`` is the missing input: an ordered list of infrastructure
+events — crash, recover, decommission, flaky — each pinned to a *window
+index* of the controller's time grid (control/windows.py), so the same
+schedule replayed over the same log produces the same failure trajectory,
+and a kill/resume of the controller mid-fault is bit-identical by
+construction (the schedule is config, not state; the *consequences* live in
+``ClusterState`` and ride the checkpoint).
+
+Event kinds (HDFS namenode vocabulary, Shvachko et al. MSST 2010):
+
+* ``crash``        — node down; its replicas become unavailable but are NOT
+                     destroyed (the disk survives a process crash).
+* ``recover``      — a crashed node returns with its replicas intact.
+* ``decommission`` — node permanently removed; its replicas are destroyed.
+* ``flaky``        — node stays up but repair copies targeting it fail with
+                     the given probability (seeded, stateless rolls —
+                     faults/repair.py), modelling a slow/half-broken node.
+* ``unflaky``      — clears the flaky probability.
+
+Schedules come from three places: explicit specs (``crash:dn2@3``,
+``crash:dn2@3-7`` = crash at 3 / recover at 8, ``flaky:dn1@2-6:0.5``),
+JSON round-trip (the ``cdrs chaos --schedule`` contract), or the seeded
+``random`` generator (chaos smoke tests), which never downs the last
+remaining node.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["FaultEvent", "FaultSchedule"]
+
+#: Within one window, events apply in this order (recover before crash so a
+#: same-window recover+crash of two nodes is order-independent by kind).
+KINDS: tuple[str, ...] = ("recover", "unflaky", "crash", "flaky",
+                          "decommission")
+_KIND_ORDER = {k: i for i, k in enumerate(KINDS)}
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One infrastructure event at a window boundary."""
+
+    window: int
+    kind: str       # one of KINDS
+    node: str       # topology node name
+    #: ``flaky`` only: probability a repair copy targeting the node fails.
+    fail_prob: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in _KIND_ORDER:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (want one of {KINDS})")
+        if self.window < 0:
+            raise ValueError(f"fault window must be >= 0, got {self.window}")
+        if not 0.0 <= self.fail_prob <= 1.0:
+            raise ValueError(
+                f"fail_prob must be in [0, 1], got {self.fail_prob}")
+
+    def spec(self) -> str:
+        s = f"{self.kind}:{self.node}@{self.window}"
+        if self.kind == "flaky":
+            s += f":{self.fail_prob:g}"
+        return s
+
+
+class FaultSchedule:
+    """Immutable, window-sorted event list (see module docstring)."""
+
+    def __init__(self, events=()):
+        evs = tuple(sorted(events,
+                           key=lambda e: (e.window, _KIND_ORDER[e.kind],
+                                          e.node)))
+        self.events: tuple[FaultEvent, ...] = evs
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def for_window(self, w: int) -> tuple[FaultEvent, ...]:
+        return tuple(e for e in self.events if e.window == int(w))
+
+    @property
+    def max_window(self) -> int:
+        return max((e.window for e in self.events), default=-1)
+
+    def nodes(self) -> tuple[str, ...]:
+        return tuple(sorted({e.node for e in self.events}))
+
+    def validate_nodes(self, topology_nodes) -> None:
+        unknown = sorted(set(self.nodes()) - set(topology_nodes))
+        if unknown:
+            raise ValueError(
+                f"fault schedule names nodes outside the topology "
+                f"{tuple(topology_nodes)}: {unknown}")
+
+    # -- constructors --------------------------------------------------------
+    @classmethod
+    def from_specs(cls, specs) -> "FaultSchedule":
+        """Parse ``kind:node@window`` specs.
+
+        ``crash:dn2@3-7`` expands to crash at 3 plus recover at 8 (the span
+        is inclusive).  ``flaky:dn1@2-6:0.5`` expands to flaky(p=0.5) at 2
+        plus unflaky at 7; the probability defaults to 0.5.
+        """
+        events: list[FaultEvent] = []
+        for spec in specs:
+            try:
+                kind, rest = spec.split(":", 1)
+                if kind == "flaky" and rest.count(":") == 1:
+                    rest, prob_s = rest.rsplit(":", 1)
+                    prob = float(prob_s)
+                else:
+                    prob = 0.5
+                node, span = rest.split("@", 1)
+                if "-" in span:
+                    lo, hi = (int(s) for s in span.split("-", 1))
+                else:
+                    lo = hi = int(span)
+            except ValueError:
+                raise ValueError(
+                    f"bad fault spec {spec!r} (want kind:node@window, e.g. "
+                    f"'crash:dn2@3', 'crash:dn2@3-7', 'flaky:dn1@2-6:0.5')"
+                ) from None
+            if "-" in span:
+                if hi < lo:
+                    raise ValueError(
+                        f"bad fault span in {spec!r}: {hi} < {lo}")
+                if kind == "crash":
+                    events += [FaultEvent(lo, "crash", node),
+                               FaultEvent(hi + 1, "recover", node)]
+                elif kind == "flaky":
+                    events += [FaultEvent(lo, "flaky", node, fail_prob=prob),
+                               FaultEvent(hi + 1, "unflaky", node)]
+                else:
+                    raise ValueError(
+                        f"spans are only valid for crash/flaky, not "
+                        f"{kind!r} ({spec!r})")
+            elif kind == "flaky":
+                events.append(FaultEvent(lo, kind, node, fail_prob=prob))
+            else:
+                events.append(FaultEvent(lo, kind, node))
+        return cls(events)
+
+    @classmethod
+    def random(cls, nodes, n_windows: int, seed: int = 0,
+               crash_rate: float = 0.08, recover_windows=(2, 5),
+               flaky_rate: float = 0.04,
+               flaky_prob: float = 0.5) -> "FaultSchedule":
+        """Seeded random schedule for chaos smoke runs.
+
+        Per window each UP node crashes with ``crash_rate`` (recovering a
+        uniform ``recover_windows`` span later) and each up node turns
+        flaky for one window with ``flaky_rate``.  The generator never
+        downs the last remaining up node, so the workload always has at
+        least one replica target.  Deterministic in (nodes, n_windows,
+        seed).
+        """
+        rng = np.random.default_rng(seed)
+        nodes = tuple(nodes)
+        up = {n: True for n in nodes}
+        pending_recover: dict[str, int] = {}
+        events: list[FaultEvent] = []
+        for w in range(int(n_windows)):
+            for n, rw in list(pending_recover.items()):
+                if rw == w:
+                    events.append(FaultEvent(w, "recover", n))
+                    up[n] = True
+                    del pending_recover[n]
+            for n in nodes:  # fixed iteration order: determinism
+                if not up[n]:
+                    continue
+                if rng.random() < crash_rate and sum(up.values()) > 1:
+                    span = int(rng.integers(recover_windows[0],
+                                            recover_windows[1] + 1))
+                    events.append(FaultEvent(w, "crash", n))
+                    up[n] = False
+                    pending_recover[n] = w + span
+                elif rng.random() < flaky_rate:
+                    events += [FaultEvent(w, "flaky", n,
+                                          fail_prob=flaky_prob),
+                               FaultEvent(w + 1, "unflaky", n)]
+        # Flush recoveries scheduled past the horizon: a node crashed near
+        # the end must still heal if the replayed log runs longer than
+        # ``n_windows``.
+        for n, rw in sorted(pending_recover.items()):
+            events.append(FaultEvent(rw, "recover", n))
+        return cls(events)
+
+    # -- serialization (the ``cdrs chaos --schedule`` JSON contract) --------
+    def to_json(self) -> list[dict]:
+        return [{"window": e.window, "kind": e.kind, "node": e.node,
+                 **({"fail_prob": e.fail_prob} if e.kind == "flaky"
+                    else {})}
+                for e in self.events]
+
+    @classmethod
+    def from_json(cls, rows) -> "FaultSchedule":
+        return cls([FaultEvent(int(r["window"]), r["kind"], r["node"],
+                               fail_prob=float(r.get("fail_prob", 0.0)))
+                    for r in rows])
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"FaultSchedule({[e.spec() for e in self.events]})"

@@ -1,0 +1,290 @@
+"""Mutable cluster state: node liveness + the evolving replica map.
+
+``cluster/placement.py`` produces an *immutable* placement — correct for
+the batch pipeline, useless once nodes can die.  ``ClusterState`` takes one
+placement as the starting condition and becomes the source of truth the
+fault schedule (faults/schedule.py), the repair scheduler
+(faults/repair.py) and the controller's migrations all mutate:
+
+* per-node status — up/down (crash/recover), decommissioned (permanent,
+  replicas destroyed), and a flaky fail-probability for repair targeting;
+* the replica map — ``(n_files, n_nodes)`` int32 node ids, -1 = empty slot
+  (width = node count: replicas are distinct-per-node, so no file can ever
+  need more slots);
+* durability accounting — vectorized under-replicated / at-risk (1 live
+  replica) / lost (0 live replicas) tiers against an *effective* target
+  rf = min(target, up nodes) (a 3-replica target is unattainable with 2
+  nodes up; HDFS likewise re-replicates only to live capacity).
+
+Everything is deterministic and the whole state round-trips through
+``state_arrays``/``load_state_arrays`` so a controller checkpoint taken
+mid-fault resumes bit-identically.  ``placement_view`` renders the live
+replicas back into a ``PlacementResult`` so the existing replay
+(cluster/evaluate.py) measures locality/balance under the outage — no
+second evaluation path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..cluster.placement import ClusterTopology, PlacementResult
+
+__all__ = ["ClusterState"]
+
+
+class ClusterState:
+    """One controlled cluster's mutable placement + node status."""
+
+    def __init__(self, placement: PlacementResult, size_bytes: np.ndarray):
+        self.topology: ClusterTopology = placement.topology
+        self.nodes: tuple[str, ...] = tuple(placement.topology.nodes)
+        n_nodes = len(self.nodes)
+        n = placement.replica_map.shape[0]
+        self._node_idx = {nm: i for i, nm in enumerate(self.nodes)}
+        self.sizes = np.asarray(size_bytes, dtype=np.int64)
+        if self.sizes.shape != (n,):
+            raise ValueError(
+                f"size_bytes shape {self.sizes.shape} != ({n},)")
+
+        rm = np.full((n, n_nodes), -1, dtype=np.int32)
+        w = min(placement.replica_map.shape[1], n_nodes)
+        rm[:, :w] = placement.replica_map[:, :w]
+        self.replica_map = rm
+        self.node_up = np.ones(n_nodes, dtype=bool)
+        self.node_decommissioned = np.zeros(n_nodes, dtype=bool)
+        self.node_fail_prob = np.zeros(n_nodes, dtype=np.float64)
+        #: Bytes *assigned* per node (down replicas still occupy disk);
+        #: the deterministic least-loaded repair-target preference.
+        self.node_bytes = np.zeros(n_nodes, dtype=np.int64)
+        assigned = self.replica_map >= 0
+        np.add.at(self.node_bytes, self.replica_map[assigned],
+                  np.broadcast_to(self.sizes[:, None],
+                                  self.replica_map.shape)[assigned])
+        #: Bumped on every mutation — cache-invalidation for evaluators.
+        self.version = 0
+
+    # -- node status ---------------------------------------------------------
+    def _nid(self, node: str) -> int:
+        try:
+            return self._node_idx[node]
+        except KeyError:
+            raise ValueError(
+                f"unknown node {node!r} (topology: {self.nodes})") from None
+
+    def apply_event(self, ev) -> None:
+        """Apply one FaultEvent (faults/schedule.py)."""
+        i = self._nid(ev.node)
+        if ev.kind == "crash":
+            self.node_up[i] = False
+        elif ev.kind == "recover":
+            if not self.node_decommissioned[i]:
+                self.node_up[i] = True
+        elif ev.kind == "decommission":
+            self.node_up[i] = False
+            self.node_decommissioned[i] = True
+            gone = self.replica_map == i
+            self.node_bytes[i] = 0
+            self.replica_map[gone] = -1
+        elif ev.kind == "flaky":
+            self.node_fail_prob[i] = float(ev.fail_prob)
+        elif ev.kind == "unflaky":
+            self.node_fail_prob[i] = 0.0
+        else:  # pragma: no cover - FaultEvent validates kinds
+            raise ValueError(f"unknown fault kind {ev.kind!r}")
+        self.version += 1
+
+    @property
+    def n_available(self) -> int:
+        """Nodes that can hold a live replica right now."""
+        return int((self.node_up & ~self.node_decommissioned).sum())
+
+    # -- replica accounting --------------------------------------------------
+    def live_mask(self) -> np.ndarray:
+        """(n, n_nodes) bool: slot holds a replica on an UP node."""
+        rm = self.replica_map
+        return (rm >= 0) & self.node_up[np.clip(rm, 0, None)]
+
+    def live_counts(self) -> np.ndarray:
+        return self.live_mask().sum(axis=1).astype(np.int32)
+
+    def effective_target(self, target_rf: np.ndarray) -> np.ndarray:
+        return np.minimum(np.asarray(target_rf, dtype=np.int64),
+                          self.n_available)
+
+    def repair_needs(self, target_rf: np.ndarray):
+        """(file ids, live counts, effective targets) of every file below
+        its effective target — the repair planner's work list."""
+        live = self.live_counts()
+        eff = self.effective_target(target_rf)
+        fids = np.flatnonzero(live < eff)
+        return fids, live, eff
+
+    def durability(self, target_rf: np.ndarray, cat: np.ndarray,
+                   categories) -> dict:
+        """Vectorized durability tiers, total and per category.
+
+        Tiers are disjoint: ``lost`` (0 live replicas — unreadable until a
+        crashed holder recovers), ``at_risk`` (exactly 1 live replica when
+        the effective target wants more — one failure from loss),
+        ``under_replicated`` (>= 2 live but below target).  ``cat`` uses
+        -1 for not-yet-planned files, bucketed as "Unplanned".
+        """
+        live = self.live_counts()
+        eff = self.effective_target(target_rf)
+        lost = live == 0
+        at_risk = (live == 1) & (eff >= 2)
+        under = (live >= 2) & (live < eff)
+
+        names = list(categories) + ["Unplanned"]
+        bucket = np.where(np.asarray(cat) >= 0, cat, len(categories))
+        per: dict[str, dict] = {}
+        for mask, key in ((under, "under_replicated"), (at_risk, "at_risk"),
+                          (lost, "lost")):
+            counts = np.bincount(bucket[mask], minlength=len(names))
+            for ci, c in enumerate(counts):
+                if c:
+                    per.setdefault(names[ci], {})[key] = int(c)
+        return {
+            "nodes_up": self.n_available,
+            "under_replicated": int(under.sum()),
+            "at_risk": int(at_risk.sum()),
+            "lost": int(lost.sum()),
+            "per_category": per,
+        }
+
+    def lost_mask(self) -> np.ndarray:
+        return self.live_counts() == 0
+
+    # -- mutation ------------------------------------------------------------
+    def pick_repair_target(self, fid: int, rotate: int = 0) -> int:
+        """Deterministic target for a new replica of ``fid``: an available
+        node not already assigned a replica (up OR down — a down holder
+        still owns the bytes and will return), least-loaded first.
+        ``rotate`` (the repair attempt count) steps through the candidate
+        ring so a retry after a flaky failure tries a different node."""
+        row = self.replica_map[fid]
+        holding = set(int(x) for x in row[row >= 0])
+        avail = [i for i in range(len(self.nodes))
+                 if self.node_up[i] and not self.node_decommissioned[i]
+                 and i not in holding]
+        if not avail:
+            return -1
+        avail.sort(key=lambda i: (int(self.node_bytes[i]), i))
+        return avail[int(rotate) % len(avail)]
+
+    def add_replica(self, fid: int, node: int) -> None:
+        row = self.replica_map[fid]
+        free = np.flatnonzero(row < 0)
+        if free.size == 0:  # pragma: no cover - width==n_nodes prevents this
+            raise RuntimeError(f"file {fid} has no free replica slot")
+        row[free[0]] = node
+        self.node_bytes[node] += self.sizes[fid]
+        self.version += 1
+
+    def drop_replica(self, fid: int, node: int) -> None:
+        row = self.replica_map[fid]
+        slots = np.flatnonzero(row == node)
+        if slots.size:
+            row[slots[0]] = -1
+            self.node_bytes[node] -= self.sizes[fid]
+            self.version += 1
+
+    def apply_rf_target(self, fid: int, rf_new: int) -> int:
+        """Bring ``fid`` toward ``rf_new`` live replicas (capped at the
+        available node count): migrations call this when a planned rf
+        change applies.  Adds go to the least-loaded eligible node; drops
+        release down-but-assigned slots first (free metadata deletes),
+        then the most-loaded live holders.  Returns live delta."""
+        target = min(int(rf_new), self.n_available)
+        live = int((self.live_mask()[fid]).sum())
+        delta = 0
+        if live == 0:
+            # No live source to copy from: a lost file cannot be
+            # re-replicated by fiat.  The repair path heals it to target
+            # the window a crashed holder recovers.
+            return 0
+        while live < target:
+            node = self.pick_repair_target(fid)
+            if node < 0:
+                break
+            self.add_replica(fid, node)
+            live += 1
+            delta += 1
+        if live > target:
+            # Release dead-weight slots on DOWN nodes first.
+            row = self.replica_map[fid]
+            for node in [int(x) for x in row[row >= 0]
+                         if not self.node_up[int(x)]]:
+                self.drop_replica(fid, node)
+        while live > target:
+            row = self.replica_map[fid]
+            holders = [int(x) for x in row[row >= 0]
+                       if self.node_up[int(x)]]
+            if not holders:  # pragma: no cover - live>target implies holders
+                break
+            holders.sort(key=lambda i: (-int(self.node_bytes[i]), i))
+            self.drop_replica(fid, holders[0])
+            live -= 1
+            delta -= 1
+        return delta
+
+    def trim_excess(self, target_rf: np.ndarray) -> int:
+        """Drop live replicas beyond the effective target (a recovered node
+        can resurface replicas the repair path already re-created) — free
+        metadata deletes, HDFS's excess-replica pruning.  Returns files
+        trimmed."""
+        live = self.live_counts()
+        eff = self.effective_target(target_rf)
+        over = np.flatnonzero(live > eff)
+        for fid in over:
+            self.apply_rf_target(int(fid), int(eff[fid]))
+        return int(over.size)
+
+    # -- rendering back into the immutable world -----------------------------
+    def placement_view(self) -> PlacementResult:
+        """The LIVE replicas as a PlacementResult (rows compacted so live
+        node ids lead, -1 padding trails) for cluster/evaluate.py replay.
+        Files with zero live replicas get rf=0 — their reads are served by
+        nobody and count as non-local."""
+        live = self.live_mask()
+        masked = np.where(live, self.replica_map, -1).astype(np.int32)
+        order = np.argsort(~live, axis=1, kind="stable")
+        compact = np.take_along_axis(masked, order, axis=1)
+        rf_live = live.sum(axis=1).astype(np.int32)
+        storage = np.zeros(len(self.nodes), dtype=np.int64)
+        sel = compact >= 0
+        np.add.at(storage, compact[sel],
+                  np.broadcast_to(self.sizes[:, None], compact.shape)[sel])
+        return PlacementResult(replica_map=compact, rf=rf_live,
+                               topology=self.topology,
+                               storage_per_node=storage)
+
+    # -- checkpoint ----------------------------------------------------------
+    def state_arrays(self) -> dict[str, np.ndarray]:
+        return {
+            "fault_replica_map": self.replica_map.copy(),
+            "fault_node_up": self.node_up.copy(),
+            "fault_node_decommissioned": self.node_decommissioned.copy(),
+            "fault_node_fail_prob": self.node_fail_prob.copy(),
+        }
+
+    def load_state_arrays(self, arrays: dict) -> None:
+        rm = np.asarray(arrays["fault_replica_map"], dtype=np.int32)
+        if rm.shape != self.replica_map.shape:
+            raise ValueError(
+                f"checkpoint replica map shape {rm.shape} != "
+                f"{self.replica_map.shape} — stale checkpoint?")
+        self.replica_map = rm.copy()
+        self.node_up = np.asarray(arrays["fault_node_up"],
+                                  dtype=bool).copy()
+        self.node_decommissioned = np.asarray(
+            arrays["fault_node_decommissioned"], dtype=bool).copy()
+        self.node_fail_prob = np.asarray(arrays["fault_node_fail_prob"],
+                                         dtype=np.float64).copy()
+        self.node_bytes = np.zeros(len(self.nodes), dtype=np.int64)
+        assigned = self.replica_map >= 0
+        np.add.at(self.node_bytes, self.replica_map[assigned],
+                  np.broadcast_to(self.sizes[:, None],
+                                  self.replica_map.shape)[assigned])
+        self.version += 1

@@ -32,6 +32,12 @@ Threshold-based anomaly flags turn the metrics into verdicts:
   flapping).
 * ``locality_regressed`` — the window's applied moves measurably lowered
   the replayed locality (before/after gap beyond ``locality_drop``).
+* ``durability_lost`` — fault mode (control + faults/): the window ended
+  with files at ZERO live replicas; reads of them fail until a crashed
+  holder recovers.
+* ``repair_backlogged`` — the repair backlog stayed non-empty
+  ``repair_backlog_windows`` windows running: nodes are failing faster
+  than the churn budget lets the re-replicator heal.
 
 One ``{"kind": "audit", ...}`` event per window rides the same JSONL stream
 as everything else, plus ``audit.*`` gauges (silhouette, entropy, byte
@@ -66,6 +72,11 @@ class AuditConfig:
     #: Before/after locality gap (absolute ratio points) that flags a
     #: window's applied moves as a regression.
     locality_drop: float = 0.01
+    #: Consecutive windows with a non-empty repair backlog (fault mode,
+    #: faults/repair.py) before the repair pipeline counts as backlogged —
+    #: the churn budget is structurally too tight to re-replicate as fast
+    #: as nodes fail.
+    repair_backlog_windows: int = 3
     #: Row cap for the silhouette/Davies-Bouldin geometry (deterministic
     #: stride sample; None = all rows).  The metrics are means over rows,
     #: so a few thousand samples pin them to the third decimal while
@@ -139,6 +150,7 @@ class DecisionAuditor:
         self._prev_silhouette: float | None = None
         self._prev_byte_cost: int | None = None
         self._budget_streak = 0
+        self._repair_streak = 0
 
     def audit_window(self, tel, *, window: int, rec: dict,
                      X: np.ndarray | None,
@@ -205,6 +217,18 @@ class DecisionAuditor:
         if (before is not None and after is not None
                 and after < before - cfg.locality_drop):
             flags.append("locality_regressed")
+        dur = rec.get("durability")
+        if dur is not None:
+            event["durability"] = {k: dur[k] for k in
+                                   ("under_replicated", "at_risk", "lost")}
+            if dur["lost"]:
+                flags.append("durability_lost")
+        if rec.get("repair_backlog"):
+            self._repair_streak += 1
+        else:
+            self._repair_streak = 0
+        if self._repair_streak >= cfg.repair_backlog_windows:
+            flags.append("repair_backlogged")
         event["flags"] = flags
 
         self._prev_fractions = frac

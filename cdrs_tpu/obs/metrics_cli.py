@@ -93,6 +93,35 @@ def _render_roofline(digest, out, peak_flops=None, peak_gbps=None) -> None:
         print(" ".join(parts), file=out)
 
 
+def _render_durability(windows: list[dict], out) -> None:
+    """Fault-mode digest: durability tiers, outage span, repair traffic
+    (window records from a ``cdrs chaos`` / fault-schedule run)."""
+    dur_w = [w for w in windows if w.get("durability")]
+    if not dur_w:
+        return
+    last = dur_w[-1]["durability"]
+    lost_max = max(w["durability"]["lost"] for w in dur_w)
+    degraded = sum(1 for w in dur_w
+                   if w["durability"]["lost"]
+                   or w["durability"]["at_risk"]
+                   or w["durability"]["under_replicated"])
+    rep_bytes = sum(int(w.get("repair_bytes", 0)) for w in windows)
+    rep_moves = sum(int(w.get("repair_moves", 0)) for w in windows)
+    rep_failed = sum(int(w.get("repair_failed", 0)) for w in windows)
+    faults = sum(len(w.get("fault_events") or ()) for w in windows)
+    unavail = sum(int(w.get("unavailable_reads", 0)) for w in windows)
+    print(f"\nDurability: {faults} fault events over {len(dur_w)} windows, "
+          f"{degraded} degraded (max {lost_max} lost)", file=out)
+    print(f"  final: {last['lost']} lost / {last['at_risk']} at-risk / "
+          f"{last['under_replicated']} under-replicated "
+          f"({last['nodes_up']} nodes up)", file=out)
+    line = (f"  repair: {rep_moves} replicas, {_fmt_bytes(rep_bytes)}"
+            + (f", {rep_failed} failed copies" if rep_failed else ""))
+    if unavail:
+        line += f"; {unavail} reads hit lost files"
+    print(line, file=out)
+
+
 def _render_audit(audits: list[dict], out) -> None:
     if not audits:
         return
@@ -166,6 +195,7 @@ def summarize_events(events: list[dict], out=None, peak_flops=None,
                   f"{inertia}, final shift {last['shift']:.3g}", file=out)
 
     _render_audit(digest["audits"], out)
+    _render_durability(digest["windows"], out)
 
     windows = digest["windows"]
     if windows:

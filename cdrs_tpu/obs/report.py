@@ -129,6 +129,12 @@ def _tiles(digest: dict, n_events: int) -> str:
                       f"{sum(1 for w in windows if w.get('recluster'))}"))
         tiles.append(("bytes migrated", _fmt_bytes(
             sum(int(w.get("bytes_migrated", 0)) for w in windows))))
+        dur = [w for w in windows if w.get("durability")]
+        if dur:
+            tiles.append(("max files lost",
+                          f"{max(w['durability']['lost'] for w in dur)}"))
+            tiles.append(("repair bytes", _fmt_bytes(
+                sum(int(w.get("repair_bytes", 0)) for w in windows))))
     if audits:
         flagged = sum(1 for a in audits if a.get("flags"))
         tiles.append(("flagged windows", f"{flagged}"))
@@ -313,6 +319,36 @@ def _window_section(digest: dict) -> str:
             + "".join(rows) + "</table>")
 
 
+def _durability_section(digest: dict) -> str:
+    """Fault-mode timeline (window records carrying ``durability``):
+    tiers per window, repair traffic, fault events.  Absent for streams
+    without fault accounting — pre-fault reports render unchanged."""
+    windows = [w for w in digest["windows"] if w.get("durability")]
+    if not windows:
+        return ""
+    rows = []
+    for w in windows:
+        d = w["durability"]
+        faults = ", ".join(w.get("fault_events") or ()) or "—"
+        rows.append(
+            f"<tr><td>{_esc(w.get('window'))}</td>"
+            f"<td><code>{_esc(faults)}</code></td>"
+            f'<td class="num">{_fmt(d.get("nodes_up"))}</td>'
+            f'<td class="num">{_fmt(d.get("lost"))}</td>'
+            f'<td class="num">{_fmt(d.get("at_risk"))}</td>'
+            f'<td class="num">{_fmt(d.get("under_replicated"))}</td>'
+            f'<td class="num">{_fmt(w.get("repair_moves"))}</td>'
+            f'<td class="num">{_fmt_bytes(w.get("repair_bytes"))}</td>'
+            f'<td class="num">{_fmt(w.get("repair_backlog"))}</td>'
+            f"</tr>")
+    return ("<h2>Durability (fault mode)</h2><table><tr><th>window</th>"
+            "<th>fault events</th><th class=num>nodes up</th>"
+            "<th class=num>lost</th><th class=num>at risk</th>"
+            "<th class=num>under-repl.</th><th class=num>repairs</th>"
+            "<th class=num>repair bytes</th><th class=num>backlog</th>"
+            "</tr>" + "".join(rows) + "</table>")
+
+
 def _trace_section(digest: dict) -> str:
     traces = digest["traces"]
     if not traces:
@@ -356,6 +392,7 @@ def render_html(events: list[dict], title: str = "cdrs telemetry report"
         + _span_section(digest)
         + _xla_section(digest)
         + _audit_section(digest)
+        + _durability_section(digest)
         + _window_section(digest)
         + _trace_section(digest)
         + _gauge_section(digest)

@@ -20,11 +20,29 @@ import tempfile
 
 import numpy as np
 
-__all__ = ["save_state", "load_state", "kmeans_jax_checkpointed"]
+__all__ = ["CheckpointError", "save_state", "load_state",
+           "kmeans_jax_checkpointed"]
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint file exists but cannot be read (corrupt/truncated).
+
+    Raised instead of the raw ``zipfile``/``ValueError`` internals numpy
+    leaks on a torn npz, with the offending path in the message.  Callers
+    that retain snapshots can fall back to the ``.prev`` last-good copy
+    ``save_state`` keeps (the controller does — control/controller.py)."""
 
 
 def save_state(path: str, arrays: dict, meta: dict | None = None) -> None:
-    """Atomic npz snapshot (write temp + rename) with a JSON meta blob."""
+    """Atomic npz snapshot (write temp + rename) with a JSON meta blob.
+
+    The previous snapshot, when one exists, is retained as ``<path>.prev``
+    (a hardlink, not a copy) before the new one lands, so a snapshot
+    corrupted after the fact (disk fault, torn write surfaced later) has a
+    one-older fallback behind it.  ``path`` itself never transiently
+    disappears: the link is created first and the new snapshot replaces
+    ``path`` atomically — deleting ``path`` by hand therefore always means
+    "start over", never "resume from .prev"."""
     payload = {k: np.asarray(v) for k, v in arrays.items()}
     payload["__meta__"] = np.frombuffer(
         json.dumps(meta or {}).encode(), dtype=np.uint8)
@@ -34,6 +52,21 @@ def save_state(path: str, arrays: dict, meta: dict | None = None) -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **payload)
+        if os.path.exists(path):
+            prev = path + ".prev"
+            try:
+                if os.path.exists(prev):
+                    os.unlink(prev)
+                os.link(path, prev)
+            except OSError:
+                # Filesystem without hardlinks: retain by copy instead —
+                # slower, but ``path`` must never transiently disappear
+                # (a crash in that window would silently restart the
+                # controller instead of resuming).
+                import shutil
+
+                shutil.copyfile(path, prev + ".cp")
+                os.replace(prev + ".cp", prev)
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -42,11 +75,21 @@ def save_state(path: str, arrays: dict, meta: dict | None = None) -> None:
 
 
 def load_state(path: str) -> tuple[dict, dict]:
-    """Returns (arrays, meta); raises FileNotFoundError when absent."""
-    with np.load(path) as z:
-        arrays = {k: z[k] for k in z.files if k != "__meta__"}
-        meta = json.loads(bytes(z["__meta__"].tobytes()).decode()) \
-            if "__meta__" in z.files else {}
+    """Returns (arrays, meta); raises FileNotFoundError when absent and
+    :class:`CheckpointError` when present but corrupt/truncated."""
+    try:
+        with np.load(path) as z:
+            arrays = {k: z[k] for k in z.files if k != "__meta__"}
+            meta = json.loads(bytes(z["__meta__"].tobytes()).decode()) \
+                if "__meta__" in z.files else {}
+    except FileNotFoundError:
+        raise
+    except Exception as e:
+        raise CheckpointError(
+            f"checkpoint {path!r} is corrupt or truncated "
+            f"({type(e).__name__}: {e}); delete it to start over"
+            + (f" or restore the retained snapshot {path + '.prev'!r}"
+               if os.path.exists(path + ".prev") else "")) from e
     return arrays, meta
 
 

@@ -7,6 +7,7 @@ pytest.importorskip("jax")
 
 from cdrs_tpu.ops.kmeans_np import kmeans_plusplus_init
 from cdrs_tpu.utils.checkpoint import (
+    CheckpointError,
     kmeans_jax_checkpointed,
     load_state,
     save_state,
@@ -28,6 +29,41 @@ def test_save_load_roundtrip(tmp_path):
     arrays, meta = load_state(p)
     np.testing.assert_array_equal(arrays["a"], np.arange(5))
     assert meta == {"it": 7, "note": "x"}
+
+
+def test_corrupt_checkpoint_raises_checkpoint_error(tmp_path):
+    """A truncated/garbage npz raises CheckpointError naming the path —
+    not numpy's raw zipfile internals."""
+    p = str(tmp_path / "torn.npz")
+    save_state(p, {"a": np.arange(8)}, {"it": 1})
+    with open(p, "r+b") as f:
+        f.truncate(40)
+    with pytest.raises(CheckpointError, match="torn.npz"):
+        load_state(p)
+    q = str(tmp_path / "junk.npz")
+    with open(q, "wb") as f:
+        f.write(b"not an npz at all")
+    with pytest.raises(CheckpointError, match="junk.npz"):
+        load_state(q)
+    # Absent stays FileNotFoundError (the existence-probe contract).
+    with pytest.raises(FileNotFoundError):
+        load_state(str(tmp_path / "absent.npz"))
+
+
+def test_save_state_retains_prev_snapshot(tmp_path):
+    """Every overwrite renames the previous snapshot to <path>.prev, so a
+    corrupted current snapshot always has a one-older fallback."""
+    import os
+
+    p = str(tmp_path / "s.npz")
+    save_state(p, {"a": np.asarray([1])}, {"gen": 1})
+    assert not os.path.exists(p + ".prev")  # first write: nothing to keep
+    save_state(p, {"a": np.asarray([2])}, {"gen": 2})
+    save_state(p, {"a": np.asarray([3])}, {"gen": 3})
+    arrays, meta = load_state(p)
+    assert meta["gen"] == 3 and arrays["a"][0] == 3
+    arrays_prev, meta_prev = load_state(p + ".prev")
+    assert meta_prev["gen"] == 2 and arrays_prev["a"][0] == 2
 
 
 def test_checkpointed_matches_uninterrupted(blobs, tmp_path):

@@ -48,6 +48,31 @@ def test_placement_invariants(workload):
     assert (p.replica_map == p2.replica_map).all()
 
 
+def test_placement_holds_property():
+    """Property-style: ``PlacementResult.holds`` == per-event brute force
+    over random mixed-rf placements, including out-of-topology (node < 0)
+    clients — which must never match the -1 padding of short rows."""
+    rng = np.random.default_rng(7)
+    for trial in range(5):
+        n = int(rng.integers(20, 80))
+        manifest = generate_population(
+            GeneratorConfig(n_files=n, seed=int(rng.integers(0, 1000))))
+        topo = ClusterTopology(nodes=tuple(manifest.nodes))
+        rf = rng.integers(1, 5, size=n).astype(np.int32)  # mixed-rf rows
+        p = place_replicas(manifest, rf, topo, seed=trial)
+        e = int(rng.integers(50, 200))
+        pid = rng.integers(0, n, size=e).astype(np.int64)
+        # Clients include -1 (outside the topology) and every real node.
+        node = rng.integers(-1, len(topo), size=e).astype(np.int32)
+        got = p.holds(pid, node)
+        want = np.asarray([
+            node[j] >= 0 and int(node[j]) in
+            set(p.replica_map[pid[j]][p.replica_map[pid[j]] >= 0].tolist())
+            for j in range(e)])
+        np.testing.assert_array_equal(got, want)
+        assert not got[node < 0].any()
+
+
 def test_evaluate_tiny_hand_example():
     m = Manifest(paths=["/a", "/b"], creation_ts=np.zeros(2),
                  primary_node_id=np.array([0, 1], dtype=np.int32),
