@@ -1,29 +1,35 @@
-"""Durability baseline: kill one datanode, measure the self-healing loop.
+"""Durability baselines: node kills, rack kills, partitions, stragglers.
 
-The chaos counterpart of control_bench.py: a stationary workload on a
-5-node topology settles into its category plan, then one node crashes at a
-fixed window and never returns.  The fault-injected controller
-(control/controller.py + faults/) must re-replicate every under-replicated
-file back to its (effective) target rf through the SAME per-window churn
-budget drift migrations use.  Reported:
+The chaos counterpart of control_bench.py.  Two scenario families:
 
-* **windows to full re-replication** — windows after the kill until zero
-  lost / at-risk / under-replicated files (the acceptance bound);
-* **repair traffic** — bytes of re-replication copies, and per-window
-  proof that repair + migration traffic stayed inside the budget;
-* **files lost** — must be zero: the scenario runs a min-rf-2 scoring
-  table (Moderate 1 -> 2), because any rf=1 category trivially loses a
-  node's singleton replicas on a kill — a true statement about rf=1, but
-  not the re-replication property this baseline pins;
-* **kill/resume bit-identity** — a controller killed mid-outage and
-  resumed from its checkpoint reproduces the uninterrupted run's record
-  stream exactly;
-* **telemetry overhead** — the PR-2 ≤ 1.05x wall-clock budget re-checked
-  with fault accounting + repair planning enabled (interleaved paired
-  rounds, best-window ratio — the repo's standard methodology).
+**Kill one node** (``run_chaos_bench`` -> data/chaos_bench.json): a
+stationary workload on a 5-node topology settles into its category plan,
+then one node crashes at a fixed window and never returns.  The
+fault-injected controller (control/controller.py + faults/) must
+re-replicate every under-replicated file back to its (effective) target
+rf through the SAME per-window churn budget drift migrations use.
+Reported: windows to full re-replication, repair traffic + per-window
+proof the budget held, zero files lost (min-rf-2 scoring table — any rf=1
+category trivially loses a node's singletons), kill/resume bit-identity,
+and the telemetry-overhead ratio (≤ 1.05x budget, interleaved paired
+rounds, best-window — the repo's standard methodology; the instrumented
+schedule now includes a partition and a straggler so the new
+fault-accounting paths are inside the measured loop).
 
-``python -m cdrs_tpu.benchmarks.chaos_bench`` writes the JSON artifact to
-``data/chaos_bench.json``.
+**Rack kill + partition** (``run_rack_bench`` ->
+data/chaos_rack_bench.json): a 6-node topology in 3 racks of 2.
+(a) A whole rack crashes permanently: with the domain-aware placement
+(``--racks``) every rf >= 2 file keeps a replica outside the dead rack —
+ZERO lost; the SAME schedule under the flat (rack-blind) policy loses a
+measurable file count — the HDFS/CRUSH rack-awareness claim, actually
+measured.  (b) A rack-sized network partition opens and heals within the
+run, with a straggler degrading one survivor: reads behind the partition
+fail (counted), stranded repairs defer with backoff instead of burning
+churn, straggler copies are charged size/throughput against the budget,
+and after the heal the run ends with zero lost / zero correlated-risk
+files; a controller killed mid-partition resumes bit-identically.
+
+``python -m cdrs_tpu.benchmarks.chaos_bench`` writes both artifacts.
 """
 
 from __future__ import annotations
@@ -46,9 +52,14 @@ from ..faults import FaultSchedule
 from ..sim.access import simulate_access
 from ..sim.generator import generate_population
 
-__all__ = ["run_chaos_bench", "chaos_overhead"]
+__all__ = ["run_chaos_bench", "run_rack_bench", "chaos_overhead"]
 
 _NODES = ("dn1", "dn2", "dn3", "dn4", "dn5")
+#: Rack scenarios: 6 nodes in 3 racks of 2 — one rack is a minority the
+#: cluster must survive losing outright.
+_RACK_NODES = ("dn1", "dn2", "dn3", "dn4", "dn5", "dn6")
+_RACK_SPEC = "r0=dn1,dn2;r1=dn3,dn4;r2=dn5,dn6"
+_KILLED_RACK = ("dn3", "dn4")
 
 
 def _min_rf2_scoring():
@@ -180,6 +191,171 @@ def run_chaos_bench(
     return out
 
 
+def _durability_timeline(records: list[dict]) -> list[dict]:
+    """Per-window durability/repair digest for the artifact timelines."""
+    out = []
+    for r in records:
+        d = r["durability"]
+        out.append({
+            "window": r["window"], "fault_events": r["fault_events"],
+            "nodes_up": d["nodes_up"],
+            "nodes_partitioned": d.get("nodes_partitioned", 0),
+            "lost": d["lost"], "unreachable": d.get("unreachable", 0),
+            "at_risk": d["at_risk"],
+            "under_replicated": d["under_replicated"],
+            "correlated_risk": d.get("correlated_risk", 0),
+            "repair_moves": r["repair_moves"],
+            "repair_bytes": r["repair_bytes"],
+            "repair_bytes_copied": r.get("repair_bytes_copied", 0),
+            "repair_rebalanced": r.get("repair_rebalanced", 0),
+            "repair_deferred_partition":
+                r.get("repair_deferred_partition", 0),
+            "repair_backlog": r["repair_backlog"],
+            "bytes_migrated": r["bytes_migrated"],
+            "unavailable_reads": r.get("unavailable_reads", 0),
+        })
+    return out
+
+
+def run_rack_bench(
+    n_files: int = 400,
+    seed: int = 13,
+    duration: float = 1800.0,
+    n_windows: int = 15,
+    kill_window: int = 5,
+    partition_windows: tuple[int, int] = (4, 7),
+    degrade_factor: float = 0.25,
+    k: int = 12,
+    max_bytes_frac: float = 0.25,
+    resume_check: bool = True,
+) -> dict:
+    """Rack-kill + rack-partition scenarios (module docstring); returns
+    the ``data/chaos_rack_bench.json`` artifact dict."""
+    from ..cluster import ClusterTopology
+
+    window_seconds = duration / n_windows
+    manifest = generate_population(
+        GeneratorConfig(n_files=n_files, seed=seed, nodes=_RACK_NODES))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=duration, seed=seed + 1))
+    scoring = _min_rf2_scoring()
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+    max_bytes = int(max_bytes_frac * float(sizes.sum()))
+    racked = ClusterTopology.from_rack_spec(_RACK_NODES, _RACK_SPEC)
+
+    def mk(schedule: FaultSchedule,
+           topology=None) -> ReplicationController:
+        cfg = ControllerConfig(
+            window_seconds=window_seconds, default_rf=2,
+            max_bytes_per_window=max_bytes, hysteresis_windows=1,
+            kmeans=KMeansConfig(k=k, seed=42), scoring=scoring,
+            fault_schedule=FaultSchedule(schedule.events),
+            topology=topology)
+        return ReplicationController(manifest, cfg)
+
+    # -- (a) whole-rack kill: domain-aware vs flat placement ---------------
+    kill = FaultSchedule.from_specs(
+        [f"crash:{n}@{kill_window}" for n in _KILLED_RACK])
+    sides = {}
+    for name, topo in (("domain_aware", racked), ("flat", None)):
+        res = mk(kill, topo).run(events)
+        timeline = _durability_timeline(res.records)
+        recover_at = next(
+            (t["window"] for t in timeline
+             if t["window"] >= kill_window
+             and t["lost"] + t["at_risk"] + t["under_replicated"] == 0),
+            None)
+        sides[name] = {
+            "timeline": timeline,
+            "files_lost_max": max(t["lost"] for t in timeline),
+            "files_lost_final": timeline[-1]["lost"],
+            "correlated_risk_final": timeline[-1]["correlated_risk"],
+            "windows_to_full_re_replication":
+                None if recover_at is None else recover_at - kill_window,
+            "repair_bytes_total": int(sum(t["repair_bytes"]
+                                          for t in timeline)),
+            "budget_respected": all(
+                t["repair_bytes"] + t["bytes_migrated"] <= max_bytes
+                for t in timeline),
+        }
+
+    # -- (b) rack partition that heals + straggler survivor ---------------
+    p0, p1 = partition_windows
+    part = FaultSchedule.from_specs([
+        f"partition:{'+'.join(_KILLED_RACK)}@{p0}-{p1}",
+        f"degrade:dn5@{p0}-{p1}:{degrade_factor:g}",
+    ])
+    pres = mk(part, racked).run(events)
+    ptimeline = _durability_timeline(pres.records)
+    psum = pres.summary()["durability"]
+    partition_out: dict = {
+        "schedule": [e.spec() for e in part],
+        "timeline": ptimeline,
+        "files_lost_max": max(t["lost"] for t in ptimeline),
+        "unreachable_max": max(t["unreachable"] for t in ptimeline),
+        "stalled_repairs": psum["partition_stalled_repairs"],
+        "unavailable_reads": psum["unavailable_reads"],
+        "lost_final": psum["lost_final"],
+        "unreachable_final": psum["unreachable_final"],
+        "correlated_risk_final": psum["correlated_risk_final"],
+        "under_replicated_final": psum["under_replicated_final"],
+        "budget_respected": all(
+            t["repair_bytes"] + t["bytes_migrated"] <= max_bytes
+            for t in ptimeline),
+    }
+    if resume_check:
+        import os
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            ck = os.path.join(td, "rack.npz")
+            a = mk(part, racked).run(events, checkpoint_path=ck,
+                                     max_windows=p0 + 2)  # mid-partition
+            b = mk(part, racked).run(events, checkpoint_path=ck)
+            identical = (_strip(a.records) + _strip(b.records)
+                         == _strip(pres.records)
+                         and bool(np.array_equal(b.rf, pres.rf)))
+        partition_out["kill_resume"] = {
+            "killed_after_window": p0 + 1, "bit_identical": identical}
+
+    out = {
+        "scenario": {
+            "n_files": n_files, "seed": seed, "nodes": list(_RACK_NODES),
+            "racks": _RACK_SPEC, "killed_rack": list(_KILLED_RACK),
+            "duration_seconds": duration, "n_windows": n_windows,
+            "window_seconds": window_seconds, "k": k,
+            "kill_window": kill_window,
+            "partition_windows": list(partition_windows),
+            "degrade": f"dn5@{p0}-{p1}:{degrade_factor:g}",
+            "default_rf": 2,
+            "replication_factors": scoring.replication_factors,
+            "max_bytes_per_window": max_bytes,
+            "max_bytes_frac": max_bytes_frac,
+        },
+        "rack_kill": sides,
+        "rack_partition": partition_out,
+        "criteria": {
+            "domain_aware_zero_lost":
+                sides["domain_aware"]["files_lost_max"] == 0,
+            "flat_loses_files": sides["flat"]["files_lost_max"] > 0,
+            "domain_recovered_within_run":
+                sides["domain_aware"]["windows_to_full_re_replication"]
+                is not None,
+            "partition_heals_clean":
+                partition_out["lost_final"] == 0
+                and partition_out["unreachable_final"] == 0
+                and partition_out["correlated_risk_final"] == 0,
+            "budget_respected":
+                sides["domain_aware"]["budget_respected"]
+                and partition_out["budget_respected"],
+            **({"partition_resume_bit_identical":
+                partition_out["kill_resume"]["bit_identical"]}
+               if resume_check else {}),
+        },
+    }
+    return out
+
+
 def chaos_overhead(n_files: int = 8000, duration: float = 480.0,
                    window_seconds: float = 60.0,
                    repeats: int = 9) -> dict:
@@ -190,8 +366,10 @@ def chaos_overhead(n_files: int = 8000, duration: float = 480.0,
     durability accounting and repair planning active on BOTH sides — the
     instrumented side additionally streams window records, fault/
     durability/repair counters+gauges and audit events through the sink.
-    Pins the ISSUE-4 acceptance: fault accounting keeps telemetry inside
-    the ≤ 1.05x budget."""
+    The schedule includes a crash span, a network partition and a
+    straggler, so the partition/correlated-risk accounting added for
+    failure domains is inside the measured loop.  Pins the acceptance:
+    fault accounting keeps telemetry inside the ≤ 1.05x budget."""
     import os
     import tempfile
 
@@ -203,8 +381,11 @@ def chaos_overhead(n_files: int = 8000, duration: float = 480.0,
     events = simulate_access(
         manifest, SimulatorConfig(duration_seconds=duration, seed=8))
     n_windows = int(duration // window_seconds)
-    schedule = FaultSchedule.from_specs(
-        [f"crash:dn2@{n_windows // 3}-{2 * n_windows // 3}"])
+    schedule = FaultSchedule.from_specs([
+        f"crash:dn2@{n_windows // 3}-{2 * n_windows // 3}",
+        f"partition:dn4@{n_windows // 4}-{n_windows // 2}",
+        f"degrade:dn5@{n_windows // 2}-{3 * n_windows // 4}:0.5",
+    ])
 
     def mk() -> ReplicationController:
         cfg = ControllerConfig(window_seconds=window_seconds, default_rf=2,
@@ -260,6 +441,7 @@ def chaos_overhead(n_files: int = 8000, duration: float = 480.0,
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--out", default="data/chaos_bench.json")
+    p.add_argument("--rack_out", default="data/chaos_rack_bench.json")
     p.add_argument("--n_files", type=int, default=400)
     p.add_argument("--seed", type=int, default=11)
     p.add_argument("--duration", type=float, default=1800.0)
@@ -268,20 +450,44 @@ def main(argv=None) -> int:
     p.add_argument("--k", type=int, default=12)
     p.add_argument("--no_overhead", action="store_true",
                    help="skip the paired telemetry-overhead rounds")
+    p.add_argument("--scenario", choices=["kill", "rack", "all"],
+                   default="all",
+                   help="kill = one-node crash (data/chaos_bench.json); "
+                        "rack = rack kill + partition "
+                        "(data/chaos_rack_bench.json)")
     args = p.parse_args(argv)
 
-    out = run_chaos_bench(n_files=args.n_files, seed=args.seed,
-                          duration=args.duration, n_windows=args.windows,
-                          kill_window=args.kill_window, k=args.k,
-                          overhead=not args.no_overhead)
-    with open(args.out, "w") as f:
-        json.dump(out, f, indent=2)
-        f.write("\n")
-    print(json.dumps({"out": args.out, **out["criteria"],
-                      "windows_to_full_re_replication": out["recovery"][
-                          "windows_to_full_re_replication"],
-                      "repair_bytes_total": out["recovery"][
-                          "repair_bytes_total"]}))
+    summary: dict = {}
+    if args.scenario in ("kill", "all"):
+        out = run_chaos_bench(n_files=args.n_files, seed=args.seed,
+                              duration=args.duration, n_windows=args.windows,
+                              kill_window=args.kill_window, k=args.k,
+                              overhead=not args.no_overhead)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+        summary.update({"out": args.out, **out["criteria"],
+                        "windows_to_full_re_replication": out["recovery"][
+                            "windows_to_full_re_replication"],
+                        "repair_bytes_total": out["recovery"][
+                            "repair_bytes_total"]})
+    if args.scenario in ("rack", "all"):
+        rack = run_rack_bench(n_files=args.n_files, seed=args.seed + 2,
+                              duration=args.duration,
+                              n_windows=args.windows, k=args.k)
+        with open(args.rack_out, "w") as f:
+            json.dump(rack, f, indent=2)
+            f.write("\n")
+        # Prefix the rack criteria: both scenarios define
+        # budget_respected, and the rack value must not shadow the kill
+        # scenario's in the combined stdout digest.
+        summary.update({
+            "rack_out": args.rack_out,
+            **{f"rack_{k}": v for k, v in rack["criteria"].items()},
+            "flat_files_lost_max": rack["rack_kill"]["flat"][
+                "files_lost_max"],
+        })
+    print(json.dumps(summary))
     return 0
 
 

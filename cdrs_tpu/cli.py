@@ -436,11 +436,12 @@ def _cmd_stream_inner(args) -> int:
     return 0
 
 
-def _controller_cfg(args, fault_schedule=None):
+def _controller_cfg(args, fault_schedule=None, topology=None):
     """ControllerConfig from the shared control/chaos argument set."""
     from .control import ControllerConfig
 
     return ControllerConfig(
+        topology=topology,
         window_seconds=args.window_seconds,
         drift_threshold=args.drift_threshold,
         full_recluster_drift=args.full_drift,
@@ -506,17 +507,26 @@ def _cmd_control(args) -> int:
 
 def _cmd_chaos(args) -> int:
     """Fault-injected controller run: the control loop plus a seeded
-    FaultSchedule (node crash/recover/decommission/flaky), durability
-    accounting per window, and the repair planner competing with drift
-    migrations for the same churn budget (faults/)."""
+    FaultSchedule (node crash/recover/decommission/flaky, network
+    partitions, stragglers), failure-domain-aware placement (--racks),
+    durability accounting per window, and the repair planner competing
+    with drift migrations for the same churn budget (faults/)."""
     from .faults import FaultSchedule
     from .io.events import Manifest
 
     manifest = Manifest.read_csv(args.manifest)
+    topology = None
+    if args.racks:
+        from .cluster import ClusterTopology
+
+        topology = ClusterTopology.from_rack_spec(manifest.nodes,
+                                                  args.racks)
     events = []
     for kind, flag in (("crash", args.kill), ("recover", args.recover),
                        ("decommission", args.decommission),
-                       ("flaky", args.flaky)):
+                       ("flaky", args.flaky),
+                       ("partition", args.partition),
+                       ("degrade", args.degrade)):
         for spec in flag or ():
             events.extend(FaultSchedule.from_specs([f"{kind}:{spec}"]))
     if args.schedule:
@@ -528,8 +538,8 @@ def _cmd_chaos(args) -> int:
             seed=args.fault_seed))
     if not events:
         print("error: chaos needs at least one fault (--kill/--recover/"
-              "--decommission/--flaky/--schedule/--random_faults)",
-              file=sys.stderr)
+              "--decommission/--flaky/--partition/--degrade/--schedule/"
+              "--random_faults)", file=sys.stderr)
         return 1
     schedule = FaultSchedule(events)
     if args.schedule_out:
@@ -538,7 +548,7 @@ def _cmd_chaos(args) -> int:
             f.write("\n")
         print(f"schedule: {len(schedule)} events -> {args.schedule_out}",
               file=sys.stderr)
-    return _run_controller(args, _controller_cfg(args, schedule),
+    return _run_controller(args, _controller_cfg(args, schedule, topology),
                            "chaos_cmd", manifest=manifest)
 
 
@@ -772,6 +782,23 @@ def main(argv: list[str] | None = None) -> int:
                    help="repair copies to NODE fail with probability P "
                         "(default 0.5) over windows W..W2, e.g. "
                         "dn1@2-6:0.5; repeatable")
+    p.add_argument("--racks", default=None, metavar="SPEC",
+                   help="failure domains: ';'-separated rack groups, each "
+                        "'name=n1,n2' or bare 'n1,n2' (auto-named), e.g. "
+                        "'r0=dn1,dn2;r1=dn3,dn4' — placement spreads "
+                        "replicas across racks, durability accounting "
+                        "gains the correlated-risk tier")
+    p.add_argument("--partition", action="append",
+                   metavar="NODES@W[-W2]",
+                   help="network-partition a '+'-joined node set over "
+                        "windows W..W2 (unreachable as a group, replicas "
+                        "intact), e.g. dn1+dn2@4-6; repeatable")
+    p.add_argument("--degrade", action="append",
+                   metavar="NODE@W[-W2][:M]",
+                   help="straggler: NODE moves repair bytes at Mx nominal "
+                        "throughput (default 0.5) over windows W..W2 — "
+                        "copies through it charge size/M of the churn "
+                        "budget, e.g. dn3@2-6:0.25; repeatable")
     p.add_argument("--schedule", default=None, metavar="JSON",
                    help="load additional fault events from a JSON file "
                         "(the --schedule_out format)")

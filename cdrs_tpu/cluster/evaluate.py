@@ -105,13 +105,17 @@ def evaluate_placement(
     mean_ops = total_ops.mean() if total_ops.sum() else 1.0
     load_balance = float(total_ops.max() / max(mean_ops, 1e-12))
 
+    # A hand-built PlacementResult may omit storage_per_node (it defaults
+    # to None); derive it from the replica map rather than crashing.
+    storage = placement.compute_storage(manifest.size_bytes)
+
     return PolicyMetrics(
         read_locality=read_locality,
         reads_per_node=reads_per_node,
         writes_per_node=writes_per_node,
         load_balance=load_balance,
-        storage_per_node=placement.storage_per_node,
-        total_storage=int(placement.storage_per_node.sum()),
+        storage_per_node=storage,
+        total_storage=int(storage.sum()),
         n_reads=n_reads,
         n_writes=n_writes,
     )

@@ -1,10 +1,19 @@
-"""Replica placement over simulated datanodes.
+"""Replica placement over simulated datanodes, failure-domain aware.
 
 HDFS-like policy, fully vectorized: replica 0 lives on the file's primary
 node (the reference manifest's ``primary_node`` column, generator.py:44);
-additional replicas go to distinct other nodes chosen by a seeded random
-permutation per file (the statistical shape of HDFS's random target chooser,
-minus rack topology).  Deterministic given (manifest, rf, seed).
+replica 1 goes to a node in a *different failure domain* than the primary,
+replica 2 to a second node in that same remote domain, and any further
+replicas to distinct nodes by seeded random priority — the shape of HDFS's
+rack-aware block placement (Shvachko et al., MSST 2010: local node, remote
+rack, same remote rack, then spread) over `ClusterTopology.domains`.
+
+A flat topology (no ``domains``) treats every node as its own failure
+domain, which makes the policy degenerate *bit-for-bit* to the historical
+distinct-node random chooser: replica 1's "different domain" is simply the
+best-priority non-primary node, and a one-node "second domain" has no
+second member to boost.  Deterministic given (manifest, rf, seed) either
+way — no per-file Python loop.
 """
 
 from __future__ import annotations
@@ -16,23 +25,141 @@ import numpy as np
 
 from ..io.events import Manifest
 
-__all__ = ["ClusterTopology", "PlacementResult", "place_replicas"]
+__all__ = ["ClusterTopology", "PlacementResult", "place_replicas",
+           "reset_rf_cap_warning"]
 
-#: One warning per process: the cap itself is HDFS behaviour and placement
-#: runs per window in the controller — the *first* silent downgrade is the
-#: operator-relevant event (e.g. Archival rf=4 on a 3-node topology).
-_RF_CAP_WARNED = False
+
+class _OnceWarning:
+    """Per-process one-shot warning latch, resettable for test isolation.
+
+    The rf cap itself is HDFS behaviour and placement runs per window in
+    the controller — the *first* silent downgrade is the operator-relevant
+    event (e.g. Archival rf=4 on a 3-node topology).  A module-global bool
+    (the previous implementation) could never be re-armed, so tests after
+    the first firing could not assert the warning.
+    """
+
+    def __init__(self) -> None:
+        self.fired = False
+
+    def reset(self) -> None:
+        self.fired = False
+
+    def warn(self, message: str) -> None:
+        if self.fired:
+            return
+        self.fired = True
+        warnings.warn(message, stacklevel=3)
+
+
+_RF_CAP_WARNING = _OnceWarning()
+
+
+def reset_rf_cap_warning() -> None:
+    """Re-arm the one-shot rf-cap warning (test isolation hook)."""
+    _RF_CAP_WARNING.reset()
 
 
 @dataclass
 class ClusterTopology:
-    """Datanode set.  The reference's compose file runs one real datanode and
-    imagines three (SURVEY.md §5 note); here the node set is explicit."""
+    """Datanode set with failure domains.  The reference's compose file runs
+    one real datanode and imagines three (SURVEY.md §5 note); here the node
+    set is explicit, and each node maps to a failure domain (rack/zone) so
+    correlated failures — a rack losing power, a switch partitioning half
+    the cluster — are expressible."""
 
     nodes: tuple[str, ...] = ("dn1", "dn2", "dn3")
+    #: Per-node failure-domain name, parallel to ``nodes``.  Empty = every
+    #: node is its own domain (the flat topology: node loss IS domain loss,
+    #: and domain-aware placement reduces to the distinct-node policy).
+    domains: tuple[str, ...] = ()
+
+    def __post_init__(self):
+        self.nodes = tuple(self.nodes)
+        self.domains = tuple(self.domains)
+        if not self.nodes:
+            raise ValueError("topology needs at least one node")
+        if len(set(self.nodes)) != len(self.nodes):
+            dupes = sorted({n for n in self.nodes
+                            if self.nodes.count(n) > 1})
+            raise ValueError(
+                f"duplicate node names in topology: {dupes} — every node "
+                f"must be unique (a duplicate silently corrupts "
+                f"storage_per_node accounting)")
+        if self.domains and len(self.domains) != len(self.nodes):
+            raise ValueError(
+                f"domains has {len(self.domains)} entries for "
+                f"{len(self.nodes)} nodes — must be parallel to nodes "
+                f"(one failure-domain name per node)")
 
     def __len__(self) -> int:
         return len(self.nodes)
+
+    @property
+    def domain_names(self) -> tuple[str, ...]:
+        """Distinct domain names in first-appearance order."""
+        src = self.domains if self.domains else self.nodes
+        return tuple(dict.fromkeys(src))
+
+    @property
+    def n_domains(self) -> int:
+        return len(self.domain_names)
+
+    def domain_index(self) -> np.ndarray:
+        """(n_nodes,) int32: each node's domain id (domain_names order)."""
+        src = self.domains if self.domains else self.nodes
+        idx = {d: i for i, d in enumerate(self.domain_names)}
+        return np.asarray([idx[d] for d in src], dtype=np.int32)
+
+    @classmethod
+    def from_racks(cls, nodes, racks: dict) -> "ClusterTopology":
+        """Topology from a ``node -> domain`` mapping.
+
+        Every mapped node must exist; nodes the mapping omits fall back to
+        their own singleton domain (flat behaviour for the unmapped rest).
+        """
+        nodes = tuple(nodes)
+        unknown = sorted(set(racks) - set(nodes))
+        if unknown:
+            raise ValueError(
+                f"rack map names nodes outside the topology {nodes}: "
+                f"{unknown}")
+        return cls(nodes, tuple(str(racks.get(n, n)) for n in nodes))
+
+    @classmethod
+    def from_rack_spec(cls, nodes, spec: str) -> "ClusterTopology":
+        """Topology from a CLI rack spec: ``;``-separated groups, each
+        ``name=n1,n2`` or bare ``n1,n2`` (auto-named rack0, rack1, ...) —
+        e.g. ``--racks 'r0=dn1,dn2;r1=dn3,dn4'``."""
+        racks: dict[str, str] = {}
+        seen_names: set[str] = set()
+        for i, group in enumerate(g for g in spec.split(";") if g.strip()):
+            if "=" in group:
+                name, members = group.split("=", 1)
+                name = name.strip()
+            else:
+                name, members = f"rack{i}", group
+            if name in seen_names:
+                # An auto-generated rack0 colliding with an explicit
+                # 'rack0=' would silently merge two groups into one
+                # failure domain — exactly the separation the spec was
+                # written to buy.
+                raise ValueError(
+                    f"duplicate rack name {name!r} in spec {spec!r} "
+                    f"(auto-named bare groups use rack0, rack1, ...)")
+            seen_names.add(name)
+            for m in members.split(","):
+                m = m.strip()
+                if not m:
+                    continue
+                if m in racks:
+                    raise ValueError(
+                        f"node {m!r} appears in two rack groups "
+                        f"({racks[m]!r} and {name!r}) in spec {spec!r}")
+                racks[m] = name
+        if not racks:
+            raise ValueError(f"rack spec {spec!r} names no nodes")
+        return cls.from_racks(nodes, racks)
 
 
 @dataclass
@@ -42,7 +169,10 @@ class PlacementResult:
     replica_map: np.ndarray          # (n, max_rf) int32
     rf: np.ndarray                   # (n,) int32 effective rf (capped at #nodes)
     topology: ClusterTopology
-    storage_per_node: np.ndarray = field(default=None)  # (#nodes,) bytes
+    #: (#nodes,) bytes; ``place_replicas`` always fills it, but a
+    #: hand-built result may omit it — consumers must guard or call
+    #: ``compute_storage``.
+    storage_per_node: np.ndarray | None = field(default=None)
 
     def holds(self, pid: np.ndarray, node: np.ndarray) -> np.ndarray:
         """Bool per event: does ``node`` hold a replica of file ``pid``?
@@ -51,6 +181,29 @@ class PlacementResult:
         must not match the -1 padding slots of mixed-rf rows.
         """
         return (self.replica_map[pid] == node[:, None]).any(axis=1) & (node >= 0)
+
+    def compute_storage(self, size_bytes: np.ndarray) -> np.ndarray:
+        """(#nodes,) replica bytes from the map; fills ``storage_per_node``
+        when the constructor left it None."""
+        if self.storage_per_node is None:
+            sizes = np.asarray(size_bytes, dtype=np.int64)
+            storage = np.zeros(len(self.topology), dtype=np.int64)
+            sel = self.replica_map >= 0
+            np.add.at(storage, self.replica_map[sel],
+                      np.broadcast_to(sizes[:, None],
+                                      self.replica_map.shape)[sel])
+            self.storage_per_node = storage
+        return self.storage_per_node
+
+    def domain_counts(self) -> np.ndarray:
+        """(n,) int32: distinct failure domains each file's replicas span."""
+        dom = self.topology.domain_index()
+        assigned = self.replica_map >= 0
+        counts = np.zeros(self.replica_map.shape[0], dtype=np.int32)
+        slot_dom = dom[np.clip(self.replica_map, 0, None)]
+        for d in range(self.topology.n_domains):
+            counts += ((slot_dom == d) & assigned).any(axis=1)
+        return counts
 
 
 def place_replicas(
@@ -62,8 +215,13 @@ def place_replicas(
     """Place ``rf_per_file`` replicas of each file onto the topology.
 
     ``rf`` is capped at the node count (HDFS behaviour for small clusters).
-    Replica 0 is the primary node; the remaining ``rf-1`` are drawn without
-    replacement from the other nodes via per-file random priority sort.
+    Replica 0 is the primary node.  With failure domains, replica 1 is the
+    best-priority node in a seeded-random *remote* domain and replica 2 the
+    second-best node of that same domain (HDFS rack-aware: off-rack, then
+    same remote rack); the remaining ``rf-3`` are drawn without replacement
+    from the other nodes via per-file random priority sort.  On a flat
+    topology every node is its own domain and the policy is exactly the
+    historical distinct-node random chooser.
     """
     topology = topology or ClusterTopology()
     n = len(manifest)
@@ -85,15 +243,12 @@ def place_replicas(
     rf_want = np.asarray(rf_per_file, dtype=np.int32)
     n_capped = int((rf_want > n_nodes).sum())
     if n_capped:
-        global _RF_CAP_WARNED
-        if not _RF_CAP_WARNED:
-            _RF_CAP_WARNED = True
-            warnings.warn(
-                f"replication factor capped at the node count for "
-                f"{n_capped} files (requested up to {int(rf_want.max())}, "
-                f"topology has {n_nodes} nodes) — replicas are "
-                f"distinct-per-node, so e.g. Archival rf=4 on a 3-node "
-                f"topology places 3", stacklevel=2)
+        _RF_CAP_WARNING.warn(
+            f"replication factor capped at the node count for "
+            f"{n_capped} files (requested up to {int(rf_want.max())}, "
+            f"topology has {n_nodes} nodes) — replicas are "
+            f"distinct-per-node, so e.g. Archival rf=4 on a 3-node "
+            f"topology places 3")
         from ..obs import current as _obs_current
 
         tel = _obs_current()
@@ -104,21 +259,39 @@ def place_replicas(
     max_rf = int(rf.max())
 
     rng = np.random.default_rng(seed)
-    # Random priorities per (file, node); primary forced to the front.
+    # Random priorities per (file, node); the sort key starts as the raw
+    # priorities and gets the structured slots forced to the front.
     prio = rng.random((n, n_nodes))
-    prio[np.arange(n), primary] = -1.0          # sorts first
-    order = np.argsort(prio, axis=1).astype(np.int32)  # (n, n_nodes)
+    key = prio.copy()
+    key[np.arange(n), primary] = -3.0           # replica 0: the primary
+    dom = topology.domain_index()
+    if topology.n_domains > 1 and n_nodes > 1:
+        # Remote domain per file: the domain of the best-priority node
+        # OUTSIDE the primary's domain (a seeded random domain choice
+        # weighted exactly like the node choice itself).
+        same = dom[None, :] == dom[primary][:, None]       # (n, n_nodes)
+        remote_prio = np.where(same, np.inf, prio)
+        best_remote = np.argmin(remote_prio, axis=1)       # (n,)
+        has_remote = np.isfinite(remote_prio[np.arange(n), best_remote])
+        in_rdom = ((dom[None, :] == dom[best_remote][:, None])
+                   & ~same & has_remote[:, None])
+        # Replica 1 = best node of the remote domain, replica 2 = its
+        # second-best (same remote rack, HDFS-style).  Everything else
+        # keeps its raw priority — "rest on distinct nodes".
+        masked = np.where(in_rdom, prio, np.inf)
+        part = np.partition(masked, 1, axis=1)
+        m1, m2 = part[:, 0], part[:, 1]
+        key = np.where(np.isfinite(m1)[:, None] & (masked == m1[:, None]),
+                       -2.0, key)
+        key = np.where(np.isfinite(m2)[:, None] & (masked == m2[:, None]),
+                       -1.0, key)
+    order = np.argsort(key, axis=1).astype(np.int32)       # (n, n_nodes)
 
     replica_map = order[:, :max_rf].copy()
     mask = np.arange(max_rf)[None, :] < rf[:, None]
     replica_map[~mask] = -1
 
-    storage = np.zeros(n_nodes, dtype=np.int64)
-    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
-    for j in range(max_rf):
-        col = replica_map[:, j]
-        sel = col >= 0
-        np.add.at(storage, col[sel], sizes[sel])
-
-    return PlacementResult(replica_map=replica_map, rf=rf, topology=topology,
-                           storage_per_node=storage)
+    result = PlacementResult(replica_map=replica_map, rf=rf,
+                             topology=topology)
+    result.compute_storage(manifest.size_bytes)
+    return result

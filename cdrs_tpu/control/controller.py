@@ -91,14 +91,20 @@ class ControllerConfig:
     #: window's moves (cluster/evaluate.py).
     evaluate: bool = True
     #: Fault feed (faults/schedule.FaultSchedule): node crash/recover/
-    #: decommission/flaky events keyed to window indices.  When set the
-    #: controller maintains a mutable ClusterState, accounts durability
-    #: tiers per window, and runs the repair planner against the SAME
-    #: byte/file churn budget as drift migrations (repairs first).
+    #: decommission/flaky/partition/degrade events keyed to window
+    #: indices.  When set the controller maintains a mutable ClusterState,
+    #: accounts durability tiers per window, and runs the repair planner
+    #: against the SAME byte/file churn budget as drift migrations
+    #: (repairs first).
     fault_schedule: object | None = None
     #: Seed of the deterministic flaky-target failure rolls
     #: (faults/repair.py) — stateless, so kill/resume replays them.
     repair_seed: int = 0
+    #: Failure-domain topology (cluster/placement.ClusterTopology) for the
+    #: fault path: maps nodes to racks/zones so placement and repair
+    #: spread replicas across domains.  None = flat (every manifest node
+    #: its own domain).  Node set must equal the manifest's.
+    topology: object | None = None
 
     def __post_init__(self):
         if self.window_seconds <= 0:
@@ -158,9 +164,15 @@ class ControllerResult:
                                     for r in self.records),
                 "files_lost_max": max(r["durability"]["lost"]
                                       for r in dur),
+                "unreachable_max": max(r["durability"].get("unreachable", 0)
+                                       for r in dur),
+                "correlated_risk_max": max(
+                    r["durability"].get("correlated_risk", 0) for r in dur),
                 "lost_final": last["lost"],
                 "at_risk_final": last["at_risk"],
                 "under_replicated_final": last["under_replicated"],
+                "unreachable_final": last.get("unreachable", 0),
+                "correlated_risk_final": last.get("correlated_risk", 0),
                 "nodes_up_final": last["nodes_up"],
                 "repair_moves_total": int(sum(r.get("repair_moves", 0)
                                               for r in self.records)),
@@ -168,6 +180,11 @@ class ControllerResult:
                                               for r in self.records)),
                 "repair_failed_total": int(sum(r.get("repair_failed", 0)
                                                for r in self.records)),
+                "repair_rebalanced_total": int(sum(
+                    r.get("repair_rebalanced", 0) for r in self.records)),
+                "partition_stalled_repairs": int(sum(
+                    r.get("repair_deferred_partition", 0)
+                    for r in self.records)),
                 "unavailable_reads": int(sum(
                     r.get("unavailable_reads", 0) for r in self.records)),
             }
@@ -237,7 +254,13 @@ class ReplicationController:
             from ..cluster import ClusterTopology, place_replicas
             from ..faults import ClusterState, RepairScheduler
 
-            topology = ClusterTopology(nodes=tuple(manifest.nodes))
+            topology = cfg.topology or ClusterTopology(
+                nodes=tuple(manifest.nodes))
+            if set(topology.nodes) != set(manifest.nodes):
+                raise ValueError(
+                    f"topology nodes {tuple(topology.nodes)} != manifest "
+                    f"nodes {tuple(manifest.nodes)} — the failure-domain "
+                    f"topology must cover exactly the manifest's node set")
             cfg.fault_schedule.validate_nodes(topology.nodes)
             placement = place_replicas(manifest, self.current_rf, topology,
                                        seed=0)
@@ -423,12 +446,15 @@ class ReplicationController:
             seconds["repair"] = time.perf_counter() - t0
             rec["repair_moves"] = len(rr.applied)
             rec["repair_bytes"] = int(rr.bytes_used)
+            rec["repair_bytes_copied"] = int(rr.bytes_copied)
             rec["repair_failed"] = rr.failed
+            rec["repair_rebalanced"] = rr.rebalanced
             rec["repair_backlog"] = len(self._repairs.backlog)
             rec["repair_deferred_budget"] = rr.deferred_budget
             rec["repair_deferred_backoff"] = rr.deferred_backoff
             rec["repair_deferred_no_source"] = rr.deferred_no_source
             rec["repair_deferred_no_target"] = rr.deferred_no_target
+            rec["repair_deferred_partition"] = rr.deferred_partition
             bytes_reserved = rr.bytes_used
             files_reserved = rr.files_touched
 
@@ -453,12 +479,13 @@ class ReplicationController:
                 self.current_rf, self.current_cat, CATEGORIES)
             if len(events):
                 # Reads the outage actually refused this window: reads of
-                # files with zero live replicas.
-                lost = self._cluster_state.lost_mask()
+                # files with zero REACHABLE replicas (lost outright, or
+                # wholly stranded behind a partition).
+                unreadable = self._cluster_state.unreadable_mask()
                 keep = events.path_id >= 0
                 pid = events.path_id[keep]
                 reads = np.asarray(events.op)[keep] == 0
-                rec["unavailable_reads"] = int(lost[pid[reads]].sum())
+                rec["unavailable_reads"] = int(unreadable[pid[reads]].sum())
             else:
                 rec["unavailable_reads"] = 0
 
@@ -542,6 +569,10 @@ class ReplicationController:
                             rec["deferred_budget"])
         if rec.get("fault_events"):
             tel.counter_inc("fault.events", len(rec["fault_events"]))
+            n_part_ev = sum(1 for s in rec["fault_events"]
+                            if s.startswith(("partition:", "heal:")))
+            if n_part_ev:
+                tel.counter_inc("fault.partition.events", n_part_ev)
         dur = rec.get("durability")
         if dur is not None:
             tel.gauge("durability.under_replicated",
@@ -549,6 +580,14 @@ class ReplicationController:
             tel.gauge("durability.at_risk", dur["at_risk"])
             tel.gauge("durability.lost", dur["lost"])
             tel.gauge("durability.nodes_up", dur["nodes_up"])
+            tel.gauge("durability.correlated.files",
+                      dur.get("correlated_risk", 0))
+            tel.gauge("durability.correlated.domains_reachable",
+                      dur.get("domains_reachable", 1))
+            tel.gauge("fault.partition.nodes",
+                      dur.get("nodes_partitioned", 0))
+            tel.gauge("fault.partition.unreachable_files",
+                      dur.get("unreachable", 0))
             if rec.get("unavailable_reads"):
                 tel.counter_inc("fault.unavailable_reads",
                                 rec["unavailable_reads"])
@@ -567,6 +606,12 @@ class ReplicationController:
         if rec.get("repair_deferred_no_target"):
             tel.counter_inc("repair.deferred_no_target",
                             rec["repair_deferred_no_target"])
+        if rec.get("repair_deferred_partition"):
+            tel.counter_inc("fault.partition.stalled_repairs",
+                            rec["repair_deferred_partition"])
+        if rec.get("repair_rebalanced"):
+            tel.counter_inc("repair.rebalanced_domain",
+                            rec["repair_rebalanced"])
         for stage, secs in seconds.items():
             tel.histogram(f"controller.{stage}.seconds", secs)
 

@@ -4,14 +4,17 @@ category -> replication-factor mapping was designed for.
 Pieces:
 
 * ``FaultSchedule`` (schedule.py) — seeded, deterministic node events
-  (crash/recover/decommission/flaky) keyed to controller windows.
-* ``ClusterState`` (state.py) — the mutable cluster: node liveness, the
-  evolving replica map, vectorized durability tiers (under-replicated /
-  at-risk / lost), and the ``placement_view`` bridge back into the
-  immutable evaluation world.
+  (crash/recover/decommission/flaky, ``partition``/``heal`` node sets,
+  ``degrade``/``restore`` stragglers) keyed to controller windows.
+* ``ClusterState`` (state.py) — the mutable cluster: node liveness and
+  reachability, the evolving replica map, vectorized durability tiers
+  (under-replicated / at-risk / unreachable / lost, plus the
+  correlated-risk failure-domain overlay), and the ``placement_view``
+  bridge back into the immutable evaluation world.
 * ``RepairScheduler`` (repair.py) — HDFS-style re-replication under the
   same per-window churn budget as drift migrations, with deterministic
-  flaky-failure rolls + exponential backoff.
+  flaky-failure rolls + exponential backoff, partition-stall deferral,
+  straggler-inflated budget charging and cross-domain spread rebalance.
 
 The online controller (control/controller.py) wires these into its window
 loop when ``ControllerConfig.fault_schedule`` is set; ``cdrs chaos`` is

@@ -2,14 +2,36 @@
 
 The HDFS namenode's re-replication queue (Shvachko et al. MSST 2010) in the
 controller's vocabulary: every window the scheduler re-derives the work
-list from ``ClusterState`` (files below their effective target rf), orders
-it **lost > at-risk > under-replicated** (tie-broken by category rf
-descending, then file index — the highest-durability categories heal
-first), and admits replica copies against the SAME per-window byte/file
-budget the migration scheduler uses: the controller runs repairs first and
-hands the consumed budget to ``MigrationScheduler.schedule`` as a
+list from ``ClusterState`` (files below their effective target rf, plus
+files at target whose reachable replicas all share one failure domain),
+orders it **lost > at-risk > under-replicated > correlated**, tie-broken by
+category rf descending then file index (the highest-durability categories
+heal first), and admits replica copies against the SAME per-window
+byte/file budget the migration scheduler uses: the controller runs repairs
+first and hands the consumed budget to ``MigrationScheduler.schedule`` as a
 reservation, so repair traffic and drift-migration traffic genuinely
 compete for one churn allowance instead of stacking two.
+
+Domain spread: targets come from ``ClusterState.pick_repair_target``, which
+prefers failure domains the file does not yet occupy, and the
+**correlated-risk rebalance** pass moves one replica of an
+all-in-one-domain file into a fresh domain (copy charged to the budget, the
+same-domain drop free) — the self-healing counterpart of the domain-aware
+placement policy.
+
+Partitions: a file whose only live replicas sit behind a network partition
+has no reachable copy source.  Instead of burning budget on doomed copies,
+the task is **deferred with exponential backoff** (``deferred_partition``)
+— when the partition heals the file usually has its replicas back and
+leaves the backlog on the next sync; what it cost in the meantime is
+visibility, not churn.
+
+Stragglers: a node degraded to ``m``x throughput moves bytes ``1/m`` as
+fast, so a copy routed through it is charged ``size/m`` against the byte
+budget — the window's wire-time is the budgeted resource.  The charge uses
+the slowest of (best reachable source, target); the report carries both
+the raw data bytes (``bytes_copied``) and the budget charge
+(``bytes_used``).
 
 Failure handling: a copy targeting a flaky node (ClusterState
 ``node_fail_prob``) fails with that probability — decided by a *stateless*
@@ -47,8 +69,15 @@ class RepairTask:
 
     file_index: int
     attempts: int = 0
-    #: First window the task is eligible again (exponential backoff).
+    #: First window the task is eligible again (exponential backoff after
+    #: a failed copy — a flaky target).
     next_window: int = 0
+    #: Partition-stall backoff, kept SEPARATE from the copy-failure
+    #: backoff: it gates only the stranded-file rescan and is ignored the
+    #: moment a reachable source returns (a healed partition must not
+    #: leave the file waiting out a stale retry window).
+    stalled: int = 0
+    stall_until: int = 0
 
 
 @dataclass
@@ -56,14 +85,21 @@ class RepairReport:
     """What one window's repair pass did (per-window observation)."""
 
     applied: list[tuple[int, int, int]] = field(default_factory=list)
-    #: Byte budget consumed, INCLUDING failed copies (traffic was spent).
+    #: Byte budget consumed, INCLUDING failed copies (traffic was spent)
+    #: and straggler inflation (a 0.25x node charges 4x the bytes).
     bytes_used: int = 0
+    #: Raw data bytes successfully copied (no straggler inflation).
+    bytes_copied: int = 0
     files_touched: int = 0
     failed: int = 0
+    #: Correlated-risk files rebalanced into a fresh failure domain.
+    rebalanced: int = 0
     deferred_budget: int = 0
     deferred_backoff: int = 0
     deferred_no_source: int = 0
     deferred_no_target: int = 0
+    #: Files stranded behind a partition (live replicas, none reachable).
+    deferred_partition: int = 0
 
 
 def _fail_roll(seed: int, window: int, fid: int, attempt: int,
@@ -86,12 +122,29 @@ class RepairScheduler:
         """Re-derive the backlog from the cluster's current gaps: newly
         damaged files enter, files healed by a recover/migration leave
         (and their attempt counters reset with them), files still damaged
-        keep their backoff state.  Also prunes excess replicas a recovered
-        node resurfaced (free)."""
+        keep their backoff state.  Correlated-risk files (at target but
+        all reachable replicas in one failure domain) enter too — the
+        rebalance work list.  Also prunes excess replicas a recovered node
+        or healed partition resurfaced (free)."""
         state.trim_excess(target_rf)
-        fids, _live, _eff = state.repair_needs(target_rf)
+        fids, _reach, _eff = state.repair_needs(target_rf)
+        corr = np.flatnonzero(state.correlated_mask(target_rf))
+        work = np.union1d(fids, corr)
         self.backlog = {int(f): self.backlog.get(int(f), RepairTask(int(f)))
-                        for f in fids}
+                        for f in work}
+
+    def _charge(self, state, fid: int, target: int) -> int:
+        """Budget charge of copying ``fid`` to ``target``: size divided by
+        the slowest throughput on the route (best reachable source vs the
+        target) — straggler wire-time inflation, deterministic."""
+        size = int(state.sizes[fid])
+        node_reach = state.node_reachable()
+        row = state.replica_map[fid]
+        srcs = [int(x) for x in row[row >= 0] if node_reach[int(x)]]
+        src_m = max((float(state.node_throughput[s]) for s in srcs),
+                    default=1.0)
+        m = min(src_m, float(state.node_throughput[target]))
+        return int(np.ceil(size / max(m, 1e-9)))
 
     def schedule(self, window: int, state, target_rf: np.ndarray,
                  cat: np.ndarray, *, max_bytes: int | None = None,
@@ -99,9 +152,9 @@ class RepairScheduler:
         """One window's repair pass; mutates ``state`` and the backlog.
 
         Budget semantics mirror MigrationScheduler: a copy is admitted
-        while ``bytes_used + size <= max_bytes`` except that a single copy
-        larger than the whole budget is admitted as the window's first
-        byte-moving operation (the largest file must not starve);
+        while ``bytes_used + charge <= max_bytes`` except that a single
+        copy larger than the whole budget is admitted as the window's
+        first byte-moving operation (the largest file must not starve);
         ``max_bytes == 0`` is a true freeze.  ``max_files`` caps distinct
         files repaired this window.
         """
@@ -109,13 +162,22 @@ class RepairScheduler:
         if not self.backlog:
             return rep
         live = state.live_counts()
+        reach = state.reachable_counts()
         eff = state.effective_target(target_rf)
+        corr = state.correlated_mask(target_rf)
         cat = np.asarray(cat)
         rf_vec = np.asarray(target_rf, dtype=np.int64)
 
         def prio(t: RepairTask):
             f = t.file_index
-            tier = 0 if live[f] == 0 else (1 if live[f] == 1 else 2)
+            if reach[f] == 0:
+                tier = 0
+            elif reach[f] == 1:
+                tier = 1
+            elif reach[f] < eff[f]:
+                tier = 2
+            else:
+                tier = 3          # correlated-risk rebalance: spread last
             return (tier, -int(rf_vec[f]), f)
 
         order = sorted(self.backlog.values(), key=prio)
@@ -126,8 +188,23 @@ class RepairScheduler:
             if task.next_window > window:
                 rep.deferred_backoff += 1
                 continue
-            if live[f] == 0:
-                rep.deferred_no_source += 1
+            if reach[f] == 0:
+                if live[f] > 0:
+                    # Stranded behind a partition: the data is intact but
+                    # unreachable — back off instead of rescanning (and
+                    # never burn budget on a doomed copy).  The moment the
+                    # partition heals the file either leaves the backlog
+                    # (replicas back above target) or repairs immediately:
+                    # the stall backoff gates only this branch.
+                    if task.stall_until > window:
+                        rep.deferred_backoff += 1
+                    else:
+                        task.stalled += 1
+                        task.stall_until = window + min(2 ** task.stalled,
+                                                        _MAX_BACKOFF)
+                        rep.deferred_partition += 1
+                else:
+                    rep.deferred_no_source += 1
                 continue
             if max_files is not None and f not in touched \
                     and len(touched) >= max_files:
@@ -135,18 +212,22 @@ class RepairScheduler:
                 continue
             size = int(state.sizes[f])
             copy = 0
-            while live[f] < eff[f]:
+            rebalance = reach[f] >= eff[f] and bool(corr[f])
+            spread_fixed = False
+            while reach[f] < eff[f] or (rebalance and copy == 0):
+                target = state.pick_repair_target(
+                    f, rotate=task.attempts + copy,
+                    new_domain_only=rebalance)
+                if target < 0:
+                    rep.deferred_no_target += 1
+                    break
+                charge = self._charge(state, f, target)
                 if max_bytes is not None:
-                    over = rep.bytes_used + size > max_bytes
+                    over = rep.bytes_used + charge > max_bytes
                     first = rep.bytes_used == 0 and max_bytes > 0
                     if over and not first:
                         rep.deferred_budget += 1
                         break
-                target = state.pick_repair_target(
-                    f, rotate=task.attempts + copy)
-                if target < 0:
-                    rep.deferred_no_target += 1
-                    break
                 p = float(state.node_fail_prob[target])
                 if p > 0.0 and _fail_roll(self.seed, window, f,
                                           task.attempts, copy) < p:
@@ -155,15 +236,25 @@ class RepairScheduler:
                     task.next_window = window + min(2 ** task.attempts,
                                                     _MAX_BACKOFF)
                     rep.failed += 1
-                    rep.bytes_used += size
+                    rep.bytes_used += charge
                     touched.add(f)
                     break
                 state.add_replica(f, target)
-                live[f] += 1
-                rep.bytes_used += size
+                rep.bytes_used += charge
+                rep.bytes_copied += size
                 rep.applied.append((f, int(target), size))
                 touched.add(f)
-            if live[f] >= eff[f]:
+                if rebalance:
+                    # The spread move: the new-domain copy landed, drop one
+                    # replica from the crowded domain (free metadata
+                    # delete) — net reachable count unchanged.
+                    state.drop_crowded(f)
+                    rep.rebalanced += 1
+                    spread_fixed = True
+                    break
+                reach[f] += 1
+                copy += 1
+            if reach[f] >= eff[f] and (not bool(corr[f]) or spread_fixed):
                 healed.append(f)
         for f in healed:
             self.backlog.pop(f, None)
@@ -180,18 +271,32 @@ class RepairScheduler:
                 [t.attempts for t in tasks], dtype=np.int64),
             "repair_next_window": np.asarray(
                 [t.next_window for t in tasks], dtype=np.int64),
+            "repair_stalled": np.asarray(
+                [t.stalled for t in tasks], dtype=np.int64),
+            "repair_stall_until": np.asarray(
+                [t.stall_until for t in tasks], dtype=np.int64),
         }
 
     def load_state_arrays(self, arrays: dict) -> None:
         fid = np.asarray(arrays["repair_file_index"], dtype=np.int64)
         att = np.asarray(arrays["repair_attempts"], dtype=np.int64)
         nxt = np.asarray(arrays["repair_next_window"], dtype=np.int64)
-        if not (fid.shape == att.shape == nxt.shape):
+        # Pre-partition checkpoints lack the stall arrays: default to "no
+        # partition stall" rather than refusing to load.
+        zero = np.zeros_like(fid)
+        stl = np.asarray(arrays.get("repair_stalled", zero), dtype=np.int64)
+        unt = np.asarray(arrays.get("repair_stall_until", zero),
+                         dtype=np.int64)
+        if not (fid.shape == att.shape == nxt.shape == stl.shape
+                == unt.shape):
             raise ValueError(
                 f"repair backlog arrays disagree on length: "
-                f"{fid.shape} vs {att.shape} vs {nxt.shape}")
+                f"{fid.shape} vs {att.shape} vs {nxt.shape} vs "
+                f"{stl.shape} vs {unt.shape}")
         self.backlog = {
             int(fid[i]): RepairTask(int(fid[i]), attempts=int(att[i]),
-                                    next_window=int(nxt[i]))
+                                    next_window=int(nxt[i]),
+                                    stalled=int(stl[i]),
+                                    stall_until=int(unt[i]))
             for i in range(fid.shape[0])
         }

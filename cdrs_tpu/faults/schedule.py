@@ -4,12 +4,13 @@ The paper's category -> replication-factor mapping (Hot=3, Shared=2,
 Moderate=1, Archival=4) exists to survive datanode failures, yet nothing in
 the batch pipeline or the online controller ever loses a node.  A
 ``FaultSchedule`` is the missing input: an ordered list of infrastructure
-events — crash, recover, decommission, flaky — each pinned to a *window
-index* of the controller's time grid (control/windows.py), so the same
-schedule replayed over the same log produces the same failure trajectory,
-and a kill/resume of the controller mid-fault is bit-identical by
-construction (the schedule is config, not state; the *consequences* live in
-``ClusterState`` and ride the checkpoint).
+events — crash, recover, decommission, flaky, partition, degrade — each
+pinned to a *window index* of the controller's time grid
+(control/windows.py), so the same schedule replayed over the same log
+produces the same failure trajectory, and a kill/resume of the controller
+mid-fault is bit-identical by construction (the schedule is config, not
+state; the *consequences* live in ``ClusterState`` and ride the
+checkpoint).
 
 Event kinds (HDFS namenode vocabulary, Shvachko et al. MSST 2010):
 
@@ -21,12 +22,23 @@ Event kinds (HDFS namenode vocabulary, Shvachko et al. MSST 2010):
                      the given probability (seeded, stateless rolls —
                      faults/repair.py), modelling a slow/half-broken node.
 * ``unflaky``      — clears the flaky probability.
+* ``partition``    — a node SET becomes unreachable as a group (switch
+                     failure / netsplit): replicas behind it are intact but
+                     cannot serve reads or source/sink repair copies.
+                     Group syntax: ``dn2+dn3``.
+* ``heal``         — the partition heals; the node set is reachable again.
+* ``degrade``      — straggler: the node stays up but moves data at
+                     ``factor``x its nominal throughput (repair copies
+                     routed through it are charged ``size/factor`` of the
+                     churn budget — the wire time is real).
+* ``restore``      — clears the straggler multiplier back to 1.0.
 
 Schedules come from three places: explicit specs (``crash:dn2@3``,
-``crash:dn2@3-7`` = crash at 3 / recover at 8, ``flaky:dn1@2-6:0.5``),
-JSON round-trip (the ``cdrs chaos --schedule`` contract), or the seeded
-``random`` generator (chaos smoke tests), which never downs the last
-remaining node.
+``crash:dn2@3-7`` = crash at 3 / recover at 8, ``flaky:dn1@2-6:0.5``,
+``partition:dn2+dn3@4-6`` = partition at 4 / heal at 7,
+``degrade:dn3@2-6:0.25``), JSON round-trip (the ``cdrs chaos --schedule``
+contract), or the seeded ``random`` generator (chaos smoke tests), which
+never downs the last remaining node.
 """
 
 from __future__ import annotations
@@ -37,11 +49,16 @@ import numpy as np
 
 __all__ = ["FaultEvent", "FaultSchedule"]
 
-#: Within one window, events apply in this order (recover before crash so a
-#: same-window recover+crash of two nodes is order-independent by kind).
-KINDS: tuple[str, ...] = ("recover", "unflaky", "crash", "flaky",
+#: Within one window, events apply in this order (healing kinds before
+#: breaking kinds so a same-window heal+break of two node sets is
+#: order-independent by kind).
+KINDS: tuple[str, ...] = ("recover", "heal", "unflaky", "restore",
+                          "crash", "partition", "flaky", "degrade",
                           "decommission")
 _KIND_ORDER = {k: i for i, k in enumerate(KINDS)}
+#: Kinds whose span form (``@lo-hi``) expands to (start kind, end kind).
+_SPAN_END = {"crash": "recover", "flaky": "unflaky",
+             "partition": "heal", "degrade": "restore"}
 
 
 @dataclass(frozen=True)
@@ -50,9 +67,14 @@ class FaultEvent:
 
     window: int
     kind: str       # one of KINDS
-    node: str       # topology node name
+    #: Topology node name; ``partition``/``heal`` accept a ``+``-joined
+    #: group (``dn2+dn3``) — the set drops/returns atomically.
+    node: str
     #: ``flaky`` only: probability a repair copy targeting the node fails.
     fail_prob: float = 0.0
+    #: ``degrade`` only: throughput multiplier in (0, 1] — 0.25 = the node
+    #: moves repair bytes at a quarter of nominal speed.
+    factor: float = 1.0
 
     def __post_init__(self):
         if self.kind not in _KIND_ORDER:
@@ -63,11 +85,25 @@ class FaultEvent:
         if not 0.0 <= self.fail_prob <= 1.0:
             raise ValueError(
                 f"fail_prob must be in [0, 1], got {self.fail_prob}")
+        if not 0.0 < self.factor <= 1.0:
+            raise ValueError(
+                f"degrade factor must be in (0, 1], got {self.factor}")
+        if "+" in self.node and self.kind not in ("partition", "heal"):
+            raise ValueError(
+                f"node groups ('+') are only valid for partition/heal, "
+                f"not {self.kind!r} ({self.node!r})")
+
+    @property
+    def node_list(self) -> tuple[str, ...]:
+        """The event's nodes (partition/heal groups split on ``+``)."""
+        return tuple(self.node.split("+"))
 
     def spec(self) -> str:
         s = f"{self.kind}:{self.node}@{self.window}"
         if self.kind == "flaky":
             s += f":{self.fail_prob:g}"
+        elif self.kind == "degrade":
+            s += f":{self.factor:g}"
         return s
 
 
@@ -94,7 +130,7 @@ class FaultSchedule:
         return max((e.window for e in self.events), default=-1)
 
     def nodes(self) -> tuple[str, ...]:
-        return tuple(sorted({e.node for e in self.events}))
+        return tuple(sorted({n for e in self.events for n in e.node_list}))
 
     def validate_nodes(self, topology_nodes) -> None:
         unknown = sorted(set(self.nodes()) - set(topology_nodes))
@@ -109,14 +145,17 @@ class FaultSchedule:
         """Parse ``kind:node@window`` specs.
 
         ``crash:dn2@3-7`` expands to crash at 3 plus recover at 8 (the span
-        is inclusive).  ``flaky:dn1@2-6:0.5`` expands to flaky(p=0.5) at 2
-        plus unflaky at 7; the probability defaults to 0.5.
+        is inclusive); partitions likewise (``partition:dn2+dn3@4-6`` =
+        partition at 4, heal at 7).  ``flaky:dn1@2-6:0.5`` expands to
+        flaky(p=0.5) at 2 plus unflaky at 7 (probability defaults to 0.5);
+        ``degrade:dn3@2-6:0.25`` to degrade(factor=0.25) at 2 plus restore
+        at 7 (factor defaults to 0.5).
         """
         events: list[FaultEvent] = []
         for spec in specs:
             try:
                 kind, rest = spec.split(":", 1)
-                if kind == "flaky" and rest.count(":") == 1:
+                if kind in ("flaky", "degrade") and rest.count(":") == 1:
                     rest, prob_s = rest.rsplit(":", 1)
                     prob = float(prob_s)
                 else:
@@ -129,41 +168,46 @@ class FaultSchedule:
             except ValueError:
                 raise ValueError(
                     f"bad fault spec {spec!r} (want kind:node@window, e.g. "
-                    f"'crash:dn2@3', 'crash:dn2@3-7', 'flaky:dn1@2-6:0.5')"
+                    f"'crash:dn2@3', 'crash:dn2@3-7', 'flaky:dn1@2-6:0.5', "
+                    f"'partition:dn2+dn3@4-6', 'degrade:dn3@2-6:0.25')"
                 ) from None
+            kw = {}
+            if kind == "flaky":
+                kw["fail_prob"] = prob
+            elif kind == "degrade":
+                kw["factor"] = prob
             if "-" in span:
                 if hi < lo:
                     raise ValueError(
                         f"bad fault span in {spec!r}: {hi} < {lo}")
-                if kind == "crash":
-                    events += [FaultEvent(lo, "crash", node),
-                               FaultEvent(hi + 1, "recover", node)]
-                elif kind == "flaky":
-                    events += [FaultEvent(lo, "flaky", node, fail_prob=prob),
-                               FaultEvent(hi + 1, "unflaky", node)]
-                else:
+                if kind not in _SPAN_END:
                     raise ValueError(
-                        f"spans are only valid for crash/flaky, not "
-                        f"{kind!r} ({spec!r})")
-            elif kind == "flaky":
-                events.append(FaultEvent(lo, kind, node, fail_prob=prob))
+                        f"spans are only valid for "
+                        f"{'/'.join(_SPAN_END)}, not {kind!r} ({spec!r})")
+                events += [FaultEvent(lo, kind, node, **kw),
+                           FaultEvent(hi + 1, _SPAN_END[kind], node)]
             else:
-                events.append(FaultEvent(lo, kind, node))
+                events.append(FaultEvent(lo, kind, node, **kw))
         return cls(events)
 
     @classmethod
     def random(cls, nodes, n_windows: int, seed: int = 0,
                crash_rate: float = 0.08, recover_windows=(2, 5),
                flaky_rate: float = 0.04,
-               flaky_prob: float = 0.5) -> "FaultSchedule":
+               flaky_prob: float = 0.5,
+               degrade_rate: float = 0.0,
+               degrade_factor: float = 0.25) -> "FaultSchedule":
         """Seeded random schedule for chaos smoke runs.
 
         Per window each UP node crashes with ``crash_rate`` (recovering a
         uniform ``recover_windows`` span later) and each up node turns
-        flaky for one window with ``flaky_rate``.  The generator never
-        downs the last remaining up node, so the workload always has at
-        least one replica target.  Deterministic in (nodes, n_windows,
-        seed).
+        flaky for one window with ``flaky_rate`` or — when
+        ``degrade_rate`` > 0 — into a one-window straggler with
+        ``degrade_rate``.  The generator never downs the last remaining up
+        node, so the workload always has at least one replica target.
+        Deterministic in (nodes, n_windows, seed); ``degrade_rate=0`` (the
+        default) draws no extra rolls, so pre-existing (nodes, n_windows,
+        seed) schedules are unchanged.
         """
         rng = np.random.default_rng(seed)
         nodes = tuple(nodes)
@@ -189,6 +233,10 @@ class FaultSchedule:
                     events += [FaultEvent(w, "flaky", n,
                                           fail_prob=flaky_prob),
                                FaultEvent(w + 1, "unflaky", n)]
+                elif degrade_rate and rng.random() < degrade_rate:
+                    events += [FaultEvent(w, "degrade", n,
+                                          factor=degrade_factor),
+                               FaultEvent(w + 1, "restore", n)]
         # Flush recoveries scheduled past the horizon: a node crashed near
         # the end must still heal if the replayed log runs longer than
         # ``n_windows``.
@@ -200,13 +248,15 @@ class FaultSchedule:
     def to_json(self) -> list[dict]:
         return [{"window": e.window, "kind": e.kind, "node": e.node,
                  **({"fail_prob": e.fail_prob} if e.kind == "flaky"
-                    else {})}
+                    else {}),
+                 **({"factor": e.factor} if e.kind == "degrade" else {})}
                 for e in self.events]
 
     @classmethod
     def from_json(cls, rows) -> "FaultSchedule":
         return cls([FaultEvent(int(r["window"]), r["kind"], r["node"],
-                               fail_prob=float(r.get("fail_prob", 0.0)))
+                               fail_prob=float(r.get("fail_prob", 0.0)),
+                               factor=float(r.get("factor", 1.0)))
                     for r in rows])
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid

@@ -7,19 +7,33 @@ fault schedule (faults/schedule.py), the repair scheduler
 (faults/repair.py) and the controller's migrations all mutate:
 
 * per-node status — up/down (crash/recover), decommissioned (permanent,
-  replicas destroyed), and a flaky fail-probability for repair targeting;
+  replicas destroyed), partitioned (up but unreachable as a group —
+  netsplit), a flaky fail-probability for repair targeting, and a
+  straggler throughput multiplier (degrade/restore);
 * the replica map — ``(n_files, n_nodes)`` int32 node ids, -1 = empty slot
   (width = node count: replicas are distinct-per-node, so no file can ever
   need more slots);
-* durability accounting — vectorized under-replicated / at-risk (1 live
-  replica) / lost (0 live replicas) tiers against an *effective* target
-  rf = min(target, up nodes) (a 3-replica target is unattainable with 2
-  nodes up; HDFS likewise re-replicates only to live capacity).
+* durability accounting — vectorized under-replicated / at-risk (1
+  reachable replica) / lost (0 live replicas) tiers against an *effective*
+  target rf = min(target, reachable nodes), plus two correlated-failure
+  views: **unreachable** (live replicas exist but every one is stranded
+  behind a partition — reads fail, data survives) and **correlated risk**
+  (>= 2 reachable replicas that all share ONE failure domain while a
+  second domain is available — a single rack/switch failure away from
+  unavailability, the gap HDFS rack-awareness and CRUSH failure-domain
+  buckets exist to close).
+
+Two masks tell the liveness story: ``live`` = the replica's node is up
+(data intact — partitioned nodes count, their disks are fine), ``reachable``
+= up AND not behind a partition (can serve reads, source or sink repair
+copies).  Without partitions they coincide, and every pre-partition
+behaviour is unchanged.
 
 Everything is deterministic and the whole state round-trips through
 ``state_arrays``/``load_state_arrays`` so a controller checkpoint taken
-mid-fault resumes bit-identically.  ``placement_view`` renders the live
-replicas back into a ``PlacementResult`` so the existing replay
+mid-fault resumes bit-identically (pre-partition checkpoints load with the
+new arrays defaulted).  ``placement_view`` renders the REACHABLE replicas
+back into a ``PlacementResult`` so the existing replay
 (cluster/evaluate.py) measures locality/balance under the outage — no
 second evaluation path.
 """
@@ -42,6 +56,8 @@ class ClusterState:
         n_nodes = len(self.nodes)
         n = placement.replica_map.shape[0]
         self._node_idx = {nm: i for i, nm in enumerate(self.nodes)}
+        self.domain_index = self.topology.domain_index()
+        self.n_domains = self.topology.n_domains
         self.sizes = np.asarray(size_bytes, dtype=np.int64)
         if self.sizes.shape != (n,):
             raise ValueError(
@@ -53,7 +69,10 @@ class ClusterState:
         self.replica_map = rm
         self.node_up = np.ones(n_nodes, dtype=bool)
         self.node_decommissioned = np.zeros(n_nodes, dtype=bool)
+        self.node_partitioned = np.zeros(n_nodes, dtype=bool)
         self.node_fail_prob = np.zeros(n_nodes, dtype=np.float64)
+        #: Straggler throughput multiplier in (0, 1]; 1.0 = nominal.
+        self.node_throughput = np.ones(n_nodes, dtype=np.float64)
         #: Bytes *assigned* per node (down replicas still occupy disk);
         #: the deterministic least-loaded repair-target preference.
         self.node_bytes = np.zeros(n_nodes, dtype=np.int64)
@@ -73,104 +92,193 @@ class ClusterState:
                 f"unknown node {node!r} (topology: {self.nodes})") from None
 
     def apply_event(self, ev) -> None:
-        """Apply one FaultEvent (faults/schedule.py)."""
-        i = self._nid(ev.node)
-        if ev.kind == "crash":
-            self.node_up[i] = False
-        elif ev.kind == "recover":
-            if not self.node_decommissioned[i]:
-                self.node_up[i] = True
-        elif ev.kind == "decommission":
-            self.node_up[i] = False
-            self.node_decommissioned[i] = True
-            gone = self.replica_map == i
-            self.node_bytes[i] = 0
-            self.replica_map[gone] = -1
-        elif ev.kind == "flaky":
-            self.node_fail_prob[i] = float(ev.fail_prob)
-        elif ev.kind == "unflaky":
-            self.node_fail_prob[i] = 0.0
-        else:  # pragma: no cover - FaultEvent validates kinds
-            raise ValueError(f"unknown fault kind {ev.kind!r}")
+        """Apply one FaultEvent (faults/schedule.py); partition/heal groups
+        (``dn2+dn3``) apply to every member atomically."""
+        for name in ev.node_list:
+            i = self._nid(name)
+            if ev.kind == "crash":
+                self.node_up[i] = False
+            elif ev.kind == "recover":
+                if not self.node_decommissioned[i]:
+                    self.node_up[i] = True
+            elif ev.kind == "decommission":
+                self.node_up[i] = False
+                self.node_decommissioned[i] = True
+                gone = self.replica_map == i
+                self.node_bytes[i] = 0
+                self.replica_map[gone] = -1
+            elif ev.kind == "partition":
+                self.node_partitioned[i] = True
+            elif ev.kind == "heal":
+                self.node_partitioned[i] = False
+            elif ev.kind == "flaky":
+                self.node_fail_prob[i] = float(ev.fail_prob)
+            elif ev.kind == "unflaky":
+                self.node_fail_prob[i] = 0.0
+            elif ev.kind == "degrade":
+                self.node_throughput[i] = float(ev.factor)
+            elif ev.kind == "restore":
+                self.node_throughput[i] = 1.0
+            else:  # pragma: no cover - FaultEvent validates kinds
+                raise ValueError(f"unknown fault kind {ev.kind!r}")
         self.version += 1
+
+    def node_reachable(self) -> np.ndarray:
+        """(n_nodes,) bool: up, not decommissioned, not partitioned."""
+        return (self.node_up & ~self.node_decommissioned
+                & ~self.node_partitioned)
 
     @property
     def n_available(self) -> int:
-        """Nodes that can hold a live replica right now."""
-        return int((self.node_up & ~self.node_decommissioned).sum())
+        """Nodes that can hold a live replica AND be reached right now."""
+        return int(self.node_reachable().sum())
+
+    @property
+    def n_partitioned(self) -> int:
+        return int(self.node_partitioned.sum())
+
+    def domains_reachable(self) -> int:
+        """Failure domains with at least one reachable node."""
+        reach = self.node_reachable()
+        return int(np.unique(self.domain_index[reach]).size)
 
     # -- replica accounting --------------------------------------------------
     def live_mask(self) -> np.ndarray:
-        """(n, n_nodes) bool: slot holds a replica on an UP node."""
+        """(n, n_nodes) bool: slot holds a replica on an UP node (the data
+        exists — partitioned holders count, their disks are fine)."""
         rm = self.replica_map
         return (rm >= 0) & self.node_up[np.clip(rm, 0, None)]
 
+    def reachable_mask(self) -> np.ndarray:
+        """(n, n_nodes) bool: slot holds a replica that can actually serve
+        (up AND not behind a partition)."""
+        rm = self.replica_map
+        return (rm >= 0) & self.node_reachable()[np.clip(rm, 0, None)]
+
     def live_counts(self) -> np.ndarray:
         return self.live_mask().sum(axis=1).astype(np.int32)
+
+    def reachable_counts(self) -> np.ndarray:
+        return self.reachable_mask().sum(axis=1).astype(np.int32)
+
+    def domain_spread(self) -> np.ndarray:
+        """(n,) int32: distinct failure domains holding a REACHABLE replica
+        of each file."""
+        reach = self.reachable_mask()
+        slot_dom = self.domain_index[np.clip(self.replica_map, 0, None)]
+        counts = np.zeros(self.replica_map.shape[0], dtype=np.int32)
+        for d in range(self.n_domains):
+            counts += ((slot_dom == d) & reach).any(axis=1)
+        return counts
 
     def effective_target(self, target_rf: np.ndarray) -> np.ndarray:
         return np.minimum(np.asarray(target_rf, dtype=np.int64),
                           self.n_available)
 
     def repair_needs(self, target_rf: np.ndarray):
-        """(file ids, live counts, effective targets) of every file below
-        its effective target — the repair planner's work list."""
-        live = self.live_counts()
+        """(file ids, reachable counts, effective targets) of every file
+        below its effective target — the repair planner's work list."""
+        reach = self.reachable_counts()
         eff = self.effective_target(target_rf)
-        fids = np.flatnonzero(live < eff)
-        return fids, live, eff
+        fids = np.flatnonzero(reach < eff)
+        return fids, reach, eff
+
+    def correlated_mask(self, target_rf: np.ndarray) -> np.ndarray:
+        """(n,) bool: files whose >= 2 reachable replicas ALL share one
+        failure domain while a second domain is reachable and the target
+        wants >= 2 — one rack/switch failure from unavailability.  An
+        overlay, not a tier: a file can be under-replicated AND
+        correlated."""
+        if self.n_domains < 2 or self.domains_reachable() < 2:
+            return np.zeros(self.replica_map.shape[0], dtype=bool)
+        reach = self.reachable_counts()
+        eff = self.effective_target(target_rf)
+        return (reach >= 2) & (self.domain_spread() == 1) & (eff >= 2)
 
     def durability(self, target_rf: np.ndarray, cat: np.ndarray,
                    categories) -> dict:
         """Vectorized durability tiers, total and per category.
 
-        Tiers are disjoint: ``lost`` (0 live replicas — unreadable until a
-        crashed holder recovers), ``at_risk`` (exactly 1 live replica when
-        the effective target wants more — one failure from loss),
-        ``under_replicated`` (>= 2 live but below target).  ``cat`` uses
-        -1 for not-yet-planned files, bucketed as "Unplanned".
+        Tiers are disjoint: ``lost`` (0 live replicas — every holder is
+        crashed/decommissioned), ``unreachable`` (live replicas exist but
+        all are stranded behind a partition — reads fail, data survives),
+        ``at_risk`` (exactly 1 reachable replica when the effective target
+        wants more), ``under_replicated`` (>= 2 reachable but below
+        target).  ``correlated_risk`` is an overlay count (see
+        ``correlated_mask``).  ``cat`` uses -1 for not-yet-planned files,
+        bucketed as "Unplanned".
         """
         live = self.live_counts()
+        reach = self.reachable_counts()
         eff = self.effective_target(target_rf)
         lost = live == 0
-        at_risk = (live == 1) & (eff >= 2)
-        under = (live >= 2) & (live < eff)
+        unreachable = (reach == 0) & ~lost
+        at_risk = (reach == 1) & (eff >= 2)
+        under = (reach >= 2) & (reach < eff)
 
         names = list(categories) + ["Unplanned"]
         bucket = np.where(np.asarray(cat) >= 0, cat, len(categories))
         per: dict[str, dict] = {}
         for mask, key in ((under, "under_replicated"), (at_risk, "at_risk"),
-                          (lost, "lost")):
+                          (unreachable, "unreachable"), (lost, "lost")):
             counts = np.bincount(bucket[mask], minlength=len(names))
             for ci, c in enumerate(counts):
                 if c:
                     per.setdefault(names[ci], {})[key] = int(c)
         return {
             "nodes_up": self.n_available,
+            "nodes_partitioned": self.n_partitioned,
+            "domains_reachable": self.domains_reachable(),
             "under_replicated": int(under.sum()),
             "at_risk": int(at_risk.sum()),
+            "unreachable": int(unreachable.sum()),
             "lost": int(lost.sum()),
+            "correlated_risk": int(self.correlated_mask(target_rf).sum()),
             "per_category": per,
         }
 
     def lost_mask(self) -> np.ndarray:
+        """Files with NO live replica anywhere (data gone until a crashed
+        holder recovers)."""
         return self.live_counts() == 0
 
+    def unreadable_mask(self) -> np.ndarray:
+        """Files a read cannot be served for right now: no reachable
+        replica (lost OR wholly stranded behind a partition)."""
+        return self.reachable_counts() == 0
+
     # -- mutation ------------------------------------------------------------
-    def pick_repair_target(self, fid: int, rotate: int = 0) -> int:
-        """Deterministic target for a new replica of ``fid``: an available
+    def _file_domains(self, fid: int) -> set:
+        """Domains already holding an ASSIGNED replica of ``fid`` (down
+        holders count: their copy returns on recovery)."""
+        row = self.replica_map[fid]
+        return {int(self.domain_index[x]) for x in row[row >= 0]}
+
+    def pick_repair_target(self, fid: int, rotate: int = 0,
+                           new_domain_only: bool = False) -> int:
+        """Deterministic target for a new replica of ``fid``: a reachable
         node not already assigned a replica (up OR down — a down holder
-        still owns the bytes and will return), least-loaded first.
-        ``rotate`` (the repair attempt count) steps through the candidate
-        ring so a retry after a flaky failure tries a different node."""
+        still owns the bytes and will return), preferring nodes in failure
+        domains the file does not yet occupy (maximum domain spread),
+        least-loaded within a preference class.  ``rotate`` (the repair
+        attempt count) steps through the candidate ring so a retry after a
+        flaky failure tries a different node.  ``new_domain_only``
+        restricts candidates to unoccupied domains (the correlated-risk
+        rebalance pass — a same-domain copy would not fix anything)."""
         row = self.replica_map[fid]
         holding = set(int(x) for x in row[row >= 0])
+        have_domains = self._file_domains(fid)
+        reach = self.node_reachable()
         avail = [i for i in range(len(self.nodes))
-                 if self.node_up[i] and not self.node_decommissioned[i]
-                 and i not in holding]
+                 if reach[i] and i not in holding]
+        if new_domain_only:
+            avail = [i for i in avail
+                     if int(self.domain_index[i]) not in have_domains]
         if not avail:
             return -1
-        avail.sort(key=lambda i: (int(self.node_bytes[i]), i))
+        avail.sort(key=lambda i: (
+            int(self.domain_index[i]) in have_domains,   # new domains first
+            int(self.node_bytes[i]), i))
         return avail[int(rotate) % len(avail)]
 
     def add_replica(self, fid: int, node: int) -> None:
@@ -190,19 +298,47 @@ class ClusterState:
             self.node_bytes[node] -= self.sizes[fid]
             self.version += 1
 
+    def _drop_order(self, fid: int, holders: list[int]) -> list[int]:
+        """Holders sorted most-droppable first: crowded domains lose
+        replicas before singleton domains (keep the spread the domain-aware
+        placement bought), most-loaded node within a domain class."""
+        dom_count: dict[int, int] = {}
+        for h in holders:
+            d = int(self.domain_index[h])
+            dom_count[d] = dom_count.get(d, 0) + 1
+        return sorted(holders, key=lambda i: (
+            -dom_count[int(self.domain_index[i])],
+            -int(self.node_bytes[i]), i))
+
+    def drop_crowded(self, fid: int) -> int:
+        """Drop one REACHABLE replica from the file's most-crowded domain
+        (the free half of a spread rebalance).  Returns the node dropped,
+        or -1 when the file has fewer than 2 reachable replicas."""
+        row = self.replica_map[fid]
+        reach = self.node_reachable()
+        holders = [int(x) for x in row[row >= 0] if reach[int(x)]]
+        if len(holders) < 2:
+            return -1
+        victim = self._drop_order(fid, holders)[0]
+        self.drop_replica(fid, victim)
+        return victim
+
     def apply_rf_target(self, fid: int, rf_new: int) -> int:
-        """Bring ``fid`` toward ``rf_new`` live replicas (capped at the
-        available node count): migrations call this when a planned rf
-        change applies.  Adds go to the least-loaded eligible node; drops
-        release down-but-assigned slots first (free metadata deletes),
-        then the most-loaded live holders.  Returns live delta."""
+        """Bring ``fid`` toward ``rf_new`` reachable replicas (capped at
+        the reachable node count): migrations call this when a planned rf
+        change applies.  Adds go to the spread-preferred least-loaded
+        eligible node; drops release down-but-assigned slots first (free
+        metadata deletes), then reachable holders crowded-domain-first.
+        Replicas stranded behind a partition are never dropped — they are
+        the durability story until the partition heals.  Returns reachable
+        delta."""
         target = min(int(rf_new), self.n_available)
-        live = int((self.live_mask()[fid]).sum())
+        live = int((self.reachable_mask()[fid]).sum())
         delta = 0
         if live == 0:
-            # No live source to copy from: a lost file cannot be
-            # re-replicated by fiat.  The repair path heals it to target
-            # the window a crashed holder recovers.
+            # No reachable source to copy from: a lost or stranded file
+            # cannot be re-replicated by fiat.  The repair path heals it
+            # the window a holder recovers or the partition heals.
             return 0
         while live < target:
             node = self.pick_repair_target(fid)
@@ -212,53 +348,51 @@ class ClusterState:
             live += 1
             delta += 1
         if live > target:
-            # Release dead-weight slots on DOWN nodes first.
+            # Release dead-weight slots on DOWN nodes first (partitioned
+            # nodes are up — their stranded copies are kept).
             row = self.replica_map[fid]
             for node in [int(x) for x in row[row >= 0]
                          if not self.node_up[int(x)]]:
                 self.drop_replica(fid, node)
+        reach = self.node_reachable()
         while live > target:
             row = self.replica_map[fid]
-            holders = [int(x) for x in row[row >= 0]
-                       if self.node_up[int(x)]]
+            holders = [int(x) for x in row[row >= 0] if reach[int(x)]]
             if not holders:  # pragma: no cover - live>target implies holders
                 break
-            holders.sort(key=lambda i: (-int(self.node_bytes[i]), i))
-            self.drop_replica(fid, holders[0])
+            self.drop_replica(fid, self._drop_order(fid, holders)[0])
             live -= 1
             delta -= 1
         return delta
 
     def trim_excess(self, target_rf: np.ndarray) -> int:
-        """Drop live replicas beyond the effective target (a recovered node
-        can resurface replicas the repair path already re-created) — free
-        metadata deletes, HDFS's excess-replica pruning.  Returns files
-        trimmed."""
-        live = self.live_counts()
+        """Drop reachable replicas beyond the effective target (a recovered
+        node or healed partition can resurface replicas the repair path
+        already re-created) — free metadata deletes, HDFS's excess-replica
+        pruning, crowded-domain-first so the trim never collapses the
+        spread.  Returns files trimmed."""
+        reach = self.reachable_counts()
         eff = self.effective_target(target_rf)
-        over = np.flatnonzero(live > eff)
+        over = np.flatnonzero(reach > eff)
         for fid in over:
             self.apply_rf_target(int(fid), int(eff[fid]))
         return int(over.size)
 
     # -- rendering back into the immutable world -----------------------------
     def placement_view(self) -> PlacementResult:
-        """The LIVE replicas as a PlacementResult (rows compacted so live
-        node ids lead, -1 padding trails) for cluster/evaluate.py replay.
-        Files with zero live replicas get rf=0 — their reads are served by
-        nobody and count as non-local."""
-        live = self.live_mask()
-        masked = np.where(live, self.replica_map, -1).astype(np.int32)
-        order = np.argsort(~live, axis=1, kind="stable")
+        """The REACHABLE replicas as a PlacementResult (rows compacted so
+        reachable node ids lead, -1 padding trails) for cluster/evaluate.py
+        replay.  Files with zero reachable replicas get rf=0 — their reads
+        are served by nobody and count as non-local."""
+        reach = self.reachable_mask()
+        masked = np.where(reach, self.replica_map, -1).astype(np.int32)
+        order = np.argsort(~reach, axis=1, kind="stable")
         compact = np.take_along_axis(masked, order, axis=1)
-        rf_live = live.sum(axis=1).astype(np.int32)
-        storage = np.zeros(len(self.nodes), dtype=np.int64)
-        sel = compact >= 0
-        np.add.at(storage, compact[sel],
-                  np.broadcast_to(self.sizes[:, None], compact.shape)[sel])
-        return PlacementResult(replica_map=compact, rf=rf_live,
-                               topology=self.topology,
-                               storage_per_node=storage)
+        rf_live = reach.sum(axis=1).astype(np.int32)
+        view = PlacementResult(replica_map=compact, rf=rf_live,
+                               topology=self.topology)
+        view.compute_storage(self.sizes)
+        return view
 
     # -- checkpoint ----------------------------------------------------------
     def state_arrays(self) -> dict[str, np.ndarray]:
@@ -266,7 +400,9 @@ class ClusterState:
             "fault_replica_map": self.replica_map.copy(),
             "fault_node_up": self.node_up.copy(),
             "fault_node_decommissioned": self.node_decommissioned.copy(),
+            "fault_node_partitioned": self.node_partitioned.copy(),
             "fault_node_fail_prob": self.node_fail_prob.copy(),
+            "fault_node_throughput": self.node_throughput.copy(),
         }
 
     def load_state_arrays(self, arrays: dict) -> None:
@@ -275,14 +411,23 @@ class ClusterState:
             raise ValueError(
                 f"checkpoint replica map shape {rm.shape} != "
                 f"{self.replica_map.shape} — stale checkpoint?")
+        n_nodes = len(self.nodes)
         self.replica_map = rm.copy()
         self.node_up = np.asarray(arrays["fault_node_up"],
                                   dtype=bool).copy()
         self.node_decommissioned = np.asarray(
             arrays["fault_node_decommissioned"], dtype=bool).copy()
+        # Pre-partition checkpoints lack the two newer arrays: default to
+        # "no partition, nominal throughput" rather than refusing to load.
+        self.node_partitioned = np.asarray(
+            arrays.get("fault_node_partitioned", np.zeros(n_nodes, bool)),
+            dtype=bool).copy()
         self.node_fail_prob = np.asarray(arrays["fault_node_fail_prob"],
                                          dtype=np.float64).copy()
-        self.node_bytes = np.zeros(len(self.nodes), dtype=np.int64)
+        self.node_throughput = np.asarray(
+            arrays.get("fault_node_throughput", np.ones(n_nodes)),
+            dtype=np.float64).copy()
+        self.node_bytes = np.zeros(n_nodes, dtype=np.int64)
         assigned = self.replica_map >= 0
         np.add.at(self.node_bytes, self.replica_map[assigned],
                   np.broadcast_to(self.sizes[:, None],

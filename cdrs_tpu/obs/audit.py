@@ -38,6 +38,13 @@ Threshold-based anomaly flags turn the metrics into verdicts:
 * ``repair_backlogged`` — the repair backlog stayed non-empty
   ``repair_backlog_windows`` windows running: nodes are failing faster
   than the churn budget lets the re-replicator heal.
+* ``domain_diversity_violated`` — files whose reachable replicas all sit
+  in ONE failure domain while a second domain is available
+  (``correlated_risk`` > 0): a single rack/switch failure away from
+  unavailability, the exact gap domain-aware placement exists to close.
+* ``partition_stalled_repairs`` — repairs were deferred this window
+  because every copy source is stranded behind a network partition; the
+  backlog cannot drain until the partition heals.
 
 One ``{"kind": "audit", ...}`` event per window rides the same JSONL stream
 as everything else, plus ``audit.*`` gauges (silhouette, entropy, byte
@@ -219,10 +226,16 @@ class DecisionAuditor:
             flags.append("locality_regressed")
         dur = rec.get("durability")
         if dur is not None:
-            event["durability"] = {k: dur[k] for k in
-                                   ("under_replicated", "at_risk", "lost")}
+            event["durability"] = {
+                k: dur.get(k, 0) for k in
+                ("under_replicated", "at_risk", "lost", "unreachable",
+                 "correlated_risk")}
             if dur["lost"]:
                 flags.append("durability_lost")
+            if dur.get("correlated_risk"):
+                flags.append("domain_diversity_violated")
+        if rec.get("repair_deferred_partition"):
+            flags.append("partition_stalled_repairs")
         if rec.get("repair_backlog"):
             self._repair_streak += 1
         else:

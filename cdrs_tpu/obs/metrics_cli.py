@@ -118,8 +118,20 @@ def _render_durability(windows: list[dict], out) -> None:
     line = (f"  repair: {rep_moves} replicas, {_fmt_bytes(rep_bytes)}"
             + (f", {rep_failed} failed copies" if rep_failed else ""))
     if unavail:
-        line += f"; {unavail} reads hit lost files"
+        line += f"; {unavail} reads hit unreadable files"
     print(line, file=out)
+    part_w = sum(1 for w in dur_w
+                 if w["durability"].get("nodes_partitioned"))
+    stalled = sum(int(w.get("repair_deferred_partition", 0))
+                  for w in windows)
+    rebal = sum(int(w.get("repair_rebalanced", 0)) for w in windows)
+    corr_max = max((w["durability"].get("correlated_risk", 0)
+                    for w in dur_w), default=0)
+    if part_w or stalled or rebal or corr_max:
+        print(f"  domains: {part_w} partitioned windows, {stalled} "
+              f"partition-stalled repairs, {rebal} spread rebalances, "
+              f"correlated-risk max {corr_max} "
+              f"(final {last.get('correlated_risk', 0)})", file=out)
 
 
 def _render_audit(audits: list[dict], out) -> None:

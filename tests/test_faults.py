@@ -427,14 +427,13 @@ def test_migration_scheduler_rejects_malformed_arrays():
 def test_placement_rf_cap_warns_and_counts():
     import warnings
 
-    import cdrs_tpu.cluster.placement as P
-    from cdrs_tpu.cluster import ClusterTopology, place_replicas
+    from cdrs_tpu.cluster import (ClusterTopology, place_replicas,
+                                  reset_rf_cap_warning)
     from cdrs_tpu.obs import Telemetry
 
     manifest = generate_population(GeneratorConfig(n_files=30, seed=1))
     rf = np.full(30, 4, dtype=np.int32)  # Archival rf=4, 3-node topology
-    monkey_old = P._RF_CAP_WARNED
-    P._RF_CAP_WARNED = False
+    reset_rf_cap_warning()
     try:
         tel = Telemetry()
         with tel:
@@ -447,8 +446,14 @@ def test_placement_rf_cap_warns_and_counts():
                 place_replicas(manifest, rf,
                                ClusterTopology(("dn1", "dn2", "dn3")))
             assert tel.counters["placement.rf_capped"] == 60
+            # The latch is resettable (test isolation): re-arm and it
+            # fires again within the same process.
+            reset_rf_cap_warning()
+            with pytest.warns(UserWarning, match="capped at the node"):
+                place_replicas(manifest, rf,
+                               ClusterTopology(("dn1", "dn2", "dn3")))
     finally:
-        P._RF_CAP_WARNED = monkey_old
+        reset_rf_cap_warning()
 
 
 # -- cdrs chaos CLI ----------------------------------------------------------

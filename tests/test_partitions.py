@@ -1,0 +1,540 @@
+"""Failure domains, network partitions, stragglers (ISSUE 5):
+domain-aware placement properties, topology validation, partition/degrade
+fault kinds, correlated-risk durability, stall-aware repair, rack-kill
+bench.
+
+``CDRS_CHAOS_SEED`` varies the workload seeds — CI's partition+straggler
+smoke step runs this file alongside the test_faults chaos matrix.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.cluster import (
+    ClusterTopology,
+    PlacementResult,
+    evaluate_placement,
+    place_replicas,
+)
+from cdrs_tpu.config import (
+    CATEGORIES,
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from cdrs_tpu.control import ControllerConfig, ReplicationController
+from cdrs_tpu.faults import (
+    ClusterState,
+    FaultEvent,
+    FaultSchedule,
+    RepairScheduler,
+)
+from cdrs_tpu.sim.access import simulate_access
+from cdrs_tpu.sim.generator import generate_population
+
+SEED = int(os.environ.get("CDRS_CHAOS_SEED", "0"))
+NODES = ("dn1", "dn2", "dn3", "dn4", "dn5", "dn6")
+RACK_SPEC = "r0=dn1,dn2;r1=dn3,dn4;r2=dn5,dn6"
+
+
+def _racked():
+    return ClusterTopology.from_rack_spec(NODES, RACK_SPEC)
+
+
+@pytest.fixture(scope="module")
+def workload():
+    manifest = generate_population(
+        GeneratorConfig(n_files=150, seed=51 + SEED, nodes=NODES))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=600.0, seed=52 + SEED))
+    return manifest, events
+
+
+# -- topology validation (satellite) -----------------------------------------
+
+def test_topology_rejects_duplicates_and_empty():
+    with pytest.raises(ValueError, match="duplicate node names"):
+        ClusterTopology(("dn1", "dn2", "dn1"))
+    with pytest.raises(ValueError, match="at least one node"):
+        ClusterTopology(())
+    with pytest.raises(ValueError, match="parallel to nodes"):
+        ClusterTopology(("dn1", "dn2"), domains=("r0",))
+
+
+def test_topology_rack_mapping_and_spec():
+    t = ClusterTopology.from_racks(("a", "b", "c"), {"a": "r0", "b": "r0"})
+    assert t.domains == ("r0", "r0", "c")      # unmapped -> own domain
+    assert t.n_domains == 2
+    np.testing.assert_array_equal(t.domain_index(), [0, 0, 1])
+    with pytest.raises(ValueError, match="outside the topology"):
+        ClusterTopology.from_racks(("a", "b"), {"z": "r0"})
+
+    t2 = ClusterTopology.from_rack_spec(NODES, RACK_SPEC)
+    assert t2.domain_names == ("r0", "r1", "r2")
+    t3 = ClusterTopology.from_rack_spec(("a", "b", "c"), "a,b;c")
+    assert t3.domain_names == ("rack0", "rack1")
+    with pytest.raises(ValueError, match="two rack groups"):
+        ClusterTopology.from_rack_spec(("a", "b"), "r0=a;r1=a,b")
+    with pytest.raises(ValueError, match="names no nodes"):
+        ClusterTopology.from_rack_spec(("a",), ";")
+    # An auto-named bare group colliding with an explicit 'rack0=' must
+    # raise, not silently merge two groups into one failure domain.
+    with pytest.raises(ValueError, match="duplicate rack name"):
+        ClusterTopology.from_rack_spec(("a", "b", "c", "d"),
+                                       "a,b;rack0=c,d")
+
+
+# -- domain-aware placement properties (satellite) ---------------------------
+
+def test_placement_domain_properties():
+    """Property-style: over random rf vectors, placement (a) never
+    co-locates two replicas on one node, (b) spans >= 2 domains whenever
+    rf >= 2 and >= 2 domains exist, (c) is bit-identical across repeated
+    calls, (d) puts replica 2 in replica 1's remote domain (HDFS shape)."""
+    rng = np.random.default_rng(500 + SEED)
+    topo = _racked()
+    dom = topo.domain_index()
+    for trial in range(4):
+        n = int(rng.integers(40, 120))
+        manifest = generate_population(GeneratorConfig(
+            n_files=n, seed=int(rng.integers(0, 1000)), nodes=NODES))
+        rf = rng.integers(1, 7, size=n).astype(np.int32)
+        p = place_replicas(manifest, rf, topo, seed=trial)
+        for i in range(n):
+            reps = p.replica_map[i][p.replica_map[i] >= 0]
+            assert len(set(reps.tolist())) == len(reps) == p.rf[i]
+            assert p.replica_map[i, 0] == manifest.primary_node_id[i]
+        dc = p.domain_counts()
+        assert (dc[p.rf >= 2] >= 2).all()
+        r3 = p.replica_map[p.rf >= 3]
+        if len(r3):
+            assert (dom[r3[:, 0]] != dom[r3[:, 1]]).all()
+            assert (dom[r3[:, 1]] == dom[r3[:, 2]]).all()
+        p2 = place_replicas(manifest, rf, topo, seed=trial)
+        np.testing.assert_array_equal(p.replica_map, p2.replica_map)
+
+
+def test_flat_topology_equals_singleton_domains():
+    """No ``domains`` == every node its own domain == the historical flat
+    policy: all three spell the same replica map."""
+    manifest = generate_population(
+        GeneratorConfig(n_files=80, seed=3 + SEED, nodes=NODES))
+    rf = np.random.default_rng(SEED).integers(1, 5, size=80).astype(np.int32)
+    flat = place_replicas(manifest, rf, ClusterTopology(NODES), seed=2)
+    singl = place_replicas(manifest, rf,
+                           ClusterTopology(NODES, domains=NODES), seed=2)
+    np.testing.assert_array_equal(flat.replica_map, singl.replica_map)
+
+
+def test_placement_result_storage_optional():
+    """Satellite: ``storage_per_node`` defaults to None and consumers
+    guard it — a hand-built PlacementResult evaluates fine, and the lazy
+    compute matches the eager one."""
+    manifest = generate_population(
+        GeneratorConfig(n_files=40, seed=4, nodes=NODES))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=60.0, seed=5))
+    rf = np.full(40, 2, dtype=np.int32)
+    eager = place_replicas(manifest, rf, ClusterTopology(NODES), seed=0)
+    bare = PlacementResult(replica_map=eager.replica_map.copy(),
+                           rf=eager.rf.copy(), topology=eager.topology)
+    assert bare.storage_per_node is None
+    m = evaluate_placement(manifest, events, bare, seed=0)
+    np.testing.assert_array_equal(m.storage_per_node,
+                                  eager.storage_per_node)
+    assert m.total_storage == int(eager.storage_per_node.sum())
+
+
+# -- schedule: partition / degrade kinds -------------------------------------
+
+def test_schedule_partition_and_degrade_specs():
+    s = FaultSchedule.from_specs(
+        ["partition:dn3+dn4@4-6", "degrade:dn5@2-3:0.25"])
+    # Window 4 carries degrade's span-end restore AND the partition start,
+    # healing kinds first (KINDS order).
+    assert [e.spec() for e in s.for_window(4)] == \
+        ["restore:dn5@4", "partition:dn3+dn4@4"]
+    assert s.for_window(7) == (FaultEvent(7, "heal", "dn3+dn4"),)
+    assert s.for_window(2)[0].factor == 0.25
+    assert s.for_window(4)[1].node_list == ("dn3", "dn4")
+    assert s.nodes() == ("dn3", "dn4", "dn5")
+    # heal sorts before partition within a window (KINDS order).
+    s2 = FaultSchedule([FaultEvent(1, "partition", "dn1"),
+                        FaultEvent(1, "heal", "dn2")])
+    assert [e.kind for e in s2.for_window(1)] == ["heal", "partition"]
+    # JSON round-trip carries the degrade factor.
+    assert FaultSchedule.from_json(s.to_json()).events == s.events
+    with pytest.raises(ValueError, match="factor must be in"):
+        FaultEvent(0, "degrade", "dn1", factor=0.0)
+    with pytest.raises(ValueError, match="only valid for partition/heal"):
+        FaultEvent(0, "crash", "dn1+dn2")
+    with pytest.raises(ValueError, match="outside the topology"):
+        s.validate_nodes(("dn3", "dn5"))   # dn4 hides inside the group
+
+
+# -- cluster state: partitions, stragglers, correlated risk ------------------
+
+def _state(rf=2, n=24, topology=None, seed=None):
+    topology = topology or _racked()
+    manifest = generate_population(GeneratorConfig(
+        n_files=n, seed=(10 + SEED) if seed is None else seed,
+        nodes=topology.nodes))
+    placement = place_replicas(manifest, np.full(n, rf, dtype=np.int32),
+                               topology, seed=0)
+    return ClusterState(placement, manifest.size_bytes)
+
+
+def test_state_partition_reachable_vs_live():
+    st = _state(rf=2)
+    base = st.live_counts().copy()
+    st.apply_event(FaultEvent(0, "partition", "dn3+dn4"))
+    assert st.n_partitioned == 2 and st.n_available == 4
+    # Data intact (live unchanged), service degraded (reachable drops).
+    np.testing.assert_array_equal(st.live_counts(), base)
+    held = ((st.replica_map == 2) | (st.replica_map == 3)).any(axis=1)
+    np.testing.assert_array_equal(
+        st.reachable_counts(), base - held.astype(np.int32))
+    # Domain-aware rf=2 placement spans 2 racks: nothing is unreadable.
+    assert not st.unreadable_mask().any()
+    assert st.domains_reachable() == 2
+    st.apply_event(FaultEvent(1, "heal", "dn3+dn4"))
+    np.testing.assert_array_equal(st.reachable_counts(), base)
+    assert st.n_available == 6
+
+
+def test_state_stranded_files_flat_topology():
+    """Flat topology + rf=1: partitioning a node strands its singleton
+    replicas — unreachable (not lost), unreadable, healed by the heal."""
+    st = _state(rf=1, topology=ClusterTopology(NODES))
+    on_dn1 = (st.replica_map == 0).any(axis=1)
+    if not on_dn1.any():
+        pytest.skip("no singleton landed on dn1 at this seed")
+    st.apply_event(FaultEvent(0, "partition", "dn1"))
+    target = np.full(24, 1, dtype=np.int64)
+    d = st.durability(target, np.zeros(24, dtype=np.int64), CATEGORIES)
+    assert d["unreachable"] == int(on_dn1.sum()) and d["lost"] == 0
+    np.testing.assert_array_equal(st.unreadable_mask(), on_dn1)
+    # placement_view hides stranded replicas from the replay.
+    assert (st.placement_view().rf[on_dn1] == 0).all()
+    st.apply_event(FaultEvent(1, "heal", "dn1"))
+    d2 = st.durability(target, np.zeros(24, dtype=np.int64), CATEGORIES)
+    assert d2["unreachable"] == 0 and not st.unreadable_mask().any()
+
+
+def test_state_degrade_restore_and_checkpoint_roundtrip():
+    st = _state(rf=2)
+    st.apply_event(FaultEvent(0, "degrade", "dn5", factor=0.25))
+    st.apply_event(FaultEvent(0, "partition", "dn1"))
+    assert st.node_throughput[4] == 0.25 and st.node_partitioned[0]
+    arrays = st.state_arrays()
+    st2 = _state(rf=2)
+    st2.load_state_arrays(arrays)
+    np.testing.assert_array_equal(st2.node_partitioned, st.node_partitioned)
+    np.testing.assert_array_equal(st2.node_throughput, st.node_throughput)
+    st.apply_event(FaultEvent(1, "restore", "dn5"))
+    assert st.node_throughput[4] == 1.0
+    # Back-compat: a pre-partition checkpoint (no partition/throughput
+    # arrays) loads with defaults instead of raising.
+    legacy = {k: v for k, v in arrays.items()
+              if k not in ("fault_node_partitioned",
+                           "fault_node_throughput")}
+    st3 = _state(rf=2)
+    st3.load_state_arrays(legacy)
+    assert not st3.node_partitioned.any()
+    assert (st3.node_throughput == 1.0).all()
+
+
+def test_state_correlated_risk_matches_bruteforce():
+    """Vectorized correlated/unreachable accounting == per-file brute
+    force over random partition/crash states on the racked topology."""
+    rng = np.random.default_rng(300 + SEED)
+    topo = _racked()
+    dom = topo.domain_index()
+    for trial in range(5):
+        st = _state(rf=1 + int(rng.integers(0, 3)), n=40,
+                    seed=int(rng.integers(0, 1000)))
+        target = rng.integers(1, 5, size=40).astype(np.int64)
+        cat = rng.integers(-1, 4, size=40).astype(np.int64)
+        for i in np.flatnonzero(rng.random(6) < 0.3):
+            st.apply_event(FaultEvent(0, "crash", NODES[i]))
+        for i in np.flatnonzero(rng.random(6) < 0.3):
+            if st.node_up[i]:
+                st.apply_event(FaultEvent(0, "partition", NODES[i]))
+        d = st.durability(target, cat, CATEGORIES)
+        reach_nodes = st.node_reachable()
+        avail = int(reach_nodes.sum())
+        doms_reach = len({int(dom[i]) for i in range(6) if reach_nodes[i]})
+        lost = unreach = at_risk = under = corr = 0
+        for f in range(40):
+            row = st.replica_map[f]
+            live = sum(1 for x in row if x >= 0 and st.node_up[x])
+            reach = [int(x) for x in row
+                     if x >= 0 and reach_nodes[int(x)]]
+            eff = min(int(target[f]), avail)
+            if live == 0:
+                lost += 1
+            elif not reach:
+                unreach += 1
+            elif len(reach) == 1 and eff >= 2:
+                at_risk += 1
+            elif 2 <= len(reach) < eff:
+                under += 1
+            if (len(reach) >= 2 and eff >= 2 and doms_reach >= 2
+                    and len({int(dom[x]) for x in reach}) == 1):
+                corr += 1
+        assert (d["lost"], d["unreachable"], d["at_risk"],
+                d["under_replicated"], d["correlated_risk"]) == \
+            (lost, unreach, at_risk, under, corr)
+        assert d["domains_reachable"] == doms_reach
+        tier_sum = sum(v for c in d["per_category"].values()
+                       for v in c.values())
+        assert tier_sum == lost + unreach + at_risk + under
+
+
+# -- repair: stalls, stragglers, spread rebalance ----------------------------
+
+def test_repair_defers_stranded_without_burning_budget():
+    """A file wholly behind a partition defers (deferred_partition, zero
+    bytes), backs off exponentially, and repairs the window the partition
+    heals — the stall backoff must not outlive the stranding."""
+    st = _state(rf=1, topology=ClusterTopology(NODES))
+    on_dn1 = (st.replica_map == 0).any(axis=1)
+    if not on_dn1.any():
+        pytest.skip("no singleton landed on dn1 at this seed")
+    st.apply_event(FaultEvent(0, "partition", "dn1"))
+    target = np.full(24, 2, dtype=np.int64)   # want 2, strand the source
+    cat = np.zeros(24, dtype=np.int64)
+    rs = RepairScheduler(seed=SEED)
+    rs.sync(st, target)
+    r0 = rs.schedule(0, st, target, cat, max_bytes=10**12)
+    n_stranded = int(on_dn1.sum())
+    assert r0.deferred_partition == n_stranded
+    stranded_fid = int(np.flatnonzero(on_dn1)[0])
+    assert rs.backlog[stranded_fid].stall_until > 1   # backoff armed
+    # Stranded copies never touched the budget.
+    bytes_reachable = sum(
+        int(st.sizes[f]) for f in range(24) if not on_dn1[f])
+    assert r0.bytes_used <= 2 * bytes_reachable
+    r1 = rs.schedule(1, st, target, cat)
+    assert r1.deferred_backoff >= n_stranded and r1.deferred_partition == 0
+    # Heal: the stall backoff is ignored the moment a source is reachable.
+    st.apply_event(FaultEvent(2, "heal", "dn1"))
+    rs.sync(st, target)
+    r2 = rs.schedule(2, st, target, cat)
+    assert r2.deferred_partition == 0
+    assert (st.reachable_counts() >= 2).all()
+
+
+def test_repair_charges_straggler_inflation():
+    """Copies routed through a degraded node charge size/throughput of
+    budget while moving only ``size`` data bytes."""
+    topo = ClusterTopology(("dn1", "dn2"))
+    manifest = generate_population(
+        GeneratorConfig(n_files=4, seed=1, nodes=topo.nodes))
+    placement = place_replicas(manifest, np.full(4, 1, dtype=np.int32),
+                               topo, seed=0)
+    st = ClusterState(placement, manifest.size_bytes)
+    st.apply_event(FaultEvent(0, "degrade", "dn1", factor=0.25))
+    st.apply_event(FaultEvent(0, "degrade", "dn2", factor=0.25))
+    target = np.full(4, 2, dtype=np.int64)
+    rs = RepairScheduler(seed=SEED)
+    rs.sync(st, target)
+    rep = rs.schedule(0, st, target, np.zeros(4, dtype=np.int64))
+    assert rep.bytes_copied == int(manifest.size_bytes.sum())
+    assert rep.bytes_used == sum(
+        int(np.ceil(int(s) / 0.25)) for s in manifest.size_bytes)
+    st.apply_event(FaultEvent(1, "restore", "dn1"))
+    st.apply_event(FaultEvent(1, "restore", "dn2"))
+    # Budget admission uses the inflated charge: a degraded-route copy
+    # bigger than the budget defers (after the first-copy exemption).
+    st2 = ClusterState(place_replicas(
+        manifest, np.full(4, 1, dtype=np.int32), topo, seed=0),
+        manifest.size_bytes)
+    st2.apply_event(FaultEvent(0, "degrade", "dn2", factor=0.5))
+    rs2 = RepairScheduler(seed=SEED)
+    rs2.sync(st2, target)
+    budget = int(manifest.size_bytes.sum())   # fits raw, not inflated 2x
+    rep2 = rs2.schedule(0, st2, target, np.zeros(4, dtype=np.int64),
+                        max_bytes=budget)
+    assert rep2.deferred_budget > 0
+    assert rep2.bytes_used <= max(budget,
+                                  2 * int(manifest.size_bytes.max()))
+
+
+def test_repair_rebalances_correlated_files():
+    """A file at target rf with both replicas in ONE rack gets one replica
+    moved to a fresh rack (copy budgeted, drop free, net count equal)."""
+    topo = _racked()
+    manifest = generate_population(
+        GeneratorConfig(n_files=6, seed=2, nodes=NODES))
+    placement = place_replicas(manifest, np.full(6, 2, dtype=np.int32),
+                               topo, seed=0)
+    st = ClusterState(placement, manifest.size_bytes)
+    # Force file 0 into rack r0 only (dn1=0, dn2=1).
+    row = st.replica_map[0]
+    for x in [int(v) for v in row[row >= 0]]:
+        st.drop_replica(0, x)
+    st.add_replica(0, 0)
+    st.add_replica(0, 1)
+    target = np.full(6, 2, dtype=np.int64)
+    assert st.correlated_mask(target)[0]
+    rs = RepairScheduler(seed=SEED)
+    rs.sync(st, target)
+    assert 0 in rs.backlog
+    rep = rs.schedule(0, st, target, np.zeros(6, dtype=np.int64))
+    assert rep.rebalanced >= 1
+    assert not st.correlated_mask(target)[0]
+    assert st.reachable_counts()[0] == 2      # move, not grow
+    assert not rs.backlog.get(0)              # healed out of the backlog
+
+
+# -- controller + auditor + CLI ----------------------------------------------
+
+def test_controller_partition_stalls_then_heals(workload):
+    """Flat topology, rf=1 default: a partitioned node strands singleton
+    files (unreachable tier, unavailable reads, stalled repairs — NO
+    budget burned on them), and the heal clears everything."""
+    manifest, events = workload
+    sched = FaultSchedule.from_specs(["partition:dn2@1-2"])
+    res = ReplicationController(
+        manifest, ControllerConfig(
+            window_seconds=120.0, kmeans=KMeansConfig(k=8, seed=42),
+            scoring=validated_scoring_config(), drift_threshold=10.0,
+            fault_schedule=sched)).run(events)
+    by_w = {r["window"]: r for r in res.records}
+    if by_w[1]["durability"]["unreachable"] == 0:
+        pytest.skip("no singleton replica landed on dn2 at this seed")
+    assert by_w[1]["durability"]["lost"] == 0
+    assert by_w[1]["repair_deferred_partition"] >= 0
+    last = res.records[-1]["durability"]
+    assert last["unreachable"] == 0 and last["lost"] == 0
+    d = res.summary()["durability"]
+    assert d["unreachable_max"] > 0 and d["unreachable_final"] == 0
+
+
+def test_controller_rack_partition_with_straggler_resumes(tmp_path,
+                                                          workload):
+    """Racked topology + rack partition + straggler: domain spread keeps
+    every file readable, the run heals clean, and kill/resume
+    mid-partition is bit-identical (partition + throughput state ride the
+    checkpoint)."""
+    import dataclasses
+
+    manifest, events = workload
+    base = validated_scoring_config()
+    scoring = dataclasses.replace(
+        base, replication_factors={c: max(2, r) for c, r in
+                                   base.replication_factors.items()})
+
+    def mk():
+        sched = FaultSchedule.from_specs(
+            ["partition:dn3+dn4@1-2", "degrade:dn5@1-3:0.25"])
+        return ReplicationController(
+            manifest, ControllerConfig(
+                window_seconds=120.0, default_rf=2,
+                kmeans=KMeansConfig(k=8, seed=42), scoring=scoring,
+                fault_schedule=sched, topology=_racked()))
+
+    def strip(rs):
+        return [{k: v for k, v in r.items() if k != "seconds"}
+                for r in rs]
+
+    ref = mk().run(events)
+    assert all(r["durability"]["lost"] == 0 for r in ref.records)
+    assert all(r["durability"]["unreachable"] == 0 for r in ref.records)
+    last = ref.records[-1]["durability"]
+    assert last["correlated_risk"] == 0 and last["under_replicated"] == 0
+    ck = str(tmp_path / "part.npz")
+    a = mk().run(events, checkpoint_path=ck, max_windows=2)  # mid-partition
+    b = mk().run(events, checkpoint_path=ck)
+    assert strip(a.records) + strip(b.records) == strip(ref.records)
+    np.testing.assert_array_equal(b.rf, ref.rf)
+
+
+def test_controller_topology_must_match_manifest(workload):
+    manifest, _ = workload
+    bad = ClusterTopology(("dn1", "dn2"))
+    with pytest.raises(ValueError, match="manifest"):
+        ReplicationController(
+            manifest, ControllerConfig(
+                kmeans=KMeansConfig(k=8, seed=42),
+                fault_schedule=FaultSchedule.from_specs(["crash:dn1@0"]),
+                topology=bad))
+
+
+def test_audit_flags_domain_and_partition_anomalies():
+    from cdrs_tpu.obs import Telemetry
+    from cdrs_tpu.obs.audit import DecisionAuditor
+
+    aud = DecisionAuditor(np.ones(4, dtype=np.int64), len(CATEGORIES))
+    rec = {"window": 3, "recluster": False, "deferred_budget": 0,
+           "repair_deferred_partition": 2, "repair_backlog": 0,
+           "durability": {"under_replicated": 0, "at_risk": 0, "lost": 0,
+                          "unreachable": 1, "correlated_risk": 3}}
+    tel = Telemetry()
+    with tel:
+        ev = aud.audit_window(tel, window=3, rec=rec, X=None,
+                              centroids=None,
+                              rf=np.full(4, 2, dtype=np.int64),
+                              cat=np.zeros(4, dtype=np.int64))
+    assert "domain_diversity_violated" in ev["flags"]
+    assert "partition_stalled_repairs" in ev["flags"]
+    assert ev["durability"]["correlated_risk"] == 3
+    assert tel.counters["audit.flags.domain_diversity_violated"] == 1
+
+
+def test_cli_chaos_racks_partition_degrade(tmp_path, capsys):
+    from cdrs_tpu.cli import main
+
+    m = str(tmp_path / "m.csv")
+    log = str(tmp_path / "a.log")
+    assert main(["gen", "--n", "60", "--nodes", ",".join(NODES),
+                 "--seed", str(60 + SEED), "--out_manifest", m]) == 0
+    assert main(["simulate", "--manifest", m, "--out", log,
+                 "--duration_seconds", "300", "--seed",
+                 str(61 + SEED)]) == 0
+    sched_out = str(tmp_path / "sched.json")
+    capsys.readouterr()
+    assert main(["chaos", "--manifest", m, "--access_log", log,
+                 "--window_seconds", "60", "--scoring_config", "validated",
+                 "--default_rf", "2", "--racks", RACK_SPEC,
+                 "--partition", "dn3+dn4@1-2", "--degrade", "dn5@2-3:0.25",
+                 "--schedule_out", sched_out]) == 0
+    out = json.loads(capsys.readouterr().out)
+    d = out["durability"]
+    assert d["lost_final"] == 0 and d["unreachable_final"] == 0
+    assert d["correlated_risk_final"] == 0
+    rows = json.load(open(sched_out))
+    assert {r["kind"] for r in rows} == {"partition", "heal", "degrade",
+                                         "restore"}
+    assert any(r.get("factor") == 0.25 for r in rows)
+    # A malformed rack spec is a clean argparse-style failure, not a crash.
+    with pytest.raises(ValueError, match="two rack groups"):
+        main(["chaos", "--manifest", m, "--access_log", log,
+              "--racks", "r0=dn1;r1=dn1", "--kill", "dn2@1"])
+
+
+# -- rack-kill bench harness -------------------------------------------------
+
+def test_rack_bench_small_scenario():
+    """Rack kill at toy scale: zero lost under domain-aware placement,
+    measurable loss under the flat policy on the same seed/schedule, the
+    partition scenario heals clean and resumes bit-identically."""
+    from cdrs_tpu.benchmarks.chaos_bench import run_rack_bench
+
+    out = run_rack_bench(n_files=120, seed=17 + SEED, duration=720.0,
+                         n_windows=8, kill_window=3,
+                         partition_windows=(2, 4), resume_check=True)
+    c = out["criteria"]
+    assert c["domain_aware_zero_lost"]
+    assert c["flat_loses_files"]
+    assert c["domain_recovered_within_run"]
+    assert c["partition_heals_clean"]
+    assert c["budget_respected"]
+    assert c["partition_resume_bit_identical"]
+    assert out["rack_kill"]["flat"]["files_lost_max"] > 0
+    assert out["rack_kill"]["domain_aware"]["files_lost_max"] == 0
