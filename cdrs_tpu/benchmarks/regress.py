@@ -122,11 +122,17 @@ def _scrape_tail(tail: str, source: str, round_no: int | None
 def extract_records(doc, source: str) -> list[dict]:
     """Flatten one bench artifact (driver capture or raw detail JSON) into
     history records: the headline metric plus completed nested config
-    blocks (``config3``, ``config4_rehearsal``)."""
+    blocks (``config3``, ``config4_rehearsal``) and — for artifacts that
+    carry several comparable metrics, like ``data/serve_bench.json`` —
+    every entry of a top-level ``bench_records`` list."""
     round_no = None
     m = re.search(r"r(\d+)", os.path.basename(source))
     if m:
         round_no = int(m.group(1))
+    # Artifacts whose filename carries no round (data/serve_bench.json)
+    # stamp it explicitly — ingest stays reproducible from the file alone.
+    if isinstance(doc, dict) and isinstance(doc.get("round"), int):
+        round_no = doc["round"]
     if isinstance(doc, dict) and "n" in doc and "cmd" in doc:
         round_no = int(doc["n"])
         detail = doc.get("parsed")
@@ -142,6 +148,10 @@ def extract_records(doc, source: str) -> list[dict]:
         records.append(rec)
     for block in _NESTED_BLOCKS:
         rec = _record_from(detail.get(block), source, round_no)
+        if rec:
+            records.append(rec)
+    for entry in detail.get("bench_records") or ():
+        rec = _record_from(entry, source, round_no)
         if rec:
             records.append(rec)
     return records

@@ -440,8 +440,19 @@ def _controller_cfg(args, fault_schedule=None, topology=None):
     """ControllerConfig from the shared control/chaos argument set."""
     from .control import ControllerConfig
 
+    serve_cfg = None
+    if getattr(args, "serve", False):
+        from .serve import ServeConfig, SloSpec
+
+        serve_cfg = ServeConfig(
+            policy=args.serve_policy, seed=args.serve_seed,
+            service_ms=args.serve_service_ms,
+            slo=SloSpec(target_ms=args.serve_slo_ms,
+                        availability=args.serve_slo_availability),
+            recluster_on_hotspot=not args.no_hotspot_recluster)
     return ControllerConfig(
         topology=topology,
+        serve=serve_cfg,
         window_seconds=args.window_seconds,
         drift_threshold=args.drift_threshold,
         full_recluster_drift=args.full_drift,
@@ -550,6 +561,123 @@ def _cmd_chaos(args) -> int:
               file=sys.stderr)
     return _run_controller(args, _controller_cfg(args, schedule, topology),
                            "chaos_cmd", manifest=manifest)
+
+
+def _cmd_serve(args) -> int:
+    """Read-path SLO replay: drive the vectorized read router over the
+    access log in time windows against a static placement (serve/), with
+    optional fault injection (partitions, stragglers, crashes) shaping
+    reachability and service times.  Prints a JSON serving digest; with
+    --metrics, streams ``serve.*`` telemetry (latency hist_bulk, p99/SLO
+    gauges, hotspot counters) plus per-window records that ``cdrs metrics
+    summarize|report`` digest into the serving section."""
+    import contextlib
+
+    from .cluster.evaluate import _client_to_topology
+    from .cluster.placement import ClusterTopology, place_replicas
+    from .control.windows import iter_windows
+    from .faults import FaultSchedule
+    from .faults.state import ClusterState
+    from .io.events import Manifest
+    from .obs import current as _obs_current
+    from .serve import (
+        HotspotDetector,
+        ReadRouter,
+        ServeConfig,
+        SloSpec,
+        emit_window_telemetry,
+    )
+
+    manifest = Manifest.read_csv(args.manifest)
+    topology = ClusterTopology(nodes=tuple(manifest.nodes))
+    if args.racks:
+        topology = ClusterTopology.from_rack_spec(manifest.nodes,
+                                                  args.racks)
+    serve_cfg = ServeConfig(
+        policy=args.policy, seed=args.seed, service_ms=args.service_ms,
+        slo=SloSpec(target_ms=args.slo_ms,
+                    availability=args.slo_availability))
+    rf = np.full(len(manifest), args.default_rf, dtype=np.int32)
+    placement = place_replicas(manifest, rf, topology, seed=0)
+
+    events = []
+    for kind, flag in (("crash", args.kill), ("partition", args.partition),
+                       ("degrade", args.degrade)):
+        for spec in flag or ():
+            events.extend(FaultSchedule.from_specs([f"{kind}:{spec}"]))
+    schedule = FaultSchedule(events) if events else None
+    state = None
+    if schedule is not None:
+        schedule.validate_nodes(topology.nodes)
+        state = ClusterState(placement,
+                             np.asarray(manifest.size_bytes,
+                                        dtype=np.int64))
+
+    router = ReadRouter(len(topology), serve_cfg)
+    hotspot = HotspotDetector(
+        len(manifest), alpha=serve_cfg.hotspot_alpha,
+        spike_factor=serve_cfg.hotspot_spike_factor,
+        min_reads=serve_cfg.hotspot_min_reads,
+        top_k=serve_cfg.hotspot_top_k)
+
+    records: list[dict] = []
+    with contextlib.ExitStack() as stack:
+        # --metrics activates the instrument; window records ride the same
+        # stream with "kind": "window" (the controller's sink contract).
+        _open_telemetry(args, stack, "serve_cmd")
+        tel = _obs_current()
+        with StageTimer("serve") as t:
+            for w, ev in iter_windows(args.access_log, manifest,
+                                      args.window_seconds,
+                                      batch_size=args.batch_size):
+                if args.max_windows is not None \
+                        and len(records) >= args.max_windows:
+                    break
+                if state is not None:
+                    for fev in schedule.for_window(w):
+                        state.apply_event(fev)
+                rec: dict = {"window": int(w), "n_events": int(len(ev))}
+                if len(ev):
+                    keep = ev.path_id >= 0
+                    is_read = np.asarray(ev.op)[keep] == 0
+                    pid = ev.path_id[keep][is_read]
+                    ts = ev.ts[keep][is_read]
+                    client = _client_to_topology(ev, topology)[keep][is_read]
+                    hs = hotspot.observe(
+                        np.bincount(pid, minlength=len(manifest)))
+                    if state is not None:
+                        rm, ok = state.replica_map, state.reachable_mask()
+                        thr = state.node_throughput
+                    else:
+                        rm = placement.replica_map
+                        ok = rm >= 0
+                        thr = np.ones(len(topology))
+                    res = router.route(
+                        rm, ok, thr, ts=ts, pid=pid, client=client,
+                        window_seconds=args.window_seconds,
+                        rng=np.random.default_rng([args.seed, int(w)]))
+                    rec["n_reads"] = res.n_reads
+                    rec.update(res.record_fields())
+                    rec["hotspot_score"] = round(hs.score, 6)
+                    rec["hotspot_files"] = list(hs.files)
+                    if tel is not None:
+                        # Same serve.* emission path as the controller
+                        # (serve/router.py) — the schemas cannot drift.
+                        emit_window_telemetry(tel, rec, res.latency_ms)
+                records.append(rec)
+                if tel is not None:
+                    tel._emit({"kind": "window", **rec})
+    from .obs.aggregate import serve_digest
+
+    out = serve_digest(records) or {"windows": len(records),
+                                    "reads_routed": 0}
+    out["policy"] = args.policy
+    out["seconds"] = round(t.elapsed, 3)
+    if out.get("reads_routed"):
+        out["routed_reads_per_sec"] = round(
+            out["reads_routed"] / max(t.elapsed, 1e-9), 1)
+    print(json.dumps(out, indent=2))
+    return 0
 
 
 def _cmd_bench(args) -> int:
@@ -753,6 +881,23 @@ def main(argv: list[str] | None = None) -> int:
                             "live controller)")
         p.add_argument("--no_evaluate", action="store_true",
                        help="skip the per-window locality/balance replay")
+        p.add_argument("--serve", action="store_true",
+                       help="route every window's reads through the read "
+                            "router (serve/): latency p50/p95/p99, SLO "
+                            "burn, utilization and hotspot fields on the "
+                            "window records; hotspot spikes trigger "
+                            "re-clusters")
+        p.add_argument("--serve_policy",
+                       choices=["primary", "random", "least_loaded",
+                                "p2c"], default="p2c")
+        p.add_argument("--serve_seed", type=int, default=0)
+        p.add_argument("--serve_service_ms", type=float, default=0.5)
+        p.add_argument("--serve_slo_ms", type=float, default=10.0)
+        p.add_argument("--serve_slo_availability", type=float,
+                       default=0.999)
+        p.add_argument("--no_hotspot_recluster", action="store_true",
+                       help="observe hotspots without feeding them back "
+                            "into the re-cluster trigger")
         p.add_argument("--medians_from_data", action="store_true")
         p.add_argument("--scoring_config", default=None,
                        metavar="JSON|validated")
@@ -813,6 +958,47 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--repair_seed", type=int, default=0,
                    help="seed of the deterministic flaky-failure rolls")
     p.set_defaults(fn=_cmd_chaos)
+
+    p = sub.add_parser("serve", help="read-path SLO replay: route the log's "
+                       "reads against a placement (replica-selection "
+                       "policies, FIFO queue latency model, p99/SLO burn, "
+                       "hotspot detection; optional partitions/stragglers)")
+    p.add_argument("--manifest", required=True)
+    p.add_argument("--access_log", required=True,
+                   help="globally time-sorted log (CSV access.log or "
+                        ".cdrsb)")
+    p.add_argument("--window_seconds", type=float, default=60.0)
+    p.add_argument("--policy", choices=["primary", "random", "least_loaded",
+                                        "p2c"], default="p2c",
+                   help="replica selection: primary-only | seeded random | "
+                        "global least-loaded | power-of-two-choices "
+                        "(default)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="replica-choice seed (per-window streams derive "
+                        "from it)")
+    p.add_argument("--default_rf", type=int, default=2)
+    p.add_argument("--service_ms", type=float, default=0.5,
+                   help="per-read service time at nominal node throughput")
+    p.add_argument("--slo_ms", type=float, default=10.0,
+                   help="read-latency SLO target")
+    p.add_argument("--slo_availability", type=float, default=0.999,
+                   help="SLO availability objective (error budget = 1 - "
+                        "this)")
+    p.add_argument("--racks", default=None, metavar="SPEC",
+                   help="failure domains (the chaos --racks spec): "
+                        "placement spreads replicas across racks")
+    p.add_argument("--kill", action="append", metavar="NODE@W[-W2]",
+                   help="crash NODE over windows W..W2; repeatable")
+    p.add_argument("--partition", action="append", metavar="NODES@W[-W2]",
+                   help="network-partition a '+'-joined node set; "
+                        "repeatable")
+    p.add_argument("--degrade", action="append", metavar="NODE@W[-W2][:M]",
+                   help="straggler: NODE serves reads at Mx nominal speed "
+                        "(service time / M); repeatable")
+    p.add_argument("--batch_size", type=int, default=1_000_000)
+    p.add_argument("--max_windows", type=int, default=None)
+    _add_metrics_arg(p)
+    p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("bench", help="benchmark harness (BASELINE.md configs)")
     p.add_argument("--config", type=int, default=1)

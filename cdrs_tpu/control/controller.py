@@ -105,6 +105,16 @@ class ControllerConfig:
     #: spread replicas across domains.  None = flat (every manifest node
     #: its own domain).  Node set must equal the manifest's.
     topology: object | None = None
+    #: Read-path serving (serve/router.ServeConfig): when set, every
+    #: window's reads route through the vectorized replica-selection
+    #: router against the live placement (reachability + straggler
+    #: factors when a fault schedule is also set), adding latency
+    #: p50/p95/p99, SLO burn, utilization and hotspot fields to the
+    #: window records — and, with ``recluster_on_hotspot``, feeding the
+    #: hotspot detector back into the re-cluster trigger as a drift
+    #: signal (a flash crowd re-clusters the window it lands, without
+    #: waiting for the cumulative feature fold).
+    serve: object | None = None
 
     def __post_init__(self):
         if self.window_seconds <= 0:
@@ -188,6 +198,19 @@ class ControllerResult:
                 "unavailable_reads": int(sum(
                     r.get("unavailable_reads", 0) for r in self.records)),
             }
+            # Length-normalized: raw unavailable counts from runs of
+            # different window counts are not comparable (older records
+            # lack n_reads; fall back to the event count).
+            n_reads = sum(int(r.get("n_reads", 0)) for r in self.records)
+            denom = n_reads or out["events"]
+            out["durability"]["unavailable_read_fraction"] = (
+                out["durability"]["unavailable_reads"] / denom if denom
+                else 0.0)
+        from ..obs.aggregate import serve_digest
+
+        serve = serve_digest(self.records)
+        if serve is not None:
+            out["serve"] = serve
         return out
 
 
@@ -266,6 +289,30 @@ class ReplicationController:
                                        seed=0)
             self._cluster_state = ClusterState(placement, self._sizes)
             self._repairs = RepairScheduler(seed=cfg.repair_seed)
+        #: Serving layer (serve/): router + hotspot detector, only when a
+        #: ServeConfig is set.  The router is stateless per window; the
+        #: hotspot EWMA is the ONLY serve state and rides the checkpoint.
+        self._router = None
+        self._hotspot = None
+        self._serve_topology = None
+        self._last_latency_ms: np.ndarray | None = None
+        if cfg.serve is not None:
+            from ..serve import HotspotDetector, ReadRouter
+
+            if self._cluster_state is not None:
+                self._serve_topology = self._cluster_state.topology
+            else:
+                from ..cluster import ClusterTopology
+
+                self._serve_topology = cfg.topology or ClusterTopology(
+                    nodes=tuple(manifest.nodes))
+            self._router = ReadRouter(len(self._serve_topology.nodes),
+                                      cfg.serve)
+            self._hotspot = HotspotDetector(
+                n, alpha=cfg.serve.hotspot_alpha,
+                spike_factor=cfg.serve.hotspot_spike_factor,
+                min_reads=cfg.serve.hotspot_min_reads,
+                top_k=cfg.serve.hotspot_top_k)
         #: One warning per controller when the jax kernel path degrades to
         #: the numpy fallback (fault-tolerance part 4).
         self._kernel_fallback_warned = False
@@ -381,6 +428,29 @@ class ReplicationController:
             rec["nodes_up"] = self._cluster_state.n_available
             seconds["faults"] = time.perf_counter() - t0
 
+        # Serving: extract the window's reads once (hotspot detection now,
+        # routing after the window's repairs/migrations apply) and score
+        # them against the EWMA baseline — the flash-crowd signal the
+        # cumulative feature fold dilutes away.
+        read_pid = read_ts = read_client = None
+        hotspot = None
+        if self._router is not None and len(events):
+            t0 = time.perf_counter()
+            from ..cluster.evaluate import _client_to_topology
+
+            keep = events.path_id >= 0
+            is_read = np.asarray(events.op)[keep] == 0
+            read_pid = events.path_id[keep][is_read]
+            read_ts = events.ts[keep][is_read]
+            read_client = _client_to_topology(
+                events, self._serve_topology)[keep][is_read]
+            counts = np.bincount(read_pid, minlength=len(self.manifest))
+            hotspot = self._hotspot.observe(counts)
+            rec["n_reads"] = int(read_pid.shape[0])
+            rec["hotspot_score"] = round(hotspot.score, 6)
+            rec["hotspot_files"] = list(hotspot.files)
+            seconds["hotspot"] = time.perf_counter() - t0
+
         X = None
         drift = None
         t0 = time.perf_counter()
@@ -396,9 +466,20 @@ class ReplicationController:
             else drift.population_delta
 
         cold = self._accepted_centroids is None and self._events_total > 0
-        trigger = cold or (drift is not None
-                           and drift.score >= cfg.drift_threshold)
+        drift_fire = (drift is not None
+                      and drift.score >= cfg.drift_threshold)
+        # Hotspot feedback: a fired detector triggers a re-cluster exactly
+        # like drift crossing its threshold.  Drift keeps naming priority
+        # in the trigger label — a window where both fire is a drift
+        # window that also happens to be hot.
+        hot_fire = (hotspot is not None and hotspot.fired
+                    and cfg.serve.recluster_on_hotspot
+                    and self._accepted_centroids is not None)
+        trigger = cold or drift_fire or hot_fire
         rec["recluster"] = bool(trigger)
+        rec["recluster_trigger"] = ("cold" if cold
+                                    else "drift" if drift_fire
+                                    else "hotspot" if hot_fire else None)
         rec["recluster_mode"] = None
         rec["plan_moves_pending"] = None
         t0 = time.perf_counter()
@@ -485,9 +566,37 @@ class ReplicationController:
                 keep = events.path_id >= 0
                 pid = events.path_id[keep]
                 reads = np.asarray(events.op)[keep] == 0
+                # The denominator that makes the count comparable across
+                # run lengths (unavailable_read_fraction in the digests).
+                rec["n_reads"] = int(reads.sum())
                 rec["unavailable_reads"] = int(unreadable[pid[reads]].sum())
             else:
+                rec["n_reads"] = 0
                 rec["unavailable_reads"] = 0
+
+        if self._router is not None and read_pid is not None:
+            # Route the window's reads against the END-of-window placement
+            # (post repair + migration — the locality_after convention):
+            # reachability masks and straggler factors become service-time
+            # multipliers, and every read gets an exact FIFO-queue latency
+            # sample (serve/router.py).
+            t0 = time.perf_counter()
+            if self._cluster_state is not None:
+                rm = self._cluster_state.replica_map
+                slot_ok = self._cluster_state.reachable_mask()
+                thr = self._cluster_state.node_throughput
+            else:
+                placement = self._placement_for(self.current_rf)
+                rm = placement.replica_map
+                slot_ok = rm >= 0
+                thr = np.ones(len(self._serve_topology.nodes))
+            res = self._router.route(
+                rm, slot_ok, thr, ts=read_ts, pid=read_pid,
+                client=read_client, window_seconds=cfg.window_seconds,
+                rng=np.random.default_rng([int(cfg.serve.seed), int(w)]))
+            rec.update(res.record_fields())
+            self._last_latency_ms = res.latency_ms
+            seconds["serve"] = time.perf_counter() - t0
 
         t0 = time.perf_counter()
         rec["locality_before"] = rec["locality_after"] = None
@@ -612,6 +721,14 @@ class ReplicationController:
         if rec.get("repair_rebalanced"):
             tel.counter_inc("repair.rebalanced_domain",
                             rec["repair_rebalanced"])
+        if self._router is not None:
+            from ..serve import emit_window_telemetry
+
+            # The shared serve.* emission path (serve/router.py) — `cdrs
+            # serve` streams through the same helper, so the two surfaces
+            # cannot drift apart.
+            emit_window_telemetry(tel, rec, self._last_latency_ms)
+        self._last_latency_ms = None
         for stage, secs in seconds.items():
             tel.histogram(f"controller.{stage}.seconds", secs)
 
@@ -680,20 +797,29 @@ class ReplicationController:
             np.float64)
         self._accepted_fractions = frac / max(len(labels), 1)
 
-    def _evaluate(self, events: EventLog, rf: np.ndarray):
-        from ..cluster import ClusterTopology, evaluate_placement, \
-            place_replicas
-
-        # Placement is a pure seeded function of the rf vector; cache it so
-        # move-free windows (the common steady state) and the before/after
-        # pair don't redo the O(n x nodes) priority sort.
+    def _placement_for(self, rf: np.ndarray):
+        """Placement for an rf vector — a pure seeded function, cached so
+        move-free windows (the common steady state), the before/after
+        evaluation pair, and the read router don't redo the O(n x nodes)
+        priority sort.  Serve mode routes against the serve topology
+        (``cfg.topology`` or flat); without serve this is the historical
+        flat topology bit-for-bit."""
         key = rf.tobytes()
         if self._placement_key != key:
-            topology = ClusterTopology(nodes=tuple(self.manifest.nodes))
+            from ..cluster import ClusterTopology, place_replicas
+
+            topology = self._serve_topology or ClusterTopology(
+                nodes=tuple(self.manifest.nodes))
             self._placement = place_replicas(self.manifest, rf.copy(),
                                              topology, seed=0)
             self._placement_key = key
-        m = evaluate_placement(self.manifest, events, self._placement, seed=0)
+        return self._placement
+
+    def _evaluate(self, events: EventLog, rf: np.ndarray):
+        from ..cluster import evaluate_placement
+
+        m = evaluate_placement(self.manifest, events,
+                               self._placement_for(rf), seed=0)
         return float(m.read_locality), float(m.load_balance)
 
     # -- checkpoint --------------------------------------------------------
@@ -716,6 +842,8 @@ class ReplicationController:
         if self._cluster_state is not None:
             arrays.update(self._cluster_state.state_arrays())
             arrays.update(self._repairs.state_arrays())
+        if self._hotspot is not None:
+            arrays.update(self._hotspot.state_arrays())
         meta = {
             "window_index": self.window_index,
             "last_window_events": self._last_window_events,
@@ -732,6 +860,7 @@ class ReplicationController:
             "backend": self.cfg.backend,
             "n_files": len(self.manifest),
             "faults": self._cluster_state is not None,
+            "serve": self._router is not None,
         }
         if self.cfg.backend == "jax":
             meta["pad_events"] = self._state.pad_events
@@ -760,6 +889,16 @@ class ReplicationController:
                 f"{bool(meta.get('faults', False))} but the controller "
                 f"expects {self._cluster_state is not None} — stale "
                 f"checkpoint? delete it to start over")
+        # Serve-mode flag likewise checked separately: pre-serve
+        # checkpoints carry no "serve" key and keep loading in serve-less
+        # controllers; a serve-enabled controller cannot resume bit-
+        # identically without the hotspot EWMA baseline.
+        if bool(meta.get("serve", False)) != (self._router is not None):
+            raise ValueError(
+                f"checkpoint {path!r} has serve="
+                f"{bool(meta.get('serve', False))} but the controller "
+                f"expects {self._router is not None} — stale checkpoint? "
+                f"delete it to start over")
         if self.cfg.backend == "jax":
             import jax.numpy as jnp
 
@@ -791,6 +930,8 @@ class ReplicationController:
         if self._cluster_state is not None:
             self._cluster_state.load_state_arrays(arrays)
             self._repairs.load_state_arrays(arrays)
+        if self._hotspot is not None:
+            self._hotspot.load_state_arrays(arrays)
         self.window_index = int(meta["window_index"])
         self._last_window_events = int(meta.get("last_window_events", 0))
         self._t0 = meta.get("t0")

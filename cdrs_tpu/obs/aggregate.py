@@ -14,7 +14,8 @@ skipped upstream (sink contract, obs/sink.py).
 from __future__ import annotations
 
 __all__ = ["collect", "span_forest", "ordered_span_paths", "percentile",
-           "dedup_windows", "final_counters", "roofline_rows", "fmt_bytes"]
+           "bucket_percentile", "merge_hist_buckets", "dedup_windows",
+           "final_counters", "roofline_rows", "fmt_bytes", "serve_digest"]
 
 
 def fmt_bytes(b, sep: str = " ") -> str:
@@ -38,6 +39,47 @@ def percentile(values: list[float], q: float) -> float:
         return float("nan")
     idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
     return s[idx]
+
+
+def _norm_le(le) -> float:
+    """Bucket upper bound from its JSON form (``"+Inf"`` -> inf)."""
+    return float("inf") if le in ("+Inf", "inf", None) else float(le)
+
+
+def merge_hist_buckets(target: dict, event: dict) -> None:
+    """Fold one ``hist_bulk`` event into a per-name aggregate of shape
+    ``{"count", "sum", "min", "max", "buckets": {le: count}}`` (the same
+    shape ``Telemetry.hist_buckets`` keeps in-process)."""
+    n = int(event.get("count", 0))
+    if n <= 0:
+        return
+    target["count"] = target.get("count", 0) + n
+    target["sum"] = target.get("sum", 0.0) + float(event.get("sum", 0.0))
+    vmin, vmax = float(event.get("min", 0.0)), float(event.get("max", 0.0))
+    target["min"] = vmin if "min" not in target else min(target["min"], vmin)
+    target["max"] = vmax if "max" not in target else max(target["max"], vmax)
+    buckets = target.setdefault("buckets", {})
+    for le, c in event.get("buckets", ()):
+        key = _norm_le(le)
+        buckets[key] = buckets.get(key, 0) + int(c)
+
+
+def bucket_percentile(agg: dict, q: float) -> float:
+    """Percentile estimate from a bucket aggregate: the upper bound of
+    the first bucket whose cumulative count reaches ``q``·total (the
+    overflow bucket reports the observed max instead of inf).  Resolution
+    is the ladder step (~78%); exact raw samples, when a name has both,
+    merge via ``obs.telemetry.bucket_counts`` before calling."""
+    total = agg.get("count", 0)
+    if not total:
+        return float("nan")
+    target = max(1, int(round(q * total)))
+    cum = 0
+    for le in sorted(agg.get("buckets", {})):
+        cum += agg["buckets"][le]
+        if cum >= target:
+            return agg.get("max", le) if le == float("inf") else le
+    return agg.get("max", float("nan"))  # pragma: no cover - counts agree
 
 
 def span_forest(events: list[dict]):
@@ -130,7 +172,8 @@ def collect(events: list[dict]) -> dict:
 
     Keys: ``spans`` (span forest), ``counters`` (final values),
     ``gauges`` (last value), ``gauge_series`` (every observation, stream
-    order), ``hists``, ``traces`` ({(run, call): [kmeans_iter events]}),
+    order), ``hists``, ``hist_buckets`` (merged ``hist_bulk`` aggregates
+    per name), ``traces`` ({(run, call): [kmeans_iter events]}),
     ``windows`` / ``audits`` (last-wins per window), ``xla`` (one row per
     (kernel, sig) merging compile and exec events), ``meta`` (last run
     metadata seen).
@@ -138,6 +181,7 @@ def collect(events: list[dict]) -> dict:
     gauges: dict[str, float] = {}
     gauge_series: dict[str, list[float]] = {}
     hists: dict[str, list[float]] = {}
+    hist_buckets: dict[str, dict] = {}
     traces: dict[tuple, list[dict]] = {}
     xla: dict[tuple, dict] = {}
     meta: dict = {}
@@ -148,6 +192,8 @@ def collect(events: list[dict]) -> dict:
             gauge_series.setdefault(e["name"], []).append(float(e["value"]))
         elif kind == "hist":
             hists.setdefault(e["name"], []).append(float(e["value"]))
+        elif kind == "hist_bulk":
+            merge_hist_buckets(hist_buckets.setdefault(e["name"], {}), e)
         elif kind == "kmeans_iter":
             traces.setdefault((str(e.get("run")), int(e.get("call", 0))),
                               []).append(e)
@@ -177,12 +223,53 @@ def collect(events: list[dict]) -> dict:
         "gauges": gauges,
         "gauge_series": gauge_series,
         "hists": hists,
+        "hist_buckets": hist_buckets,
         "traces": traces,
         "windows": dedup_windows(events, "window"),
         "audits": dedup_windows(events, "audit"),
         "xla": [xla[k] for k in sorted(xla, key=lambda t: (str(t[0]),
                                                            str(t[1])))],
         "meta": meta,
+    }
+
+
+def serve_digest(windows: list[dict]) -> dict | None:
+    """Read-path SLO digest over the serving window records (windows
+    carrying ``reads_routed`` — a ``ControllerConfig.serve`` or ``cdrs
+    serve`` run).  None when the stream has no serving records, so
+    pre-serve streams render unchanged everywhere.  Latency fields are
+    None when NO window routed a read (a full-outage run has no latency
+    sample — zero would claim a perfect tail); outage windows still
+    count toward the unavailable fraction."""
+    sw = [w for w in windows if w.get("reads_routed") is not None]
+    if not sw:
+        return None
+    routed = sum(int(w.get("reads_routed", 0)) for w in sw)
+    unavail = sum(int(w.get("reads_unavailable", 0)) for w in sw)
+    total = routed + unavail
+    lat = [w for w in sw if w.get("latency_p99_ms") is not None]
+    hot = [w for w in sw if w.get("hotspot_files")]
+    last_lat = lat[-1] if lat else {}
+    burns = [float(w.get("slo_burn", 0.0)) for w in sw]
+    return {
+        "windows": len(sw),
+        "reads_routed": routed,
+        "reads_unavailable": unavail,
+        "unavailable_fraction": unavail / total if total else 0.0,
+        "latency_p50_ms_last": last_lat.get("latency_p50_ms"),
+        "latency_p99_ms_last": last_lat.get("latency_p99_ms"),
+        "latency_p99_ms_max": max(
+            (float(w["latency_p99_ms"]) for w in lat), default=None),
+        "slo_burn_max": max(burns),
+        "slo_burn_mean": sum(burns) / len(burns),
+        "utilization_max": max(float(w.get("utilization_max", 0.0))
+                               for w in sw),
+        "hotspot_windows": len(hot),
+        "hotspot_files_last": list(hot[-1].get("hotspot_files", ()))
+        if hot else [],
+        "hotspot_reclusters": sum(
+            1 for w in sw if w.get("recluster_trigger") == "hotspot"),
+        "locality_last": sw[-1].get("serve_locality"),
     }
 
 

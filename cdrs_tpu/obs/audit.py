@@ -45,6 +45,13 @@ Threshold-based anomaly flags turn the metrics into verdicts:
 * ``partition_stalled_repairs`` — repairs were deferred this window
   because every copy source is stranded behind a network partition; the
   backlog cannot drain until the partition heals.
+* ``hotspot_recluster`` — serve mode (control + serve/): this window's
+  re-cluster was triggered by the HOTSPOT detector, not feature drift — a
+  flash crowd the cumulative fold had not yet surfaced.  The flag is the
+  audit-trail proof that the serving feedback path, not drift, acted.
+* ``slo_burning`` — serve mode: the window consumed more than its share
+  of the read-latency error budget (``slo_burn`` > 1): reads over the
+  SLO target plus unavailable reads exceeded ``1 - availability``.
 
 One ``{"kind": "audit", ...}`` event per window rides the same JSONL stream
 as everything else, plus ``audit.*`` gauges (silhouette, entropy, byte
@@ -236,6 +243,13 @@ class DecisionAuditor:
                 flags.append("domain_diversity_violated")
         if rec.get("repair_deferred_partition"):
             flags.append("partition_stalled_repairs")
+        if rec.get("recluster_trigger") == "hotspot":
+            flags.append("hotspot_recluster")
+        if rec.get("latency_p99_ms") is not None:
+            event["latency_p99_ms"] = rec["latency_p99_ms"]
+            event["slo_burn"] = rec.get("slo_burn")
+            if (rec.get("slo_burn") or 0.0) > 1.0:
+                flags.append("slo_burning")
         if rec.get("repair_backlog"):
             self._repair_streak += 1
         else:

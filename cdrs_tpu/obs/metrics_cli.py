@@ -31,13 +31,16 @@ import re
 import sys
 
 from .aggregate import (
+    bucket_percentile,
     collect,
     dedup_windows,
     final_counters,
     fmt_bytes,
+    merge_hist_buckets,
     ordered_span_paths,
     percentile,
     roofline_rows,
+    serve_digest,
     span_forest,
 )
 from .sink import read_events
@@ -93,6 +96,36 @@ def _render_roofline(digest, out, peak_flops=None, peak_gbps=None) -> None:
         print(" ".join(parts), file=out)
 
 
+def _render_serving(windows: list[dict], out) -> None:
+    """Read-path SLO digest (serving window records from a
+    ``ControllerConfig.serve`` / ``cdrs serve`` run)."""
+    d = serve_digest(windows)
+    if d is None:
+        return
+
+    def g(v):  # latency fields are None for windows that routed nothing
+        return "—" if v is None else f"{v:g}"
+
+    print(f"\nServing: {d['reads_routed']} reads routed over "
+          f"{d['windows']} windows "
+          f"({d['reads_unavailable']} unavailable, fraction "
+          f"{d['unavailable_fraction']:.4g})", file=out)
+    print(f"  latency p50 {g(d['latency_p50_ms_last'])} ms, "
+          f"p99 {g(d['latency_p99_ms_last'])} ms last window "
+          f"(worst-window p99 {g(d['latency_p99_ms_max'])} ms)", file=out)
+    line = (f"  SLO burn max {d['slo_burn_max']:.3g} "
+            f"(mean {d['slo_burn_mean']:.3g}); "
+            f"utilization max {d['utilization_max']:.3g}")
+    if d.get("locality_last") is not None:
+        line += f"; locality {d['locality_last']:.4g}"
+    print(line, file=out)
+    if d["hotspot_windows"]:
+        print(f"  hotspots: {d['hotspot_windows']} windows fired "
+              f"(last files {d['hotspot_files_last']}), "
+              f"{d['hotspot_reclusters']} hotspot-triggered reclusters",
+              file=out)
+
+
 def _render_durability(windows: list[dict], out) -> None:
     """Fault-mode digest: durability tiers, outage span, repair traffic
     (window records from a ``cdrs chaos`` / fault-schedule run)."""
@@ -118,7 +151,15 @@ def _render_durability(windows: list[dict], out) -> None:
     line = (f"  repair: {rep_moves} replicas, {_fmt_bytes(rep_bytes)}"
             + (f", {rep_failed} failed copies" if rep_failed else ""))
     if unavail:
-        line += f"; {unavail} reads hit unreadable files"
+        # Normalized by the reads actually presented, so runs of
+        # different lengths compare: raw counts alone are meaningless
+        # across a 5-window smoke and a 500-window soak.  Older streams
+        # without per-window ``n_reads`` fall back to the event count (an
+        # upper bound on reads — the fraction reads as a floor).
+        reads = sum(int(w.get("n_reads", 0)) for w in windows)
+        denom = reads or sum(int(w.get("n_events", 0)) for w in windows)
+        frac = f" (fraction {unavail / denom:.4g})" if denom else ""
+        line += f"; {unavail} reads hit unreadable files{frac}"
     print(line, file=out)
     part_w = sum(1 for w in dur_w
                  if w["durability"].get("nodes_partitioned"))
@@ -179,12 +220,21 @@ def summarize_events(events: list[dict], out=None, peak_flops=None,
             print(f"  {name:<40} {gauges[name]:g}", file=out)
 
     hists = digest["hists"]
-    if hists:
+    buckets = digest.get("hist_buckets", {})
+    if hists or buckets:
         print("\nHistograms:", file=out)
         for name in sorted(hists):
             vs = hists[name]
             print(f"  {name:<34} n={len(vs):<5} p50={percentile(vs, 0.5):g} "
                   f"p95={percentile(vs, 0.95):g} max={max(vs):g}", file=out)
+        # Bucketed (hist_bulk) entries: percentiles are bucket upper
+        # bounds (~ marks the ladder resolution, one 10^(1/4) step).
+        for name in sorted(buckets):
+            agg = buckets[name]
+            print(f"  {name:<34} n={agg['count']:<5} "
+                  f"p50~{bucket_percentile(agg, 0.5):.4g} "
+                  f"p95~{bucket_percentile(agg, 0.95):.4g} "
+                  f"max={agg['max']:g}", file=out)
 
     _render_roofline(digest, out, peak_flops, peak_gbps)
 
@@ -207,6 +257,7 @@ def summarize_events(events: list[dict], out=None, peak_flops=None,
                   f"{inertia}, final shift {last['shift']:.3g}", file=out)
 
     _render_audit(digest["audits"], out)
+    _render_serving(digest["windows"], out)
     _render_durability(digest["windows"], out)
 
     windows = digest["windows"]
@@ -241,12 +292,15 @@ def prometheus_lines(events: list[dict]) -> list[str]:
     counters = final_counters(events)
     gauges: dict[str, float] = {}
     hists: dict[str, list[float]] = {}
+    bulk: dict[str, dict] = {}
     for e in events:
         kind = e.get("kind")
         if kind == "gauge":
             gauges[e["name"]] = e["value"]
         elif kind == "hist":
             hists.setdefault(e["name"], []).append(float(e["value"]))
+        elif kind == "hist_bulk":
+            merge_hist_buckets(bulk.setdefault(e["name"], {}), e)
         elif kind == "span":
             hists.setdefault(f"span.{e['name']}.seconds", []).append(
                 float(e.get("dur", 0.0)))
@@ -266,6 +320,21 @@ def prometheus_lines(events: list[dict]) -> list[str]:
             f"{m}_sum {sum(vs):g}",
             f"{m}_count {len(vs)}",
         ]
+    # Bucketed (hist_bulk) names export as native Prometheus histograms:
+    # cumulative le buckets over the fixed ladder, closed by +Inf.
+    for name in sorted(bulk):
+        agg = bulk[name]
+        m = _prom_name(name)
+        lines.append(f"# TYPE {m} histogram")
+        cum = 0
+        for le in sorted(k for k in agg["buckets"] if k != float("inf")):
+            cum += agg["buckets"][le]
+            lines.append(f'{m}_bucket{{le="{le:g}"}} {cum}')
+        lines += [
+            f'{m}_bucket{{le="+Inf"}} {agg["count"]}',
+            f"{m}_sum {agg['sum']:g}",
+            f"{m}_count {agg['count']}",
+        ]
     return lines
 
 
@@ -279,6 +348,9 @@ def _tail_line(e: dict) -> str:
             f" parent={e['parent']}" if e.get("parent") is not None else "")
     if kind in ("counter", "gauge", "hist"):
         return f"{kind} {e['name']} = {e['value']:g}"
+    if kind == "hist_bulk":
+        return (f"hist_bulk {e['name']} n={e.get('count')} "
+                f"min={e.get('min', 0):g} max={e.get('max', 0):g}")
     if kind == "kmeans_iter":
         inertia = e.get("inertia")
         istr = "" if inertia is None else f" inertia={inertia:.6g}"

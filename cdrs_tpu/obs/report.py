@@ -24,11 +24,13 @@ from __future__ import annotations
 import html as _html
 
 from .aggregate import (
+    bucket_percentile,
     collect,
     fmt_bytes,
     ordered_span_paths,
     percentile,
     roofline_rows,
+    serve_digest,
 )
 
 __all__ = ["render_html"]
@@ -142,6 +144,13 @@ def _tiles(digest: dict, n_events: int) -> str:
                 if a.get("silhouette") is not None]
         if sils:
             tiles.append(("final silhouette", _fmt(sils[-1], 3)))
+    sd = serve_digest(windows)
+    if sd is not None:
+        tiles.append(("reads routed", f"{sd['reads_routed']}"))
+        p99 = sd["latency_p99_ms_last"]
+        tiles.append(("p99 latency (last)",
+                      "—" if p99 is None else f"{p99:g} ms"))
+        tiles.append(("SLO burn (max)", _fmt(sd["slo_burn_max"], 3)))
     if digest["xla"]:
         tiles.append(("XLA programs captured", f"{len(digest['xla'])}"))
     cells = "".join(
@@ -203,7 +212,8 @@ def _gauge_section(digest: dict) -> str:
 
 def _hist_section(digest: dict) -> str:
     hists = digest["hists"]
-    if not hists:
+    buckets = digest.get("hist_buckets", {})
+    if not hists and not buckets:
         return ""
     rows = []
     for name in sorted(hists):
@@ -215,6 +225,17 @@ def _hist_section(digest: dict) -> str:
             f'<td class="num">{percentile(vs, 0.95):g}</td>'
             f'<td class="num">{max(vs):g}</td>'
             f"<td>{_sparkline(vs)}</td></tr>")
+    # Bucketed (hist_bulk) names: percentiles are bucket upper bounds
+    # (the ~ marks the ladder's 10^(1/4) resolution).
+    for name in sorted(buckets):
+        agg = buckets[name]
+        rows.append(
+            f"<tr><td><code>{_esc(name)}</code></td>"
+            f'<td class="num">{agg["count"]}</td>'
+            f'<td class="num">~{_fmt(bucket_percentile(agg, 0.5))}</td>'
+            f'<td class="num">~{_fmt(bucket_percentile(agg, 0.95))}</td>'
+            f'<td class="num">{agg["max"]:g}</td>'
+            f'<td><span class="muted">bucketed</span></td></tr>')
     return ("<h2>Histograms</h2><table><tr><th>histogram</th>"
             "<th class=num>n</th><th class=num>p50</th><th class=num>p95"
             "</th><th class=num>max</th><th>observations</th></tr>"
@@ -326,6 +347,16 @@ def _durability_section(digest: dict) -> str:
     windows = [w for w in digest["windows"] if w.get("durability")]
     if not windows:
         return ""
+    # Length-normalized unavailability (see metrics_cli._render_durability
+    # for the n_reads/n_events fallback rationale).
+    unavail = sum(int(w.get("unavailable_reads", 0)) for w in windows)
+    note = ""
+    if unavail:
+        reads = sum(int(w.get("n_reads", 0)) for w in windows)
+        denom = reads or sum(int(w.get("n_events", 0)) for w in windows)
+        frac = f" (fraction {_fmt(unavail / denom, 3)})" if denom else ""
+        note = (f'<p class="muted">{unavail} reads hit unreadable files'
+                f"{frac}</p>")
     rows = []
     for w in windows:
         d = w["durability"]
@@ -341,11 +372,52 @@ def _durability_section(digest: dict) -> str:
             f'<td class="num">{_fmt_bytes(w.get("repair_bytes"))}</td>'
             f'<td class="num">{_fmt(w.get("repair_backlog"))}</td>'
             f"</tr>")
-    return ("<h2>Durability (fault mode)</h2><table><tr><th>window</th>"
+    return ("<h2>Durability (fault mode)</h2>" + note
+            + "<table><tr><th>window</th>"
             "<th>fault events</th><th class=num>nodes up</th>"
             "<th class=num>lost</th><th class=num>at risk</th>"
             "<th class=num>under-repl.</th><th class=num>repairs</th>"
             "<th class=num>repair bytes</th><th class=num>backlog</th>"
+            "</tr>" + "".join(rows) + "</table>")
+
+
+def _serve_section(digest: dict) -> str:
+    """Read-path SLO timeline (serving window records from a
+    ``ControllerConfig.serve`` / ``cdrs serve`` run): per-window latency
+    percentiles, utilization, SLO burn, unavailable fraction, hotspots.
+    Absent for pre-serve streams — older reports render unchanged."""
+    sd = serve_digest(digest["windows"])
+    if sd is None:
+        return ""
+    sw = [w for w in digest["windows"]
+          if w.get("reads_routed") is not None]
+    p99s = [float(w["latency_p99_ms"]) for w in sw
+            if w.get("latency_p99_ms") is not None]
+    spark = (f"<p>p99 latency trend {_sparkline(p99s)} · unavailable "
+             f"fraction {_fmt(sd['unavailable_fraction'], 3)} · hotspot "
+             f"windows {sd['hotspot_windows']} · hotspot-triggered "
+             f"reclusters {sd['hotspot_reclusters']}</p>"
+             if len(p99s) >= 2 else "")
+    rows = []
+    for w in sw:
+        hot = w.get("hotspot_files") or ()
+        hot_s = ", ".join(str(f) for f in hot) if hot else "—"
+        trig = w.get("recluster_trigger")
+        rows.append(
+            f"<tr><td>{_esc(w.get('window'))}</td>"
+            f'<td class="num">{_fmt(w.get("reads_routed"))}</td>'
+            f'<td class="num">{_fmt(w.get("reads_unavailable"))}</td>'
+            f'<td class="num">{_fmt(w.get("latency_p50_ms"), 3)}</td>'
+            f'<td class="num">{_fmt(w.get("latency_p99_ms"), 3)}</td>'
+            f'<td class="num">{_fmt(w.get("utilization_max"), 3)}</td>'
+            f'<td class="num">{_fmt(w.get("slo_burn"), 3)}</td>'
+            f"<td>{_esc(hot_s)}</td>"
+            f"<td>{_esc(trig) if trig else '—'}</td></tr>")
+    return ("<h2>Serving (read-path SLO)</h2>" + spark
+            + "<table><tr><th>window</th><th class=num>routed</th>"
+            "<th class=num>unavail.</th><th class=num>p50 ms</th>"
+            "<th class=num>p99 ms</th><th class=num>util. max</th>"
+            "<th class=num>SLO burn</th><th>hotspots</th><th>trigger</th>"
             "</tr>" + "".join(rows) + "</table>")
 
 
@@ -392,6 +464,7 @@ def render_html(events: list[dict], title: str = "cdrs telemetry report"
         + _span_section(digest)
         + _xla_section(digest)
         + _audit_section(digest)
+        + _serve_section(digest)
         + _durability_section(digest)
         + _window_section(digest)
         + _trace_section(digest)

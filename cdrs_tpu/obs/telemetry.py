@@ -33,7 +33,74 @@ import time
 
 from .sink import JsonlSink
 
-__all__ = ["Telemetry", "Span", "current", "run_metadata"]
+__all__ = ["Telemetry", "Span", "current", "run_metadata",
+           "HIST_BUCKETS", "bucket_counts"]
+
+#: Log-spaced bucket ladder shared by every ``histogram_bulk`` producer
+#: and consumer: ``10^(k/4)`` for k in -32..40 (1e-8 .. 1e10, ~78% step).
+#: Fixed — not per-histogram — so counts from any stream merge by upper
+#: bound, and the Prometheus export is a stable cumulative histogram.
+#: Values above the top bucket land in +Inf; values <= the bottom bound
+#: (including 0 and negatives) land in the first bucket.
+HIST_BUCKETS: tuple[float, ...] = tuple(10.0 ** (k / 4.0)
+                                        for k in range(-32, 41))
+
+#: Raw per-key sample cap of ``Telemetry.histogram``: past it the list is
+#: decimated 2:1 (uniform stride), keeping p50/p95 digests stable while
+#: bounding memory — the scalability trap ``histogram_bulk`` exists to
+#: avoid entirely on high-volume paths.
+HIST_RAW_CAP = 8192
+
+#: Bucketing cap of one ``histogram_bulk`` call: past it the buckets are
+#: computed on a uniform 1-in-stride subsample and the counts scaled
+#: back by the stride, so the per-call cost is O(cap) no matter how many
+#: samples a window produces (a million routed reads cost the same as
+#: 32k).  Percentile error from a 32k uniform subsample is far below the
+#: ladder's own ~78% bucket resolution; min/max stay exact.
+HIST_BULK_SAMPLE_CAP = 32768
+
+
+def bucket_counts(values) -> "tuple[list, int, float, float, float]":
+    """(sparse ``[le, count]`` pairs, count, sum, min, max) of ``values``
+    on the ``HIST_BUCKETS`` ladder; the overflow bucket's ``le`` is the
+    JSON-safe string ``"+Inf"``.  ``count`` is always the EXACT sample
+    count (it must reconcile with exact counters like
+    ``serve.reads_routed``); above ``HIST_BULK_SAMPLE_CAP`` samples the
+    per-bucket split comes from a uniform subsample scaled back up with
+    largest-remainder rounding, so ``sum(bucket counts) == count`` still
+    holds exactly.  ``sum`` scales with the subsample; min/max are
+    exact."""
+    import numpy as np
+
+    v = np.asarray(values, dtype=np.float64).ravel()
+    n = int(v.size)
+    if n == 0:
+        return [], 0, 0.0, 0.0, 0.0
+    vmin, vmax = float(v.min()), float(v.max())
+    sub = v
+    if n > HIST_BULK_SAMPLE_CAP:
+        stride = -(-n // HIST_BULK_SAMPLE_CAP)  # ceil div
+        sub = v[::stride]
+    ladder = np.asarray(HIST_BUCKETS)
+    idx = np.searchsorted(ladder, sub, side="left")
+    counts = np.bincount(idx, minlength=len(HIST_BUCKETS) + 1)
+    total = float(n) / float(sub.size)
+    if sub.size != n:
+        # Scale the subsample split to the exact n: floor, then hand the
+        # leftover units to the largest fractional remainders
+        # (deterministic tie-break by bucket index via argsort kind).
+        scaled = counts * total
+        floors = np.floor(scaled).astype(np.int64)
+        short = n - int(floors.sum())
+        if short > 0:
+            order = np.argsort(-(scaled - floors), kind="stable")[:short]
+            floors[order] += 1
+        counts = floors
+    sparse: list = []
+    for i in np.flatnonzero(counts):
+        le = "+Inf" if i == len(HIST_BUCKETS) else float(ladder[i])
+        sparse.append([le, int(counts[i])])
+    return (sparse, n, float(sub.sum()) * total, vmin, vmax)
 
 #: Active instrument (module-global, not a contextvar: worker threads must
 #: see the same instrument as the thread that activated it).
@@ -153,6 +220,14 @@ class Telemetry:
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
         self.histograms: dict[str, list[float]] = {}
+        #: Decimation stride per raw-histogram key (HIST_RAW_CAP contract):
+        #: sample i is retained iff i % stride == 0; doubling the stride
+        #: halves the kept list, so percentiles stay a uniform subsample.
+        self._hist_stride: dict[str, int] = {}
+        self._hist_seen: dict[str, int] = {}
+        #: Bucketed aggregates from ``histogram_bulk``:
+        #: name -> {"count", "sum", "min", "max", "buckets": {le: count}}.
+        self.hist_buckets: dict[str, dict] = {}
         self._local = threading.local()
         self._id_lock = threading.Lock()
         self._ids = 0
@@ -220,10 +295,48 @@ class Telemetry:
                     "value": float(value)})
 
     def histogram(self, name: str, value: float) -> None:
+        """One sample: emits a ``hist`` event and keeps a BOUNDED raw list
+        (uniform 2:1 decimation past ``HIST_RAW_CAP`` — p50/p95 of a
+        uniform subsample track the full stream).  High-volume producers
+        (thousands of samples per call) should use ``histogram_bulk``:
+        one bucketed event instead of one per sample."""
         with self._agg_lock:
-            self.histograms.setdefault(name, []).append(float(value))
+            lst = self.histograms.setdefault(name, [])
+            seen = self._hist_seen.get(name, 0)
+            stride = self._hist_stride.get(name, 1)
+            if seen % stride == 0:
+                lst.append(float(value))
+                if len(lst) >= HIST_RAW_CAP:
+                    del lst[1::2]
+                    self._hist_stride[name] = stride * 2
+            self._hist_seen[name] = seen + 1
         self._emit({"kind": "hist", "name": name, "t": time.time(),
                     "value": float(value)})
+
+    def histogram_bulk(self, name: str, values) -> None:
+        """A batch of samples as ONE event: counts on the fixed log-spaced
+        ``HIST_BUCKETS`` ladder plus count/sum/min/max, emitted as a
+        single ``hist_bulk`` line and merged into the in-memory
+        ``hist_buckets`` aggregate.  The serving layer's per-window
+        latency samples (potentially millions) ride this path — per-key
+        memory and stream volume stay O(buckets), not O(samples)."""
+        sparse, n, total, vmin, vmax = bucket_counts(values)
+        if n == 0:
+            return
+        with self._agg_lock:
+            agg = self.hist_buckets.setdefault(
+                name, {"count": 0, "sum": 0.0, "min": vmin, "max": vmax,
+                       "buckets": {}})
+            agg["count"] += n
+            agg["sum"] += total
+            agg["min"] = min(agg["min"], vmin)
+            agg["max"] = max(agg["max"], vmax)
+            for le, c in sparse:
+                key = float("inf") if le == "+Inf" else float(le)
+                agg["buckets"][key] = agg["buckets"].get(key, 0) + c
+        self._emit({"kind": "hist_bulk", "name": name, "t": time.time(),
+                    "count": n, "sum": total, "min": vmin, "max": vmax,
+                    "buckets": sparse})
 
     # -- jax kernel hooks --------------------------------------------------
     def record_kernel_call(self, kernel: str, signature,

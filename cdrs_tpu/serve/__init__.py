@@ -1,0 +1,38 @@
+"""Serving layer: vectorized read routing, tail-latency SLOs, hotspots.
+
+The read path the placement pipeline was missing (ROADMAP open item 2):
+
+* ``router`` — batched replica selection (primary / random /
+  least-loaded / power-of-two-choices) over the live replica map with
+  reachability masks and straggler throughput factors from ``faults``,
+  plus an exact per-node FIFO queue model yielding a latency sample per
+  read — p50/p95/p99 and SLO burn per window.
+* ``hotspot`` — EWMA top-k per-file spike detector whose firing feeds
+  back into the controller as a drift signal (flash crowd -> re-cluster
+  without waiting for cumulative feature drift).
+
+Consumed by ``ControllerConfig.serve`` (control/controller.py), the
+``cdrs serve`` CLI, and ``benchmarks/serve_bench.py``.  numpy-only: a
+base install can serve.
+"""
+
+from .hotspot import HotspotDetector, HotspotResult
+from .router import (
+    POLICIES,
+    ReadRouter,
+    ServeConfig,
+    SloSpec,
+    WindowServeResult,
+    emit_window_telemetry,
+)
+
+__all__ = [
+    "POLICIES",
+    "HotspotDetector",
+    "HotspotResult",
+    "ReadRouter",
+    "ServeConfig",
+    "SloSpec",
+    "WindowServeResult",
+    "emit_window_telemetry",
+]

@@ -1,0 +1,101 @@
+"""EWMA top-k hotspot detector — the serving layer's drift signal.
+
+Feature drift (control/drift.py) sees a workload shift only after enough
+events fold into the CUMULATIVE feature state to move centroids or
+category populations — a flash crowd landing on a cohort late in a long
+run is diluted by history and never trips the detector.  The hotspot
+detector watches the *per-window* read-count vector instead: each file
+carries an EWMA baseline of its window read counts, and a window where a
+file's count reaches ``spike_factor`` x its baseline (and at least
+``min_reads`` in absolute terms — a 2-read file "spiking" to 9 is noise)
+fires the signal.  The controller treats a firing exactly like drift
+crossing its threshold: re-cluster NOW, so migration starts rolling the
+hot cohort toward a higher replication factor windows before the feature
+fold would have noticed.
+
+Pure arithmetic on the count vector — no RNG, no dependence on the
+router's seed — so detection is deterministic and seed-invariant by
+construction (property-tested).  The EWMA state rides the controller's
+npz checkpoint (``state_arrays``/``load_state_arrays``), keeping
+kill/resume bit-identical mid-flash-crowd.
+
+The first observed window initializes the baseline and never fires: a
+cold controller re-clusters anyway, and a baseline must exist before
+"x4 over baseline" means anything.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["HotspotResult", "HotspotDetector"]
+
+
+@dataclass(frozen=True)
+class HotspotResult:
+    """One window's verdict."""
+
+    #: Any file spiked past the threshold this window.
+    fired: bool
+    #: max(count / max(EWMA, 1)) over all files — the drift-style signal
+    #: magnitude (1.0 = stationary; the threshold is ``spike_factor``).
+    score: float
+    #: Top-k spiking file ids, hottest (highest ratio) first.
+    files: tuple[int, ...]
+
+
+class HotspotDetector:
+    """Carries the per-file EWMA baseline across windows."""
+
+    def __init__(self, n_files: int, *, alpha: float = 0.3,
+                 spike_factor: float = 4.0, min_reads: int = 50,
+                 top_k: int = 8):
+        self.n_files = int(n_files)
+        self.alpha = float(alpha)
+        self.spike_factor = float(spike_factor)
+        self.min_reads = int(min_reads)
+        self.top_k = int(top_k)
+        self.ewma = np.zeros(self.n_files, dtype=np.float64)
+        self.initialized = False
+
+    def observe(self, counts: np.ndarray) -> HotspotResult:
+        """Score one window's per-file read counts and fold them into the
+        baseline.  Detection happens BEFORE the fold — a spike is judged
+        against the pre-spike baseline."""
+        counts = np.asarray(counts, dtype=np.float64)
+        if counts.shape != (self.n_files,):
+            raise ValueError(
+                f"counts shape {counts.shape} != ({self.n_files},)")
+        if not self.initialized:
+            self.ewma = counts.copy()
+            self.initialized = True
+            return HotspotResult(fired=False, score=1.0, files=())
+        ratio = counts / np.maximum(self.ewma, 1.0)
+        hot = (counts >= self.min_reads) & (ratio >= self.spike_factor)
+        score = float(ratio.max()) if ratio.size else 1.0
+        files: tuple[int, ...] = ()
+        if hot.any():
+            ids = np.flatnonzero(hot)
+            order = np.lexsort((ids, -ratio[ids]))  # ratio desc, id asc
+            files = tuple(int(i) for i in ids[order][:self.top_k])
+        self.ewma = self.alpha * counts + (1.0 - self.alpha) * self.ewma
+        return HotspotResult(fired=bool(hot.any()), score=score,
+                             files=files)
+
+    # -- checkpoint (controller npz contract) ------------------------------
+    def state_arrays(self) -> dict[str, np.ndarray]:
+        return {
+            "serve_ewma": self.ewma.copy(),
+            "serve_ewma_init": np.asarray([self.initialized]),
+        }
+
+    def load_state_arrays(self, arrays: dict) -> None:
+        ewma = np.asarray(arrays["serve_ewma"], dtype=np.float64)
+        if ewma.shape != (self.n_files,):
+            raise ValueError(
+                f"checkpoint serve_ewma shape {ewma.shape} != "
+                f"({self.n_files},) — stale checkpoint?")
+        self.ewma = ewma.copy()
+        self.initialized = bool(np.asarray(arrays["serve_ewma_init"])[0])

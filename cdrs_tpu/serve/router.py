@@ -1,0 +1,393 @@
+"""Vectorized read router: request-level serving against a live placement.
+
+The pipeline decides *where replicas live*; until now nothing served reads
+against them — ``cluster/evaluate.py`` replays locality offline, so the
+best reportable number was a hit ratio.  The observable that matters in a
+serving system is **tail latency under load** (Dean & Barroso, *The Tail
+at Scale*: p99 is the product metric, replica choice is the lever), which
+only a request-level model can produce.  This router is that model, fully
+vectorized over the access log — no per-request Python:
+
+* **Replica selection** per read, among the file's REACHABLE replicas
+  (``faults.ClusterState`` masks; a static placement treats every assigned
+  slot as reachable).  A client holding a replica is always served
+  locally (the HDFS short-circuit read, and exactly the locality rule of
+  cluster/evaluate.py — the router's locality equals the offline replay's
+  by construction).  Remote reads pick a replica by policy:
+
+  - ``primary``       — first reachable slot (slot 0 is the placement's
+                        primary; under faults, the first survivor).
+  - ``random``        — seeded uniform over reachable replicas
+                        (cluster/evaluate.py's remote rule).
+  - ``least_loaded``  — the reachable replica on the node with the least
+                        accumulated busy-time (global knowledge).
+  - ``p2c``           — power-of-two-choices (Mitzenmacher): two seeded
+                        random probes, keep the less-loaded — near
+                        least-loaded quality at random-choice cost, the
+                        classic tail-latency lever.
+
+  Load feedback for ``least_loaded``/``p2c`` is batch-synchronous: reads
+  route in time-ordered chunks (``ServeConfig.chunk``) against a load
+  snapshot taken at the chunk boundary, then the snapshot absorbs the
+  chunk.  Decisions inside a chunk share one snapshot — the approximation
+  that keeps the router vectorized; chunk size trades fidelity for speed.
+
+* **Queue model** per node: single FIFO server with a constant per-read
+  service time ``service_ms / node_throughput`` — the straggler factors
+  from ``faults`` (``degrade:dn3@2-6:0.25``) directly stretch service
+  times.  For constant service time ``s`` the FIFO recurrence
+  ``f_k = max(a_k, f_{k-1}) + s`` has the closed vectorized form
+  ``f_k = s·(k+1) + max_{j<=k}(a_j − s·j)`` (a running max), so every
+  read gets an EXACT latency sample — queueing delay included — with one
+  ``np.maximum.accumulate`` per node.  An overloaded node (arrival rate
+  above ``1/s``) builds queue linearly and its tail blows up, which is
+  precisely the behaviour replica-selection policies exist to avoid.
+
+* **SLO accounting**: an ``SloSpec`` (``target_ms``, ``availability``)
+  turns the latency samples into burn — the fraction of reads over target
+  (plus unavailable reads) divided by the error budget ``1 −
+  availability``; burn > 1 means the window consumed more than its
+  share of the budget.
+
+Reads of files with zero reachable replicas are **unavailable**: no
+latency sample, counted separately (they are the numerator of the
+``unavailable_read_fraction`` durability metric).
+
+Determinism: given (replica map, masks, throughputs, events, policy,
+seed) the routing — and therefore every latency percentile — is
+bit-reproducible; the controller seeds the per-window rng from
+``(ServeConfig.seed, window_index)`` so kill/resume replays identically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["POLICIES", "SloSpec", "ServeConfig", "WindowServeResult",
+           "ReadRouter", "emit_window_telemetry"]
+
+
+def emit_window_telemetry(tel, rec: dict, latency_ms=None) -> None:
+    """One serving window's observations through a Telemetry instrument.
+
+    The SINGLE emission path for the ``serve.*`` schema (the table in
+    docs/ARCHITECTURE.md) — the controller (control/controller.py) and
+    the standalone ``cdrs serve`` command both call it, so the two
+    streams cannot drift apart.  ``rec`` is the window record carrying
+    ``WindowServeResult.record_fields()`` (plus hotspot/trigger fields
+    when present); ``latency_ms`` is the window's raw sample array,
+    emitted as ONE bucketed ``hist_bulk`` event (obs/telemetry.py — not
+    one ``hist`` event per read).  No-op for non-serving records.
+    """
+    if rec.get("reads_routed") is None:
+        return
+    if rec["reads_routed"]:
+        tel.counter_inc("serve.reads_routed", rec["reads_routed"])
+    if rec.get("reads_unavailable"):
+        tel.counter_inc("serve.reads_unavailable",
+                        rec["reads_unavailable"])
+    if rec.get("latency_p99_ms") is not None:
+        tel.gauge("serve.latency_p50_ms", rec["latency_p50_ms"])
+        tel.gauge("serve.latency_p99_ms", rec["latency_p99_ms"])
+    tel.gauge("serve.utilization_max", rec.get("utilization_max", 0.0))
+    tel.gauge("serve.slo_burn", rec.get("slo_burn", 0.0))
+    if rec.get("hotspot_files"):
+        tel.counter_inc("serve.hotspot.windows")
+        tel.gauge("serve.hotspot.score", rec.get("hotspot_score", 0.0))
+    if rec.get("recluster_trigger") == "hotspot":
+        tel.counter_inc("serve.reclusters.hotspot")
+    if latency_ms is not None and len(latency_ms):
+        tel.histogram_bulk("serve.latency_ms", latency_ms)
+
+POLICIES: tuple[str, ...] = ("primary", "random", "least_loaded", "p2c")
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """Read-path SLO: latency target and availability objective."""
+
+    #: A read slower than this counts against the error budget.
+    target_ms: float = 10.0
+    #: Fraction of reads that must meet the target AND be served at all;
+    #: the error budget is ``1 - availability``.
+    availability: float = 0.999
+
+    def __post_init__(self):
+        if self.target_ms <= 0:
+            raise ValueError(f"target_ms must be > 0, got {self.target_ms}")
+        if not 0.0 < self.availability < 1.0:
+            raise ValueError(
+                f"availability must be in (0, 1), got {self.availability}")
+
+
+@dataclass
+class ServeConfig:
+    """Knobs of the read router + hotspot feedback (module docstring)."""
+
+    policy: str = "p2c"
+    #: Seed of the replica-choice rng; the controller derives a per-window
+    #: stream from ``(seed, window_index)`` so resume replays identically.
+    seed: int = 0
+    #: Per-read service time at NOMINAL node throughput; a straggler at
+    #: factor m serves one read in ``service_ms / m``.
+    service_ms: float = 0.5
+    #: Reads per load-feedback chunk (least_loaded/p2c): decisions inside
+    #: a chunk share one load snapshot.  Larger chunks are faster but
+    #: herd (stale-load oscillation — every decision in the chunk sees
+    #: the same "coolest" node); 4096 keeps p2c within a few percent of
+    #: per-request feedback while still routing millions of reads/sec.
+    chunk: int = 4096
+    slo: SloSpec = field(default_factory=SloSpec)
+    #: Hotspot detector (serve/hotspot.py): EWMA smoothing of per-file
+    #: window read counts, spike = count >= spike_factor x EWMA (and >=
+    #: min_reads); the top_k hottest files ride the window record.
+    hotspot_alpha: float = 0.3
+    hotspot_spike_factor: float = 4.0
+    hotspot_min_reads: int = 50
+    hotspot_top_k: int = 8
+    #: Feed the hotspot signal back into the controller as a drift
+    #: trigger: a flash crowd forces a re-cluster the window it lands,
+    #: without waiting for the cumulative feature fold to notice.
+    recluster_on_hotspot: bool = True
+
+    def __post_init__(self):
+        if self.policy not in POLICIES:
+            raise ValueError(
+                f"unknown routing policy {self.policy!r} (want one of "
+                f"{POLICIES})")
+        if self.service_ms <= 0:
+            raise ValueError(
+                f"service_ms must be > 0, got {self.service_ms}")
+        if self.chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {self.chunk}")
+        if not 0.0 < self.hotspot_alpha <= 1.0:
+            raise ValueError(
+                f"hotspot_alpha must be in (0, 1], got {self.hotspot_alpha}")
+        if self.hotspot_spike_factor <= 1.0:
+            raise ValueError(
+                f"hotspot_spike_factor must be > 1, got "
+                f"{self.hotspot_spike_factor}")
+        if self.hotspot_top_k < 1:
+            raise ValueError(
+                f"hotspot_top_k must be >= 1, got {self.hotspot_top_k}")
+
+
+@dataclass
+class WindowServeResult:
+    """One routed batch/window of reads and its latency/SLO digest."""
+
+    n_reads: int                  # reads presented to the router
+    n_routed: int                 # reads that found a reachable replica
+    n_unavailable: int            # reads with zero reachable replicas
+    n_local: int                  # served by the client's own node
+    server: np.ndarray            # (n_reads,) int32 node id, -1 unavailable
+    latency_ms: np.ndarray        # (n_routed,) float64, routed reads only
+    #: None when NO read was routed (a full outage has no latency sample
+    #: — reporting p99=0 for the worst window would invert reality).
+    p50_ms: float | None
+    p95_ms: float | None
+    p99_ms: float | None
+    reads_per_node: np.ndarray    # (n_nodes,) int64
+    utilization: np.ndarray       # (n_nodes,) busy-time / window span
+    slo_violations: int           # over-target + unavailable
+    slo_burn: float               # violation fraction / error budget
+
+    @property
+    def locality(self) -> float:
+        """Local reads / total reads — cluster/evaluate.py's definition
+        (unavailable reads count as non-local)."""
+        return self.n_local / self.n_reads if self.n_reads else 1.0
+
+    @property
+    def utilization_max(self) -> float:
+        return float(self.utilization.max()) if self.utilization.size \
+            else 0.0
+
+    def record_fields(self) -> dict:
+        """The window-record slice of this result (JSONL-safe scalars;
+        latency percentiles are None for a window that routed nothing)."""
+        rnd = lambda v: None if v is None else round(v, 6)  # noqa: E731
+        return {
+            "reads_routed": self.n_routed,
+            "reads_unavailable": self.n_unavailable,
+            "serve_locality": round(self.locality, 6),
+            "latency_p50_ms": rnd(self.p50_ms),
+            "latency_p95_ms": rnd(self.p95_ms),
+            "latency_p99_ms": rnd(self.p99_ms),
+            "utilization_max": round(self.utilization_max, 6),
+            "slo_violations": self.slo_violations,
+            "slo_burn": round(self.slo_burn, 6),
+        }
+
+
+def _pick_rank(ok: np.ndarray, rank: np.ndarray) -> np.ndarray:
+    """Slot index of the ``rank``-th True per row of ``ok`` (rank < row
+    count of Trues; rows with no True return slot 0 — callers mask)."""
+    csum = np.cumsum(ok, axis=1)
+    return np.argmax(csum > rank[:, None], axis=1).astype(np.int32)
+
+
+class ReadRouter:
+    """Routes read batches against a replica map (module docstring)."""
+
+    def __init__(self, n_nodes: int, cfg: ServeConfig):
+        if n_nodes < 1:
+            raise ValueError(f"n_nodes must be >= 1, got {n_nodes}")
+        self.n_nodes = int(n_nodes)
+        self.cfg = cfg
+
+    # -- selection ---------------------------------------------------------
+    def _select(self, cand: np.ndarray, ok: np.ndarray, n_ok: np.ndarray,
+                service_s: np.ndarray, rng: np.random.Generator
+                ) -> np.ndarray:
+        """(e,) int32 server node per read (-1 = unavailable); reads must
+        be in time order — the load feedback consumes them chunkwise."""
+        policy = self.cfg.policy
+        e = cand.shape[0]
+        any_ok = n_ok > 0
+        if policy == "primary":
+            slot = np.argmax(ok, axis=1)
+            server = cand[np.arange(e), slot].astype(np.int32)
+            server[~any_ok] = -1
+            return server
+        if policy == "random":
+            rank = np.minimum((rng.random(e) * n_ok).astype(np.int64),
+                              np.maximum(n_ok - 1, 0))
+            slot = _pick_rank(ok, rank)
+            server = cand[np.arange(e), slot].astype(np.int32)
+            server[~any_ok] = -1
+            return server
+
+        # Load-feedback policies: chunked batch-synchronous routing.
+        server = np.full(e, -1, dtype=np.int32)
+        load = np.zeros(self.n_nodes, dtype=np.float64)  # busy seconds
+        chunk = self.cfg.chunk
+        safe = np.clip(cand, 0, None)
+        if policy == "p2c":
+            r1 = rng.random(e)
+            r2 = rng.random(e)
+        for lo in range(0, e, chunk):
+            hi = min(lo + chunk, e)
+            c_cand = cand[lo:hi]
+            c_ok = ok[lo:hi]
+            c_any = any_ok[lo:hi]
+            rows = np.arange(hi - lo)
+            if policy == "least_loaded":
+                node_load = np.where(c_ok, load[safe[lo:hi]], np.inf)
+                slot = np.argmin(node_load, axis=1)
+                srv = c_cand[rows, slot].astype(np.int32)
+            else:  # p2c: two probes with replacement, keep the cooler one
+                n1 = np.maximum(n_ok[lo:hi] - 1, 0)
+                rank1 = np.minimum((r1[lo:hi] * n_ok[lo:hi]).astype(
+                    np.int64), n1)
+                rank2 = np.minimum((r2[lo:hi] * n_ok[lo:hi]).astype(
+                    np.int64), n1)
+                s1 = c_cand[rows, _pick_rank(c_ok, rank1)]
+                s2 = c_cand[rows, _pick_rank(c_ok, rank2)]
+                srv = np.where(load[np.clip(s2, 0, None)]
+                               < load[np.clip(s1, 0, None)],
+                               s2, s1).astype(np.int32)
+            srv[~c_any] = -1
+            server[lo:hi] = srv
+            routed = srv[srv >= 0]
+            if routed.size:
+                load += np.bincount(routed, minlength=self.n_nodes) \
+                    * service_s
+        return server
+
+    # -- the queue model ---------------------------------------------------
+    def _latency(self, server: np.ndarray, ts: np.ndarray,
+                 service_s: np.ndarray) -> np.ndarray:
+        """(e,) seconds; NaN for unavailable reads.  Exact per-node FIFO
+        with constant service time: ``f_k = s(k+1) + cummax(a_j - s j)``
+        (the closed form of ``f_k = max(a_k, f_{k-1}) + s``)."""
+        lat = np.full(server.shape[0], np.nan)
+        for node in range(self.n_nodes):
+            m = server == node
+            if not m.any():
+                continue
+            a = ts[m]
+            s = service_s[node]
+            k = np.arange(a.size, dtype=np.float64)
+            finish = s * (k + 1.0) + np.maximum.accumulate(a - s * k)
+            lat[m] = finish - a
+        return lat
+
+    # -- entry -------------------------------------------------------------
+    def route(self, replica_map: np.ndarray, slot_ok: np.ndarray,
+              node_throughput: np.ndarray, *, ts: np.ndarray,
+              pid: np.ndarray, client: np.ndarray,
+              window_seconds: float | None = None,
+              rng: np.random.Generator | None = None) -> WindowServeResult:
+        """Route one time-ordered batch of reads.
+
+        ``replica_map``: (n_files, R) int32 node ids, -1 = empty slot.
+        ``slot_ok``: (n_files, R) bool — slot holds a replica that can
+        serve (``ClusterState.reachable_mask()``; a static placement
+        passes ``replica_map >= 0``).  ``node_throughput``: (n_nodes,)
+        straggler factors in (0, 1].  ``ts``/``pid``/``client``: per-read
+        epoch seconds (ascending), file id, and client node id (-1 =
+        outside the topology).  ``window_seconds`` scales utilization
+        (default: the batch's time span).
+        """
+        rng = rng or np.random.default_rng(self.cfg.seed)
+        ts = np.asarray(ts, dtype=np.float64)
+        pid = np.asarray(pid)
+        client = np.asarray(client)
+        e = int(pid.shape[0])
+        thr = np.asarray(node_throughput, dtype=np.float64)
+        service_s = (self.cfg.service_ms / 1000.0) / np.maximum(thr, 1e-9)
+
+        if e == 0:
+            z = np.zeros(self.n_nodes)
+            return WindowServeResult(
+                n_reads=0, n_routed=0, n_unavailable=0, n_local=0,
+                server=np.zeros(0, dtype=np.int32),
+                latency_ms=np.zeros(0), p50_ms=None, p95_ms=None,
+                p99_ms=None, reads_per_node=z.astype(np.int64),
+                utilization=z, slo_violations=0, slo_burn=0.0)
+
+        cand = replica_map[pid]                       # (e, R)
+        ok = slot_ok[pid]
+        n_ok = ok.sum(axis=1)
+        local = ((cand == client[:, None]) & ok).any(axis=1) & (client >= 0)
+
+        server = self._select(cand, ok, n_ok, service_s, rng)
+        # Local reads short-circuit to the client AFTER selection so the
+        # load-feedback policies still account their busy time in order.
+        # (Selection already charged a replica for them; the local node IS
+        # one of the replicas, so the approximation only shifts which
+        # holder was charged inside one chunk.)
+        server = np.where(local, client.astype(np.int32), server)
+
+        unavailable = server < 0
+        n_unavail = int(unavailable.sum())
+        lat_s = self._latency(server, ts, service_s)
+        routed = ~unavailable
+        latency_ms = lat_s[routed] * 1000.0
+
+        counts = np.bincount(server[routed], minlength=self.n_nodes
+                             ).astype(np.int64)
+        span = float(window_seconds) if window_seconds else \
+            max(float(ts[-1] - ts[0]), 1e-9)
+        utilization = counts * service_s / max(span, 1e-9)
+
+        if latency_ms.size:
+            p50, p95, p99 = (float(np.percentile(latency_ms, q))
+                             for q in (50.0, 95.0, 99.0))
+        else:
+            # A full outage routed nothing: there IS no latency sample.
+            p50 = p95 = p99 = None
+        slo = self.cfg.slo
+        violations = int((latency_ms > slo.target_ms).sum()) + n_unavail
+        burn = (violations / e) / (1.0 - slo.availability)
+
+        return WindowServeResult(
+            n_reads=e, n_routed=int(routed.sum()),
+            n_unavailable=n_unavail, n_local=int(local.sum()),
+            server=server, latency_ms=latency_ms,
+            p50_ms=p50, p95_ms=p95, p99_ms=p99,
+            reads_per_node=counts, utilization=utilization,
+            slo_violations=violations, slo_burn=float(burn))

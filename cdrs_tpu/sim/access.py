@@ -30,7 +30,8 @@ import numpy as np
 from ..config import SimulatorConfig
 from ..io.events import EventLog, Manifest
 
-__all__ = ["simulate_access", "simulate_access_with_shift", "jittered_rates"]
+__all__ = ["simulate_access", "simulate_access_with_shift",
+           "simulate_flash_crowd", "jittered_rates"]
 
 
 def jittered_rates(
@@ -127,6 +128,89 @@ def simulate_access(
         client_id=client_id[order].astype(np.int32),
         clients=clients,
     )
+
+
+def simulate_flash_crowd(
+    manifest: Manifest,
+    cfg: SimulatorConfig,
+    *,
+    cohort: np.ndarray,
+    start: float,
+    duration: float,
+    boost: float,
+    sim_start: float | None = None,
+    engine: str = "numpy",
+) -> tuple[EventLog, np.ndarray]:
+    """Base Poisson workload plus a read BURST on a cohort: flash crowd.
+
+    The serving-layer scenario ``simulate_access_with_shift`` cannot
+    express: the category flip changes the cohort's rates for the whole
+    remaining stream, so the CUMULATIVE feature fold eventually drifts.
+    A flash crowd is a transient — over ``[start, start + duration)``
+    seconds of the simulated span, each cohort file emits EXTRA reads at
+    ``boost`` x its category's mean read rate (clients drawn with the
+    same locality bias), then traffic returns to baseline.  Late in a
+    long log the burst is diluted by history and the drift detector never
+    fires; the per-window hotspot detector (serve/hotspot.py) fires the
+    window it lands — exactly the gap the serving feedback closes.
+
+    Returns ``(events, cohort_mask)``: the merged, globally time-sorted
+    log and the bool mask of burst files.  Deterministic in ``cfg.seed``
+    (the burst draws from a derived independent stream).
+    """
+    dur_total = float(cfg.duration_seconds)
+    if not 0.0 <= float(start) < dur_total:
+        raise ValueError(
+            f"start must fall inside [0, {dur_total}), got {start}")
+    if duration <= 0 or float(start) + float(duration) > dur_total:
+        raise ValueError(
+            f"burst [{start}, {start + duration}) must fit inside the "
+            f"{dur_total}s simulation span")
+    if boost <= 0:
+        raise ValueError(f"boost must be > 0, got {boost}")
+    in_cohort = np.asarray(cohort, dtype=bool)
+    if in_cohort.shape != (len(manifest),):
+        raise ValueError(
+            f"cohort mask shape {in_cohort.shape} != ({len(manifest)},)")
+    if sim_start is None:
+        sim_start = float(np.ceil(manifest.creation_ts.max())) + 1.0
+
+    base = simulate_access(manifest, cfg, sim_start=sim_start,
+                           engine=engine)
+
+    seed_b = None if cfg.seed is None else int(cfg.seed) + 0x9E37
+    rng = np.random.default_rng(seed_b)
+    ids = np.flatnonzero(in_cohort)
+    default = cfg.rate_profiles.get("moderate", {"read_rate": 0.1,
+                                                 "locality_bias": 0.5})
+    read_mu = np.asarray([
+        cfg.rate_profiles.get(manifest.category[i], default)["read_rate"]
+        for i in ids])
+    loc_mu = np.asarray([
+        cfg.rate_profiles.get(manifest.category[i],
+                              default)["locality_bias"] for i in ids])
+    counts = rng.poisson(boost * read_mu * float(duration))
+    total = int(counts.sum())
+    pid = np.repeat(ids.astype(np.int32), counts)
+    ts = sim_start + float(start) + rng.random(total) * float(duration)
+
+    from ..io.events import client_vocabulary
+
+    clients, client_pool = client_vocabulary(manifest, cfg.clients)
+    use_primary = rng.random(total) < np.repeat(loc_mu, counts)
+    random_client = client_pool[rng.integers(0, len(cfg.clients),
+                                             size=total)]
+    client_id = np.where(use_primary, manifest.primary_node_id[pid],
+                         random_client).astype(np.int32)
+    burst = EventLog(ts=ts, path_id=pid,
+                     op=np.zeros(total, dtype=np.int8),  # all reads
+                     client_id=client_id, clients=clients)
+
+    merged = EventLog.concat([base, burst])
+    order = np.argsort(merged.ts, kind="stable")
+    return EventLog(ts=merged.ts[order], path_id=merged.path_id[order],
+                    op=merged.op[order], client_id=merged.client_id[order],
+                    clients=merged.clients), in_cohort
 
 
 def simulate_access_with_shift(

@@ -1,0 +1,559 @@
+"""Serving layer (ISSUE 6): vectorized read router, queue model, SLO
+accounting, hotspot feedback, bucketed telemetry histograms, and the
+controller/CLI wiring."""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.cluster import ClusterTopology, evaluate_placement, \
+    place_replicas
+from cdrs_tpu.config import (
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from cdrs_tpu.control import ControllerConfig, ReplicationController
+from cdrs_tpu.serve import (
+    POLICIES,
+    HotspotDetector,
+    ReadRouter,
+    ServeConfig,
+    SloSpec,
+)
+from cdrs_tpu.sim.access import simulate_access
+from cdrs_tpu.sim.generator import generate_population
+
+SEED = int(os.environ.get("CDRS_CHAOS_SEED", "0"))
+
+
+def _placement(n_files=500, n_nodes=6, rf=3, seed=0):
+    nodes = tuple(f"dn{i}" for i in range(1, n_nodes + 1))
+    manifest = generate_population(
+        GeneratorConfig(n_files=n_files, seed=seed, nodes=nodes))
+    placement = place_replicas(
+        manifest, np.full(n_files, rf, dtype=np.int32),
+        ClusterTopology(nodes=nodes), seed=seed)
+    return manifest, placement
+
+
+def _reads(n_files, n_nodes, e=20000, seed=0, span=60.0, skew=3.0):
+    rng = np.random.default_rng(seed)
+    ts = np.sort(rng.random(e) * span)
+    pid = (n_files * rng.random(e) ** skew).astype(np.int32)
+    client = rng.integers(-1, n_nodes, e).astype(np.int32)
+    return ts, pid, client
+
+
+# -- routing policies --------------------------------------------------------
+
+
+@pytest.mark.parametrize("policy", POLICIES)
+def test_never_selects_unreachable_node(policy):
+    """No policy ever routes a read to a node outside the reachable set;
+    reads with zero reachable replicas come back unavailable (-1)."""
+    manifest, placement = _placement(seed=SEED)
+    n_nodes = len(placement.topology)
+    rm = placement.replica_map
+    # Knock out two nodes: their slots become unreachable.
+    down = {1, 4}
+    node_ok = np.asarray([i not in down for i in range(n_nodes)])
+    slot_ok = (rm >= 0) & node_ok[np.clip(rm, 0, None)]
+    ts, pid, client = _reads(len(manifest), n_nodes, seed=SEED + 1)
+    router = ReadRouter(n_nodes, ServeConfig(policy=policy, seed=SEED))
+    res = router.route(rm, slot_ok, np.ones(n_nodes), ts=ts, pid=pid,
+                       client=client, window_seconds=60.0)
+    routed = res.server[res.server >= 0]
+    assert not np.isin(routed, list(down)).any()
+    # Unavailable exactly when the file has no reachable slot.
+    expect_unavail = ~slot_ok[pid].any(axis=1)
+    assert np.array_equal(res.server < 0, expect_unavail)
+    assert res.n_unavailable == int(expect_unavail.sum())
+    assert res.latency_ms.shape == (res.n_routed,)
+    assert np.isfinite(res.latency_ms).all() and (res.latency_ms > 0).all()
+
+
+def test_p2c_load_not_worse_than_random():
+    """Power-of-two-choices' max node load <= random-replica's on the same
+    seed (Mitzenmacher) — measured as busy-seconds on a skewed stream."""
+    manifest, placement = _placement(n_files=300, seed=SEED)
+    n_nodes = len(placement.topology)
+    rm, slot_ok = placement.replica_map, placement.replica_map >= 0
+    ts, pid, client = _reads(len(manifest), n_nodes, e=60000,
+                             seed=SEED + 2, skew=5.0)
+    client = np.full_like(client, -1)  # no local short-circuit: pure policy
+    loads = {}
+    for policy in ("random", "p2c"):
+        router = ReadRouter(n_nodes, ServeConfig(policy=policy, seed=SEED))
+        res = router.route(rm, slot_ok, np.ones(n_nodes), ts=ts, pid=pid,
+                           client=client, window_seconds=60.0)
+        loads[policy] = res.reads_per_node.max()
+    assert loads["p2c"] <= loads["random"]
+
+
+def test_flat_nominal_locality_matches_offline_replay():
+    """Flat topology + all-nominal throughput: the router's locality (any
+    policy — local reads always short-circuit) equals the offline
+    replay's read_locality on the same placement and events."""
+    manifest, placement = _placement(n_files=400, rf=2, seed=SEED)
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=300, seed=SEED + 3))
+    m = evaluate_placement(manifest, events, placement, seed=0)
+
+    from cdrs_tpu.cluster.evaluate import _client_to_topology
+
+    keep = events.path_id >= 0
+    is_read = np.asarray(events.op)[keep] == 0
+    pid = events.path_id[keep][is_read]
+    ts = events.ts[keep][is_read]
+    client = _client_to_topology(events, placement.topology)[keep][is_read]
+    n_nodes = len(placement.topology)
+    for policy in ("primary", "random"):
+        router = ReadRouter(n_nodes, ServeConfig(policy=policy, seed=SEED))
+        res = router.route(placement.replica_map,
+                           placement.replica_map >= 0, np.ones(n_nodes),
+                           ts=ts, pid=pid, client=client)
+        assert res.locality == pytest.approx(m.read_locality, abs=1e-12)
+        assert res.n_unavailable == 0
+
+
+def test_queue_model_matches_naive_fifo():
+    """The closed-form latency (s(k+1) + cummax(a - sk)) equals the naive
+    per-request FIFO recurrence on a single node."""
+    rng = np.random.default_rng(SEED)
+    ts = np.sort(rng.random(800) * 2.0)
+    rm = np.zeros((50, 1), dtype=np.int32)
+    router = ReadRouter(1, ServeConfig(policy="primary", service_ms=1.5))
+    res = router.route(rm, rm >= 0, np.ones(1), ts=ts,
+                       pid=rng.integers(0, 50, 800).astype(np.int32),
+                       client=np.full(800, -1, dtype=np.int32))
+    s = 1.5e-3
+    f_prev = -np.inf
+    naive = []
+    for a in ts:
+        f_prev = max(a, f_prev) + s
+        naive.append((f_prev - a) * 1000.0)
+    assert np.allclose(res.latency_ms, naive)
+    assert res.p50_ms <= res.p95_ms <= res.p99_ms
+
+
+def test_straggler_stretches_service_time():
+    """A degraded node's reads take at least service_ms/factor."""
+    rng = np.random.default_rng(SEED)
+    e = 2000
+    ts = np.sort(rng.random(e) * 60.0)
+    rm = np.zeros((10, 1), dtype=np.int32)  # every read forced to node 0
+    router = ReadRouter(1, ServeConfig(policy="primary", service_ms=0.5))
+    thr = np.asarray([0.25])
+    res = router.route(rm, rm >= 0, thr, ts=ts,
+                       pid=rng.integers(0, 10, e).astype(np.int32),
+                       client=np.full(e, -1, dtype=np.int32),
+                       window_seconds=60.0)
+    assert res.latency_ms.min() >= 2.0 - 1e-9  # 0.5ms / 0.25
+    nominal = ReadRouter(1, ServeConfig(policy="primary",
+                                        service_ms=0.5)).route(
+        rm, rm >= 0, np.ones(1), ts=ts,
+        pid=rng.integers(0, 10, e).astype(np.int32),
+        client=np.full(e, -1, dtype=np.int32), window_seconds=60.0)
+    assert res.p99_ms > nominal.p99_ms
+
+
+def test_routing_deterministic_given_seed():
+    manifest, placement = _placement(seed=SEED)
+    n_nodes = len(placement.topology)
+    ts, pid, client = _reads(len(manifest), n_nodes, seed=SEED)
+    for policy in POLICIES:
+        a, b = (ReadRouter(n_nodes, ServeConfig(policy=policy, seed=7))
+                .route(placement.replica_map, placement.replica_map >= 0,
+                       np.ones(n_nodes), ts=ts, pid=pid, client=client,
+                       rng=np.random.default_rng([7, 3]))
+                for _ in range(2))
+        assert np.array_equal(a.server, b.server)
+        assert np.array_equal(a.latency_ms, b.latency_ms)
+
+
+def test_full_outage_window_has_no_latency_sample():
+    """A window where every read is unavailable reports latency
+    percentiles as None — not 0, which would claim a perfect tail for
+    exactly the worst window — while still counting the unavailable
+    reads (and their fraction) in the serving digest."""
+    from cdrs_tpu.obs.aggregate import serve_digest
+
+    rng = np.random.default_rng(SEED)
+    rm = np.zeros((10, 1), dtype=np.int32)
+    slot_ok = np.zeros((10, 1), dtype=bool)  # nothing reachable
+    router = ReadRouter(1, ServeConfig(policy="p2c"))
+    res = router.route(rm, slot_ok, np.ones(1),
+                       ts=np.sort(rng.random(100)),
+                       pid=rng.integers(0, 10, 100).astype(np.int32),
+                       client=np.full(100, -1, dtype=np.int32))
+    assert res.n_unavailable == 100 and res.n_routed == 0
+    fields = res.record_fields()
+    assert fields["latency_p99_ms"] is None
+    assert fields["latency_p50_ms"] is None
+    d = serve_digest([{"window": 0, **fields}])
+    assert d["reads_unavailable"] == 100
+    assert d["unavailable_fraction"] == 1.0
+    assert d["latency_p99_ms_max"] is None
+    # The renderers survive the latency-less digest.
+    from cdrs_tpu.obs.metrics_cli import summarize_events
+    from cdrs_tpu.obs.report import render_html
+
+    out = io.StringIO()
+    summarize_events([{"kind": "window", "window": 0, **fields}], out=out)
+    assert "p99 — ms" in out.getvalue()
+    assert "Serving (read-path SLO)" in render_html(
+        [{"kind": "window", "window": 0, **fields}])
+
+
+def test_slo_burn_accounting():
+    """Burn = (over-target + unavailable) / reads / error budget."""
+    rng = np.random.default_rng(SEED)
+    e = 4000
+    ts = np.sort(rng.random(e) * 1.0)  # 4000 r/s on one 2000 r/s node
+    rm = np.zeros((10, 1), dtype=np.int32)
+    router = ReadRouter(1, ServeConfig(
+        policy="primary", service_ms=0.5,
+        slo=SloSpec(target_ms=5.0, availability=0.99)))
+    res = router.route(rm, rm >= 0, np.ones(1), ts=ts,
+                       pid=rng.integers(0, 10, e).astype(np.int32),
+                       client=np.full(e, -1, dtype=np.int32),
+                       window_seconds=1.0)
+    over = int((res.latency_ms > 5.0).sum())
+    assert res.slo_violations == over
+    assert res.slo_burn == pytest.approx((over / e) / 0.01)
+    assert res.slo_burn > 1.0  # an overloaded node burns the budget
+
+
+# -- hotspot detector --------------------------------------------------------
+
+
+def test_hotspot_detects_spike_not_stationary():
+    det = HotspotDetector(100, spike_factor=4.0, min_reads=20, top_k=4)
+    rng = np.random.default_rng(SEED)
+    base = rng.poisson(10.0, 100).astype(float)
+    assert not det.observe(base).fired          # first window: baseline
+    for _ in range(3):
+        assert not det.observe(
+            rng.poisson(10.0, 100).astype(float)).fired
+    spike = rng.poisson(10.0, 100).astype(float)
+    spike[[7, 42]] += 200.0
+    res = det.observe(spike)
+    assert res.fired and set(res.files) == {7, 42}
+    assert res.score >= 4.0
+    # The spike folds into the EWMA: a repeat at the same level decays.
+    res2 = det.observe(spike)
+    assert res2.score < res.score
+
+
+def test_hotspot_deterministic_and_seed_invariant():
+    """Detection is pure arithmetic on counts: identical across detector
+    instances and independent of any router seed."""
+    rng = np.random.default_rng(SEED)
+    windows = [rng.poisson(8.0, 64).astype(float) for _ in range(6)]
+    windows[4][5] += 500.0
+
+    def run():
+        det = HotspotDetector(64, min_reads=10)
+        return [(r.fired, r.score, r.files)
+                for r in (det.observe(w) for w in windows)]
+
+    assert run() == run()
+
+
+def test_hotspot_state_roundtrip():
+    det = HotspotDetector(32, alpha=0.5)
+    det.observe(np.arange(32, dtype=float))
+    det.observe(np.ones(32))
+    arrays = det.state_arrays()
+    det2 = HotspotDetector(32, alpha=0.5)
+    det2.load_state_arrays(arrays)
+    a = det.observe(np.full(32, 7.0))
+    b = det2.observe(np.full(32, 7.0))
+    assert (a.fired, a.score, a.files) == (b.fired, b.score, b.files)
+    assert np.array_equal(det.ewma, det2.ewma)
+
+
+# -- controller integration --------------------------------------------------
+
+
+_NODES5 = ("dn1", "dn2", "dn3", "dn4", "dn5")
+
+
+def _controller(manifest, serve=None, faults=None, **kw):
+    cfg = ControllerConfig(
+        window_seconds=60.0, default_rf=2,
+        kmeans=KMeansConfig(k=8, seed=42),
+        scoring=validated_scoring_config(), serve=serve,
+        fault_schedule=faults, **kw)
+    return ReplicationController(manifest, cfg)
+
+
+@pytest.fixture(scope="module")
+def serve_workload():
+    manifest = generate_population(
+        GeneratorConfig(n_files=300, seed=SEED + 5, nodes=_NODES5))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=480, seed=SEED + 6))
+    return manifest, events
+
+
+def test_controller_serve_records(serve_workload):
+    manifest, events = serve_workload
+    res = _controller(manifest, serve=ServeConfig(policy="p2c",
+                                                  seed=3)).run(events)
+    busy = [r for r in res.records if r["n_events"]]
+    assert busy
+    for r in busy:
+        assert r["reads_routed"] + r["reads_unavailable"] == r["n_reads"]
+        assert np.isfinite(r["latency_p99_ms"])
+        assert r["latency_p50_ms"] <= r["latency_p99_ms"]
+        assert 0.0 <= r["serve_locality"] <= 1.0
+        assert r["utilization_max"] >= 0.0
+    summary = res.summary()
+    assert summary["serve"]["reads_routed"] == sum(
+        r["reads_routed"] for r in busy)
+    assert np.isfinite(summary["serve"]["latency_p99_ms_max"])
+
+
+def test_controller_serve_kill_resume_bit_identical(serve_workload, tmp_path):
+    """Serve state (hotspot EWMA, per-window routing seeds) rides the npz
+    checkpoint: kill/resume reproduces the uninterrupted records."""
+    manifest, events = serve_workload
+    from cdrs_tpu.faults import FaultSchedule
+
+    sched = FaultSchedule.from_specs(
+        ["partition:dn2@3-5", "degrade:dn3@2-6:0.25"])
+
+    def mk():
+        return _controller(manifest, serve=ServeConfig(policy="p2c", seed=3),
+                           faults=FaultSchedule(sched.events))
+
+    def strip(rs):
+        return [{k: v for k, v in r.items() if k != "seconds"}
+                for r in rs]
+
+    full = mk().run(events)
+    ck = str(tmp_path / "serve.npz")
+    a = mk().run(events, checkpoint_path=ck, max_windows=4)
+    b = mk().run(events, checkpoint_path=ck)
+    assert strip(a.records) + strip(b.records) == strip(full.records)
+    assert np.array_equal(b.rf, full.rf)
+
+
+def test_serve_checkpoint_flag_mismatch(serve_workload, tmp_path):
+    manifest, events = serve_workload
+    ck = str(tmp_path / "plain.npz")
+    _controller(manifest).run(events, checkpoint_path=ck, max_windows=2)
+    with pytest.raises(ValueError, match="serve"):
+        _controller(manifest, serve=ServeConfig()).run(
+            events, checkpoint_path=ck)
+
+
+def test_hotspot_triggers_recluster_drift_does_not():
+    """Flash crowd: the drift-only controller sleeps through the burst
+    (score inside the detector's noise band); the serve-enabled one
+    re-clusters the burst window with trigger='hotspot' and raises the
+    audit flag.  Runs the bench's own scenario (benchmarks/serve_bench)
+    at its quick scale — the acceptance criterion, tested."""
+    from cdrs_tpu.benchmarks.serve_bench import run_flash_crowd
+
+    f = run_flash_crowd(n_files=200, duration=900.0, n_windows=9,
+                        burst_windows=(6, 6), k=8)
+    assert f["hotspot_catches_what_drift_misses"]
+    assert f["drift_only"]["reclusters_at_or_after_burst"] == []
+    hot = f["hotspot_feedback"]["hotspot_reclusters"]
+    assert hot == [6]
+    assert f["hotspot_feedback"]["audit_hotspot_flag_windows"] == hot
+    # The separation the artifact pins: the burst barely moves the drift
+    # statistic but multiplies the hotspot ratio far past its threshold.
+    assert f["drift_at_burst"] < f["drift_threshold"]
+    assert f["hotspot_score_at_burst"] >= 4.0
+
+
+# -- telemetry: bucketed histograms & raw cap --------------------------------
+
+
+def test_histogram_bulk_buckets_and_merge():
+    from cdrs_tpu.obs import JsonlSink, Telemetry, read_events
+    from cdrs_tpu.obs.aggregate import bucket_percentile, collect
+    import tempfile
+
+    rng = np.random.default_rng(SEED)
+    vals = rng.lognormal(0.0, 1.0, 5000)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "t.jsonl")
+        with Telemetry(JsonlSink(path)) as tel:
+            tel.histogram_bulk("lat", vals[:3000])
+            tel.histogram_bulk("lat", vals[3000:])
+            agg_mem = tel.hist_buckets["lat"]
+        events = read_events(path)
+    bulk = [e for e in events if e.get("kind") == "hist_bulk"]
+    assert len(bulk) == 2  # one event per CALL, not per sample
+    digest = collect(events)
+    agg = digest["hist_buckets"]["lat"]
+    assert agg["count"] == 5000 == sum(agg["buckets"].values())
+    assert agg["count"] == agg_mem["count"]
+    assert agg["min"] == pytest.approx(vals.min())
+    assert agg["max"] == pytest.approx(vals.max())
+    # Bucket-estimated percentiles sit within one ladder step of exact.
+    for q in (0.5, 0.95, 0.99):
+        est = bucket_percentile(agg, q)
+        exact = float(np.quantile(vals, q))
+        assert exact <= est <= exact * 10 ** 0.25 * 1.01
+
+
+def test_histogram_bulk_subsample_scaling():
+    from cdrs_tpu.obs.telemetry import HIST_BULK_SAMPLE_CAP, bucket_counts
+
+    rng = np.random.default_rng(SEED)
+    vals = rng.lognormal(0.0, 1.0, HIST_BULK_SAMPLE_CAP * 3 + 17)
+    sparse, n, total, vmin, vmax = bucket_counts(vals)
+    assert n == sum(c for _, c in sparse)  # counts stay self-consistent
+    assert abs(n - vals.size) <= 4  # stride rounding only
+    assert vmin == vals.min() and vmax == vals.max()
+    assert total == pytest.approx(vals.sum(), rel=0.05)
+
+
+def test_histogram_raw_cap_keeps_percentiles():
+    from cdrs_tpu.obs import Telemetry
+    from cdrs_tpu.obs.aggregate import percentile
+    from cdrs_tpu.obs.telemetry import HIST_RAW_CAP
+
+    with Telemetry() as tel:
+        n = HIST_RAW_CAP * 6
+        for i in range(n):
+            tel.histogram("h", float(i % 1000))
+        kept = tel.histograms["h"]
+    assert len(kept) < HIST_RAW_CAP
+    assert percentile(kept, 0.5) == pytest.approx(500, rel=0.05)
+    assert percentile(kept, 0.95) == pytest.approx(950, rel=0.05)
+
+
+def test_prometheus_histogram_export():
+    from cdrs_tpu.obs.metrics_cli import prometheus_lines
+
+    events = [
+        {"kind": "hist_bulk", "name": "serve.latency_ms", "count": 7,
+         "sum": 10.0, "min": 0.4, "max": 900.0,
+         "buckets": [[0.5623413251903491, 3], [1.0, 3], ["+Inf", 1]]},
+    ]
+    lines = prometheus_lines(events)
+    text = "\n".join(lines)
+    assert "# TYPE cdrs_serve_latency_ms histogram" in text
+    assert 'cdrs_serve_latency_ms_bucket{le="1"} 6' in text  # cumulative
+    assert 'cdrs_serve_latency_ms_bucket{le="+Inf"} 7' in text
+    assert "cdrs_serve_latency_ms_count 7" in text
+
+
+# -- digests -----------------------------------------------------------------
+
+
+def _serve_windows():
+    return [{"kind": "window", "window": i, "n_events": 100, "n_reads": 80,
+             "reads_routed": 78, "reads_unavailable": 2,
+             "latency_p50_ms": 0.5, "latency_p95_ms": 1.0,
+             "latency_p99_ms": 2.0 + i, "slo_burn": 0.5 * i,
+             "utilization_max": 0.5, "serve_locality": 0.7,
+             "hotspot_files": [1] if i == 1 else [],
+             "recluster_trigger": "hotspot" if i == 1 else None}
+            for i in range(3)]
+
+
+def test_summarize_serving_and_unavailable_fraction(capsys):
+    from cdrs_tpu.obs.metrics_cli import summarize_events
+
+    events = _serve_windows()
+    events.append({"kind": "window", "window": 3, "n_events": 50,
+                   "n_reads": 40, "unavailable_reads": 4,
+                   "durability": {"lost": 1, "at_risk": 0,
+                                  "under_replicated": 0, "nodes_up": 4}})
+    out = io.StringIO()
+    summarize_events(events, out=out)
+    text = out.getvalue()
+    assert "Serving: 234 reads routed over 3 windows" in text
+    assert "hotspots: 1 windows fired" in text
+    # unavailable fraction normalizes by presented reads: 4 / 280.
+    assert "fraction 0.01429" in text
+
+
+def test_report_serving_section():
+    from cdrs_tpu.obs.report import render_html
+
+    html = render_html(_serve_windows())
+    assert "Serving (read-path SLO)" in html
+    assert "hotspot-triggered" in html
+
+
+def test_serve_digest_absent_for_plain_streams():
+    from cdrs_tpu.obs.aggregate import serve_digest
+
+    assert serve_digest([{"window": 0, "n_events": 5}]) is None
+
+
+# -- regress ingestion -------------------------------------------------------
+
+
+def test_regress_extracts_bench_records():
+    from cdrs_tpu.benchmarks.regress import extract_records
+
+    doc = {"criteria": {}, "bench_records": [
+        {"metric": "serve_routed_reads_per_sec", "value": 2.0e6,
+         "unit": "reads/s", "backend": "numpy"},
+        {"metric": "serve_chaos_p99_ms_p2c", "value": 8.0, "unit": "ms",
+         "backend": "numpy"},
+    ]}
+    recs = extract_records(doc, "serve_bench.json")
+    assert {r["metric"] for r in recs} == {
+        "serve_routed_reads_per_sec", "serve_chaos_p99_ms_p2c"}
+    by = {r["metric"]: r for r in recs}
+    assert by["serve_routed_reads_per_sec"]["direction"] == "higher"
+    assert by["serve_chaos_p99_ms_p2c"]["direction"] == "lower"
+    assert by["serve_chaos_p99_ms_p2c"]["platform"] == "numpy"
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_serve_cli_smoke(tmp_path, capsys):
+    from cdrs_tpu.cli import main
+
+    manifest = generate_population(
+        GeneratorConfig(n_files=120, seed=11, nodes=("dn1", "dn2", "dn3")))
+    man_path = str(tmp_path / "m.csv")
+    manifest.write_csv(man_path)
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=240, seed=12))
+    log_path = str(tmp_path / "a.log")
+    events.write_csv(log_path, manifest)
+    metrics = str(tmp_path / "s.jsonl")
+    rc = main(["serve", "--manifest", man_path, "--access_log", log_path,
+               "--policy", "p2c", "--degrade", "dn2@1-2:0.5",
+               "--metrics", metrics])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["reads_routed"] > 0
+    assert np.isfinite(out["latency_p99_ms_last"])
+    assert out["policy"] == "p2c"
+    from cdrs_tpu.obs import read_events
+    from cdrs_tpu.obs.aggregate import collect, serve_digest
+
+    stream = read_events(metrics)
+    digest = collect(stream)
+    assert "serve.latency_ms" in digest["hist_buckets"]
+    assert serve_digest(digest["windows"]) is not None
+
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError, match="policy"):
+        ServeConfig(policy="nearest")
+    with pytest.raises(ValueError, match="service_ms"):
+        ServeConfig(service_ms=0.0)
+    with pytest.raises(ValueError, match="availability"):
+        SloSpec(availability=1.0)
+    with pytest.raises(ValueError, match="spike_factor"):
+        ServeConfig(hotspot_spike_factor=1.0)
