@@ -10,6 +10,7 @@ subcommand of ``python -m cdrs_tpu`` (or the ``cdrs`` console script):
   cluster   features CSV -> final_categories.csv       (reference: main.py)
   pipeline  all of the above end-to-end      (reference: run_pipeline.sh)
             (alias: run)
+  storage   storage strategies: EC/tier config resolution + cost estimate
   bench     benchmark harness                          (new; BASELINE.md configs)
   metrics   inspect telemetry JSONL streams            (new; obs/metrics_cli.py)
 
@@ -271,12 +272,36 @@ def _cmd_pipeline(args) -> int:
     return 0
 
 
+def _read_assignments(manifest, path, categories):
+    """Parse a cluster/control assignments CSV (path,category,...) into
+    matched ``(file_id, category)`` pairs, with the shared no-match
+    error / partial-match warning.  Returns None when rows exist but
+    none matched."""
+    import csv as _csv
+
+    pairs, rows = [], 0
+    with open(path, newline="") as f:
+        for row in _csv.DictReader(f):
+            rows += 1
+            i = manifest.path_to_id.get(row.get("path"))
+            c = row.get("category")
+            if i is not None and c in categories:
+                pairs.append((i, c))
+    if rows and not pairs:
+        print(f"error: no row of {path} matched a manifest path with a "
+              f"known category — is this the cluster --assignments_csv "
+              f"output?", file=sys.stderr)
+        return None
+    if len(pairs) < rows:
+        print(f"warning: {rows - len(pairs)}/{rows} assignment rows "
+              f"ignored (unknown path or category)", file=sys.stderr)
+    return pairs
+
+
 def _cmd_evaluate(args) -> int:
     """Apply decided replication factors on the simulated cluster and report
     locality/load/storage vs uniform baselines (the reference decides factors
     but never applies them — SURVEY.md §6)."""
-    import csv as _csv
-
     from .cluster import ClusterTopology, compare_policies
     from .io.events import EventLog, Manifest
 
@@ -288,27 +313,16 @@ def _cmd_evaluate(args) -> int:
     # wrong factors.
     scoring = _load_scoring(args)
     rf = np.full(len(manifest), args.default_rf, dtype=np.int32)
-    rows = matched = 0
     want_plan = bool(args.emit_plan or args.emit_setrep)
     plan_rows: list[tuple[str, str]] = []
-    with open(args.assignments_csv, newline="") as f:
-        for row in _csv.DictReader(f):
-            rows += 1
-            i = manifest.path_to_id.get(row["path"])
-            r = scoring.replication_factors.get(row.get("category"))
-            if i is not None and r is not None:
-                rf[i] = r
-                matched += 1
-                if want_plan:
-                    plan_rows.append((row["path"], row["category"]))
-    if rows and matched == 0:
-        print(f"error: no row of {args.assignments_csv} matched a manifest "
-              f"path with a known category — is this the cluster "
-              f"--assignments_csv output?", file=sys.stderr)
+    pairs = _read_assignments(manifest, args.assignments_csv,
+                              scoring.replication_factors)
+    if pairs is None:
         return 1
-    if matched < rows:
-        print(f"warning: {rows - matched}/{rows} assignment rows ignored "
-              f"(unknown path or category)", file=sys.stderr)
+    for i, c in pairs:
+        rf[i] = scoring.replication_factors[c]
+        if want_plan:
+            plan_rows.append((manifest.paths[i], c))
 
     if want_plan:
         from .cluster import build_plan, write_plan_csv, write_setrep_script
@@ -440,6 +454,12 @@ def _controller_cfg(args, fault_schedule=None, topology=None):
     """ControllerConfig from the shared control/chaos argument set."""
     from .control import ControllerConfig
 
+    scoring = _load_scoring(args)
+    storage_cfg = None
+    if getattr(args, "storage_config", None):
+        from .storage import resolve_storage_config
+
+        storage_cfg = resolve_storage_config(args.storage_config, scoring)
     serve_cfg = None
     if getattr(args, "serve", False):
         from .serve import ServeConfig, SloSpec
@@ -453,6 +473,7 @@ def _controller_cfg(args, fault_schedule=None, topology=None):
     return ControllerConfig(
         topology=topology,
         serve=serve_cfg,
+        storage=storage_cfg,
         window_seconds=args.window_seconds,
         drift_threshold=args.drift_threshold,
         full_recluster_drift=args.full_drift,
@@ -466,7 +487,7 @@ def _controller_cfg(args, fault_schedule=None, topology=None):
         kmeans=KMeansConfig(k=args.k, seed=args.seed,
                             init_method=getattr(args, 'init_method', 'auto'),
                             dtype=getattr(args, 'dtype', None)),
-        scoring=_load_scoring(args),
+        scoring=scoring,
         mesh_shape=_parse_mesh(args.mesh),
         evaluate=not args.no_evaluate,
         fault_schedule=fault_schedule,
@@ -676,6 +697,87 @@ def _cmd_serve(args) -> int:
     if out.get("reads_routed"):
         out["routed_reads_per_sec"] = round(
             out["reads_routed"] / max(t.elapsed, 1e-9), 1)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def _cmd_storage(args) -> int:
+    """Storage-strategy inspection: resolve a strategy config against
+    the category vocabulary (``show``) or estimate its byte/cost
+    footprint over a real manifest + category assignment (``estimate``)
+    — the offline counterpart of the per-window ``storage`` record the
+    controller emits when ``--storage_config`` is set."""
+    from .config import CATEGORIES
+    from .storage import resolve_storage_config
+
+    scoring = _load_scoring(args)
+    cfg = resolve_storage_config(args.storage_config, scoring)
+    rows = cfg.describe(CATEGORIES, scoring.replication_factors)
+
+    if args.action == "show":
+        print(json.dumps({
+            "storage_config": args.storage_config,
+            "pure_replication": cfg.pure_replication,
+            "default_tier": cfg.default_tier,
+            "tiers": cfg.to_dict()["tiers"],
+            "categories": rows,
+        }, indent=2))
+        return 0
+
+    # estimate
+    from .io.events import Manifest
+
+    if not args.manifest or not args.assignments_csv:
+        print("error: storage estimate needs --manifest and "
+              "--assignments_csv (the cluster/control per-file "
+              "path,cluster,category table)", file=sys.stderr)
+        return 1
+    manifest = Manifest.read_csv(args.manifest)
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+    cat_idx = {c: i for i, c in enumerate(CATEGORIES)}
+    cat = np.full(len(manifest), -1, dtype=np.int64)
+    pairs = _read_assignments(manifest, args.assignments_csv, cat_idx)
+    if pairs is None:
+        return 1
+    for i, c in pairs:
+        cat[i] = cat_idx[c]
+    rf_vec = np.asarray([scoring.replication_factors[c]
+                         for c in CATEGORIES], dtype=np.int64)
+    by_cat = []
+    tot = {"raw": 0, "stored": 0, "cost": 0.0, "baseline": 0}
+    for ci, c in enumerate(CATEGORIES):
+        sel = cat == ci
+        if not sel.any():
+            continue
+        s = cfg.strategy_for(c, scoring.replication_factors.get(c))
+        raw = int(sizes[sel].sum())
+        shard = -(-sizes[sel] // s.shard_div)
+        stored = int((shard * s.n_shards).sum())
+        cost = stored * cfg.tiers[s.tier].byte_cost
+        baseline = int(raw * rf_vec[ci])
+        by_cat.append({
+            "category": c, "files": int(sel.sum()), "strategy": s.spec(),
+            "bytes_raw": raw, "bytes_stored": stored,
+            "cost_units": round(cost, 3),
+            "bytes_replicate_baseline": baseline,
+            "bytes_saved_vs_baseline": baseline - stored,
+        })
+        tot["raw"] += raw
+        tot["stored"] += stored
+        tot["cost"] += cost
+        tot["baseline"] += baseline
+    out = {
+        "storage_config": args.storage_config,
+        "note": "logical estimate — shard counts are not capped at the "
+                "node count (a live run's `storage` record is)",
+        "files": len(manifest), "files_categorized": len(pairs),
+        "per_category": by_cat,
+        "bytes_raw": tot["raw"], "bytes_stored": tot["stored"],
+        "cost_units": round(tot["cost"], 3),
+        "bytes_replicate_baseline": tot["baseline"],
+        "stored_vs_baseline_ratio": round(
+            tot["baseline"] / tot["stored"], 4) if tot["stored"] else None,
+    }
     print(json.dumps(out, indent=2))
     return 0
 
@@ -901,6 +1003,16 @@ def main(argv: list[str] | None = None) -> int:
         p.add_argument("--medians_from_data", action="store_true")
         p.add_argument("--scoring_config", default=None,
                        metavar="JSON|validated")
+        p.add_argument("--storage_config", default=None,
+                       metavar="JSON|replicate|ec_archival",
+                       help="storage strategies (cdrs_tpu/storage): a "
+                            "JSON config mapping categories to "
+                            "replicate(rf)/ec(k,m) strategies on "
+                            "hot/warm/cold tiers, 'replicate' for the "
+                            "explicit degenerate config, or "
+                            "'ec_archival' for the built-in EC(6,3)-"
+                            "cold Archival preset; inspect with "
+                            "'cdrs storage show'")
         _add_backend_arg(p)
         _add_init_method_arg(p)
 
@@ -999,6 +1111,30 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--max_windows", type=int, default=None)
     _add_metrics_arg(p)
     p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser("storage", help="storage strategies: resolve a "
+                       "replicate/EC/tier config ('show') or estimate "
+                       "its byte/cost footprint over a manifest "
+                       "('estimate')")
+    p.add_argument("action", choices=["show", "estimate"],
+                   help="show = resolved per-category strategy table; "
+                        "estimate = byte/cost footprint of a category "
+                        "assignment vs the replicate baseline")
+    p.add_argument("--storage_config", default="ec_archival",
+                   metavar="JSON|replicate|ec_archival",
+                   help="strategy config (default: the built-in "
+                        "EC(6,3)-cold Archival preset)")
+    p.add_argument("--manifest", default=None,
+                   help="(estimate) manifest CSV")
+    p.add_argument("--assignments_csv", default=None,
+                   help="(estimate) per-file path,cluster,category table "
+                        "(cluster --assignments_csv / control --plan_out)")
+    p.add_argument("--medians_from_data", action="store_true")
+    p.add_argument("--scoring_config", default=None,
+                   metavar="JSON|validated",
+                   help="scoring config supplying the replicate-fallback "
+                        "rf table")
+    p.set_defaults(fn=_cmd_storage)
 
     p = sub.add_parser("bench", help="benchmark harness (BASELINE.md configs)")
     p.add_argument("--config", type=int, default=1)

@@ -11,14 +11,14 @@ load balance, and storage cost (SURVEY.md §4.2's missing validation loop).
 """
 
 from .placement import (ClusterTopology, PlacementResult, place_replicas,
-                        reset_rf_cap_warning)
+                        place_stripes, reset_rf_cap_warning)
 from .evaluate import PolicyMetrics, evaluate_placement, compare_policies
 from .plan import (PlanEntry, build_plan, write_plan_csv, read_plan_csv,
                    write_setrep_script)
 
 __all__ = [
     "ClusterTopology", "PlacementResult", "place_replicas",
-    "reset_rf_cap_warning",
+    "place_stripes", "reset_rf_cap_warning",
     "PolicyMetrics", "evaluate_placement", "compare_policies",
     "PlanEntry", "build_plan", "write_plan_csv", "read_plan_csv",
     "write_setrep_script",

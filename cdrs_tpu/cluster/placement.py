@@ -26,7 +26,7 @@ import numpy as np
 from ..io.events import Manifest
 
 __all__ = ["ClusterTopology", "PlacementResult", "place_replicas",
-           "reset_rf_cap_warning"]
+           "place_stripes", "reset_rf_cap_warning"]
 
 
 class _OnceWarning:
@@ -211,6 +211,7 @@ def place_replicas(
     rf_per_file: np.ndarray,
     topology: ClusterTopology | None = None,
     seed: int | None = 0,
+    size_bytes: np.ndarray | None = None,
 ) -> PlacementResult:
     """Place ``rf_per_file`` replicas of each file onto the topology.
 
@@ -293,5 +294,29 @@ def place_replicas(
 
     result = PlacementResult(replica_map=replica_map, rf=rf,
                              topology=topology)
-    result.compute_storage(manifest.size_bytes)
+    result.compute_storage(manifest.size_bytes if size_bytes is None
+                           else size_bytes)
     return result
+
+
+def place_stripes(
+    manifest: Manifest,
+    shards_per_file: np.ndarray,
+    topology: ClusterTopology | None = None,
+    seed: int | None = 0,
+    shard_bytes: np.ndarray | None = None,
+) -> PlacementResult:
+    """Vectorized stripe placement for storage strategies (cdrs_tpu/storage).
+
+    An erasure-coded file's k+m shards want exactly what replicas want:
+    distinct nodes, spread across failure domains (Ceph CRUSH places EC
+    chunks with the same rule it places replicas) — so stripe placement
+    IS ``place_replicas`` over the per-file shard count.  A replicate
+    strategy's ``n_shards == rf``, so a config with only ``replicate``
+    strategies degenerates bit-for-bit to today's placements.  The one
+    difference is byte accounting: a slot of an EC file holds
+    ``shard_bytes`` (~ size/k) rather than the full size, so
+    ``storage_per_node`` is computed from ``shard_bytes`` when given.
+    """
+    return place_replicas(manifest, shards_per_file, topology, seed,
+                          size_bytes=shard_bytes)

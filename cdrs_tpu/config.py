@@ -362,6 +362,11 @@ def scoring_config_from_dict(d: Mapping) -> ScoringConfig:
     missing = set(cfg.features) - set(cfg.global_medians)
     if missing:
         raise ValueError(f"global_medians missing features {sorted(missing)}")
+    # rf >= 1 per category, offender named (models/replication.py): an
+    # rf=0 typo must fail at parse time, not deep inside placement.
+    from .models.replication import validate_replication_factors
+
+    validate_replication_factors(cfg)
     return cfg
 
 

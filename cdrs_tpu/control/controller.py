@@ -115,6 +115,16 @@ class ControllerConfig:
     #: signal (a flash crowd re-clusters the window it lands, without
     #: waiting for the cumulative feature fold).
     serve: object | None = None
+    #: Storage strategies (storage/strategy.StorageConfig): when set,
+    #: each category resolves to ``replicate(rf)`` or ``ec(k, m)`` on a
+    #: storage tier instead of the scoring rf table.  Shard counts drive
+    #: placement/migration targets, the faults layer accounts stripe
+    #: durability (lost below k live shards) and charges EC
+    #: reconstruction reads against the churn budget, the serve router
+    #: adds tier/degraded-read latency, and every window record carries
+    #: a ``storage`` byte/cost digest.  A config with only ``replicate``
+    #: strategies reproduces the historical behaviour bit-for-bit.
+    storage: object | None = None
 
     def __post_init__(self):
         if self.window_seconds <= 0:
@@ -206,11 +216,14 @@ class ControllerResult:
             out["durability"]["unavailable_read_fraction"] = (
                 out["durability"]["unavailable_reads"] / denom if denom
                 else 0.0)
-        from ..obs.aggregate import serve_digest
+        from ..obs.aggregate import serve_digest, storage_digest
 
         serve = serve_digest(self.records)
         if serve is not None:
             out["serve"] = serve
+        storage = storage_digest(self.records)
+        if storage is not None:
+            out["storage"] = storage
         return out
 
 
@@ -262,8 +275,37 @@ class ReplicationController:
         self._accepted_category_idx: np.ndarray | None = None
         self._accepted_fractions: np.ndarray | None = None
 
+        #: Storage-strategy vectors (storage/): None = historical rf
+        #: semantics.  Resolved here so a bad strategy (EC k < 1, unknown
+        #: tier, typo'd category) fails at construction, not mid-run.
+        self._storage = None
+        if cfg.storage is not None:
+            self._storage = cfg.storage.vectors(
+                CATEGORIES, cfg.scoring.replication_factors)
+            # Replicate rf caps at the node count (the placement cap); an
+            # EC stripe CANNOT — fewer than k+m distinct nodes means the
+            # stripe never reaches full strength and below k it cannot
+            # reconstruct, so the storage record would report bytes no
+            # real cluster could hold.
+            n_nodes = (len(cfg.topology.nodes) if cfg.topology is not None
+                       else len(manifest.nodes))
+            sv = self._storage
+            for i, c in enumerate(sv.categories):
+                if sv.ec_k[i] > 0 and int(sv.n_shards[i]) > n_nodes:
+                    raise ValueError(
+                        f"storage strategy for category {c!r} needs "
+                        f"{int(sv.n_shards[i])} distinct nodes for its "
+                        f"EC stripe but the topology has {n_nodes}")
+
         self.current_rf = np.full(n, int(cfg.default_rf), dtype=np.int32)
         self.current_cat = np.full(n, -1, dtype=np.int32)
+        #: Category whose strategy is actually INSTALLED per file.  A
+        #: deferred conversion (apply_strategy_target refused while the
+        #: file was unreadable) keeps the OLD encoding on disk, so byte
+        #: billing and read penalties follow this vector while the
+        #: target follows current_cat; they re-converge when the
+        #: reconcile pass lands the re-encode.
+        self._installed_cat = self.current_cat.copy()
         self.scheduler = MigrationScheduler(
             n, max_bytes_per_window=cfg.max_bytes_per_window,
             max_files_per_window=cfg.max_files_per_window,
@@ -519,9 +561,25 @@ class ReplicationController:
         bytes_reserved = files_reserved = 0
         if self._cluster_state is not None:
             t0 = time.perf_counter()
-            self._repairs.sync(self._cluster_state, self.current_rf)
+            repair_rf = self.current_rf
+            if self._storage is not None:
+                converted, deferred = self._reconcile_strategies()
+                rec["storage_conversions_retried"] = converted
+                if len(deferred):
+                    # A deferred conversion keeps its installed encoding,
+                    # so repair maintains THAT form's intent
+                    # (installed_shards): topping up toward the unapplied
+                    # target's shard count would write full-size copies
+                    # the re-encode drops the moment it lands — budget
+                    # burned on doomed copies.
+                    cs = self._cluster_state
+                    repair_rf = self.current_rf.copy()
+                    repair_rf[deferred] = np.maximum(
+                        cs.installed_shards[deferred],
+                        cs.min_live[deferred])
+            self._repairs.sync(self._cluster_state, repair_rf)
             rr = self._repairs.schedule(
-                w, self._cluster_state, self.current_rf, self.current_cat,
+                w, self._cluster_state, repair_rf, self.current_cat,
                 max_bytes=cfg.max_bytes_per_window,
                 max_files=cfg.max_files_per_window)
             seconds["repair"] = time.perf_counter() - t0
@@ -545,8 +603,29 @@ class ReplicationController:
         for m in applied:
             self.current_rf[m.file_index] = m.rf_new
             self.current_cat[m.file_index] = m.cat_new
+            installed = True
             if self._cluster_state is not None:
-                self._cluster_state.apply_rf_target(m.file_index, m.rf_new)
+                if self._storage is not None:
+                    # The move may convert the file between strategies
+                    # (replicate <-> EC stripe): apply_strategy_target
+                    # re-encodes when the shape changes (or defers if
+                    # the file is unreadable right now — the reconcile
+                    # pass below retries) and degenerates to
+                    # apply_rf_target when it does not.
+                    cs = self._cluster_state
+                    want = self._file_strategy(int(m.cat_new),
+                                               m.file_index)
+                    cs.apply_strategy_target(m.file_index, *want,
+                                             m.rf_new)
+                    installed = (
+                        int(cs.min_live[m.file_index]) == want[0]
+                        and int(cs.shard_bytes[m.file_index]) == want[1]
+                        and int(cs.ec_k[m.file_index]) == want[2])
+                else:
+                    self._cluster_state.apply_rf_target(m.file_index,
+                                                        m.rf_new)
+            if installed:
+                self._installed_cat[m.file_index] = m.cat_new
         seconds["schedule"] = time.perf_counter() - t0
         rec["moves_applied"] = len(applied)
         rec["bytes_migrated"] = int(sum(m.bytes_moved for m in applied))
@@ -574,6 +653,12 @@ class ReplicationController:
                 rec["n_reads"] = 0
                 rec["unavailable_reads"] = 0
 
+        if self._storage is not None:
+            # Byte/cost accounting of the applied strategies, post
+            # repair + migration (the end-of-window convention) — the
+            # observable the cost-vs-durability frontier is built on.
+            rec["storage"] = self._storage_record()
+
         if self._router is not None and read_pid is not None:
             # Route the window's reads against the END-of-window placement
             # (post repair + migration — the locality_after convention):
@@ -584,16 +669,28 @@ class ReplicationController:
             if self._cluster_state is not None:
                 rm = self._cluster_state.replica_map
                 slot_ok = self._cluster_state.reachable_mask()
+                if self._storage is not None:
+                    # An EC stripe below k reachable shards cannot serve
+                    # a read from ANY surviving slot — mask the whole
+                    # row so the router counts it unavailable, agreeing
+                    # with unreadable_mask()/unavailable_reads in the
+                    # same window record.
+                    readable = ~self._cluster_state.unreadable_mask()
+                    slot_ok = slot_ok & readable[:, None]
                 thr = self._cluster_state.node_throughput
             else:
                 placement = self._placement_for(self.current_rf)
                 rm = placement.replica_map
                 slot_ok = rm >= 0
                 thr = np.ones(len(self._serve_topology.nodes))
+            extra_ms = None
+            if self._storage is not None:
+                extra_ms = self._serve_penalty_ms(slot_ok)[read_pid]
             res = self._router.route(
                 rm, slot_ok, thr, ts=read_ts, pid=read_pid,
                 client=read_client, window_seconds=cfg.window_seconds,
-                rng=np.random.default_rng([int(cfg.serve.seed), int(w)]))
+                rng=np.random.default_rng([int(cfg.serve.seed), int(w)]),
+                extra_ms=extra_ms)
             rec.update(res.record_fields())
             self._last_latency_ms = res.latency_ms
             seconds["serve"] = time.perf_counter() - t0
@@ -721,6 +818,14 @@ class ReplicationController:
         if rec.get("repair_rebalanced"):
             tel.counter_inc("repair.rebalanced_domain",
                             rec["repair_rebalanced"])
+        st = rec.get("storage")
+        if st is not None:
+            tel.gauge("storage.bytes_stored", st["bytes_stored"])
+            tel.gauge("storage.overhead_ratio", st["overhead_ratio"])
+            tel.gauge("storage.cost_units", st["cost_units"])
+            tel.gauge("storage.ec_files", st["ec_files"])
+            for t, b in st["per_tier_bytes"].items():
+                tel.gauge(f"storage.tier.{t}.bytes", b)
         if self._router is not None:
             from ..serve import emit_window_telemetry
 
@@ -767,7 +872,13 @@ class ReplicationController:
         labels = np.asarray(decision.labels)
         cat_idx = np.asarray(decision.category_idx)
         new_cat = cat_idx[labels].astype(np.int64)
-        rf_vec = np.asarray(cfg.scoring.rf_vector(), dtype=np.int64)
+        # With a storage config the target "rf" is the strategy's shard
+        # count (rf for replicate, k+m for EC) — the one generalization
+        # the whole downstream plan/placement/repair machinery needs.
+        if self._storage is not None:
+            rf_vec = self._storage.n_shards.astype(np.int64)
+        else:
+            rf_vec = np.asarray(cfg.scoring.rf_vector(), dtype=np.int64)
         new_rf = rf_vec[new_cat]
 
         # Priority: the new category's scoring margin over the file's
@@ -785,8 +896,29 @@ class ReplicationController:
                              file_scores.min(axis=1))
         priority = new_score - old_score
 
+        move_bytes = None
+        if self._storage is not None:
+            # A strategy re-encode (shape change: replicate <-> EC, or a
+            # different k) drops every old copy and writes rf_new NEW
+            # shards — charge those written bytes, not an rf delta of
+            # full-size copies (which is 0 for an equal-shard-count
+            # conversion and a several-fold over-charge for rf=2 ->
+            # ec(6,3)).  Same-shape moves keep the historical formula at
+            # the (shared) shard size.
+            sv = self._storage
+            old_cat = self.current_cat
+            shard_old = sv.file_shard_bytes(old_cat, self._sizes)
+            shard_new = sv.file_shard_bytes(new_cat, self._sizes)
+            convert = ((sv.file_min_live(old_cat)
+                        != sv.file_min_live(new_cat))
+                       | (shard_old != shard_new)
+                       | (sv.file_ec_k(old_cat) != sv.file_ec_k(new_cat)))
+            move_bytes = np.where(
+                convert, new_rf * shard_new,
+                shard_new * np.maximum(new_rf - self.current_rf, 0))
         moves = plan_diff(self.current_rf, new_rf, self.current_cat, new_cat,
-                          self._sizes, priority=priority)
+                          self._sizes, priority=priority,
+                          move_bytes=move_bytes)
         self.scheduler.submit(moves)
 
         self._accepted_centroids = np.asarray(
@@ -797,6 +929,124 @@ class ReplicationController:
             np.float64)
         self._accepted_fractions = frac / max(len(labels), 1)
 
+    # -- storage strategies (storage/) -------------------------------------
+    def _file_strategy(self, cat: int, fid: int) -> tuple[int, int, int]:
+        """(min_live, shard_bytes, ec_k) of one file under ``cat``."""
+        sv = self._storage
+        if cat < 0:
+            return 1, int(self._sizes[fid]), 0
+        return (int(sv.min_live[cat]),
+                -(-int(self._sizes[fid]) // int(sv.shard_div[cat])),
+                int(sv.ec_k[cat]))
+
+    def _reconcile_strategies(self) -> tuple[int, np.ndarray]:
+        """Retry deferred strategy conversions (apply_strategy_target
+        refused a re-encode while the file was unreadable): once the
+        partition heals or a holder recovers, the file converts to the
+        strategy its applied category wants.  The original migration
+        already paid the churn budget when it was scheduled, so the
+        retry is the same move landing late, not new traffic.  Returns
+        (converted count, file ids STILL deferred) — the repair pass
+        needs the latter to maintain those files' installed form."""
+        cs = self._cluster_state
+        sv = self._storage
+        cat = self.current_cat
+        want_min = sv.file_min_live(cat)
+        want_shard = sv.file_shard_bytes(cat, self._sizes)
+        want_k = sv.file_ec_k(cat)
+        fids = cs.strategy_mismatch(want_min, want_shard, want_k)
+        converted = 0
+        still = []
+        for fid in fids:
+            f = int(fid)
+            cs.apply_strategy_target(
+                f, int(want_min[f]), int(want_shard[f]),
+                int(want_k[f]), int(self.current_rf[f]))
+            # Success = the strategy now matches (the shard-count DELTA
+            # can legitimately be 0, e.g. replicate(3) -> ec(2,1)).
+            if (int(cs.min_live[f]) == int(want_min[f])
+                    and int(cs.shard_bytes[f]) == int(want_shard[f])
+                    and int(cs.ec_k[f]) == int(want_k[f])):
+                converted += 1
+                self._installed_cat[f] = int(cat[f])
+            else:
+                still.append(f)
+        return converted, np.asarray(still, dtype=np.int64)
+
+    def _storage_record(self) -> dict:
+        """Vectorized byte/cost digest of the APPLIED storage strategies:
+        stored vs raw bytes, tier split, cost units (stored bytes x tier
+        byte cost), EC stripe count.  Fault runs count the ACTUAL
+        assigned slots at the INSTALLED shard size (mid-outage a stripe
+        may be short, and a deferred conversion still holds full-size
+        replicate copies — the bytes truly on disk); plain runs count
+        the target shards capped at the node count (the placement
+        cap).  Tier and byte cost likewise follow the INSTALLED
+        category (_installed_cat): a deferred rf->EC conversion's
+        full-size copies bill at their current hot tier, not the cold
+        tier they have not reached yet."""
+        sv = self._storage
+        cat = self.current_cat
+        planned = cat >= 0
+        icat = self._installed_cat
+        isafe = np.clip(icat, 0, None)
+        if self._cluster_state is not None:
+            cs = self._cluster_state
+            counts = (cs.replica_map >= 0).sum(axis=1)
+            shard_b = cs.shard_bytes
+            ec_files = int(((cs.ec_k > 0) & planned).sum())
+        else:
+            counts = np.minimum(self.current_rf, len(self.manifest.nodes))
+            shard_b = sv.file_shard_bytes(cat, self._sizes)
+            ec_files = int(((sv.file_ec_k(cat) > 0) & planned).sum())
+        stored = counts.astype(np.int64) * shard_b
+        raw = int(self._sizes.sum())
+        cost_file = np.where(icat >= 0, sv.byte_cost[isafe],
+                             sv.default_byte_cost)
+        tier_file = np.where(icat >= 0, sv.tier_idx[isafe],
+                             sv.default_tier_idx)
+        per_tier = np.bincount(tier_file, weights=stored,
+                               minlength=len(sv.tier_names))
+        names = list(sv.categories) + ["Unplanned"]
+        bucket = np.where(planned, cat, len(sv.categories))
+        per_cat = np.bincount(bucket, weights=stored, minlength=len(names))
+        total = int(stored.sum())
+        return {
+            "bytes_raw": raw,
+            "bytes_stored": total,
+            "overhead_ratio": round(total / raw, 6) if raw else 0.0,
+            "cost_units": round(float((stored * cost_file).sum()), 3),
+            "ec_files": ec_files,
+            "per_tier_bytes": {t: int(per_tier[i])
+                               for i, t in enumerate(sv.tier_names)
+                               if per_tier[i]},
+            "per_category_bytes": {c: int(per_cat[i])
+                                   for i, c in enumerate(names)
+                                   if per_cat[i]},
+        }
+
+    def _serve_penalty_ms(self, slot_ok: np.ndarray) -> np.ndarray:
+        """(n_files,) additive read latency from the storage layer: the
+        tier penalty (a cold read is ``1/throughput`` x slower than the
+        hot-tier service time) plus the degraded-read penalty — a read
+        of an EC file whose PRIMARY shard is unreachable must gather k
+        shards from the surviving stripe before it can answer.  Reads
+        hit whatever encoding is actually on disk, so the penalty
+        follows the INSTALLED category (deferred conversions are still
+        plain hot-tier copies)."""
+        sv = self._storage
+        cat = self._installed_cat
+        safe = np.clip(cat, 0, None)
+        pen = np.where(cat >= 0, sv.read_penalty[safe],
+                       sv.default_read_penalty)
+        k_file = sv.file_ec_k(cat)
+        primary_down = ~slot_ok[:, 0] if slot_ok.shape[1] else \
+            np.ones(cat.shape[0], dtype=bool)
+        base = float(self.cfg.serve.service_ms)
+        return base * (pen - 1.0) + np.where(
+            (k_file > 0) & primary_down,
+            base * (k_file - 1) * pen, 0.0)
+
     def _placement_for(self, rf: np.ndarray):
         """Placement for an rf vector — a pure seeded function, cached so
         move-free windows (the common steady state), the before/after
@@ -805,13 +1055,31 @@ class ReplicationController:
         (``cfg.topology`` or flat); without serve this is the historical
         flat topology bit-for-bit."""
         key = rf.tobytes()
+        if self._storage is not None:
+            # Two categories can share a shard count but differ in
+            # shard SIZE (replicate vs EC) — the storage accounting of
+            # the cached placement depends on the category vector too.
+            key += self.current_cat.tobytes()
         if self._placement_key != key:
-            from ..cluster import ClusterTopology, place_replicas
+            from ..cluster import (
+                ClusterTopology,
+                place_replicas,
+                place_stripes,
+            )
 
             topology = self._serve_topology or ClusterTopology(
                 nodes=tuple(self.manifest.nodes))
-            self._placement = place_replicas(self.manifest, rf.copy(),
-                                             topology, seed=0)
+            if self._storage is not None:
+                # Shard-aware placement: an EC slot holds size/k bytes,
+                # not the full file (all-replicate shard_bytes == sizes
+                # and this is place_replicas bit-for-bit).
+                self._placement = place_stripes(
+                    self.manifest, rf.copy(), topology, seed=0,
+                    shard_bytes=self._storage.file_shard_bytes(
+                        self.current_cat, self._sizes))
+            else:
+                self._placement = place_replicas(self.manifest, rf.copy(),
+                                                 topology, seed=0)
             self._placement_key = key
         return self._placement
 
@@ -834,6 +1102,7 @@ class ReplicationController:
                 arrays["dec_" + k] = v
         arrays["current_rf"] = self.current_rf
         arrays["current_cat"] = self.current_cat
+        arrays["installed_cat"] = self._installed_cat
         if self._accepted_centroids is not None:
             arrays["accepted_centroids"] = self._accepted_centroids
             arrays["accepted_category_idx"] = self._accepted_category_idx
@@ -861,6 +1130,7 @@ class ReplicationController:
             "n_files": len(self.manifest),
             "faults": self._cluster_state is not None,
             "serve": self._router is not None,
+            "storage": self._storage is not None,
         }
         if self.cfg.backend == "jax":
             meta["pad_events"] = self._state.pad_events
@@ -899,6 +1169,16 @@ class ReplicationController:
                 f"{bool(meta.get('serve', False))} but the controller "
                 f"expects {self._router is not None} — stale checkpoint? "
                 f"delete it to start over")
+        # Storage-strategy flag, same posture: pre-storage checkpoints
+        # carry no "storage" key and keep loading in storage-less
+        # controllers; a storage-enabled controller must not resume from
+        # a snapshot whose targets meant plain rf.
+        if bool(meta.get("storage", False)) != (self._storage is not None):
+            raise ValueError(
+                f"checkpoint {path!r} has storage="
+                f"{bool(meta.get('storage', False))} but the controller "
+                f"expects {self._storage is not None} — stale "
+                f"checkpoint? delete it to start over")
         if self.cfg.backend == "jax":
             import jax.numpy as jnp
 
@@ -922,6 +1202,11 @@ class ReplicationController:
             self._dec_obs_end = meta.get("dec_obs_end")
         self.current_rf = arrays["current_rf"].astype(np.int32)
         self.current_cat = arrays["current_cat"].astype(np.int32)
+        # Pre-PR-7 checkpoints have no installed_cat: nothing was ever
+        # deferred, so installed == target.
+        self._installed_cat = (arrays["installed_cat"].astype(np.int32)
+                               if "installed_cat" in arrays
+                               else self.current_cat.copy())
         if "accepted_centroids" in arrays:
             self._accepted_centroids = arrays["accepted_centroids"]
             self._accepted_category_idx = arrays["accepted_category_idx"]

@@ -46,16 +46,20 @@ class PlanMove:
     rf_new: int
     cat_old: int      # index into config.CATEGORIES; -1 = not yet planned
     cat_new: int
-    bytes_moved: int  # size_bytes * max(0, rf_new - rf_old)
+    bytes_moved: int  # default size_bytes * max(0, rf_new - rf_old)
     priority: float   # larger = applied earlier
 
 
 def plan_diff(rf_old, rf_new, cat_old, cat_new, size_bytes,
-              priority=None) -> list[PlanMove]:
+              priority=None, move_bytes=None) -> list[PlanMove]:
     """Moves for every file whose (rf, category) changed between two plans.
 
     All inputs are (n,) arrays; ``priority`` defaults to zero, so callers
-    that don't score moves get stable file-index ordering.
+    that don't score moves get stable file-index ordering.  ``move_bytes``
+    overrides the per-file byte cost (the storage layer charges a
+    strategy re-encode as the new shards written, not an rf delta of
+    full copies); default is the historical
+    ``size_bytes * max(0, rf_new - rf_old)``.
     """
     rf_old = np.asarray(rf_old, dtype=np.int64)
     rf_new = np.asarray(rf_new, dtype=np.int64)
@@ -70,7 +74,13 @@ def plan_diff(rf_old, rf_new, cat_old, cat_new, size_bytes,
     prio = np.zeros(n) if priority is None else np.asarray(priority,
                                                            dtype=np.float64)
     changed = np.flatnonzero((rf_new != rf_old) | (cat_new != cat_old))
-    bytes_moved = size_bytes * np.maximum(rf_new - rf_old, 0)
+    if move_bytes is None:
+        bytes_moved = size_bytes * np.maximum(rf_new - rf_old, 0)
+    else:
+        bytes_moved = np.asarray(move_bytes, dtype=np.int64)
+        if bytes_moved.shape != (n,):
+            raise ValueError(
+                f"move_bytes shape {bytes_moved.shape} != ({n},)")
     return [PlanMove(file_index=int(i), rf_old=int(rf_old[i]),
                      rf_new=int(rf_new[i]), cat_old=int(cat_old[i]),
                      cat_new=int(cat_new[i]), bytes_moved=int(bytes_moved[i]),

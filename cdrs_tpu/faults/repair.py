@@ -33,6 +33,14 @@ the slowest of (best reachable source, target); the report carries both
 the raw data bytes (``bytes_copied``) and the budget charge
 (``bytes_used``).
 
+Erasure coding (cdrs_tpu/storage): rebuilding one shard of an ``ec(k, m)``
+stripe reads ``k`` surviving shards, so the budget charge is ``k x
+shard_bytes`` (~ one full file) while only ``shard_bytes`` of new data is
+written — the EC repair-amplification tradeoff HDFS-EC documents.  A
+stripe below ``k`` live shards is unrecoverable (``deferred_no_source``),
+and one with >= k live but < k reachable shards is partition-stranded
+exactly like a wholly stranded replicate file.
+
 Failure handling: a copy targeting a flaky node (ClusterState
 ``node_fail_prob``) fails with that probability — decided by a *stateless*
 seeded roll keyed on (seed, window, file, attempt), so a killed/resumed
@@ -134,17 +142,28 @@ class RepairScheduler:
                         for f in work}
 
     def _charge(self, state, fid: int, target: int) -> int:
-        """Budget charge of copying ``fid`` to ``target``: size divided by
-        the slowest throughput on the route (best reachable source vs the
-        target) — straggler wire-time inflation, deterministic."""
-        size = int(state.sizes[fid])
+        """Budget charge of creating one new shard of ``fid`` on
+        ``target``: the wire bytes (one full copy for a replicate file;
+        ``k x shard_bytes`` reconstruction reads for an EC stripe —
+        ``ClusterState.repair_read_bytes``) divided by the slowest
+        throughput on the route — straggler wire-time inflation,
+        deterministic.  A replicate copy streams from the single BEST
+        reachable source; an EC rebuild must read k shards from k
+        distinct holders, so it is gated by the slowest of the k FASTEST
+        sources."""
+        read_bytes = int(state.repair_read_bytes(fid))
         node_reach = state.node_reachable()
         row = state.replica_map[fid]
-        srcs = [int(x) for x in row[row >= 0] if node_reach[int(x)]]
-        src_m = max((float(state.node_throughput[s]) for s in srcs),
-                    default=1.0)
+        srcs = [float(state.node_throughput[int(x)]) for x in row[row >= 0]
+                if node_reach[int(x)]]
+        k = int(state.ec_k[fid])
+        if k > 1 and srcs:
+            srcs.sort(reverse=True)
+            src_m = srcs[min(k, len(srcs)) - 1]
+        else:
+            src_m = max(srcs, default=1.0)
         m = min(src_m, float(state.node_throughput[target]))
-        return int(np.ceil(size / max(m, 1e-9)))
+        return int(np.ceil(read_bytes / max(m, 1e-9)))
 
     def schedule(self, window: int, state, target_rf: np.ndarray,
                  cat: np.ndarray, *, max_bytes: int | None = None,
@@ -167,13 +186,16 @@ class RepairScheduler:
         corr = state.correlated_mask(target_rf)
         cat = np.asarray(cat)
         rf_vec = np.asarray(target_rf, dtype=np.int64)
+        #: Existence threshold per file (storage/): 1 for replicate,
+        #: k for an EC(k, m) stripe — below it there is no repair source.
+        need = state.min_live
 
         def prio(t: RepairTask):
             f = t.file_index
-            if reach[f] == 0:
-                tier = 0
-            elif reach[f] == 1:
-                tier = 1
+            if reach[f] < need[f]:
+                tier = 0          # lost / wholly stranded
+            elif reach[f] == need[f]:
+                tier = 1          # at risk: one failure from loss
             elif reach[f] < eff[f]:
                 tier = 2
             else:
@@ -188,14 +210,16 @@ class RepairScheduler:
             if task.next_window > window:
                 rep.deferred_backoff += 1
                 continue
-            if reach[f] == 0:
-                if live[f] > 0:
+            if reach[f] < need[f]:
+                if live[f] >= need[f]:
                     # Stranded behind a partition: the data is intact but
-                    # unreachable — back off instead of rescanning (and
-                    # never burn budget on a doomed copy).  The moment the
-                    # partition heals the file either leaves the backlog
-                    # (replicas back above target) or repairs immediately:
-                    # the stall backoff gates only this branch.
+                    # unreachable (a replicate copy, or enough EC shards,
+                    # exists on live-but-partitioned nodes) — back off
+                    # instead of rescanning (and never burn budget on a
+                    # doomed copy).  The moment the partition heals the
+                    # file either leaves the backlog (replicas back above
+                    # target) or repairs immediately: the stall backoff
+                    # gates only this branch.
                     if task.stall_until > window:
                         rep.deferred_backoff += 1
                     else:
@@ -210,7 +234,9 @@ class RepairScheduler:
                     and len(touched) >= max_files:
                 rep.deferred_budget += 1
                 continue
-            size = int(state.sizes[f])
+            # Raw data bytes WRITTEN per new shard (no reconstruction
+            # amplification — that lives in the budget charge).
+            size = int(state.shard_bytes[f])
             copy = 0
             rebalance = reach[f] >= eff[f] and bool(corr[f])
             spread_fixed = False

@@ -67,6 +67,21 @@ class ClusterState:
         w = min(placement.replica_map.shape[1], n_nodes)
         rm[:, :w] = placement.replica_map[:, :w]
         self.replica_map = rm
+        #: Storage-strategy arrays (cdrs_tpu/storage): a slot of file i
+        #: holds ``shard_bytes[i]`` bytes, the file is LOST below
+        #: ``min_live[i]`` live shards, and ``ec_k[i]`` > 0 marks an
+        #: erasure-coded stripe whose repair reads k surviving shards.
+        #: The defaults (min_live=1, shard_bytes=size, ec_k=0) are
+        #: exactly the historical replicate semantics.
+        self.min_live = np.ones(n, dtype=np.int32)
+        self.shard_bytes = self.sizes.copy()
+        self.ec_k = np.zeros(n, dtype=np.int32)
+        #: Shard-count INTENT of the installed form: what repair should
+        #: maintain for each file.  Updated when an rf change or a
+        #: strategy re-encode APPLIES — a deferred conversion keeps the
+        #: old intent, so repair never tops a file up toward a target
+        #: whose re-encode would drop the copies.
+        self.installed_shards = placement.rf.astype(np.int32).copy()
         self.node_up = np.ones(n_nodes, dtype=bool)
         self.node_decommissioned = np.zeros(n_nodes, dtype=bool)
         self.node_partitioned = np.zeros(n_nodes, dtype=bool)
@@ -76,12 +91,118 @@ class ClusterState:
         #: Bytes *assigned* per node (down replicas still occupy disk);
         #: the deterministic least-loaded repair-target preference.
         self.node_bytes = np.zeros(n_nodes, dtype=np.int64)
-        assigned = self.replica_map >= 0
-        np.add.at(self.node_bytes, self.replica_map[assigned],
-                  np.broadcast_to(self.sizes[:, None],
-                                  self.replica_map.shape)[assigned])
+        self._recompute_node_bytes()
         #: Bumped on every mutation — cache-invalidation for evaluators.
         self.version = 0
+
+    def _recompute_node_bytes(self) -> None:
+        self.node_bytes = np.zeros(len(self.nodes), dtype=np.int64)
+        assigned = self.replica_map >= 0
+        np.add.at(self.node_bytes, self.replica_map[assigned],
+                  np.broadcast_to(self.shard_bytes[:, None],
+                                  self.replica_map.shape)[assigned])
+
+    # -- storage strategies --------------------------------------------------
+    def set_strategy_arrays(self, min_live: np.ndarray,
+                            shard_bytes: np.ndarray,
+                            ec_k: np.ndarray) -> None:
+        """Install per-file storage-strategy arrays (controller wiring,
+        checkpoint load) and re-derive the per-node byte accounting."""
+        n = self.replica_map.shape[0]
+        for name, a in (("min_live", min_live),
+                        ("shard_bytes", shard_bytes), ("ec_k", ec_k)):
+            if np.asarray(a).shape != (n,):
+                raise ValueError(
+                    f"{name} shape {np.asarray(a).shape} != ({n},)")
+        self.min_live = np.asarray(min_live, dtype=np.int32).copy()
+        self.shard_bytes = np.asarray(shard_bytes, dtype=np.int64).copy()
+        self.ec_k = np.asarray(ec_k, dtype=np.int32).copy()
+        self._recompute_node_bytes()
+        self.version += 1
+
+    def set_file_strategy(self, fid: int, min_live: int, shard_bytes: int,
+                          ec_k: int) -> None:
+        """Re-strategize ONE file (a migration moved it to a category
+        with a different storage strategy): its assigned slots re-account
+        at the new shard size."""
+        old = int(self.shard_bytes[fid])
+        new = int(shard_bytes)
+        if new != old:
+            row = self.replica_map[fid]
+            for node in row[row >= 0]:
+                self.node_bytes[int(node)] += new - old
+        self.min_live[fid] = int(min_live)
+        self.shard_bytes[fid] = new
+        self.ec_k[fid] = int(ec_k)
+        self.version += 1
+
+    def apply_strategy_target(self, fid: int, min_live: int,
+                              shard_bytes: int, ec_k: int,
+                              target: int) -> int:
+        """Move ``fid`` to a (possibly different) storage strategy and
+        bring it toward ``target`` shards — the migration-apply entry
+        point when a storage config is active.
+
+        An unchanged strategy shape (same min_live/shard_bytes/ec_k —
+        every replicate->replicate rf change) is exactly
+        ``apply_rf_target``.  A SHAPE change (replicate <-> EC, or a
+        different k) is a re-encode: it needs a readable source under
+        the CURRENT strategy and enough reachable nodes to host a
+        viable new form; otherwise the conversion is deferred — the
+        file keeps its current strategy (conservative: durability
+        accounting stays truthful to the bytes actually on disk) and
+        the controller's per-window reconcile pass retries once the
+        file is readable again.  A granted re-encode drops every old
+        slot (the old form's replicas are deleted once the new shards
+        land) and places the new shards domain-spread via
+        ``pick_repair_target``.  Returns the shard-count delta."""
+        same = (int(self.min_live[fid]) == int(min_live)
+                and int(self.shard_bytes[fid]) == int(shard_bytes)
+                and int(self.ec_k[fid]) == int(ec_k))
+        if same:
+            return self.apply_rf_target(fid, target)
+        # Per-row reachability: the full (n_files, n_nodes) mask would
+        # make the controller's reconcile loop quadratic while
+        # conversions stay deferred.
+        r = self.replica_map[fid]
+        reach = int(((r >= 0)
+                     & self.node_reachable()[np.clip(r, 0, None)]).sum())
+        if reach < int(self.min_live[fid]) \
+                or self.n_available < int(min_live):
+            return 0
+        row = self.replica_map[fid]
+        before = int((row >= 0).sum())
+        for node in [int(x) for x in row[row >= 0]]:
+            self.drop_replica(fid, node)
+        self.set_file_strategy(fid, min_live, shard_bytes, ec_k)
+        self.installed_shards[fid] = int(target)
+        placed = 0
+        goal = min(int(target), self.n_available)
+        while placed < goal:
+            node = self.pick_repair_target(fid)
+            if node < 0:  # pragma: no cover - goal <= n_available
+                break
+            self.add_replica(fid, node)
+            placed += 1
+        return placed - before
+
+    def strategy_mismatch(self, min_live: np.ndarray,
+                          shard_bytes: np.ndarray,
+                          ec_k: np.ndarray) -> np.ndarray:
+        """File ids whose installed strategy differs from the wanted
+        arrays — deferred conversions the controller retries per
+        window (see ``apply_strategy_target``)."""
+        return np.flatnonzero(
+            (self.min_live != np.asarray(min_live, np.int32))
+            | (self.shard_bytes != np.asarray(shard_bytes, np.int64))
+            | (self.ec_k != np.asarray(ec_k, np.int32)))
+
+    def repair_read_bytes(self, fid: int) -> int:
+        """Bytes read over the wire to create ONE new shard of ``fid``:
+        a replicate repair streams one full copy; an EC repair
+        reconstructs from k surviving shards (k x shard_bytes — the EC
+        repair-amplification tradeoff, HDFS-EC/Ceph semantics)."""
+        return int(self.shard_bytes[fid]) * max(int(self.ec_k[fid]), 1)
 
     # -- node status ---------------------------------------------------------
     def _nid(self, node: str) -> int:
@@ -211,10 +332,15 @@ class ClusterState:
         live = self.live_counts()
         reach = self.reachable_counts()
         eff = self.effective_target(target_rf)
-        lost = live == 0
-        unreachable = (reach == 0) & ~lost
-        at_risk = (reach == 1) & (eff >= 2)
-        under = (reach >= 2) & (reach < eff)
+        # Shard-generalized tiers (storage/strategy.py arithmetic): a
+        # file needs ``min_live`` shards to exist at all (1 full copy,
+        # or k of an EC(k, m) stripe).  With the replicate defaults
+        # (min_live == 1) these are bit-for-bit the historical tiers.
+        need = self.min_live
+        lost = live < need
+        unreachable = (reach < need) & ~lost
+        at_risk = (reach == need) & (eff > need)
+        under = (reach > need) & (reach < eff)
 
         names = list(categories) + ["Unplanned"]
         bucket = np.where(np.asarray(cat) >= 0, cat, len(categories))
@@ -238,14 +364,16 @@ class ClusterState:
         }
 
     def lost_mask(self) -> np.ndarray:
-        """Files with NO live replica anywhere (data gone until a crashed
-        holder recovers)."""
-        return self.live_counts() == 0
+        """Files below their existence threshold — no live full copy, or
+        fewer than k live shards of an EC stripe (data gone until a
+        crashed holder recovers)."""
+        return self.live_counts() < self.min_live
 
     def unreadable_mask(self) -> np.ndarray:
-        """Files a read cannot be served for right now: no reachable
-        replica (lost OR wholly stranded behind a partition)."""
-        return self.reachable_counts() == 0
+        """Files a read cannot be served for right now: fewer than
+        ``min_live`` reachable shards (lost outright, or enough of the
+        stripe stranded behind a partition)."""
+        return self.reachable_counts() < self.min_live
 
     # -- mutation ------------------------------------------------------------
     def _file_domains(self, fid: int) -> set:
@@ -287,7 +415,7 @@ class ClusterState:
         if free.size == 0:  # pragma: no cover - width==n_nodes prevents this
             raise RuntimeError(f"file {fid} has no free replica slot")
         row[free[0]] = node
-        self.node_bytes[node] += self.sizes[fid]
+        self.node_bytes[node] += self.shard_bytes[fid]
         self.version += 1
 
     def drop_replica(self, fid: int, node: int) -> None:
@@ -295,7 +423,7 @@ class ClusterState:
         slots = np.flatnonzero(row == node)
         if slots.size:
             row[slots[0]] = -1
-            self.node_bytes[node] -= self.sizes[fid]
+            self.node_bytes[node] -= self.shard_bytes[fid]
             self.version += 1
 
     def _drop_order(self, fid: int, holders: list[int]) -> list[int]:
@@ -323,7 +451,8 @@ class ClusterState:
         self.drop_replica(fid, victim)
         return victim
 
-    def apply_rf_target(self, fid: int, rf_new: int) -> int:
+    def apply_rf_target(self, fid: int, rf_new: int,
+                        record_intent: bool = True) -> int:
         """Bring ``fid`` toward ``rf_new`` reachable replicas (capped at
         the reachable node count): migrations call this when a planned rf
         change applies.  Adds go to the spread-preferred least-loaded
@@ -332,13 +461,17 @@ class ClusterState:
         Replicas stranded behind a partition are never dropped — they are
         the durability story until the partition heals.  Returns reachable
         delta."""
+        if record_intent:
+            self.installed_shards[fid] = int(rf_new)
         target = min(int(rf_new), self.n_available)
         live = int((self.reachable_mask()[fid]).sum())
         delta = 0
-        if live == 0:
-            # No reachable source to copy from: a lost or stranded file
-            # cannot be re-replicated by fiat.  The repair path heals it
-            # the window a holder recovers or the partition heals.
+        if live < int(self.min_live[fid]):
+            # No reachable source to copy/reconstruct from (a replicate
+            # file with no reachable copy, or an EC stripe below k
+            # reachable shards): a lost or stranded file cannot be
+            # re-replicated by fiat.  The repair path heals it the
+            # window a holder recovers or the partition heals.
             return 0
         while live < target:
             node = self.pick_repair_target(fid)
@@ -375,7 +508,10 @@ class ClusterState:
         eff = self.effective_target(target_rf)
         over = np.flatnonzero(reach > eff)
         for fid in over:
-            self.apply_rf_target(int(fid), int(eff[fid]))
+            # The trim's capped target is NOT a new intent — the file's
+            # installed_shards must survive a transient excess.
+            self.apply_rf_target(int(fid), int(eff[fid]),
+                                 record_intent=False)
         return int(over.size)
 
     # -- rendering back into the immutable world -----------------------------
@@ -391,7 +527,7 @@ class ClusterState:
         rf_live = reach.sum(axis=1).astype(np.int32)
         view = PlacementResult(replica_map=compact, rf=rf_live,
                                topology=self.topology)
-        view.compute_storage(self.sizes)
+        view.compute_storage(self.shard_bytes)
         return view
 
     # -- checkpoint ----------------------------------------------------------
@@ -403,6 +539,13 @@ class ClusterState:
             "fault_node_partitioned": self.node_partitioned.copy(),
             "fault_node_fail_prob": self.node_fail_prob.copy(),
             "fault_node_throughput": self.node_throughput.copy(),
+            # Storage-strategy state (storage/): which files are EC
+            # stripes right now and at what shard size — a mid-outage
+            # resume must account durability/repair identically.
+            "fault_min_live": self.min_live.copy(),
+            "fault_shard_bytes": self.shard_bytes.copy(),
+            "fault_ec_k": self.ec_k.copy(),
+            "fault_installed_shards": self.installed_shards.copy(),
         }
 
     def load_state_arrays(self, arrays: dict) -> None:
@@ -427,9 +570,23 @@ class ClusterState:
         self.node_throughput = np.asarray(
             arrays.get("fault_node_throughput", np.ones(n_nodes)),
             dtype=np.float64).copy()
-        self.node_bytes = np.zeros(n_nodes, dtype=np.int64)
-        assigned = self.replica_map >= 0
-        np.add.at(self.node_bytes, self.replica_map[assigned],
-                  np.broadcast_to(self.sizes[:, None],
-                                  self.replica_map.shape)[assigned])
+        # Pre-storage checkpoints lack the strategy arrays: default to
+        # the replicate semantics (min_live=1, shard=size, no EC).
+        n = self.replica_map.shape[0]
+        self.min_live = np.asarray(
+            arrays.get("fault_min_live", np.ones(n, np.int32)),
+            dtype=np.int32).copy()
+        self.shard_bytes = np.asarray(
+            arrays.get("fault_shard_bytes", self.sizes),
+            dtype=np.int64).copy()
+        self.ec_k = np.asarray(
+            arrays.get("fault_ec_k", np.zeros(n, np.int32)),
+            dtype=np.int32).copy()
+        # Pre-intent checkpoints: fall back to the assigned-slot count
+        # (floored at min_live) — the closest observable to the intent.
+        self.installed_shards = np.asarray(
+            arrays.get("fault_installed_shards",
+                       np.maximum((rm >= 0).sum(axis=1), self.min_live)),
+            dtype=np.int32).copy()
+        self._recompute_node_bytes()
         self.version += 1

@@ -19,7 +19,30 @@ import numpy as np
 
 from ..config import CATEGORIES, KMeansConfig, ScoringConfig
 
-__all__ = ["ClusterDecision", "ReplicationPolicyModel", "centroid_id"]
+__all__ = ["ClusterDecision", "ReplicationPolicyModel", "centroid_id",
+           "validate_replication_factors"]
+
+
+def validate_replication_factors(scoring_cfg: ScoringConfig) -> None:
+    """Reject nonsensical replication factors at config time.
+
+    An ``rf < 1`` category would sail through scoring and only explode
+    deep in placement (``place_replicas`` clamps silently; the migration
+    planner would schedule byte-free "drops" forever).  Raise here, at
+    the decision layer's front door, with the offending CATEGORY named —
+    the same posture the storage layer applies to EC shapes (``ec(k, m)``
+    needs k >= 1, m >= 0; storage/strategy.StorageConfig names the
+    category too).  Called by ``ReplicationPolicyModel`` and by
+    ``config.scoring_config_from_dict``, so both programmatic and
+    JSON-config entry points fail fast."""
+    for c in scoring_cfg.categories:
+        rf = scoring_cfg.replication_factors.get(c)
+        if rf is not None and int(rf) < 1:
+            raise ValueError(
+                f"replication factor for category {c!r} must be >= 1, "
+                f"got {rf} (0 replicas means the file does not exist; "
+                f"use an ec/tier strategy for cheap cold storage "
+                f"instead)")
 
 
 def centroid_id(centroid: np.ndarray) -> str:
@@ -82,6 +105,7 @@ class ReplicationPolicyModel:
     ):
         self.kmeans_cfg = kmeans_cfg or KMeansConfig()
         self.scoring_cfg = scoring_cfg or ScoringConfig()
+        validate_replication_factors(self.scoring_cfg)
         if backend not in ("numpy", "jax"):
             raise ValueError(f"unknown backend {backend!r}; expected 'numpy' or 'jax'")
         self.backend = backend

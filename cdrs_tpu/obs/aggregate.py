@@ -15,7 +15,8 @@ from __future__ import annotations
 
 __all__ = ["collect", "span_forest", "ordered_span_paths", "percentile",
            "bucket_percentile", "merge_hist_buckets", "dedup_windows",
-           "final_counters", "roofline_rows", "fmt_bytes", "serve_digest"]
+           "final_counters", "roofline_rows", "fmt_bytes", "serve_digest",
+           "storage_digest"]
 
 
 def fmt_bytes(b, sep: str = " ") -> str:
@@ -270,6 +271,33 @@ def serve_digest(windows: list[dict]) -> dict | None:
         "hotspot_reclusters": sum(
             1 for w in sw if w.get("recluster_trigger") == "hotspot"),
         "locality_last": sw[-1].get("serve_locality"),
+    }
+
+
+def storage_digest(windows: list[dict]) -> dict | None:
+    """Tier/byte-cost digest over the storage window records (windows
+    carrying ``storage`` — a ``ControllerConfig.storage`` run).  None
+    when the stream has no storage accounting, so pre-storage streams
+    render unchanged everywhere.  The FINAL window is the headline (the
+    end state of the run); the max overhead ratio tracks the costliest
+    intermediate state (a mid-conversion window can briefly hold both
+    shapes of a file)."""
+    sw = [w for w in windows if w.get("storage")]
+    if not sw:
+        return None
+    last = sw[-1]["storage"]
+    return {
+        "windows": len(sw),
+        "bytes_raw": last.get("bytes_raw"),
+        "bytes_stored_final": last.get("bytes_stored"),
+        "overhead_ratio_final": last.get("overhead_ratio"),
+        "overhead_ratio_max": max(
+            float(w["storage"].get("overhead_ratio", 0.0)) for w in sw),
+        "cost_units_final": last.get("cost_units"),
+        "ec_files_final": last.get("ec_files"),
+        "per_tier_bytes_final": dict(last.get("per_tier_bytes") or {}),
+        "per_category_bytes_final": dict(
+            last.get("per_category_bytes") or {}),
     }
 
 

@@ -42,6 +42,7 @@ from .aggregate import (
     roofline_rows,
     serve_digest,
     span_forest,
+    storage_digest,
 )
 from .sink import read_events
 
@@ -124,6 +125,25 @@ def _render_serving(windows: list[dict], out) -> None:
               f"(last files {d['hotspot_files_last']}), "
               f"{d['hotspot_reclusters']} hotspot-triggered reclusters",
               file=out)
+
+
+def _render_storage(windows: list[dict], out) -> None:
+    """Tier/byte-cost digest (storage window records from a
+    ``ControllerConfig.storage`` / ``--storage_config`` run)."""
+    d = storage_digest(windows)
+    if d is None:
+        return
+    print(f"\nStorage: {_fmt_bytes(d['bytes_stored_final'])} stored for "
+          f"{_fmt_bytes(d['bytes_raw'])} raw "
+          f"({d['overhead_ratio_final']:g}x, max "
+          f"{d['overhead_ratio_max']:g}x; cost "
+          f"{d['cost_units_final']:g} units)", file=out)
+    tiers = ", ".join(f"{t}={_fmt_bytes(b)}" for t, b in
+                      sorted(d["per_tier_bytes_final"].items()))
+    line = f"  tiers: {tiers or '—'}"
+    if d["ec_files_final"]:
+        line += f"; {d['ec_files_final']} erasure-coded files"
+    print(line, file=out)
 
 
 def _render_durability(windows: list[dict], out) -> None:
@@ -258,6 +278,7 @@ def summarize_events(events: list[dict], out=None, peak_flops=None,
 
     _render_audit(digest["audits"], out)
     _render_serving(digest["windows"], out)
+    _render_storage(digest["windows"], out)
     _render_durability(digest["windows"], out)
 
     windows = digest["windows"]

@@ -31,6 +31,7 @@ from .aggregate import (
     percentile,
     roofline_rows,
     serve_digest,
+    storage_digest,
 )
 
 __all__ = ["render_html"]
@@ -381,6 +382,40 @@ def _durability_section(digest: dict) -> str:
             "</tr>" + "".join(rows) + "</table>")
 
 
+def _storage_section(digest: dict) -> str:
+    """Tier/byte-cost digest (window records carrying ``storage`` — a
+    ``ControllerConfig.storage`` run): stored vs raw bytes, overhead
+    ratio, per-tier split, EC stripe count.  Absent for pre-storage
+    streams — older reports render unchanged."""
+    sd = storage_digest(digest["windows"])
+    if sd is None:
+        return ""
+    tiles = "".join(
+        f'<div class="tile"><div class="v">{v}</div>'
+        f'<div class="l">{_esc(label)}</div></div>'
+        for label, v in (
+            ("stored bytes", _fmt_bytes(sd["bytes_stored_final"])),
+            ("raw bytes", _fmt_bytes(sd["bytes_raw"])),
+            ("overhead", f'{_fmt(sd["overhead_ratio_final"], 4)}×'),
+            ("cost units", _fmt(sd["cost_units_final"], 5)),
+            ("EC files", _fmt(sd["ec_files_final"])),
+        ))
+    rows = "".join(
+        f"<tr><td>{_esc(t)}</td>"
+        f'<td class="num">{_fmt_bytes(b)}</td></tr>'
+        for t, b in sorted(sd["per_tier_bytes_final"].items()))
+    cat_rows = "".join(
+        f"<tr><td>{_esc(c)}</td>"
+        f'<td class="num">{_fmt_bytes(b)}</td></tr>'
+        for c, b in sorted(sd["per_category_bytes_final"].items()))
+    return ("<h2>Storage (tiers &amp; erasure coding)</h2>"
+            f'<div class="tiles">{tiles}</div>'
+            "<table><tr><th>tier</th><th class=num>stored</th></tr>"
+            + rows + "</table>"
+            "<table><tr><th>category</th><th class=num>stored</th></tr>"
+            + cat_rows + "</table>")
+
+
 def _serve_section(digest: dict) -> str:
     """Read-path SLO timeline (serving window records from a
     ``ControllerConfig.serve`` / ``cdrs serve`` run): per-window latency
@@ -465,6 +500,7 @@ def render_html(events: list[dict], title: str = "cdrs telemetry report"
         + _xla_section(digest)
         + _audit_section(digest)
         + _serve_section(digest)
+        + _storage_section(digest)
         + _durability_section(digest)
         + _window_section(digest)
         + _trace_section(digest)

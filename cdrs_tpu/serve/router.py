@@ -320,7 +320,8 @@ class ReadRouter:
               node_throughput: np.ndarray, *, ts: np.ndarray,
               pid: np.ndarray, client: np.ndarray,
               window_seconds: float | None = None,
-              rng: np.random.Generator | None = None) -> WindowServeResult:
+              rng: np.random.Generator | None = None,
+              extra_ms: np.ndarray | None = None) -> WindowServeResult:
         """Route one time-ordered batch of reads.
 
         ``replica_map``: (n_files, R) int32 node ids, -1 = empty slot.
@@ -331,6 +332,15 @@ class ReadRouter:
         epoch seconds (ascending), file id, and client node id (-1 =
         outside the topology).  ``window_seconds`` scales utilization
         (default: the batch's time span).
+
+        ``extra_ms``: optional (n_reads,) additive latency per read on
+        top of the queue model — the storage layer's degraded-read and
+        tier penalties (a cold-tier read is slower end to end; a read of
+        an EC file whose primary shard is down must gather k shards
+        before it can answer).  The extra time is transfer/decode work
+        on the CLIENT side of the queue, so it does not occupy the
+        chosen server — queue waits are unchanged, the latency sample
+        (and therefore the percentiles and SLO burn) carries it.
         """
         rng = rng or np.random.default_rng(self.cfg.seed)
         ts = np.asarray(ts, dtype=np.float64)
@@ -367,6 +377,9 @@ class ReadRouter:
         lat_s = self._latency(server, ts, service_s)
         routed = ~unavailable
         latency_ms = lat_s[routed] * 1000.0
+        if extra_ms is not None:
+            latency_ms = latency_ms + np.asarray(extra_ms,
+                                                 dtype=np.float64)[routed]
 
         counts = np.bincount(server[routed], minlength=self.n_nodes
                              ).astype(np.int64)

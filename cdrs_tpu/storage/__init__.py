@@ -1,0 +1,40 @@
+"""Tiered storage & erasure coding as first-class replication strategies.
+
+Generalizes the paper's "category -> replication factor" into
+"category -> strategy", where a strategy is ``replicate(rf)`` or
+``ec(k, m)`` (k data + m parity shards on k+m distinct nodes), each on a
+storage tier (hot/warm/cold) with per-tier byte cost and throughput
+(ROADMAP item 4; HDFS Erasure Coding and Ceph CRUSH in PAPERS.md).
+
+The arithmetic lives in ``strategy.py`` (n_shards / min_live /
+shard_div per category); the consumers are spread across the stack:
+``cluster.place_stripes`` (vectorized stripe placement),
+``faults.ClusterState`` (shard-aware durability tiers + reconstruction
+repair charging), ``control.ControllerConfig.storage`` (end-to-end
+wiring with checkpointed strategy state), ``serve`` (degraded-read
+penalty), ``cdrs storage`` (CLI) and ``benchmarks/storage_bench.py``
+(the cost-vs-durability frontier).  A config with only ``replicate``
+strategies degenerates bit-for-bit to the historical rf semantics.
+"""
+
+from .strategy import (
+    DEFAULT_TIERS,
+    StorageConfig,
+    StorageTier,
+    Strategy,
+    StrategyVectors,
+    load_storage_config,
+    resolve_storage_config,
+    storage_config_from_dict,
+)
+
+__all__ = [
+    "DEFAULT_TIERS",
+    "StorageConfig",
+    "StorageTier",
+    "Strategy",
+    "StrategyVectors",
+    "load_storage_config",
+    "resolve_storage_config",
+    "storage_config_from_dict",
+]

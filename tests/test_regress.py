@@ -168,6 +168,19 @@ def test_ingest_real_bench_files_builds_history(tmp_path):
             serve_rows = [h for h in have
                           if str(h.get("metric", "")).startswith("serve_")]
             assert serve_rows == serve_recs
+        # And the storage frontier rows (data/storage_bench.json, round
+        # 7) under the same append-in-artifact-order contract.
+        storage_json = os.path.join(repo, "data", "storage_bench.json")
+        if os.path.exists(storage_json):
+            from cdrs_tpu.benchmarks.regress import extract_records
+
+            with open(storage_json, encoding="utf-8") as f:
+                storage_recs = extract_records(json.load(f),
+                                               "storage_bench.json")
+            assert storage_recs
+            storage_rows = [h for h in have if str(
+                h.get("metric", "")).startswith("storage_")]
+            assert storage_rows == storage_recs
 
 
 # -- CLI ---------------------------------------------------------------------
