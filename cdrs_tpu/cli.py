@@ -492,6 +492,7 @@ def _controller_cfg(args, fault_schedule=None, topology=None):
         evaluate=not args.no_evaluate,
         fault_schedule=fault_schedule,
         repair_seed=getattr(args, "repair_seed", 0),
+        overlap_windows=getattr(args, "overlap", False),
     )
 
 
@@ -983,6 +984,12 @@ def main(argv: list[str] | None = None) -> int:
                             "live controller)")
         p.add_argument("--no_evaluate", action="store_true",
                        help="skip the per-window locality/balance replay")
+        p.add_argument("--overlap", action="store_true",
+                       help="double-buffer windows: dispatch window t+1's "
+                            "(jit'd) cluster step before window t's host "
+                            "planning runs (JAX async dispatch); "
+                            "decision-identical to the serial order, "
+                            "suspended around checkpoints")
         p.add_argument("--serve", action="store_true",
                        help="route every window's reads through the read "
                             "router (serve/): latency p50/p95/p99, SLO "

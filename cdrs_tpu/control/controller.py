@@ -125,6 +125,17 @@ class ControllerConfig:
     #: a ``storage`` byte/cost digest.  A config with only ``replicate``
     #: strategies reproduces the historical behaviour bit-for-bit.
     storage: object | None = None
+    #: Double-buffered windows: dispatch window t+1's (already jit'd)
+    #: cluster step before window t's host-side planning runs, so JAX's
+    #: async dispatch keeps the device busy while the host diffs plans,
+    #: admits migrations and runs repairs.  Decision/record-identical to
+    #: the serial order (the phases touch disjoint state; enforced by
+    #: tests): only wall-clock moves.  Overlap is suspended around
+    #: checkpoints — a snapshot must not contain the next window's fold —
+    #: so ``checkpoint_every=1`` degenerates to the serial schedule.
+    #: Meaningful on the jax backend; accepted (as a no-op pipeline) on
+    #: numpy.
+    overlap_windows: bool = False
 
     def __post_init__(self):
         if self.window_seconds <= 0:
@@ -176,6 +187,18 @@ class ControllerResult:
             # processed zero new windows still reports the real plan.
             "final_plan_hash": _plan_hash(self.rf, self.category_idx),
         }
+        # End-to-end pacing: windows per second of host wall-clock, and
+        # the planning slice of it (the SoA control-plane observable;
+        # plan_bench tracks the same two numbers at scale, and `cdrs
+        # metrics summarize` digests the same records via pacing_digest).
+        from ..obs.aggregate import pacing_digest
+
+        pacing = pacing_digest(self.records)
+        if pacing:
+            out["windows_per_sec"] = round(pacing["windows_per_sec"], 3)
+            if "plan_seconds_fraction" in pacing:
+                out["plan_seconds_fraction"] = round(
+                    pacing["plan_seconds_fraction"], 4)
         dur = [r for r in self.records if r.get("durability")]
         if dur:
             last = dur[-1]["durability"]
@@ -274,6 +297,15 @@ class ReplicationController:
         self._accepted_centroids: np.ndarray | None = None
         self._accepted_category_idx: np.ndarray | None = None
         self._accepted_fractions: np.ndarray | None = None
+        #: Per-file category of the last MATERIALIZED decision —
+        #: ``_accept_plan`` reuses this gather instead of recomputing it.
+        self._accepted_file_cat: np.ndarray | None = None
+        #: Most recent accepted decision not yet materialized to host
+        #: arrays — with ``overlap_windows`` the jax result stays a lazy
+        #: device future until the first host consumer (drift, checkpoint)
+        #: blocks on it, which is what lets window t+1's cluster step run
+        #: under window t's planning.
+        self._pending_accept = None
 
         #: Storage-strategy vectors (storage/): None = historical rf
         #: semantics.  Resolved here so a bad strategy (EC k < 1, unknown
@@ -446,6 +478,22 @@ class ReplicationController:
 
     # -- one window --------------------------------------------------------
     def process_window(self, w: int, events: EventLog) -> dict:
+        """Serial per-window step: phase A (fold, drift, cluster-step
+        dispatch) immediately followed by phase B (host planning).  The
+        overlap run loop interleaves the same two phases across
+        consecutive windows instead — identical decisions either way (the
+        phases touch disjoint controller state)."""
+        return self._window_phase_b(self._window_phase_a(w, events))
+
+    def _window_phase_a(self, w: int, events: EventLog) -> dict:
+        """Fold + drift + (maybe) dispatch the window's cluster step.
+
+        Returns the window context the planning phase consumes.  On the
+        jax backend the re-cluster result is an ASYNC device future: the
+        only state mutated here is the feature carry, the hotspot EWMA and
+        the pending-accept slot — nothing phase B of the PREVIOUS window
+        reads, which is what makes the overlap schedule legal.
+        """
         cfg = self.cfg
         seconds: dict[str, float] = {}
         t_start = time.perf_counter()
@@ -460,15 +508,6 @@ class ReplicationController:
                 self._dec[k] *= g
         seconds["fold"] = time.perf_counter() - t0
         rec["events_total"] = int(self._events_total)
-
-        if self._cluster_state is not None:
-            t0 = time.perf_counter()
-            fault_events = cfg.fault_schedule.for_window(w)
-            for ev in fault_events:
-                self._cluster_state.apply_event(ev)
-            rec["fault_events"] = [ev.spec() for ev in fault_events]
-            rec["nodes_up"] = self._cluster_state.n_available
-            seconds["faults"] = time.perf_counter() - t0
 
         # Serving: extract the window's reads once (hotspot detection now,
         # routing after the window's repairs/migrations apply) and score
@@ -496,6 +535,11 @@ class ReplicationController:
         X = None
         drift = None
         t0 = time.perf_counter()
+        # Materialize the previously accepted decision (if any) — the
+        # pipeline's one synchronization point: blocking here is blocking
+        # on the PREVIOUS window's cluster step, after planning already
+        # overlapped it.
+        self._ensure_accepted()
         if self._accepted_centroids is not None and len(events):
             X = self._feature_snapshot()
             drift = detect_drift(X, self._accepted_centroids,
@@ -524,6 +568,7 @@ class ReplicationController:
                                     else "hotspot" if hot_fire else None)
         rec["recluster_mode"] = None
         rec["plan_moves_pending"] = None
+        decision = None
         t0 = time.perf_counter()
         if trigger:
             warm = (not cold
@@ -540,9 +585,59 @@ class ReplicationController:
                     raise
                 decision = self._degraded_recluster(warm, X, init, e)
                 rec["degraded_kernel"] = True
-            self._accept(decision)
-            rec["plan_moves_pending"] = len(self.scheduler.backlog)
+            # Accept the MODEL now (next window's drift reference) but
+            # leave materialization lazy; the plan diff runs in phase B
+            # against the then-current applied plan.
+            self._pending_accept = decision
         seconds["recluster"] = time.perf_counter() - t0
+
+        seconds["host_a"] = time.perf_counter() - t_start
+        return {"w": int(w), "events": events, "rec": rec,
+                "seconds": seconds, "X": X, "decision": decision,
+                "read_pid": read_pid, "read_ts": read_ts,
+                "read_client": read_client}
+
+    def _window_phase_b(self, ctx: dict) -> dict:
+        """Host-side planning + accounting for a dispatched window: plan
+        diff/submit, fault events, repairs, budgeted migration admission,
+        durability/storage/serving records, evaluation, telemetry.  Under
+        ``overlap_windows`` this runs while the device executes the NEXT
+        window's cluster step."""
+        cfg = self.cfg
+        w = ctx["w"]
+        events: EventLog = ctx["events"]
+        rec: dict = ctx["rec"]
+        seconds: dict = ctx["seconds"]
+        X = ctx["X"]
+        read_pid, read_ts, read_client = (ctx["read_pid"], ctx["read_ts"],
+                                          ctx["read_client"])
+        t_b = time.perf_counter()
+        plan_seconds = 0.0
+
+        if ctx["decision"] is not None:
+            t0 = time.perf_counter()
+            if self._pending_accept is ctx["decision"]:
+                # Serial schedule: the decision is still pending, so
+                # materialize the model now — the window's own audit must
+                # score against the newly accepted centroids exactly as
+                # the pre-split accept did.  Under overlap the next
+                # window's phase A already materialized it, so the audit
+                # sees the same model either way.
+                self._ensure_accepted()
+            self._accept_plan(ctx["decision"])
+            rec["plan_moves_pending"] = len(self.scheduler.backlog)
+            dt = time.perf_counter() - t0
+            seconds["recluster"] += dt
+            plan_seconds += dt
+
+        if self._cluster_state is not None:
+            t0 = time.perf_counter()
+            fault_events = cfg.fault_schedule.for_window(w)
+            for ev in fault_events:
+                self._cluster_state.apply_event(ev)
+            rec["fault_events"] = [ev.spec() for ev in fault_events]
+            rec["nodes_up"] = self._cluster_state.n_available
+            seconds["faults"] = time.perf_counter() - t0
 
         # Pre-mutation placement snapshot for the before/after replay (the
         # fault path's placement is the mutable ClusterState, so "before"
@@ -583,6 +678,7 @@ class ReplicationController:
                 max_bytes=cfg.max_bytes_per_window,
                 max_files=cfg.max_files_per_window)
             seconds["repair"] = time.perf_counter() - t0
+            plan_seconds += seconds["repair"]
             rec["repair_moves"] = len(rr.applied)
             rec["repair_bytes"] = int(rr.bytes_used)
             rec["repair_bytes_copied"] = int(rr.bytes_copied)
@@ -600,12 +696,23 @@ class ReplicationController:
         t0 = time.perf_counter()
         applied = self.scheduler.schedule(w, bytes_reserved=bytes_reserved,
                                           files_reserved=files_reserved)
-        for m in applied:
-            self.current_rf[m.file_index] = m.rf_new
-            self.current_cat[m.file_index] = m.cat_new
-            installed = True
-            if self._cluster_state is not None:
-                if self._storage is not None:
+        if len(applied):
+            # Vectorized plan application — one gather per column.  The
+            # fault path still walks the (budget-bounded) admitted moves:
+            # placement mutation per file is stateful by design.
+            fi = applied.file_index
+            self.current_rf[fi] = applied.rf_new
+            self.current_cat[fi] = applied.cat_new
+            if self._cluster_state is None:
+                self._installed_cat[fi] = applied.cat_new
+            elif self._storage is None:
+                cs = self._cluster_state
+                for f, rf_new in zip(fi.tolist(),
+                                     applied.rf_new.tolist()):
+                    cs.apply_rf_target(f, rf_new)
+                self._installed_cat[fi] = applied.cat_new
+            else:
+                for m in applied:
                     # The move may convert the file between strategies
                     # (replicate <-> EC stripe): apply_strategy_target
                     # re-encodes when the shape changes (or defers if
@@ -621,14 +728,12 @@ class ReplicationController:
                         int(cs.min_live[m.file_index]) == want[0]
                         and int(cs.shard_bytes[m.file_index]) == want[1]
                         and int(cs.ec_k[m.file_index]) == want[2])
-                else:
-                    self._cluster_state.apply_rf_target(m.file_index,
-                                                        m.rf_new)
-            if installed:
-                self._installed_cat[m.file_index] = m.cat_new
+                    if installed:
+                        self._installed_cat[m.file_index] = m.cat_new
         seconds["schedule"] = time.perf_counter() - t0
+        plan_seconds += seconds["schedule"]
         rec["moves_applied"] = len(applied)
-        rec["bytes_migrated"] = int(sum(m.bytes_moved for m in applied))
+        rec["bytes_migrated"] = applied.total_bytes
         rec["backlog_files"] = len(self.scheduler.backlog)
         rec["backlog_bytes"] = int(self.scheduler.backlog_bytes)
         rec["deferred_hysteresis"] = self.scheduler.last_deferred_hysteresis
@@ -727,7 +832,16 @@ class ReplicationController:
         seconds["evaluate"] = time.perf_counter() - t0
 
         rec["plan_hash"] = _plan_hash(self.current_rf, self.current_cat)
-        seconds["total"] = time.perf_counter() - t_start
+        # ``plan`` = the host-side planning slice (plan diff/submit +
+        # repair pass + budgeted admission) — the control-plane cost the
+        # SoA planners exist to shrink, and what the overlap schedule
+        # hides under the next window's device step.  ``total`` is host
+        # wall-clock attributable to this window (both phases); under
+        # overlap the phases interleave with other windows' device time,
+        # so totals measure host work, not latency.
+        seconds["plan"] = plan_seconds
+        seconds["total"] = seconds.pop("host_a") \
+            + (time.perf_counter() - t_b)
         rec["seconds"] = {k: round(v, 6) for k, v in seconds.items()}
         self._instrument_window(rec, seconds, X)
         return rec
@@ -773,6 +887,11 @@ class ReplicationController:
         if rec["deferred_budget"]:
             tel.counter_inc("migrate.deferred_budget",
                             rec["deferred_budget"])
+        # Planner depth gauges: how much admitted work is still queued —
+        # with the SoA backlog both are O(1)/O(columns) reads, so they are
+        # safe to emit every window at any scale.
+        tel.gauge("planner.backlog_files", rec["backlog_files"])
+        tel.gauge("planner.backlog_bytes", rec["backlog_bytes"])
         if rec.get("fault_events"):
             tel.counter_inc("fault.events", len(rec["fault_events"]))
             n_part_ev = sum(1 for s in rec["fault_events"]
@@ -865,13 +984,43 @@ class ReplicationController:
                                                       dtype=np.float64)
         return self._fallback_models[warm].run(X64, init_centroids=init64)
 
-    def _accept(self, decision) -> None:
-        """Adopt a new model + plan: diff against the APPLIED plan, rebuild
-        the scheduler backlog (newest plan supersedes pending moves)."""
+    def _ensure_accepted(self) -> None:
+        """Materialize the pending accepted decision into the host-side
+        model arrays (centroids, category map, population fractions).
+        With ``overlap_windows`` + jax this is where the host finally
+        blocks on the previous window's device step; serial runs hit it
+        immediately after dispatch, reproducing the historical timing."""
+        decision = self._pending_accept
+        if decision is None:
+            return
+        self._pending_accept = None
+        cfg = self.cfg
+        self._accepted_centroids = np.asarray(
+            decision.centroids,
+            dtype=np.float64 if cfg.backend == "numpy" else np.float32)
+        cat_idx = np.asarray(decision.category_idx).astype(np.int64)
+        self._accepted_category_idx = cat_idx
+        labels = np.asarray(decision.labels)
+        new_cat = cat_idx[labels].astype(np.int64)
+        self._accepted_file_cat = new_cat
+        frac = np.bincount(new_cat, minlength=len(CATEGORIES)).astype(
+            np.float64)
+        self._accepted_fractions = frac / max(len(labels), 1)
+
+    def _accept_plan(self, decision) -> None:
+        """Adopt an accepted decision's PLAN: diff against the APPLIED
+        plan, rebuild the scheduler backlog (newest plan supersedes
+        pending moves)."""
         cfg = self.cfg
         labels = np.asarray(decision.labels)
-        cat_idx = np.asarray(decision.category_idx)
-        new_cat = cat_idx[labels].astype(np.int64)
+        # The model was materialized from THIS decision before planning
+        # (phase B materializes a still-pending one; under overlap the
+        # next window's phase A already did), so the O(n) per-file
+        # category gather can be reused instead of recomputed.
+        new_cat = self._accepted_file_cat
+        if new_cat is None:
+            new_cat = np.asarray(
+                decision.category_idx).astype(np.int64)[labels]
         # With a storage config the target "rf" is the strategy's shard
         # count (rf for replicate, k+m for EC) — the one generalization
         # the whole downstream plan/placement/repair machinery needs.
@@ -920,14 +1069,6 @@ class ReplicationController:
                           self._sizes, priority=priority,
                           move_bytes=move_bytes)
         self.scheduler.submit(moves)
-
-        self._accepted_centroids = np.asarray(
-            decision.centroids,
-            dtype=np.float64 if cfg.backend == "numpy" else np.float32)
-        self._accepted_category_idx = cat_idx.astype(np.int64)
-        frac = np.bincount(new_cat, minlength=len(CATEGORIES)).astype(
-            np.float64)
-        self._accepted_fractions = frac / max(len(labels), 1)
 
     # -- storage strategies (storage/) -------------------------------------
     def _file_strategy(self, cat: int, fid: int) -> tuple[int, int, int]:
@@ -1095,6 +1236,9 @@ class ReplicationController:
         """Atomic npz snapshot of the full controller state."""
         from ..utils.checkpoint import save_state
 
+        # A lazily accepted decision must land in host arrays before it
+        # can be serialized (no-op unless a recluster just dispatched).
+        self._ensure_accepted()
         arrays = {k: np.asarray(getattr(self._state, k))
                   for k in self._NP_STATE}
         if self._dec is not None:
@@ -1211,6 +1355,10 @@ class ReplicationController:
             self._accepted_centroids = arrays["accepted_centroids"]
             self._accepted_category_idx = arrays["accepted_category_idx"]
             self._accepted_fractions = arrays["accepted_fractions"]
+        # The stash is not checkpointed; a restored controller recomputes
+        # it on the next materialize (stale values must never survive a
+        # load).
+        self._accepted_file_cat = None
         self.scheduler.load_state_arrays(arrays)
         if self._cluster_state is not None:
             self._cluster_state.load_state_arrays(arrays)
@@ -1322,13 +1470,34 @@ class ReplicationController:
         processed = 0
         since_ckpt = 0
         t0_box: dict = {}
+        every = max(1, checkpoint_every)
+        overlap = bool(self.cfg.overlap_windows)
+        #: Window context dispatched (phase A) but not yet planned (phase
+        #: B) — the one-deep pipeline of the overlap schedule.
+        pending: dict | None = None
+
+        def finish(ctx: dict) -> None:
+            nonlocal processed, since_ckpt
+            rec = self._window_phase_b(ctx)
+            self.window_index = ctx["w"] + 1
+            self._last_window_events = len(ctx["events"])
+            records.append(rec)
+            if sink:
+                sink.emit({"kind": "window", **rec})
+            processed += 1
+            since_ckpt += 1
+
         try:
             for w, events in iter_windows(source, self.manifest,
                                           self.cfg.window_seconds,
                                           batch_size=batch_size,
                                           t0=self._t0, t0_out=t0_box):
-                if max_windows is not None and processed >= max_windows:
-                    break  # BEFORE processing: max_windows=0 mutates nothing
+                # BEFORE processing: max_windows=0 mutates nothing, and a
+                # held window counts as soon as it would complete — the
+                # next window must not even fold past the limit.
+                if max_windows is not None and processed \
+                        + (1 if pending is not None else 0) >= max_windows:
+                    break
                 if self._t0 is None:
                     # iter_windows derived the grid origin from the first
                     # event; checkpoint it so resume replays the same grid.
@@ -1347,17 +1516,27 @@ class ReplicationController:
                         self._last_window_events = len(events)
                         since_ckpt += 1  # state changed: snapshot at exit
                     continue
-                rec = self.process_window(w, events)
-                self.window_index = w + 1
-                self._last_window_events = len(events)
-                records.append(rec)
-                if sink:
-                    sink.emit({"kind": "window", **rec})
-                processed += 1
-                since_ckpt += 1
-                if checkpoint_path and since_ckpt >= max(1, checkpoint_every):
-                    self.save_checkpoint(checkpoint_path)
-                    since_ckpt = 0
+                # A window is only ever held when completing it cannot
+                # trigger a snapshot (the hold guard below), so no
+                # flush-before-fold is needed here: a checkpoint can never
+                # contain a dispatched-but-unplanned window's state.
+                ctx = self._window_phase_a(w, events)
+                if pending is not None:
+                    # The overlap: last window's host planning runs while
+                    # the device chews on this window's cluster step.
+                    finish(pending)
+                    pending = None
+                if overlap and not (checkpoint_path
+                                    and since_ckpt + 1 >= every):
+                    pending = ctx
+                else:
+                    finish(ctx)
+                    if checkpoint_path and since_ckpt >= every:
+                        self.save_checkpoint(checkpoint_path)
+                        since_ckpt = 0
+            if pending is not None:
+                finish(pending)
+                pending = None
         finally:
             if sink:
                 sink.close()

@@ -1,4 +1,4 @@
-"""Plan diffing and bounded-churn migration scheduling.
+"""Plan diffing and bounded-churn migration scheduling (structure-of-arrays).
 
 A re-cluster produces a NEW target plan (per-file category + replication
 factor); applying it wholesale is exactly the churn storm dynamic replication
@@ -19,9 +19,31 @@ out:
   frozen until ``w + 1 + hysteresis_windows``, so a borderline file cannot
   oscillate between categories every window.
 
+The control plane is **structure-of-arrays end to end**: ``plan_diff``
+returns a ``MoveSet`` (seven parallel numpy columns), the scheduler's backlog
+IS a MoveSet held in admission order, and the per-window admission runs as a
+lexsort + cumsum + ``searchsorted`` threshold scan instead of a Python loop
+over move objects — decision-identical to the historical object path
+(``cdrs_tpu/compat/reference_planners.py`` keeps that path for the
+equivalence tests and ``benchmarks/plan_bench.py``), including:
+
+* the **oversized-move allowance** — when nothing was reserved and the
+  budget is positive, the first byte-moving move is admitted even if it
+  alone exceeds the budget (the largest file must not starve);
+* **reservation semantics** — ``bytes_reserved``/``files_reserved``
+  pre-charge the window (the repair pass runs first) and a nonzero byte
+  reservation disables the oversized allowance;
+* zero-byte moves (replica drops, category-only changes) bypassing the byte
+  budget entirely while the file cap still counts them;
+* deferral telemetry (hysteresis vs budget) counted only up to the point
+  the legacy loop would have ``break``-ed on the file cap.
+
 Everything is deterministic: ties break on file index, and the scheduler's
 whole state round-trips through ``state_arrays``/``load_state_arrays`` for
-the controller's checkpoint.
+the controller's checkpoint — the backlog columns are dumped as-is (no
+re-sort, no per-move object resurrection), and load re-canonicalizes the
+admission order with one lexsort, so legacy file-index-ordered checkpoints
+keep loading bit-identically.
 """
 
 from __future__ import annotations
@@ -30,7 +52,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-__all__ = ["PlanMove", "plan_diff", "MigrationScheduler"]
+__all__ = ["PlanMove", "MoveSet", "plan_diff", "MigrationScheduler"]
 
 #: ``last_moved`` sentinel: "never moved" must stay eligible at window 0
 #: for any hysteresis setting.
@@ -39,7 +61,11 @@ _NEVER = -(2 ** 40)
 
 @dataclass(frozen=True)
 class PlanMove:
-    """One per-file replication change: rf_old -> rf_new (and its category)."""
+    """One per-file replication change: rf_old -> rf_new (and its category).
+
+    The scalar row view of a ``MoveSet`` — kept for tests and small-scale
+    callers; the planner itself never materializes these per file.
+    """
 
     file_index: int
     rf_old: int
@@ -50,8 +76,87 @@ class PlanMove:
     priority: float   # larger = applied earlier
 
 
+#: (column name, dtype) of the six integer MoveSet columns (priority is the
+#: seventh, float64) — also the checkpoint schema (``sched_<col>``).
+_MOVE_COLS = ("file_index", "rf_old", "rf_new", "cat_old", "cat_new",
+              "bytes_moved")
+
+
+class MoveSet:
+    """Parallel arrays of plan moves — the planner's native currency.
+
+    Rows are moves; column order is whatever the producer chose (the
+    scheduler keeps its backlog in admission order).  Supports ``len``,
+    file-id membership (``fid in ms``), iteration as ``PlanMove`` rows
+    (small result sets only — the compat/test surface), and fancy-indexed
+    ``take``.
+    """
+
+    __slots__ = ("file_index", "rf_old", "rf_new", "cat_old", "cat_new",
+                 "bytes_moved", "priority")
+
+    def __init__(self, file_index, rf_old, rf_new, cat_old, cat_new,
+                 bytes_moved, priority):
+        self.file_index = np.asarray(file_index, dtype=np.int64)
+        self.rf_old = np.asarray(rf_old, dtype=np.int64)
+        self.rf_new = np.asarray(rf_new, dtype=np.int64)
+        self.cat_old = np.asarray(cat_old, dtype=np.int64)
+        self.cat_new = np.asarray(cat_new, dtype=np.int64)
+        self.bytes_moved = np.asarray(bytes_moved, dtype=np.int64)
+        self.priority = np.asarray(priority, dtype=np.float64)
+        n = self.file_index.shape[0]
+        for name in self.__slots__:
+            a = getattr(self, name)
+            if a.ndim != 1 or a.shape[0] != n:
+                raise ValueError(
+                    f"MoveSet column {name} has shape {a.shape}, "
+                    f"expected ({n},)")
+
+    @classmethod
+    def empty(cls) -> "MoveSet":
+        z = np.zeros(0, dtype=np.int64)
+        return cls(z, z, z, z, z, z, np.zeros(0))
+
+    @classmethod
+    def from_moves(cls, moves) -> "MoveSet":
+        """From an iterable of ``PlanMove`` (tests, legacy callers)."""
+        moves = list(moves)
+        return cls(
+            [m.file_index for m in moves], [m.rf_old for m in moves],
+            [m.rf_new for m in moves], [m.cat_old for m in moves],
+            [m.cat_new for m in moves], [m.bytes_moved for m in moves],
+            [m.priority for m in moves])
+
+    def __len__(self) -> int:
+        return int(self.file_index.shape[0])
+
+    def __contains__(self, fid) -> bool:
+        return bool((self.file_index == int(fid)).any())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield PlanMove(
+                file_index=int(self.file_index[i]),
+                rf_old=int(self.rf_old[i]), rf_new=int(self.rf_new[i]),
+                cat_old=int(self.cat_old[i]), cat_new=int(self.cat_new[i]),
+                bytes_moved=int(self.bytes_moved[i]),
+                priority=float(self.priority[i]))
+
+    def take(self, idx) -> "MoveSet":
+        """Row subset/reorder by integer indices or boolean mask."""
+        return MoveSet(*(getattr(self, c)[idx] for c in self.__slots__))
+
+    @property
+    def total_bytes(self) -> int:
+        return int(self.bytes_moved.sum())
+
+
+def _as_move_set(moves) -> MoveSet:
+    return moves if isinstance(moves, MoveSet) else MoveSet.from_moves(moves)
+
+
 def plan_diff(rf_old, rf_new, cat_old, cat_new, size_bytes,
-              priority=None, move_bytes=None) -> list[PlanMove]:
+              priority=None, move_bytes=None) -> MoveSet:
     """Moves for every file whose (rf, category) changed between two plans.
 
     All inputs are (n,) arrays; ``priority`` defaults to zero, so callers
@@ -60,6 +165,9 @@ def plan_diff(rf_old, rf_new, cat_old, cat_new, size_bytes,
     strategy re-encode as the new shards written, not an rf delta of
     full copies); default is the historical
     ``size_bytes * max(0, rf_new - rf_old)``.
+
+    Returns a ``MoveSet`` in ascending file-index order — one gather per
+    column, no per-file Python objects.
     """
     rf_old = np.asarray(rf_old, dtype=np.int64)
     rf_new = np.asarray(rf_new, dtype=np.int64)
@@ -75,21 +183,91 @@ def plan_diff(rf_old, rf_new, cat_old, cat_new, size_bytes,
                                                            dtype=np.float64)
     changed = np.flatnonzero((rf_new != rf_old) | (cat_new != cat_old))
     if move_bytes is None:
-        bytes_moved = size_bytes * np.maximum(rf_new - rf_old, 0)
+        bytes_moved = size_bytes[changed] * np.maximum(
+            rf_new[changed] - rf_old[changed], 0)
     else:
-        bytes_moved = np.asarray(move_bytes, dtype=np.int64)
-        if bytes_moved.shape != (n,):
+        move_bytes = np.asarray(move_bytes, dtype=np.int64)
+        if move_bytes.shape != (n,):
             raise ValueError(
-                f"move_bytes shape {bytes_moved.shape} != ({n},)")
-    return [PlanMove(file_index=int(i), rf_old=int(rf_old[i]),
-                     rf_new=int(rf_new[i]), cat_old=int(cat_old[i]),
-                     cat_new=int(cat_new[i]), bytes_moved=int(bytes_moved[i]),
-                     priority=float(prio[i]))
-            for i in changed]
+                f"move_bytes shape {move_bytes.shape} != ({n},)")
+        bytes_moved = move_bytes[changed]
+    return MoveSet(changed, rf_old[changed], rf_new[changed],
+                   cat_old[changed], cat_new[changed], bytes_moved,
+                   prio[changed])
+
+
+def _next_le(values: np.ndarray, start: int, limit) -> int:
+    """First index >= ``start`` with ``values[i] <= limit``, or -1.
+
+    Chunked scan: dense hits cost O(distance to the hit), a dry suffix
+    costs one vectorized pass — the budget scan stays O(n) overall
+    instead of O(n) per admission round.
+    """
+    n = values.shape[0]
+    chunk = 4096
+    i = start
+    while i < n:
+        j = min(n, i + chunk)
+        hit = np.flatnonzero(values[i:j] <= limit)
+        if hit.size:
+            return i + int(hit[0])
+        i = j
+        chunk = min(chunk * 4, 1 << 20)
+    return -1
+
+
+def _greedy_admit(bp: np.ndarray, max_bytes: int, reserved: int
+                  ) -> np.ndarray:
+    """Byte-budget admission flags over positive byte costs ``bp`` in
+    admission order — the vectorized equivalent of the legacy sequential
+    scan (admit while ``used + b <= max_bytes``; skipped moves keep the
+    scan going; the first byte-moving move of an unreserved positive-budget
+    window is admitted unconditionally).
+
+    Runs in O(n + admissions * log n): maximal admitted runs come from one
+    ``searchsorted`` on the cumulative sum; deferred runs are skipped with
+    a chunked next-fitting-move scan.
+    """
+    n = bp.shape[0]
+    admit = np.zeros(n, dtype=bool)
+    if n == 0:
+        return admit
+    used = int(reserved)
+    j = 0
+    if used == 0 and max_bytes > 0:
+        # Oversized-move allowance: the window's first byte-moving move.
+        admit[0] = True
+        used += int(bp[0])
+        j = 1
+    cum = np.cumsum(bp)
+    while j < n:
+        base = int(cum[j - 1]) if j > 0 else 0
+        # Admit the maximal run [j, e): used + (cum[i] - base) <= max_bytes.
+        e = int(np.searchsorted(cum, max_bytes - used + base,
+                                side="right"))
+        if e > j:
+            admit[j:e] = True
+            used += int(cum[e - 1]) - base
+        e = max(e, j)
+        if e >= n:
+            break
+        # Move at e is over budget; skip it and every following move too
+        # big for what is left.
+        nxt = _next_le(bp, e + 1, max_bytes - used)
+        if nxt < 0:
+            break
+        j = nxt
+    return admit
 
 
 class MigrationScheduler:
-    """Backlog + churn budget + hysteresis (see module docstring)."""
+    """Backlog + churn budget + hysteresis (see module docstring).
+
+    The backlog is a ``MoveSet`` kept in **admission order**
+    (priority descending, file index ascending) — sorted once per
+    ``submit``, scanned (never re-sorted) by ``schedule`` and dumped
+    as-is by ``state_arrays``.
+    """
 
     def __init__(self, n_files: int, max_bytes_per_window: int | None = None,
                  max_files_per_window: int | None = None,
@@ -102,7 +280,7 @@ class MigrationScheduler:
         self.max_bytes = max_bytes_per_window
         self.max_files = max_files_per_window
         self.hysteresis = int(hysteresis_windows)
-        self.backlog: dict[int, PlanMove] = {}
+        self.backlog: MoveSet = MoveSet.empty()
         self.last_moved = np.full(n_files, _NEVER, dtype=np.int64)
         #: Telemetry of the most recent ``schedule`` call: moves skipped by
         #: the hysteresis freeze vs by the byte budget.  Plain attributes —
@@ -110,12 +288,27 @@ class MigrationScheduler:
         self.last_deferred_hysteresis = 0
         self.last_deferred_budget = 0
 
-    def submit(self, moves: list[PlanMove]) -> None:
+    @staticmethod
+    def _admission_order(moves: MoveSet) -> MoveSet:
+        """Rows re-ordered by (-priority, file_index) — the legacy
+        ``sorted`` key, as one stable lexsort."""
+        order = np.lexsort((moves.file_index, -moves.priority))
+        return moves.take(order)
+
+    def submit(self, moves) -> None:
         """Replace the backlog with the newest plan's pending moves."""
-        self.backlog = {m.file_index: m for m in moves}
+        ms = _as_move_set(moves)
+        fi = ms.file_index
+        if fi.size and np.unique(fi).size != fi.size:
+            # Legacy dict-backlog semantics: a later move for the same
+            # file overwrites an earlier one.  ``plan_diff`` emits unique
+            # files, so this pays only for hand-built move lists.
+            keep = fi.size - 1 - np.unique(fi[::-1], return_index=True)[1]
+            ms = ms.take(np.sort(keep))
+        self.backlog = self._admission_order(ms)
 
     def schedule(self, window_index: int, *, bytes_reserved: int = 0,
-                 files_reserved: int = 0) -> list[PlanMove]:
+                 files_reserved: int = 0) -> MoveSet:
         """Pop this window's moves (budgeted, prioritized, hysteresis-gated).
 
         Byte budget: a byte-moving move is admitted while ``bytes_used +
@@ -136,49 +329,81 @@ class MigrationScheduler:
         compete for ONE churn allowance.  A nonzero reservation also
         disables the oversized-move allowance for this window (the first
         byte-moving operation was the reserver's).
+
+        Returns the admitted moves as a ``MoveSet`` in admission order.
         """
-        order = sorted(self.backlog.values(),
-                       key=lambda m: (-m.priority, m.file_index))
-        applied: list[PlanMove] = []
-        bytes_used = int(bytes_reserved)
+        bl = self.backlog
         self.last_deferred_hysteresis = 0
         self.last_deferred_budget = 0
-        for m in order:
-            if self.max_files is not None \
-                    and len(applied) + int(files_reserved) >= self.max_files:
-                break
-            if window_index < int(self.last_moved[m.file_index]) \
-                    + 1 + self.hysteresis:
-                self.last_deferred_hysteresis += 1
-                continue
-            if self.max_bytes is not None and m.bytes_moved > 0:
-                over = bytes_used + m.bytes_moved > self.max_bytes
-                first = bytes_used == 0 and self.max_bytes > 0
-                if over and not first:
-                    self.last_deferred_budget += 1
-                    continue
-            applied.append(m)
-            bytes_used += m.bytes_moved
-        for m in applied:
-            del self.backlog[m.file_index]
-            self.last_moved[m.file_index] = window_index
+        m = len(bl)
+        if m == 0:
+            return MoveSet.empty()
+        cap = None
+        if self.max_files is not None:
+            cap = self.max_files - int(files_reserved)
+            if cap <= 0:
+                # The legacy loop breaks before touching its first move:
+                # nothing is processed, nothing is counted.
+                return MoveSet.empty()
+
+        hyst_ok = np.asarray(window_index, dtype=np.int64) >= \
+            self.last_moved[bl.file_index] + 1 + self.hysteresis
+        admit = np.zeros(m, dtype=bool)
+        if self.max_bytes is None:
+            admit[hyst_ok] = True
+        else:
+            cand = np.flatnonzero(hyst_ok)
+            b = bl.bytes_moved[cand]
+            adm_c = b == 0          # metadata moves: never byte-blocked
+            pos = np.flatnonzero(b > 0)
+            if pos.size:
+                adm_c = adm_c.copy()
+                adm_c[pos[_greedy_admit(b[pos], int(self.max_bytes),
+                                        int(bytes_reserved))]] = True
+            admit[cand[adm_c]] = True
+
+        # File cap: the legacy loop breaks at the first move once the
+        # admitted count (plus the reservation) reaches the cap — moves
+        # past that point are unprocessed and uncounted.  Everything the
+        # byte scan decided before that point is unaffected by the cap,
+        # so truncation after the fact reproduces the loop exactly.
+        limit = m
+        hits = np.flatnonzero(admit)
+        if cap is not None:
+            if hits.size > cap:
+                limit = int(hits[cap - 1]) + 1 if cap > 0 else 0
+                admit[limit:] = False
+                hits = hits[:cap]
+            elif hits.size == cap:
+                limit = int(hits[-1]) + 1
+
+        self.last_deferred_hysteresis = int((~hyst_ok[:limit]).sum())
+        self.last_deferred_budget = int(
+            (hyst_ok[:limit] & ~admit[:limit]).sum())
+
+        if hits.size == 0:
+            return MoveSet.empty()
+        # Integer-index takes: a boolean mask re-scans all seven columns
+        # end to end, index gathers cost only the rows they move.
+        applied = bl.take(hits)
+        self.last_moved[applied.file_index] = window_index
+        self.backlog = bl.take(np.flatnonzero(~admit))
         return applied
 
     @property
     def backlog_bytes(self) -> int:
-        return sum(m.bytes_moved for m in self.backlog.values())
+        return self.backlog.total_bytes
 
     # -- checkpoint (controller snapshots ride utils/checkpoint npz) -------
-    _MOVE_COLS = ("file_index", "rf_old", "rf_new", "cat_old", "cat_new",
-                  "bytes_moved")
+    _MOVE_COLS = _MOVE_COLS
 
     def state_arrays(self) -> dict[str, np.ndarray]:
-        moves = sorted(self.backlog.values(), key=lambda m: m.file_index)
-        out = {"sched_" + c: np.asarray([getattr(m, c) for m in moves],
-                                        dtype=np.int64)
+        """Checkpoint columns — the SoA backlog verbatim (admission
+        order), plus the hysteresis stamps.  O(columns) array copies: no
+        re-sort, no per-move objects."""
+        out = {"sched_" + c: getattr(self.backlog, c).copy()
                for c in self._MOVE_COLS}
-        out["sched_priority"] = np.asarray([m.priority for m in moves],
-                                           dtype=np.float64)
+        out["sched_priority"] = self.backlog.priority.copy()
         out["sched_last_moved"] = self.last_moved.copy()
         return out
 
@@ -186,7 +411,9 @@ class MigrationScheduler:
         """Restore the backlog + freeze stamps, validating shapes/dtypes
         against ``n_files`` up front — a truncated or foreign checkpoint
         must fail here with a message, not later with an opaque
-        IndexError deep in ``schedule``."""
+        IndexError deep in ``schedule``.  The stored row order is
+        irrelevant: admission order is re-derived with one lexsort, so
+        legacy (file-index-ordered) checkpoints resume bit-identically."""
         missing = [k for k in ("sched_last_moved", "sched_priority",
                                *("sched_" + c for c in self._MOVE_COLS))
                    if k not in arrays]
@@ -221,11 +448,4 @@ class MigrationScheduler:
             raise ValueError(
                 f"scheduler backlog names file indices outside "
                 f"[0, {self.n_files}): {bad[:5].tolist()}")
-        self.backlog = {
-            int(cols[0][i]): PlanMove(
-                file_index=int(cols[0][i]), rf_old=int(cols[1][i]),
-                rf_new=int(cols[2][i]), cat_old=int(cols[3][i]),
-                cat_new=int(cols[4][i]), bytes_moved=int(cols[5][i]),
-                priority=float(prio[i]))
-            for i in range(cols[0].shape[0])
-        }
+        self.backlog = self._admission_order(MoveSet(*cols, prio))

@@ -12,6 +12,27 @@ first and hands the consumed budget to ``MigrationScheduler.schedule`` as a
 reservation, so repair traffic and drift-migration traffic genuinely
 compete for one churn allowance instead of stacking two.
 
+**Structure-of-arrays control plane** (PR 8): the backlog is five parallel
+numpy columns (file, attempts, copy-failure backoff, partition-stall
+backoff) kept in file-index order, ``sync`` derives it from the or-ed
+work-list masks plus one ``searchsorted`` merge for carried backoff state,
+and ``schedule`` computes backoff deferrals, lost/stranded classification
+and the partition-stall bumps as UNORDERED array operations — the legacy
+(tier, -rf, file) admission order packs into one int64 key, and only the
+budget-bounded head of the work list is ever materialized in that order
+(``argpartition`` top-k with geometric refill; a full sort happens only
+for unbudgeted runs).  Only the copies actually admitted against the
+budget run file-at-a-time (target picking mutates placement state) — and
+the moment the remaining byte budget cannot fit any remaining task's
+cheapest possible copy, or the file cap fills, the entire tail of the
+work list is classified in one vectorized pass.  Combined with
+``ClusterState``'s incrementally cached counts, a window's repair-planning
+cost scales with the damage (the files the affected failure domain holds,
+plus the budgeted copies), not with cluster size.  Decisions are
+bit-identical to the legacy object path, which survives as
+``compat/reference_planners.ReferenceRepairScheduler`` for the equivalence
+tests and ``benchmarks/plan_bench.py``.
+
 Domain spread: targets come from ``ClusterState.pick_repair_target``, which
 prefers failure domains the file does not yet occupy, and the
 **correlated-risk rebalance** pass moves one replica of an
@@ -64,7 +85,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-__all__ = ["RepairTask", "RepairReport", "RepairScheduler"]
+__all__ = ["RepairTask", "RepairReport", "RepairBacklog", "RepairScheduler"]
 
 #: Backoff cap: a permanently failing target must not push the retry past
 #: the horizon of any realistic run.
@@ -73,7 +94,9 @@ _MAX_BACKOFF = 64
 
 @dataclass
 class RepairTask:
-    """One under-replicated file's pending repair."""
+    """One under-replicated file's pending repair — the scalar row view of
+    a ``RepairBacklog`` (tests and small-scale callers; the planner holds
+    columns, not objects)."""
 
     file_index: int
     attempts: int = 0
@@ -119,12 +142,71 @@ def _fail_roll(seed: int, window: int, fid: int, attempt: int,
     return zlib.crc32(key.tobytes()) / 2.0 ** 32
 
 
+class RepairBacklog:
+    """Pending repairs as five parallel columns, sorted by file index.
+
+    Dict-like reads (``fid in bl``, ``bl[fid]``, ``bl.get``, ``items()``)
+    materialize ``RepairTask`` snapshots for tests/inspection; the
+    scheduler itself only touches the columns.
+    """
+
+    __slots__ = ("fid", "attempts", "next_window", "stalled", "stall_until")
+
+    def __init__(self, fid, attempts, next_window, stalled, stall_until):
+        self.fid = np.asarray(fid, dtype=np.int64)
+        self.attempts = np.asarray(attempts, dtype=np.int64)
+        self.next_window = np.asarray(next_window, dtype=np.int64)
+        self.stalled = np.asarray(stalled, dtype=np.int64)
+        self.stall_until = np.asarray(stall_until, dtype=np.int64)
+
+    @classmethod
+    def empty(cls) -> "RepairBacklog":
+        z = np.zeros(0, dtype=np.int64)
+        return cls(z, z, z, z, z)
+
+    def __len__(self) -> int:
+        return int(self.fid.shape[0])
+
+    def _pos(self, fid) -> int:
+        i = int(np.searchsorted(self.fid, int(fid)))
+        if i < len(self) and int(self.fid[i]) == int(fid):
+            return i
+        return -1
+
+    def __contains__(self, fid) -> bool:
+        return self._pos(fid) >= 0
+
+    def __getitem__(self, fid) -> RepairTask:
+        i = self._pos(fid)
+        if i < 0:
+            raise KeyError(fid)
+        return self._task(i)
+
+    def get(self, fid, default=None):
+        i = self._pos(fid)
+        return self._task(i) if i >= 0 else default
+
+    def _task(self, i: int) -> RepairTask:
+        return RepairTask(int(self.fid[i]), attempts=int(self.attempts[i]),
+                          next_window=int(self.next_window[i]),
+                          stalled=int(self.stalled[i]),
+                          stall_until=int(self.stall_until[i]))
+
+    def items(self):
+        for i in range(len(self)):
+            yield int(self.fid[i]), self._task(i)
+
+    def take(self, idx) -> "RepairBacklog":
+        return RepairBacklog(*(getattr(self, c)[idx]
+                               for c in self.__slots__))
+
+
 class RepairScheduler:
-    """Backlog of RepairTasks + the budgeted per-window repair pass."""
+    """SoA backlog + the budgeted per-window repair pass."""
 
     def __init__(self, seed: int = 0):
         self.seed = int(seed)
-        self.backlog: dict[int, RepairTask] = {}
+        self.backlog: RepairBacklog = RepairBacklog.empty()
 
     def sync(self, state, target_rf: np.ndarray) -> None:
         """Re-derive the backlog from the cluster's current gaps: newly
@@ -133,13 +215,30 @@ class RepairScheduler:
         keep their backoff state.  Correlated-risk files (at target but
         all reachable replicas in one failure domain) enter too — the
         rebalance work list.  Also prunes excess replicas a recovered node
-        or healed partition resurfaced (free)."""
+        or healed partition resurfaced (free).  The two work lists are
+        unioned at the MASK level (one ``flatnonzero`` over the or-ed
+        boolean masks is sorted-unique by construction — no ``union1d``
+        sort of the concatenation), then one ``searchsorted`` merge
+        carries the old backoff state over — no per-task objects."""
         state.trim_excess(target_rf)
-        fids, _reach, _eff = state.repair_needs(target_rf)
-        corr = np.flatnonzero(state.correlated_mask(target_rf))
-        work = np.union1d(fids, corr)
-        self.backlog = {int(f): self.backlog.get(int(f), RepairTask(int(f)))
-                        for f in work}
+        reach = state._reach_counts
+        eff = state.effective_target(target_rf)
+        corr_mask = state.correlated_mask(target_rf, reach=reach, eff=eff)
+        work = np.flatnonzero((reach < eff) | corr_mask).astype(np.int64)
+        old = self.backlog
+        n = work.shape[0]
+        cols = {c: np.zeros(n, dtype=np.int64)
+                for c in ("attempts", "next_window", "stalled",
+                          "stall_until")}
+        if len(old):
+            pos = np.searchsorted(old.fid, work)
+            safe = np.minimum(pos, len(old) - 1)
+            match = old.fid[safe] == work
+            for c in cols:
+                cols[c][match] = getattr(old, c)[safe[match]]
+        self.backlog = RepairBacklog(work, cols["attempts"],
+                                     cols["next_window"], cols["stalled"],
+                                     cols["stall_until"])
 
     def _charge(self, state, fid: int, target: int) -> int:
         """Budget charge of creating one new shard of ``fid`` on
@@ -165,6 +264,29 @@ class RepairScheduler:
         m = min(src_m, float(state.node_throughput[target]))
         return int(np.ceil(read_bytes / max(m, 1e-9)))
 
+    def _tail_avail(self, state, fids: np.ndarray,
+                    rebalance: np.ndarray, reach: np.ndarray) -> np.ndarray:
+        """Candidate-target counts for a work-list tail, vectorized: a
+        normal repair can target any reachable node not already holding
+        the file; a rebalance copy (``new_domain_only``) only reachable
+        nodes in domains the file does not occupy.  Mirrors
+        ``ClusterState.pick_repair_target``'s candidate filter exactly —
+        only the *emptiness* matters here (no target vs budget defer)."""
+        node_reach = state.node_reachable()
+        n_avail = int(node_reach.sum())
+        avail = n_avail - reach[fids]
+        if rebalance.any():
+            per_dom = np.bincount(state.domain_index[node_reach],
+                                  minlength=state.n_domains)
+            rows = state.replica_map[fids[rebalance]]
+            assigned = rows >= 0
+            dom = state.domain_index[np.clip(rows, 0, None)]
+            occ = np.zeros(rows.shape[0], dtype=np.int64)
+            for d in range(state.n_domains):
+                occ += ((dom == d) & assigned).any(axis=1) * int(per_dom[d])
+            avail[rebalance] = n_avail - occ
+        return avail
+
     def schedule(self, window: int, state, target_rf: np.ndarray,
                  cat: np.ndarray, *, max_bytes: int | None = None,
                  max_files: int | None = None) -> RepairReport:
@@ -178,129 +300,232 @@ class RepairScheduler:
         files repaired this window.
         """
         rep = RepairReport()
-        if not self.backlog:
+        bl = self.backlog
+        if not len(bl):
             return rep
-        live = state.live_counts()
-        reach = state.reachable_counts()
+        live = state._live_counts     # read-only here: no copy
+        reach = state.reachable_counts()   # scratch: the loop bumps it
         eff = state.effective_target(target_rf)
-        corr = state.correlated_mask(target_rf)
-        cat = np.asarray(cat)
+        corr = state.correlated_mask(target_rf, reach=reach, eff=eff)
         rf_vec = np.asarray(target_rf, dtype=np.int64)
         #: Existence threshold per file (storage/): 1 for replicate,
         #: k for an EC(k, m) stripe — below it there is no repair source.
         need = state.min_live
 
-        def prio(t: RepairTask):
-            f = t.file_index
-            if reach[f] < need[f]:
-                tier = 0          # lost / wholly stranded
-            elif reach[f] == need[f]:
-                tier = 1          # at risk: one failure from loss
-            elif reach[f] < eff[f]:
-                tier = 2
-            else:
-                tier = 3          # correlated-risk rebalance: spread last
-            return (tier, -int(rf_vec[f]), f)
+        # Bulk deferrals, UNORDERED (deferral counts, stall bumps and the
+        # healed set are order-independent — only the admitted prefix
+        # needs priority order, and it is budget-bounded):
+        # 1. copy-failure backoff still running;
+        bf = bl.fid
+        r_b, n_b = reach[bf], need[bf]
+        backoff = bl.next_window > window
+        # 2. stranded (reachable below the existence threshold): lost
+        #    outright when even LIVE shards are short — otherwise the
+        #    data is intact behind a partition and the stall backoff
+        #    gates the rescan (never burning budget on doomed copies).
+        stranded = ~backoff & (r_b < n_b)
+        lost = stranded & (live[bf] < n_b)
+        stall = stranded & ~lost
+        stall_waiting = stall & (bl.stall_until > window)
+        stall_bump = stall & ~stall_waiting
+        rep.deferred_backoff = int(backoff.sum() + stall_waiting.sum())
+        rep.deferred_no_source = int(lost.sum())
+        rep.deferred_partition = int(stall_bump.sum())
+        if stall_bump.any():
+            pos = np.flatnonzero(stall_bump)
+            bl.stalled[pos] += 1
+            # min(2^s, 64) == 2^min(s, 6): stays in int64 for any s.
+            bl.stall_until[pos] = window + (
+                np.int64(1) << np.minimum(bl.stalled[pos], 6))
 
-        order = sorted(self.backlog.values(), key=prio)
-        touched: set[int] = set()
+        # The actionable work list.  The legacy admission order is the
+        # sort by (tier, -rf, file); actionable tasks are never tier 0
+        # (that is exactly ``stranded``), and file index is unique, so
+        # the whole key packs into ONE int64 — top-k selection via
+        # ``argpartition`` then replaces the full lexsort: the admitted
+        # prefix is budget/cap-bounded, so sorting all five million
+        # damaged files to admit a few hundred is wasted wall-clock.
+        act_pos = np.flatnonzero(~backoff & ~stranded)
+        af = bf[act_pos]
+        m = act_pos.shape[0]
+        r_a = r_b[act_pos]
+        tier = np.where(r_a == n_b[act_pos], 1,
+                        np.where(r_a < eff[af], 2, 3))
+        rf_a = rf_vec[af]
+        rmax = int(rf_a.max()) if m else 0
+        span = np.int64(rmax + 1)
+        n_total = np.int64(reach.shape[0])
+        # Guard arithmetic in Python ints: the overflow test must not
+        # itself overflow (np.int64 would wrap for pathological rf).
+        if m and 4 * int(span) * int(n_total) >= 2 ** 62:
+            # Pathological rf magnitudes: fall back to the explicit
+            # three-key sort rather than risk key overflow.
+            key = None
+            full_order = np.lexsort((af, -rf_a, tier))
+        else:
+            key = (tier * span + (rmax - rf_a)) * n_total + af
+            full_order = None
+        # Cheapest possible budget charge per task: the reconstruction
+        # read bytes at nominal throughput (straggler/source gating only
+        # inflates it) — its minimum over the unprocessed remainder tells
+        # when the budget is dry for every remaining task.
+        min_charge = state.shard_bytes[af] * np.maximum(
+            state.ec_k[af].astype(np.int64), 1)
+
+        #: Indices into the actionable arrays already handed to the
+        #: admission loop (chunk membership), NOT yet necessarily
+        #: processed — ``done`` counts actual processing.
+        picked = np.zeros(m, dtype=bool)
+
+        def next_chunk(k: int) -> np.ndarray | None:
+            """The k highest-priority unpicked actionable tasks, in
+            priority order — sequential chunks walk the exact legacy
+            admission order because every unpicked key exceeds every
+            picked one."""
+            if full_order is not None:
+                if picked.all():
+                    return None
+                picked[:] = True
+                return full_order
+            rest = np.flatnonzero(~picked)
+            if rest.size == 0:
+                return None
+            if k < rest.size:
+                part = rest[np.argpartition(key[rest], k - 1)[:k]]
+            else:
+                part = rest
+            picked[part] = True
+            return part[np.argsort(key[part])]
+
+        # Unbudgeted runs process every actionable task — select once in
+        # full; budgeted runs start small and refill geometrically (a
+        # refill only happens when admitted work outran the chunk).
+        if max_bytes is None and max_files is None:
+            chunk_size = m
+        else:
+            chunk_size = min(m, max(2048, 2 * (max_files or 0)))
+
+        touched = 0
         healed: list[int] = []
-        for task in order:
-            f = task.file_index
-            if task.next_window > window:
-                rep.deferred_backoff += 1
-                continue
-            if reach[f] < need[f]:
-                if live[f] >= need[f]:
-                    # Stranded behind a partition: the data is intact but
-                    # unreachable (a replicate copy, or enough EC shards,
-                    # exists on live-but-partitioned nodes) — back off
-                    # instead of rescanning (and never burn budget on a
-                    # doomed copy).  The moment the partition heals the
-                    # file either leaves the backlog (replicas back above
-                    # target) or repairs immediately: the stall backoff
-                    # gates only this branch.
-                    if task.stall_until > window:
-                        rep.deferred_backoff += 1
-                    else:
-                        task.stalled += 1
-                        task.stall_until = window + min(2 ** task.stalled,
-                                                        _MAX_BACKOFF)
-                        rep.deferred_partition += 1
-                else:
-                    rep.deferred_no_source += 1
-                continue
-            if max_files is not None and f not in touched \
-                    and len(touched) >= max_files:
-                rep.deferred_budget += 1
-                continue
-            # Raw data bytes WRITTEN per new shard (no reconstruction
-            # amplification — that lives in the budget charge).
-            size = int(state.shard_bytes[f])
-            copy = 0
-            rebalance = reach[f] >= eff[f] and bool(corr[f])
-            spread_fixed = False
-            while reach[f] < eff[f] or (rebalance and copy == 0):
-                target = state.pick_repair_target(
-                    f, rotate=task.attempts + copy,
-                    new_domain_only=rebalance)
-                if target < 0:
-                    rep.deferred_no_target += 1
+        done = 0
+        stop = False
+        chunk = next_chunk(chunk_size) if m else None
+        while chunk is not None and not stop:
+            rest_any = not picked.all()
+            rest_min = (int(min_charge[~picked].min()) if rest_any
+                        else None)
+            c_charge = min_charge[chunk]
+            sfx = np.minimum.accumulate(c_charge[::-1])[::-1]
+            for j in range(chunk.shape[0]):
+                if max_files is not None and touched >= max_files:
+                    # File cap filled: the legacy loop defers every
+                    # remaining actionable task without picking targets.
+                    rep.deferred_budget += m - done
+                    stop = True
                     break
-                charge = self._charge(state, f, target)
-                if max_bytes is not None:
-                    over = rep.bytes_used + charge > max_bytes
-                    first = rep.bytes_used == 0 and max_bytes > 0
-                    if over and not first:
-                        rep.deferred_budget += 1
+                low = int(sfx[j])
+                if rest_min is not None:
+                    low = min(low, rest_min)
+                if max_bytes is not None \
+                        and (rep.bytes_used > 0 or max_bytes == 0) \
+                        and rep.bytes_used + low > max_bytes:
+                    # Byte budget exhausted for every remaining task (any
+                    # real charge >= its reconstruction read bytes):
+                    # classify the whole tail — this chunk's remainder
+                    # plus everything never selected — in one vectorized
+                    # pass: no-work tasks heal, target-less tasks defer
+                    # as no_target, the rest as budget.
+                    sel = np.concatenate([chunk[j:],
+                                          np.flatnonzero(~picked)])
+                    fs = af[sel]
+                    rebal = (reach[fs] >= eff[fs]) & corr[fs]
+                    needs = (reach[fs] < eff[fs]) | rebal
+                    avail = self._tail_avail(state, fs, rebal, reach)
+                    no_t = needs & (avail <= 0)
+                    rep.deferred_no_target += int(no_t.sum())
+                    rep.deferred_budget += int((needs & ~no_t).sum())
+                    healed.extend(int(q) for q in act_pos[sel[~needs]])
+                    stop = True
+                    break
+                p = int(act_pos[chunk[j]])
+                f = int(af[chunk[j]])
+                done += 1
+                size = int(state.shard_bytes[f])
+                attempts = int(bl.attempts[p])
+                copy = 0
+                rebalance = reach[f] >= eff[f] and bool(corr[f])
+                spread_fixed = False
+                task_touched = False
+                while reach[f] < eff[f] or (rebalance and copy == 0):
+                    target = state.pick_repair_target(
+                        f, rotate=attempts + copy,
+                        new_domain_only=rebalance)
+                    if target < 0:
+                        rep.deferred_no_target += 1
                         break
-                p = float(state.node_fail_prob[target])
-                if p > 0.0 and _fail_roll(self.seed, window, f,
-                                          task.attempts, copy) < p:
-                    # Mid-window target failure: traffic spent, copy lost.
-                    task.attempts += 1
-                    task.next_window = window + min(2 ** task.attempts,
-                                                    _MAX_BACKOFF)
-                    rep.failed += 1
+                    charge = self._charge(state, f, target)
+                    if max_bytes is not None:
+                        over = rep.bytes_used + charge > max_bytes
+                        first = rep.bytes_used == 0 and max_bytes > 0
+                        if over and not first:
+                            rep.deferred_budget += 1
+                            break
+                    pf = float(state.node_fail_prob[target])
+                    if pf > 0.0 and _fail_roll(self.seed, window, f,
+                                               attempts, copy) < pf:
+                        # Mid-window target failure: traffic spent, copy
+                        # lost.
+                        attempts += 1
+                        bl.attempts[p] = attempts
+                        bl.next_window[p] = window + min(2 ** attempts,
+                                                         _MAX_BACKOFF)
+                        rep.failed += 1
+                        rep.bytes_used += charge
+                        task_touched = True
+                        break
+                    state.add_replica(f, target)
                     rep.bytes_used += charge
-                    touched.add(f)
-                    break
-                state.add_replica(f, target)
-                rep.bytes_used += charge
-                rep.bytes_copied += size
-                rep.applied.append((f, int(target), size))
-                touched.add(f)
-                if rebalance:
-                    # The spread move: the new-domain copy landed, drop one
-                    # replica from the crowded domain (free metadata
-                    # delete) — net reachable count unchanged.
-                    state.drop_crowded(f)
-                    rep.rebalanced += 1
-                    spread_fixed = True
-                    break
-                reach[f] += 1
-                copy += 1
-            if reach[f] >= eff[f] and (not bool(corr[f]) or spread_fixed):
-                healed.append(f)
-        for f in healed:
-            self.backlog.pop(f, None)
-        rep.files_touched = len(touched)
+                    rep.bytes_copied += size
+                    rep.applied.append((f, int(target), size))
+                    task_touched = True
+                    if rebalance:
+                        # The spread move: the new-domain copy landed,
+                        # drop one replica from the crowded domain (free
+                        # metadata delete) — net reachable count
+                        # unchanged.
+                        state.drop_crowded(f)
+                        rep.rebalanced += 1
+                        spread_fixed = True
+                        break
+                    reach[f] += 1
+                    copy += 1
+                if task_touched:
+                    touched += 1
+                if reach[f] >= eff[f] and (not bool(corr[f])
+                                           or spread_fixed):
+                    healed.append(p)
+            else:
+                chunk_size *= 8
+                chunk = next_chunk(chunk_size)
+        if healed:
+            keep = np.ones(len(bl), dtype=bool)
+            keep[np.asarray(healed, dtype=np.int64)] = False
+            self.backlog = bl.take(keep)
+        rep.files_touched = touched
         return rep
 
     # -- checkpoint (rides the controller's utils/checkpoint npz) -----------
     def state_arrays(self) -> dict[str, np.ndarray]:
-        tasks = sorted(self.backlog.values(), key=lambda t: t.file_index)
+        """The backlog columns verbatim (already file-index-sorted — the
+        legacy checkpoint order, with no re-sort and no per-task
+        objects)."""
+        bl = self.backlog
         return {
-            "repair_file_index": np.asarray(
-                [t.file_index for t in tasks], dtype=np.int64),
-            "repair_attempts": np.asarray(
-                [t.attempts for t in tasks], dtype=np.int64),
-            "repair_next_window": np.asarray(
-                [t.next_window for t in tasks], dtype=np.int64),
-            "repair_stalled": np.asarray(
-                [t.stalled for t in tasks], dtype=np.int64),
-            "repair_stall_until": np.asarray(
-                [t.stall_until for t in tasks], dtype=np.int64),
+            "repair_file_index": bl.fid.copy(),
+            "repair_attempts": bl.attempts.copy(),
+            "repair_next_window": bl.next_window.copy(),
+            "repair_stalled": bl.stalled.copy(),
+            "repair_stall_until": bl.stall_until.copy(),
         }
 
     def load_state_arrays(self, arrays: dict) -> None:
@@ -319,10 +544,9 @@ class RepairScheduler:
                 f"repair backlog arrays disagree on length: "
                 f"{fid.shape} vs {att.shape} vs {nxt.shape} vs "
                 f"{stl.shape} vs {unt.shape}")
-        self.backlog = {
-            int(fid[i]): RepairTask(int(fid[i]), attempts=int(att[i]),
-                                    next_window=int(nxt[i]),
-                                    stalled=int(stl[i]),
-                                    stall_until=int(unt[i]))
-            for i in range(fid.shape[0])
-        }
+        # Checkpoints are written file-index-sorted; re-canonicalize
+        # defensively so a hand-edited snapshot cannot corrupt the
+        # searchsorted membership lookups.
+        order = np.argsort(fid, kind="stable")
+        self.backlog = RepairBacklog(fid[order], att[order], nxt[order],
+                                     stl[order], unt[order])

@@ -94,6 +94,45 @@ class ClusterState:
         self._recompute_node_bytes()
         #: Bumped on every mutation — cache-invalidation for evaluators.
         self.version = 0
+        #: Incrementally maintained per-file counts (the control-plane
+        #: scaling contract): ``live_counts``/``reachable_counts``/
+        #: ``domain_spread`` are O(1) cache reads per window instead of
+        #: O(files x nodes) mask reductions, and mutations refresh ONLY
+        #: the touched rows — a fault event's cost scales with the files
+        #: holding the affected node (its failure domain's blast radius),
+        #: not with the cluster.
+        self._refresh_all()
+
+    # -- cached per-file counts ----------------------------------------------
+    def _refresh_all(self) -> None:
+        live = self.live_mask()
+        reach = self.reachable_mask()
+        self._live_counts = live.sum(axis=1).astype(np.int32)
+        self._reach_counts = reach.sum(axis=1).astype(np.int32)
+        slot_dom = self.domain_index[np.clip(self.replica_map, 0, None)]
+        spread = np.zeros(self.replica_map.shape[0], dtype=np.int32)
+        for d in range(self.n_domains):
+            spread += ((slot_dom == d) & reach).any(axis=1)
+        self._dom_spread = spread
+
+    def _refresh_files(self, fids: np.ndarray) -> None:
+        """Recompute the cached counts for a row subset (the files a
+        mutation touched) — O(|subset| x nodes), not O(files x nodes)."""
+        fids = np.asarray(fids, dtype=np.int64)
+        if fids.size == 0:
+            return
+        rows = self.replica_map[fids]
+        safe = np.clip(rows, 0, None)
+        assigned = rows >= 0
+        self._live_counts[fids] = (assigned
+                                   & self.node_up[safe]).sum(axis=1)
+        rmask = assigned & self.node_reachable()[safe]
+        self._reach_counts[fids] = rmask.sum(axis=1)
+        dom = self.domain_index[safe]
+        spread = np.zeros(fids.shape[0], dtype=np.int32)
+        for d in range(self.n_domains):
+            spread += ((dom == d) & rmask).any(axis=1)
+        self._dom_spread[fids] = spread
 
     def _recompute_node_bytes(self) -> None:
         self.node_bytes = np.zeros(len(self.nodes), dtype=np.int64)
@@ -161,12 +200,10 @@ class ClusterState:
                 and int(self.ec_k[fid]) == int(ec_k))
         if same:
             return self.apply_rf_target(fid, target)
-        # Per-row reachability: the full (n_files, n_nodes) mask would
-        # make the controller's reconcile loop quadratic while
-        # conversions stay deferred.
-        r = self.replica_map[fid]
-        reach = int(((r >= 0)
-                     & self.node_reachable()[np.clip(r, 0, None)]).sum())
+        # Per-row reachability from the maintained cache: the full
+        # (n_files, n_nodes) mask would make the controller's reconcile
+        # loop quadratic while conversions stay deferred.
+        reach = int(self._reach_counts[fid])
         if reach < int(self.min_live[fid]) \
                 or self.n_available < int(min_live):
             return 0
@@ -212,11 +249,22 @@ class ClusterState:
             raise ValueError(
                 f"unknown node {node!r} (topology: {self.nodes})") from None
 
+    #: Event kinds that change liveness/reachability (and therefore the
+    #: cached counts of the files holding the node); flaky/degrade kinds
+    #: touch neither the replica map nor the masks.
+    _COUNT_KINDS = ("crash", "recover", "decommission", "partition", "heal")
+
     def apply_event(self, ev) -> None:
         """Apply one FaultEvent (faults/schedule.py); partition/heal groups
-        (``dn2+dn3``) apply to every member atomically."""
+        (``dn2+dn3``) apply to every member atomically.  The cached counts
+        refresh only for files holding an affected node — the blast
+        radius, not the cluster."""
+        affected: list[np.ndarray] = []
         for name in ev.node_list:
             i = self._nid(name)
+            if ev.kind in self._COUNT_KINDS:
+                affected.append(np.flatnonzero(
+                    (self.replica_map == i).any(axis=1)))
             if ev.kind == "crash":
                 self.node_up[i] = False
             elif ev.kind == "recover":
@@ -242,6 +290,8 @@ class ClusterState:
                 self.node_throughput[i] = 1.0
             else:  # pragma: no cover - FaultEvent validates kinds
                 raise ValueError(f"unknown fault kind {ev.kind!r}")
+        if affected:
+            self._refresh_files(np.unique(np.concatenate(affected)))
         self.version += 1
 
     def node_reachable(self) -> np.ndarray:
@@ -277,20 +327,18 @@ class ClusterState:
         return (rm >= 0) & self.node_reachable()[np.clip(rm, 0, None)]
 
     def live_counts(self) -> np.ndarray:
-        return self.live_mask().sum(axis=1).astype(np.int32)
+        """(n,) int32 live replicas per file — a copy of the maintained
+        cache (callers may scratch on it, the legacy repair loop did)."""
+        return self._live_counts.copy()
 
     def reachable_counts(self) -> np.ndarray:
-        return self.reachable_mask().sum(axis=1).astype(np.int32)
+        """(n,) int32 reachable replicas per file (cached copy)."""
+        return self._reach_counts.copy()
 
     def domain_spread(self) -> np.ndarray:
         """(n,) int32: distinct failure domains holding a REACHABLE replica
-        of each file."""
-        reach = self.reachable_mask()
-        slot_dom = self.domain_index[np.clip(self.replica_map, 0, None)]
-        counts = np.zeros(self.replica_map.shape[0], dtype=np.int32)
-        for d in range(self.n_domains):
-            counts += ((slot_dom == d) & reach).any(axis=1)
-        return counts
+        of each file (cached copy)."""
+        return self._dom_spread.copy()
 
     def effective_target(self, target_rf: np.ndarray) -> np.ndarray:
         return np.minimum(np.asarray(target_rf, dtype=np.int64),
@@ -304,17 +352,22 @@ class ClusterState:
         fids = np.flatnonzero(reach < eff)
         return fids, reach, eff
 
-    def correlated_mask(self, target_rf: np.ndarray) -> np.ndarray:
+    def correlated_mask(self, target_rf: np.ndarray, *,
+                        reach: np.ndarray | None = None,
+                        eff: np.ndarray | None = None) -> np.ndarray:
         """(n,) bool: files whose >= 2 reachable replicas ALL share one
         failure domain while a second domain is reachable and the target
         wants >= 2 — one rack/switch failure from unavailability.  An
         overlay, not a tier: a file can be under-replicated AND
-        correlated."""
+        correlated.  ``reach``/``eff`` let per-window callers reuse
+        already-derived arrays instead of re-deriving 10M-row copies."""
         if self.n_domains < 2 or self.domains_reachable() < 2:
             return np.zeros(self.replica_map.shape[0], dtype=bool)
-        reach = self.reachable_counts()
-        eff = self.effective_target(target_rf)
-        return (reach >= 2) & (self.domain_spread() == 1) & (eff >= 2)
+        if reach is None:
+            reach = self._reach_counts
+        if eff is None:
+            eff = self.effective_target(target_rf)
+        return (reach >= 2) & (self._dom_spread == 1) & (eff >= 2)
 
     def durability(self, target_rf: np.ndarray, cat: np.ndarray,
                    categories) -> dict:
@@ -329,8 +382,8 @@ class ClusterState:
         ``correlated_mask``).  ``cat`` uses -1 for not-yet-planned files,
         bucketed as "Unplanned".
         """
-        live = self.live_counts()
-        reach = self.reachable_counts()
+        live = self._live_counts      # read-only below: no copies
+        reach = self._reach_counts
         eff = self.effective_target(target_rf)
         # Shard-generalized tiers (storage/strategy.py arithmetic): a
         # file needs ``min_live`` shards to exist at all (1 full copy,
@@ -359,7 +412,8 @@ class ClusterState:
             "at_risk": int(at_risk.sum()),
             "unreachable": int(unreachable.sum()),
             "lost": int(lost.sum()),
-            "correlated_risk": int(self.correlated_mask(target_rf).sum()),
+            "correlated_risk": int(self.correlated_mask(
+                target_rf, reach=reach, eff=eff).sum()),
             "per_category": per,
         }
 
@@ -416,6 +470,7 @@ class ClusterState:
             raise RuntimeError(f"file {fid} has no free replica slot")
         row[free[0]] = node
         self.node_bytes[node] += self.shard_bytes[fid]
+        self._refresh_files(np.asarray([fid]))
         self.version += 1
 
     def drop_replica(self, fid: int, node: int) -> None:
@@ -424,6 +479,7 @@ class ClusterState:
         if slots.size:
             row[slots[0]] = -1
             self.node_bytes[node] -= self.shard_bytes[fid]
+            self._refresh_files(np.asarray([fid]))
             self.version += 1
 
     def _drop_order(self, fid: int, holders: list[int]) -> list[int]:
@@ -464,7 +520,7 @@ class ClusterState:
         if record_intent:
             self.installed_shards[fid] = int(rf_new)
         target = min(int(rf_new), self.n_available)
-        live = int((self.reachable_mask()[fid]).sum())
+        live = int(self._reach_counts[fid])
         delta = 0
         if live < int(self.min_live[fid]):
             # No reachable source to copy/reconstruct from (a replicate
@@ -504,9 +560,10 @@ class ClusterState:
         already re-created) — free metadata deletes, HDFS's excess-replica
         pruning, crowded-domain-first so the trim never collapses the
         spread.  Returns files trimmed."""
-        reach = self.reachable_counts()
         eff = self.effective_target(target_rf)
-        over = np.flatnonzero(reach > eff)
+        # flatnonzero evaluates eagerly, so reading the cache in place is
+        # safe even though apply_rf_target refreshes it row by row below.
+        over = np.flatnonzero(self._reach_counts > eff)
         for fid in over:
             # The trim's capped target is NOT a new intent — the file's
             # installed_shards must survive a transient excess.
@@ -589,4 +646,5 @@ class ClusterState:
                        np.maximum((rm >= 0).sum(axis=1), self.min_live)),
             dtype=np.int32).copy()
         self._recompute_node_bytes()
+        self._refresh_all()
         self.version += 1

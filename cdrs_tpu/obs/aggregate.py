@@ -16,7 +16,7 @@ from __future__ import annotations
 __all__ = ["collect", "span_forest", "ordered_span_paths", "percentile",
            "bucket_percentile", "merge_hist_buckets", "dedup_windows",
            "final_counters", "roofline_rows", "fmt_bytes", "serve_digest",
-           "storage_digest"]
+           "storage_digest", "pacing_digest"]
 
 
 def fmt_bytes(b, sep: str = " ") -> str:
@@ -299,6 +299,31 @@ def storage_digest(windows: list[dict]) -> dict | None:
         "per_category_bytes_final": dict(
             last.get("per_category_bytes") or {}),
     }
+
+
+def pacing_digest(windows: list[dict]) -> dict | None:
+    """End-to-end pacing digest over window records carrying the PR-8
+    per-window ``seconds`` dict: windows per second of host wall-clock
+    plus the planning slice of it (the SoA control-plane observable).
+    None when no window carries timing, so older streams render
+    unchanged.  The plan fraction is computed over the windows that
+    RECORD a plan slice — a stream resumed across the PR-8 boundary must
+    not dilute the fraction with untimed windows."""
+    secs = [w["seconds"] for w in windows
+            if isinstance(w.get("seconds"), dict)
+            and w["seconds"].get("total")]
+    total = sum(s["total"] for s in secs)
+    if not secs or total <= 0:
+        return None
+    out = {"windows": len(secs),
+           "windows_per_sec": len(secs) / total}
+    plan = [float(s["plan"]) for s in secs if "plan" in s]
+    if plan:
+        plan_total = sum(s["total"] for s in secs if "plan" in s)
+        out["plan_p50_seconds"] = percentile(plan, 0.5)
+        out["plan_seconds_fraction"] = (sum(plan) / plan_total
+                                        if plan_total > 0 else 0.0)
+    return out
 
 
 def roofline_rows(digest: dict, peak_flops: float | None = None,

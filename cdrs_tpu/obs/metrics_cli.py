@@ -38,6 +38,7 @@ from .aggregate import (
     fmt_bytes,
     merge_hist_buckets,
     ordered_span_paths,
+    pacing_digest,
     percentile,
     roofline_rows,
     serve_digest,
@@ -288,6 +289,15 @@ def summarize_events(events: list[dict], out=None, peak_flops=None,
         moved = sum(int(w.get("bytes_migrated", 0)) for w in windows)
         print(f"\nController windows: {len(windows)} ({n_events} events, "
               f"{len(recl)} reclusters, {moved} bytes migrated)", file=out)
+        pacing = pacing_digest(windows)
+        if pacing:
+            line = f"End-to-end: {pacing['windows_per_sec']:.3f} windows/sec"
+            if "plan_p50_seconds" in pacing:
+                line += (f" (plan p50 "
+                         f"{pacing['plan_p50_seconds'] * 1e3:.2f} ms/window, "
+                         f"{pacing['plan_seconds_fraction']:.1%} "
+                         f"of host time)")
+            print(line, file=out)
 
 
 # -- export ------------------------------------------------------------------

@@ -1,0 +1,402 @@
+"""PR 8 equivalence: the SoA planners vs the legacy object path.
+
+The vectorized control plane (control/migrate.py, faults/repair.py) must be
+DECISION-IDENTICAL to the object-at-a-time implementations it replaced —
+admitted/deferred sets, ordering, byte accounting, backoff state, the lot.
+The legacy path survives verbatim in ``cdrs_tpu/compat/reference_planners``
+as the oracle; this module drives both over random scenarios and asserts
+bit-identity, plus checkpoint round-trips mid-backlog.
+
+``CDRS_CHAOS_SEED`` varies every rng below — CI sweeps it over 0/1/2 so
+the equivalence is not a single-seed accident.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.cluster import ClusterTopology, place_replicas
+from cdrs_tpu.compat.reference_planners import (
+    ReferenceMigrationScheduler,
+    ReferenceRepairScheduler,
+    reference_plan_diff,
+)
+from cdrs_tpu.config import GeneratorConfig
+from cdrs_tpu.control.migrate import (
+    MigrationScheduler,
+    MoveSet,
+    PlanMove,
+    plan_diff,
+)
+from cdrs_tpu.faults import ClusterState, FaultEvent, RepairScheduler
+from cdrs_tpu.sim.generator import generate_population
+
+SEED = int(os.environ.get("CDRS_CHAOS_SEED", "0"))
+
+NODES = ("dn1", "dn2", "dn3", "dn4", "dn5", "dn6")
+RACKS = {"dn1": "r0", "dn2": "r0", "dn3": "r1", "dn4": "r1",
+         "dn5": "r2", "dn6": "r2"}
+
+
+# -- scenario generators -----------------------------------------------------
+
+def _random_plan(rng, n):
+    """A random target plan: rf/category vectors plus tie-heavy priorities
+    (quantized so the file-index tiebreak is actually exercised)."""
+    rf = rng.integers(1, 5, size=n).astype(np.int64)
+    cat = rng.integers(0, 4, size=n).astype(np.int64)
+    prio = np.round(rng.normal(size=n), 1)
+    return rf, cat, prio
+
+
+def _random_budget(rng, total_bytes):
+    """(max_bytes, max_files) drawn across the regimes the admission loop
+    branches on: unbounded, frozen, starving, loose."""
+    max_bytes = rng.choice(
+        [None, 0, int(total_bytes * 0.01) + 1,
+         int(total_bytes * 0.2) + 1, int(total_bytes * 2) + 1])
+    max_files = rng.choice([None, 1, 3, 17, 1000])
+    return (None if max_bytes is None else int(max_bytes),
+            None if max_files is None else int(max_files))
+
+
+def _moves_tuples(moves):
+    """Canonical per-move tuples from either a MoveSet or a PlanMove list."""
+    return [(m.file_index, m.rf_old, m.rf_new, m.cat_old, m.cat_new,
+             m.bytes_moved, m.priority) for m in moves]
+
+
+def _backlog_dict(sched):
+    """file -> move tuple, from either scheduler's backlog."""
+    if isinstance(sched.backlog, MoveSet):
+        return {t[0]: t for t in _moves_tuples(sched.backlog)}
+    return {f: (m.file_index, m.rf_old, m.rf_new, m.cat_old, m.cat_new,
+                m.bytes_moved, m.priority)
+            for f, m in sched.backlog.items()}
+
+
+# -- plan_diff ---------------------------------------------------------------
+
+@pytest.mark.parametrize("case", range(4))
+def test_plan_diff_matches_reference(case):
+    rng = np.random.default_rng(900 + 10 * SEED + case)
+    n = int(rng.integers(5, 300))
+    rf_old, cat_old, _ = _random_plan(rng, n)
+    rf_new, cat_new, prio = _random_plan(rng, n)
+    sizes = rng.integers(1, 1 << 20, size=n).astype(np.int64)
+    move_bytes = (rng.integers(0, 1 << 18, size=n).astype(np.int64)
+                  if case % 2 else None)
+    got = plan_diff(rf_old, rf_new, cat_old, cat_new, sizes,
+                    priority=prio, move_bytes=move_bytes)
+    want = reference_plan_diff(rf_old, rf_new, cat_old, cat_new, sizes,
+                               priority=prio, move_bytes=move_bytes)
+    assert _moves_tuples(got) == _moves_tuples(want)
+
+
+def test_plan_diff_validates_shapes():
+    with pytest.raises(ValueError, match="rf_new shape"):
+        plan_diff(np.zeros(3, np.int64), np.zeros(2, np.int64),
+                  np.zeros(3, np.int64), np.zeros(3, np.int64),
+                  np.ones(3, np.int64))
+    with pytest.raises(ValueError, match="move_bytes shape"):
+        plan_diff(np.zeros(3, np.int64), np.ones(3, np.int64),
+                  np.zeros(3, np.int64), np.zeros(3, np.int64),
+                  np.ones(3, np.int64), move_bytes=np.ones(2, np.int64))
+
+
+# -- migration scheduler -----------------------------------------------------
+
+def _run_migration_pair(rng, n, windows, *, resume_at=None):
+    """Drive the vectorized and reference schedulers through ``windows``
+    random windows (fresh plans land at random windows, reservations vary)
+    and assert bit-identity at every step.  ``resume_at`` checkpoints the
+    vectorized scheduler through state_arrays at that window and continues
+    on the restored copy — the kill/resume-mid-backlog contract."""
+    sizes = rng.integers(0, 1 << 20, size=n).astype(np.int64)
+    hyst = int(rng.integers(0, 3))
+    rf0, cat0, _ = _random_plan(rng, n)
+    total = int(sizes.sum()) * 2
+    max_bytes, max_files = _random_budget(rng, total)
+    vec = MigrationScheduler(n, max_bytes_per_window=max_bytes,
+                             max_files_per_window=max_files,
+                             hysteresis_windows=hyst)
+    ref = ReferenceMigrationScheduler(n, max_bytes_per_window=max_bytes,
+                                      max_files_per_window=max_files,
+                                      hysteresis_windows=hyst)
+    applied_rf, applied_cat = rf0.copy(), cat0.copy()
+    for w in range(windows):
+        if w == 0 or rng.random() < 0.5:
+            rf_new, cat_new, prio = _random_plan(rng, n)
+            moves = plan_diff(applied_rf, rf_new, applied_cat, cat_new,
+                              sizes, priority=prio)
+            vec.submit(moves)
+            ref.submit(list(moves))
+        bres = int(rng.integers(0, total // 4 + 1)) if rng.random() < 0.4 \
+            else 0
+        fres = int(rng.integers(0, 5)) if rng.random() < 0.4 else 0
+        got = vec.schedule(w, bytes_reserved=bres, files_reserved=fres)
+        want = ref.schedule(w, bytes_reserved=bres, files_reserved=fres)
+        assert _moves_tuples(got) == _moves_tuples(want), f"window {w}"
+        assert vec.last_deferred_hysteresis == ref.last_deferred_hysteresis
+        assert vec.last_deferred_budget == ref.last_deferred_budget
+        assert _backlog_dict(vec) == _backlog_dict(ref)
+        assert vec.backlog_bytes == ref.backlog_bytes
+        np.testing.assert_array_equal(vec.last_moved, ref.last_moved)
+        for m in got:
+            applied_rf[m.file_index] = m.rf_new
+            applied_cat[m.file_index] = m.cat_new
+        if resume_at is not None and w == resume_at:
+            arrays = {k: v for k, v in vec.state_arrays().items()}
+            # Round-trip through the npz dtypes a checkpoint would carry.
+            restored = MigrationScheduler(
+                n, max_bytes_per_window=max_bytes,
+                max_files_per_window=max_files, hysteresis_windows=hyst)
+            restored.load_state_arrays(arrays)
+            assert _backlog_dict(restored) == _backlog_dict(vec)
+            np.testing.assert_array_equal(restored.last_moved,
+                                          vec.last_moved)
+            vec = restored
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_migration_scheduler_matches_reference(case):
+    rng = np.random.default_rng(3000 + 100 * SEED + case)
+    n = int(rng.integers(20, 400))
+    _run_migration_pair(rng, n, windows=8)
+
+
+def test_migration_resume_mid_backlog_is_bit_identical():
+    rng = np.random.default_rng(4100 + SEED)
+    _run_migration_pair(rng, 200, windows=10, resume_at=4)
+
+
+def test_submit_duplicate_files_keep_last_like_reference():
+    """The legacy dict backlog kept the LAST submitted move per file —
+    a hand-built move list with duplicate file indices must behave
+    identically on the SoA path (no double byte-charge, no conflicting
+    rf targets)."""
+    moves = [PlanMove(file_index=5, rf_old=1, rf_new=2, cat_old=0,
+                      cat_new=1, bytes_moved=100, priority=2.0),
+             PlanMove(file_index=3, rf_old=1, rf_new=3, cat_old=0,
+                      cat_new=2, bytes_moved=50, priority=1.0),
+             PlanMove(file_index=5, rf_old=1, rf_new=3, cat_old=0,
+                      cat_new=2, bytes_moved=200, priority=0.5)]
+    vec = MigrationScheduler(10, max_bytes_per_window=10_000)
+    ref = ReferenceMigrationScheduler(10, max_bytes_per_window=10_000)
+    vec.submit(moves)
+    ref.submit(moves)
+    assert len(vec.backlog) == 2
+    assert _backlog_dict(vec) == _backlog_dict(ref)
+    got = vec.schedule(0)
+    want = ref.schedule(0)
+    assert _moves_tuples(got) == _moves_tuples(want)
+    assert [m.rf_new for m in got] == [3, 3]  # file 5's LAST row won
+
+
+def test_migration_checkpoint_preserves_admission_order():
+    """state_arrays dumps the backlog verbatim (admission order) and load
+    re-canonicalizes — including a legacy file-index-ordered dump."""
+    sched = MigrationScheduler(50, max_bytes_per_window=10_000)
+    rng = np.random.default_rng(7 + SEED)
+    rf_old, cat_old, _ = _random_plan(rng, 50)
+    rf_new, cat_new, prio = _random_plan(rng, 50)
+    sizes = rng.integers(1, 1 << 10, size=50).astype(np.int64)
+    sched.submit(plan_diff(rf_old, rf_new, cat_old, cat_new, sizes,
+                           priority=prio))
+    arrays = sched.state_arrays()
+    order = np.lexsort((arrays["sched_file_index"],
+                        -arrays["sched_priority"]))
+    np.testing.assert_array_equal(order, np.arange(len(order)))
+    # A legacy checkpoint stored rows by file index: same backlog after load.
+    legacy_order = np.argsort(arrays["sched_file_index"])
+    legacy = {k: v[legacy_order] if k != "sched_last_moved" else v
+              for k, v in arrays.items()}
+    a, b = MigrationScheduler(50), MigrationScheduler(50)
+    a.load_state_arrays(arrays)
+    b.load_state_arrays(legacy)
+    assert _backlog_dict(a) == _backlog_dict(b)
+    np.testing.assert_array_equal(a.backlog.file_index, b.backlog.file_index)
+
+
+# -- repair scheduler --------------------------------------------------------
+
+def _mk_state(n, rng):
+    manifest = generate_population(
+        GeneratorConfig(n_files=n, seed=int(rng.integers(1 << 16)),
+                        nodes=NODES))
+    topo = ClusterTopology.from_racks(NODES, RACKS)
+    rf = rng.integers(1, 4, size=n).astype(np.int32)
+    placement = place_replicas(manifest, rf, topo, seed=0)
+    return (ClusterState(placement, manifest.size_bytes),
+            rf.astype(np.int64))
+
+
+def _random_fault(rng, w):
+    kind = rng.choice(["crash", "recover", "partition", "heal", "flaky",
+                       "unflaky", "degrade", "restore"])
+    if kind in ("partition", "heal"):
+        k = int(rng.integers(1, 3))
+        nodes = "+".join(sorted(rng.choice(NODES, size=k, replace=False)))
+        return FaultEvent(w, kind, nodes)
+    node = str(rng.choice(NODES))
+    if kind == "flaky":
+        return FaultEvent(w, kind, node,
+                          fail_prob=float(rng.choice([0.3, 0.6, 0.9])))
+    if kind == "degrade":
+        return FaultEvent(w, kind, node,
+                          factor=float(rng.choice([0.25, 0.5])))
+    return FaultEvent(w, kind, node)
+
+
+def _rep_tuple(rep):
+    return (rep.applied, rep.bytes_used, rep.bytes_copied,
+            rep.files_touched, rep.failed, rep.rebalanced,
+            rep.deferred_budget, rep.deferred_backoff,
+            rep.deferred_no_source, rep.deferred_no_target,
+            rep.deferred_partition)
+
+
+def _repair_backlog_dict(sched):
+    return {int(f): (t.attempts, t.next_window, t.stalled, t.stall_until)
+            for f, t in sched.backlog.items()}
+
+
+def _run_repair_pair(rng, n, windows, *, resume_at=None):
+    """Two identical ClusterStates take the same fault stream; the
+    vectorized and reference repair schedulers drive one each.  Reports,
+    backlogs, and the mutated placements must stay bit-identical."""
+    import copy
+
+    st_vec, rf = _mk_state(n, rng)
+    # An identical, independent state for the reference planner.
+    st_ref = copy.deepcopy(st_vec)
+
+    cat = rng.integers(0, 4, size=n).astype(np.int64)
+    total = int(st_vec.sizes.sum())
+    max_bytes, max_files = _random_budget(rng, total // 2)
+    vec = RepairScheduler(seed=SEED)
+    ref = ReferenceRepairScheduler(seed=SEED)
+    for w in range(windows):
+        n_ev = int(rng.integers(0, 3))
+        for _ in range(n_ev):
+            ev = _random_fault(rng, w)
+            st_vec.apply_event(ev)
+            st_ref.apply_event(ev)
+        vec.sync(st_vec, rf)
+        ref.sync(st_ref, rf)
+        assert _repair_backlog_dict(vec) == _repair_backlog_dict(ref), \
+            f"window {w} post-sync"
+        got = vec.schedule(w, st_vec, rf, cat, max_bytes=max_bytes,
+                           max_files=max_files)
+        want = ref.schedule(w, st_ref, rf, cat, max_bytes=max_bytes,
+                            max_files=max_files)
+        assert _rep_tuple(got) == _rep_tuple(want), f"window {w}"
+        assert _repair_backlog_dict(vec) == _repair_backlog_dict(ref), \
+            f"window {w} post-schedule"
+        np.testing.assert_array_equal(st_vec.replica_map,
+                                      st_ref.replica_map,
+                                      err_msg=f"window {w}")
+        np.testing.assert_array_equal(st_vec.node_bytes, st_ref.node_bytes)
+        if resume_at is not None and w == resume_at:
+            restored = RepairScheduler(seed=SEED)
+            restored.load_state_arrays(vec.state_arrays())
+            assert _repair_backlog_dict(restored) == _repair_backlog_dict(
+                vec)
+            vec = restored
+
+
+@pytest.mark.parametrize("case", range(4))
+def test_repair_scheduler_matches_reference(case):
+    rng = np.random.default_rng(5000 + 100 * SEED + case)
+    n = int(rng.integers(20, 150))
+    _run_repair_pair(rng, n, windows=10)
+
+
+def test_repair_resume_mid_outage_is_bit_identical():
+    rng = np.random.default_rng(6200 + SEED)
+    _run_repair_pair(rng, 80, windows=12, resume_at=5)
+
+
+def test_repair_scheduler_matches_reference_with_ec():
+    """Same equivalence with EC stripes in the mix (k-shard reconstruction
+    charges, min_live existence thresholds)."""
+    rng = np.random.default_rng(7300 + SEED)
+    n = 60
+    st_vec, rf = _mk_state(n, rng)
+    import copy
+
+    # EC-ify a random third of the files on BOTH states identically.
+    ec_files = rng.choice(n, size=n // 3, replace=False)
+    for f in ec_files:
+        f = int(f)
+        shard = max(int(st_vec.sizes[f]) // 2, 1)
+        st_vec.set_file_strategy(f, 2, shard, 2)
+        rf[f] = 3  # ec(2,1): 3 shards
+    st_ref = copy.deepcopy(st_vec)
+    cat = rng.integers(0, 4, size=n).astype(np.int64)
+    vec, ref = RepairScheduler(seed=SEED), ReferenceRepairScheduler(
+        seed=SEED)
+    for w in range(8):
+        for _ in range(int(rng.integers(0, 3))):
+            ev = _random_fault(rng, w)
+            st_vec.apply_event(ev)
+            st_ref.apply_event(ev)
+        vec.sync(st_vec, rf)
+        ref.sync(st_ref, rf)
+        got = vec.schedule(w, st_vec, rf, cat, max_bytes=200_000,
+                           max_files=6)
+        want = ref.schedule(w, st_ref, rf, cat, max_bytes=200_000,
+                            max_files=6)
+        assert _rep_tuple(got) == _rep_tuple(want), f"window {w}"
+        np.testing.assert_array_equal(st_vec.replica_map,
+                                      st_ref.replica_map)
+
+
+def test_repair_pathological_rf_lexsort_fallback_terminates():
+    """rf magnitudes large enough to overflow the packed int64 admission
+    key route through the explicit lexsort fallback; an UNBUDGETED run
+    through it must terminate (the fallback chunk is handed out exactly
+    once) and still match the reference planner."""
+    import copy
+
+    rng = np.random.default_rng(9500 + SEED)
+    st_vec, _ = _mk_state(12, rng)
+    st_ref = copy.deepcopy(st_vec)
+    # (3*span + span) * n_files >= 2^62 with span = rf.max() + 1.
+    rf = np.full(12, np.int64(2) ** 60, dtype=np.int64)
+    cat = rng.integers(0, 4, size=12).astype(np.int64)
+    ev = FaultEvent(0, "crash", NODES[0])
+    st_vec.apply_event(ev)
+    st_ref.apply_event(ev)
+    vec, ref = RepairScheduler(seed=SEED), ReferenceRepairScheduler(
+        seed=SEED)
+    vec.sync(st_vec, rf)
+    ref.sync(st_ref, rf)
+    got = vec.schedule(0, st_vec, rf, cat, max_bytes=None, max_files=None)
+    want = ref.schedule(0, st_ref, rf, cat, max_bytes=None,
+                        max_files=None)
+    assert _rep_tuple(got) == _rep_tuple(want)
+    np.testing.assert_array_equal(st_vec.replica_map, st_ref.replica_map)
+
+
+def test_cached_counts_match_mask_reductions():
+    """ClusterState's incrementally maintained counts equal the full mask
+    reductions after an arbitrary mutation stream."""
+    rng = np.random.default_rng(8400 + SEED)
+    st, rf = _mk_state(40, rng)
+    for w in range(12):
+        for _ in range(int(rng.integers(0, 3))):
+            st.apply_event(_random_fault(rng, w))
+        f = int(rng.integers(0, 40))
+        st.apply_rf_target(f, int(rng.integers(1, 4)))
+        np.testing.assert_array_equal(
+            st.live_counts(), st.live_mask().sum(axis=1))
+        np.testing.assert_array_equal(
+            st.reachable_counts(), st.reachable_mask().sum(axis=1))
+        slot_dom = st.domain_index[np.clip(st.replica_map, 0, None)]
+        reach = st.reachable_mask()
+        spread = np.zeros(40, dtype=np.int32)
+        for d in range(st.n_domains):
+            spread += ((slot_dom == d) & reach).any(axis=1)
+        np.testing.assert_array_equal(st.domain_spread(), spread)
