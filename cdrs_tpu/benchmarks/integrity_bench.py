@@ -1,0 +1,438 @@
+"""Integrity baselines: mean-time-to-detect vs scrub budget, and what rot
+costs without scrubbing.
+
+The data-integrity counterpart of chaos_bench.py.  Three scenario
+families over the 5-node topology:
+
+**MTTD vs scrub budget** (``run_mttd_sweep``): a silent-corruption event
+rots every copy on one node mid-run; the background scrubber
+(faults/scrub.py) is the only detector (serve off, no node failures).
+For each budget in the sweep (fractions of the worst-case full-lap scan
+bytes) the bench measures the per-copy detection latency in windows and
+checks it against the budget-implied bound: a round-robin scan spending
+``B`` bytes/window over a population whose lap costs at most ``L`` bytes
+must touch every copy within ``ceil(L / B) + 1`` windows (+1 for cursor
+alignment).  All injected corruptions must be detected within the bound
+at every budget.
+
+**Rot + kill overlap** (``run_overlap_bench``): rot lands at one window,
+a node holding the clean second copies dies a few windows later — the
+race scrubbing exists to win.  Scrubbed + verified-read side: detection
+and verified repair heal every file before the kill — zero true losses,
+zero corrupt reads served.  Unscrubbed + unverified side (the baseline
+production systems without a scanner actually run): garbage goes out on
+the read path (``reads_corrupt_served``) and the kill turns latent rot
+into permanent ground-truth loss (``true_lost``), while the blind
+durability tiers never report more than the truth.  A mid-scrub
+kill/resume of the scrubbed side must be bit-identical (scrub cursor +
+hint queue + rot masks ride the npz checkpoint).
+
+**Telemetry overhead** (``integrity_overhead``): the interleaved paired
+methodology (chaos_bench lineage) with the corrupt fault, the scrubber
+and the integrity record accounting active on BOTH sides — scrub
+accounting must keep telemetry inside the repo's ≤ 1.05x budget.
+
+``python -m cdrs_tpu.benchmarks.integrity_bench`` writes
+``data/integrity_bench.json``; ``--quick`` shrinks sizes for the CI
+smoke.  The round-9 bench_record (detection-margin ratio at the half-lap
+budget) is appended to ``data/bench_history.jsonl`` manually (the
+append-only contract — ``regress --ingest`` re-sorts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+
+from ..config import (
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from ..control import ControllerConfig, ReplicationController
+from ..faults import FaultSchedule, ScrubConfig
+from ..sim.access import simulate_access
+from ..sim.generator import generate_population
+
+__all__ = ["run_mttd_sweep", "run_overlap_bench", "integrity_overhead"]
+
+_NODES = ("dn1", "dn2", "dn3", "dn4", "dn5")
+
+
+def _min_rf2_scoring():
+    """validated scoring with every category at rf >= 2: one rotten copy
+    is always recoverable from a clean peer (rf=1 singletons would rot
+    unrecoverably by construction and muddy the loss accounting)."""
+    base = validated_scoring_config()
+    return dataclasses.replace(
+        base, replication_factors={c: max(2, r) for c, r in
+                                   base.replication_factors.items()})
+
+
+def _strip(records: list[dict]) -> list[dict]:
+    return [{k: v for k, v in r.items() if k != "seconds"} for r in records]
+
+
+def _lap_upper_bytes(manifest, scoring, default_rf: int) -> int:
+    """Worst-case bytes of one full scrub lap: every file at the largest
+    rf the scoring table (or the default) can assign.  An upper bound —
+    the real per-window rf mix is below it — so the implied detection
+    bound is conservative, never flattering."""
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+    rf_max = max(max(scoring.replication_factors.values()), default_rf)
+    return int(sizes.sum()) * rf_max
+
+
+def run_mttd_sweep(
+    n_files: int = 400,
+    seed: int = 17,
+    duration: float = 1800.0,
+    n_windows: int = 15,
+    corrupt_window: int = 2,
+    k: int = 12,
+    budget_fracs: tuple[float, ...] = (0.125, 0.25, 0.5),
+) -> dict:
+    """Detection latency vs scrub budget (module docstring)."""
+    window_seconds = duration / n_windows
+    manifest = generate_population(
+        GeneratorConfig(n_files=n_files, seed=seed, nodes=_NODES))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=duration, seed=seed + 1))
+    scoring = _min_rf2_scoring()
+    lap = _lap_upper_bytes(manifest, scoring, default_rf=2)
+    schedule_specs = [f"corrupt:dn2@{corrupt_window}:1.0"]
+
+    sweep = []
+    for frac in budget_fracs:
+        budget = max(int(lap * frac), 1)
+        cfg = ControllerConfig(
+            window_seconds=window_seconds, default_rf=2,
+            hysteresis_windows=1, kmeans=KMeansConfig(k=k, seed=42),
+            scoring=scoring,
+            fault_schedule=FaultSchedule.from_specs(schedule_specs),
+            scrub=ScrubConfig(bytes_per_window=budget))
+        res = ReplicationController(manifest, cfg).run(events)
+        # Per-window detections: latency of a copy found at window w is
+        # w - corrupt_window + 1 (the scrub pass of the landing window
+        # counts as one window of scanning).
+        lat_counts: list[tuple[int, int]] = []
+        for r in res.records:
+            found = (r.get("scrub") or {}).get("corrupt_found", 0)
+            if found:
+                lat_counts.append(
+                    (int(r["window"]) - corrupt_window + 1, found))
+        detected = sum(c for _, c in lat_counts)
+        integ = res.summary()["integrity"]
+        bound = int(np.ceil(lap / budget)) + 1
+        max_lat = max((lw for lw, _ in lat_counts), default=None)
+        sweep.append({
+            "budget_bytes_per_window": budget,
+            "budget_lap_fraction": frac,
+            "bound_windows": bound,
+            "injected_detected": detected,
+            "residual_corrupt_final": integ["corrupt_copies_final"],
+            "true_lost_final": integ["true_lost_final"],
+            "mttd_mean_windows": round(
+                sum(lw * c for lw, c in lat_counts) / detected, 3)
+            if detected else None,
+            "mttd_max_windows": max_lat,
+            "detected_within_bound":
+                detected > 0 and integ["corrupt_copies_final"] == 0
+                and max_lat is not None and max_lat <= bound,
+            "scrub_bytes_total": integ["scrub_bytes_total"],
+        })
+    return {
+        "scenario": {
+            "n_files": n_files, "seed": seed, "nodes": list(_NODES),
+            "duration_seconds": duration, "n_windows": n_windows,
+            "window_seconds": window_seconds, "k": k,
+            "corrupt": schedule_specs[0], "default_rf": 2,
+            "lap_upper_bytes": lap,
+            "replication_factors": scoring.replication_factors,
+        },
+        "sweep": sweep,
+    }
+
+
+def run_overlap_bench(
+    n_files: int = 400,
+    seed: int = 17,
+    duration: float = 1800.0,
+    n_windows: int = 15,
+    corrupt_window: int = 2,
+    kill_window: int = 6,
+    k: int = 12,
+    resume_check: bool = True,
+) -> dict:
+    """Rot + node-kill overlap, scrubbed vs unscrubbed (module
+    docstring)."""
+    from ..serve import ServeConfig, SloSpec
+
+    window_seconds = duration / n_windows
+    manifest = generate_population(
+        GeneratorConfig(n_files=n_files, seed=seed, nodes=_NODES))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=duration, seed=seed + 1))
+    scoring = _min_rf2_scoring()
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+    lap = _lap_upper_bytes(manifest, scoring, default_rf=2)
+    specs = [f"corrupt:dn2@{corrupt_window}:1.0",
+             f"crash:dn3@{kill_window}"]
+    max_bytes = int(3 * float(sizes.sum()))  # repairs + scrub both fit
+
+    def mk(scrub_on: bool, verify: bool) -> ReplicationController:
+        cfg = ControllerConfig(
+            window_seconds=window_seconds, default_rf=2,
+            max_bytes_per_window=max_bytes, hysteresis_windows=1,
+            kmeans=KMeansConfig(k=k, seed=42), scoring=scoring,
+            fault_schedule=FaultSchedule.from_specs(specs),
+            serve=ServeConfig(policy="p2c", seed=0, service_ms=0.5,
+                              slo=SloSpec(target_ms=10.0,
+                                          availability=0.999),
+                              verify_reads=verify),
+            scrub=ScrubConfig(bytes_per_window=max(lap // 2, 1))
+            if scrub_on else None)
+        return ReplicationController(manifest, cfg)
+
+    def side(scrub_on: bool, verify: bool) -> tuple[dict, object]:
+        t0 = time.perf_counter()
+        res = mk(scrub_on, verify).run(events)
+        summ = res.summary()
+        integ = summ["integrity"]
+        timeline = [{
+            "window": r["window"], "fault_events": r["fault_events"],
+            "corrupt_copies": r["integrity"]["corrupt_copies"],
+            "true_lost": r["integrity"]["true_lost"],
+            "detected_scrub": r["integrity"]["detected_scrub"],
+            "detected_read": r["integrity"]["detected_read"],
+            "detected_repair": r["integrity"]["detected_repair"],
+            "reads_corrupt_served": r.get("reads_corrupt_served") or 0,
+            "lost_blind": r["durability"]["lost"],
+            "repair_moves": r["repair_moves"],
+        } for r in res.records]
+        return {
+            "timeline": timeline,
+            "true_lost_final": integ["true_lost_final"],
+            "true_lost_max": integ["true_lost_max"],
+            "corrupt_reads_served": integ["corrupt_reads_served"],
+            "detected_total": integ["detected_total"],
+            "detected_scrub": integ["detected_scrub"],
+            "detected_read": integ["detected_read"],
+            "blind_lost_final": summ["durability"]["lost_final"],
+            "run_seconds": round(time.perf_counter() - t0, 3),
+        }, res
+
+    scrubbed, sres = side(scrub_on=True, verify=True)
+    unscrubbed, _ = side(scrub_on=False, verify=False)
+
+    out: dict = {
+        "scenario": {
+            "n_files": n_files, "seed": seed, "nodes": list(_NODES),
+            "duration_seconds": duration, "n_windows": n_windows,
+            "window_seconds": window_seconds, "k": k,
+            "schedule": specs, "default_rf": 2,
+            "scrub_bytes_per_window": max(lap // 2, 1),
+            "max_bytes_per_window": max_bytes,
+            "replication_factors": scoring.replication_factors,
+        },
+        "scrubbed": scrubbed,
+        "unscrubbed": unscrubbed,
+    }
+
+    if resume_check:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            ck = os.path.join(td, "integrity.npz")
+            a = mk(True, True).run(events, checkpoint_path=ck,
+                                   max_windows=corrupt_window + 2)
+            b = mk(True, True).run(events, checkpoint_path=ck)
+            identical = (_strip(a.records) + _strip(b.records)
+                         == _strip(sres.records)
+                         and bool(np.array_equal(b.rf, sres.rf))
+                         and bool(np.array_equal(b.category_idx,
+                                                 sres.category_idx)))
+        out["kill_resume"] = {
+            "killed_after_window": corrupt_window + 1,
+            "bit_identical": identical,
+        }
+    return out
+
+
+def integrity_overhead(n_files: int = 8000, duration: float = 1440.0,
+                       window_seconds: float = 60.0,
+                       repeats: int = 9) -> dict:
+    """Telemetry wall-clock ratio with the INTEGRITY machinery active.
+
+    Interleaved paired rounds, best-window ratio (the repo's standard
+    noisy-host methodology): both sides run the corrupt fault, the
+    budgeted scrubber and per-window integrity records; the instrumented
+    side additionally streams ``scrub.*``/``integrity.*`` counters and
+    gauges, window records and audit events through the sink.  The
+    24-window run length keeps each sample several seconds long — at the
+    chaos_bench 8-window scale a single sample is ~2s and the shared
+    host's jitter exceeds the 5% effect being measured."""
+    import tempfile
+
+    from ..benchmarks.summary import TELEMETRY_OVERHEAD_BUDGET
+    from ..obs import JsonlSink, Telemetry
+
+    manifest = generate_population(
+        GeneratorConfig(n_files=n_files, seed=7, nodes=_NODES))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=duration, seed=8))
+    n_windows = int(duration // window_seconds)
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+    schedule = FaultSchedule.from_specs([
+        f"corrupt:dn2@{max(n_windows // 3, 1)}:0.2",
+        f"crash:dn4@{max(n_windows // 2, 2)}-{max(3 * n_windows // 4, 3)}",
+    ])
+
+    def mk() -> ReplicationController:
+        cfg = ControllerConfig(
+            window_seconds=window_seconds, default_rf=2,
+            kmeans=KMeansConfig(k=8, seed=42),
+            scoring=_min_rf2_scoring(),
+            fault_schedule=FaultSchedule(schedule.events),
+            scrub=ScrubConfig(bytes_per_window=int(sizes.sum()) // 4))
+        return ReplicationController(manifest, cfg)
+
+    def run_plain() -> float:
+        t0 = time.perf_counter()
+        mk().run(events)
+        return time.perf_counter() - t0
+
+    def run_instr(path: str) -> float:
+        if os.path.exists(path):
+            os.remove(path)
+        t0 = time.perf_counter()
+        with Telemetry(JsonlSink(path)):
+            mk().run(events, metrics_path=path)
+        return time.perf_counter() - t0
+
+    run_plain()  # warmup
+    plain_runs: list[float] = []
+    instr_runs: list[float] = []
+    ratios: list[float] = []
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "t.jsonl")
+        for r in range(max(1, repeats)):
+            if r % 2 == 0:
+                p, i = run_plain(), run_instr(path)
+            else:
+                i, p = run_instr(path), run_plain()
+            plain_runs.append(p)
+            instr_runs.append(i)
+            ratios.append(i / p)
+    ratios.sort()
+    ratio = min(instr_runs) / min(plain_runs)
+    return {
+        "n_files": n_files,
+        "windows_per_run": n_windows,
+        "plain_seconds": min(plain_runs),
+        "telemetry_seconds": min(instr_runs),
+        "plain_runs": plain_runs,
+        "telemetry_runs": instr_runs,
+        "paired_ratios": ratios,
+        "paired_ratio_median": ratios[len(ratios) // 2],
+        "overhead_ratio": ratio,
+        "budget": TELEMETRY_OVERHEAD_BUDGET,
+        "within_budget": ratio <= TELEMETRY_OVERHEAD_BUDGET,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--out", default="data/integrity_bench.json")
+    p.add_argument("--n_files", type=int, default=400)
+    p.add_argument("--seed", type=int, default=17)
+    p.add_argument("--duration", type=float, default=1800.0)
+    p.add_argument("--windows", type=int, default=15)
+    p.add_argument("--corrupt_window", type=int, default=2)
+    p.add_argument("--kill_window", type=int, default=6)
+    p.add_argument("--k", type=int, default=12)
+    p.add_argument("--round_no", type=int, default=9)
+    p.add_argument("--no_overhead", action="store_true",
+                   help="skip the paired telemetry-overhead rounds")
+    p.add_argument("--quick", action="store_true",
+                   help="small sizes for smoke runs (CI)")
+    args = p.parse_args(argv)
+
+    if args.quick:
+        kw = dict(n_files=160, seed=args.seed, duration=720.0,
+                  n_windows=8, corrupt_window=2, k=8)
+        mttd = run_mttd_sweep(budget_fracs=(0.25, 0.5), **kw)
+        overlap = run_overlap_bench(kill_window=4, **kw)
+    else:
+        kw = dict(n_files=args.n_files, seed=args.seed,
+                  duration=args.duration, n_windows=args.windows,
+                  corrupt_window=args.corrupt_window, k=args.k)
+        mttd = run_mttd_sweep(**kw)
+        overlap = run_overlap_bench(kill_window=args.kill_window, **kw)
+
+    # The half-lap budget's detection margin: bound / actual max latency
+    # (>= 1 means the scan met its budget-implied bound) — deterministic
+    # per seed, so it bands tightly in the trajectory gate.
+    half = next(s for s in mttd["sweep"]
+                if s["budget_lap_fraction"] == 0.5)
+    margin = (half["bound_windows"] / half["mttd_max_windows"]
+              if half["mttd_max_windows"] else None)
+
+    out: dict = {
+        "round": args.round_no,
+        "mttd": mttd,
+        "overlap": overlap,
+        "criteria": {
+            "all_detected_within_bound": all(
+                s["detected_within_bound"] for s in mttd["sweep"]),
+            "scrubbed_zero_files_lost":
+                overlap["scrubbed"]["true_lost_final"] == 0,
+            "scrubbed_zero_corrupt_reads":
+                overlap["scrubbed"]["corrupt_reads_served"] == 0,
+            "unscrubbed_serves_corrupt_reads":
+                overlap["unscrubbed"]["corrupt_reads_served"] > 0,
+            "unscrubbed_loses_files":
+                overlap["unscrubbed"]["true_lost_final"] >= 1,
+            **({"mid_scrub_resume_bit_identical":
+                overlap["kill_resume"]["bit_identical"]}
+               if "kill_resume" in overlap else {}),
+        },
+        "bench_records": [
+            {"metric": "integrity_mttd_margin_half_lap",
+             "value": round(margin, 4) if margin else 0.0, "unit": "x",
+             "backend": "numpy"},
+        ],
+    }
+
+    if not args.no_overhead:
+        overhead = integrity_overhead()
+        out["overhead"] = overhead
+        out["criteria"]["overhead_within_budget"] = overhead[
+            "within_budget"]
+
+    parent = os.path.dirname(args.out)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "out": args.out, **out["criteria"],
+        "mttd_margin_half_lap": out["bench_records"][0]["value"],
+        "unscrubbed_true_lost": overlap["unscrubbed"]["true_lost_final"],
+        "unscrubbed_corrupt_reads":
+            overlap["unscrubbed"]["corrupt_reads_served"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

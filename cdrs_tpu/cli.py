@@ -469,7 +469,13 @@ def _controller_cfg(args, fault_schedule=None, topology=None):
             service_ms=args.serve_service_ms,
             slo=SloSpec(target_ms=args.serve_slo_ms,
                         availability=args.serve_slo_availability),
-            recluster_on_hotspot=not args.no_hotspot_recluster)
+            recluster_on_hotspot=not args.no_hotspot_recluster,
+            verify_reads=not getattr(args, "no_verify_reads", False))
+    scrub_cfg = None
+    if getattr(args, "scrub", None):
+        from .faults import ScrubConfig
+
+        scrub_cfg = ScrubConfig(bytes_per_window=args.scrub)
     return ControllerConfig(
         topology=topology,
         serve=serve_cfg,
@@ -493,6 +499,7 @@ def _controller_cfg(args, fault_schedule=None, topology=None):
         fault_schedule=fault_schedule,
         repair_seed=getattr(args, "repair_seed", 0),
         overlap_windows=getattr(args, "overlap", False),
+        scrub=scrub_cfg,
     )
 
 
@@ -559,7 +566,8 @@ def _cmd_chaos(args) -> int:
                        ("decommission", args.decommission),
                        ("flaky", args.flaky),
                        ("partition", args.partition),
-                       ("degrade", args.degrade)):
+                       ("degrade", args.degrade),
+                       ("corrupt", args.corrupt)):
         for spec in flag or ():
             events.extend(FaultSchedule.from_specs([f"{kind}:{spec}"]))
     if args.schedule:
@@ -568,11 +576,12 @@ def _cmd_chaos(args) -> int:
     if args.random_faults:
         events.extend(FaultSchedule.random(
             manifest.nodes, n_windows=args.random_faults,
-            seed=args.fault_seed))
+            seed=args.fault_seed, corrupt_rate=args.corrupt_rate,
+            corrupt_frac=args.corrupt_frac))
     if not events:
         print("error: chaos needs at least one fault (--kill/--recover/"
-              "--decommission/--flaky/--partition/--degrade/--schedule/"
-              "--random_faults)", file=sys.stderr)
+              "--decommission/--flaky/--partition/--degrade/--corrupt/"
+              "--schedule/--random_faults)", file=sys.stderr)
         return 1
     schedule = FaultSchedule(events)
     if args.schedule_out:
@@ -618,13 +627,15 @@ def _cmd_serve(args) -> int:
     serve_cfg = ServeConfig(
         policy=args.policy, seed=args.seed, service_ms=args.service_ms,
         slo=SloSpec(target_ms=args.slo_ms,
-                    availability=args.slo_availability))
+                    availability=args.slo_availability),
+        verify_reads=not args.no_verify_reads)
     rf = np.full(len(manifest), args.default_rf, dtype=np.int32)
     placement = place_replicas(manifest, rf, topology, seed=0)
 
     events = []
     for kind, flag in (("crash", args.kill), ("partition", args.partition),
-                       ("degrade", args.degrade)):
+                       ("degrade", args.degrade),
+                       ("corrupt", args.corrupt)):
         for spec in flag or ():
             events.extend(FaultSchedule.from_specs([f"{kind}:{spec}"]))
     schedule = FaultSchedule(events) if events else None
@@ -674,10 +685,21 @@ def _cmd_serve(args) -> int:
                         rm = placement.replica_map
                         ok = rm >= 0
                         thr = np.ones(len(topology))
+                    slot_corrupt = None
+                    if state is not None and state.has_corruption:
+                        slot_corrupt = state.slot_corrupt
                     res = router.route(
                         rm, ok, thr, ts=ts, pid=pid, client=client,
                         window_seconds=args.window_seconds,
-                        rng=np.random.default_rng([args.seed, int(w)]))
+                        rng=np.random.default_rng([args.seed, int(w)]),
+                        slot_corrupt=slot_corrupt)
+                    if (res.corrupt_pairs is not None
+                            and len(res.corrupt_pairs)):
+                        # Detect-on-read: drop the rotten copies the
+                        # window's reads exposed (same contract as the
+                        # controller's serve wiring).
+                        for fid, node in res.corrupt_pairs:
+                            state.quarantine(int(fid), int(node))
                     rec["n_reads"] = res.n_reads
                     rec.update(res.record_fields())
                     rec["hotspot_score"] = round(hs.score, 6)
@@ -1063,6 +1085,26 @@ def main(argv: list[str] | None = None) -> int:
                         "throughput (default 0.5) over windows W..W2 — "
                         "copies through it charge size/M of the churn "
                         "budget, e.g. dn3@2-6:0.25; repeatable")
+    p.add_argument("--corrupt", action="append",
+                   metavar="NODE[#FILE]@W[:F]",
+                   help="SILENT corruption: rot a seeded fraction F "
+                        "(default 0.1) of NODE's copies at window W "
+                        "(dn2@3:0.25), or exactly FILE's copy on NODE "
+                        "(dn2#17@3) — invisible until a verified read "
+                        "(--scrub, the serve read path, or a repair "
+                        "source check) touches it; repeatable")
+    p.add_argument("--scrub", type=int, default=None, metavar="BYTES",
+                   help="background scrubber (faults/scrub.py): "
+                        "verification-read BYTES per window round-robin "
+                        "over the population — capped by what remains of "
+                        "the shared churn budget after repairs — "
+                        "quarantining latent corruption into the repair "
+                        "queue")
+    p.add_argument("--no_verify_reads", action="store_true",
+                   help="with --serve: serve rotten copies as if intact "
+                        "(the unverified baseline; reads_corrupt_served "
+                        "counts the garbage) instead of detect-and-"
+                        "redirect")
     p.add_argument("--schedule", default=None, metavar="JSON",
                    help="load additional fault events from a JSON file "
                         "(the --schedule_out format)")
@@ -1074,6 +1116,14 @@ def main(argv: list[str] | None = None) -> int:
                         "(never downs the last node)")
     p.add_argument("--fault_seed", type=int, default=0,
                    help="seed of --random_faults")
+    p.add_argument("--corrupt_rate", type=float, default=0.0,
+                   help="with --random_faults: per-window probability an "
+                        "up node silently rots a seeded fraction of its "
+                        "copies (default 0 = no corruption rolls, "
+                        "pre-existing schedules unchanged)")
+    p.add_argument("--corrupt_frac", type=float, default=0.05,
+                   help="fraction of a node's copies each --corrupt_rate "
+                        "event rots")
     p.add_argument("--repair_seed", type=int, default=0,
                    help="seed of the deterministic flaky-failure rolls")
     p.set_defaults(fn=_cmd_chaos)
@@ -1114,6 +1164,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--degrade", action="append", metavar="NODE@W[-W2][:M]",
                    help="straggler: NODE serves reads at Mx nominal speed "
                         "(service time / M); repeatable")
+    p.add_argument("--corrupt", action="append",
+                   metavar="NODE[#FILE]@W[:F]",
+                   help="silently rot NODE's copies at window W (the "
+                        "chaos --corrupt spec); reads that select a "
+                        "rotten copy detect + redirect (or, with "
+                        "--no_verify_reads, serve the garbage)")
+    p.add_argument("--no_verify_reads", action="store_true",
+                   help="serve rotten copies as if intact "
+                        "(reads_corrupt_served counts the damage)")
     p.add_argument("--batch_size", type=int, default=1_000_000)
     p.add_argument("--max_windows", type=int, default=None)
     _add_metrics_arg(p)

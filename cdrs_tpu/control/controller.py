@@ -125,6 +125,13 @@ class ControllerConfig:
     #: a ``storage`` byte/cost digest.  A config with only ``replicate``
     #: strategies reproduces the historical behaviour bit-for-bit.
     storage: object | None = None
+    #: Background scrubber (faults/scrub.ScrubConfig): when set (fault
+    #: mode only), every window verification-reads the next slice of the
+    #: population round-robin under ``bytes_per_window`` — capped by what
+    #: remains of the SHARED churn budget after repairs — quarantining
+    #: the silent corruption it finds into the repair queue.  The scrub
+    #: cursor and read-detection hint queue ride the npz checkpoint.
+    scrub: object | None = None
     #: Double-buffered windows: dispatch window t+1's (already jit'd)
     #: cluster step before window t's host-side planning runs, so JAX's
     #: async dispatch keeps the device busy while the host diffs plans,
@@ -151,6 +158,10 @@ class ControllerConfig:
                 "cross-batch concurrency carry has no decayed analogue)")
         if self.drift_threshold < 0 or self.full_recluster_drift < 0:
             raise ValueError("drift thresholds must be >= 0")
+        if self.scrub is not None and self.fault_schedule is None:
+            raise ValueError(
+                "scrub requires a fault_schedule (the scrubber verifies "
+                "the mutable ClusterState the fault path maintains)")
 
 
 @dataclass
@@ -239,7 +250,11 @@ class ControllerResult:
             out["durability"]["unavailable_read_fraction"] = (
                 out["durability"]["unavailable_reads"] / denom if denom
                 else 0.0)
-        from ..obs.aggregate import serve_digest, storage_digest
+        from ..obs.aggregate import (
+            integrity_digest,
+            serve_digest,
+            storage_digest,
+        )
 
         serve = serve_digest(self.records)
         if serve is not None:
@@ -247,6 +262,9 @@ class ControllerResult:
         storage = storage_digest(self.records)
         if storage is not None:
             out["storage"] = storage
+        integrity = integrity_digest(self.records)
+        if integrity is not None:
+            out["integrity"] = integrity
         return out
 
 
@@ -363,6 +381,19 @@ class ReplicationController:
                                        seed=0)
             self._cluster_state = ClusterState(placement, self._sizes)
             self._repairs = RepairScheduler(seed=cfg.repair_seed)
+        #: Integrity layer: the background scrubber (faults/scrub.py) and
+        #: the static "does this run care about integrity at all" flag —
+        #: per-window integrity records are emitted when corruption can
+        #: happen (a corrupt fault is scheduled) or is looked for (scrub
+        #: on), so pre-integrity runs keep byte-identical records.
+        self._scrub = None
+        if cfg.scrub is not None:
+            from ..faults import Scrubber
+
+            self._scrub = Scrubber(n, cfg.scrub)
+        self._integrity_on = self._cluster_state is not None and (
+            self._scrub is not None
+            or any(ev.kind == "corrupt" for ev in cfg.fault_schedule))
         #: Serving layer (serve/): router + hotspot detector, only when a
         #: ServeConfig is set.  The router is stateless per window; the
         #: hotspot EWMA is the ONLY serve state and rides the checkpoint.
@@ -690,8 +721,34 @@ class ReplicationController:
             rec["repair_deferred_no_source"] = rr.deferred_no_source
             rec["repair_deferred_no_target"] = rr.deferred_no_target
             rec["repair_deferred_partition"] = rr.deferred_partition
+            if rr.corrupt_sources:
+                rec["repair_corrupt_sources"] = rr.corrupt_sources
             bytes_reserved = rr.bytes_used
             files_reserved = rr.files_touched
+
+        # Background scrub runs AFTER repairs (healing known damage
+        # outranks hunting unknown damage) on what remains of the shared
+        # churn budget, capped by its own bytes_per_window rate; its
+        # quarantines surface in the NEXT window's repair sync.
+        if self._scrub is not None:
+            t0 = time.perf_counter()
+            left = None
+            if cfg.max_bytes_per_window is not None:
+                left = max(int(cfg.max_bytes_per_window) - bytes_reserved, 0)
+            sr = self._scrub.run_window(w, self._cluster_state,
+                                        shared_left=left)
+            seconds["scrub"] = time.perf_counter() - t0
+            plan_seconds += seconds["scrub"]
+            rec["scrub"] = {
+                "bytes": int(sr.bytes_used),
+                "copies_verified": sr.copies_verified,
+                "files_verified": sr.files_verified,
+                "corrupt_found": sr.corrupt_found,
+                "hinted": sr.hinted,
+                "starved": bool(sr.starved),
+                "cursor": int(sr.cursor),
+            }
+            bytes_reserved += sr.bytes_used
 
         t0 = time.perf_counter()
         applied = self.scheduler.schedule(w, bytes_reserved=bytes_reserved,
@@ -764,6 +821,7 @@ class ReplicationController:
             # observable the cost-vs-durability frontier is built on.
             rec["storage"] = self._storage_record()
 
+        read_detect_copies = 0
         if self._router is not None and read_pid is not None:
             # Route the window's reads against the END-of-window placement
             # (post repair + migration — the locality_after convention):
@@ -791,14 +849,43 @@ class ReplicationController:
             extra_ms = None
             if self._storage is not None:
                 extra_ms = self._serve_penalty_ms(slot_ok)[read_pid]
+            slot_corrupt = None
+            if (self._integrity_on
+                    and self._cluster_state.has_corruption):
+                slot_corrupt = self._cluster_state.slot_corrupt
             res = self._router.route(
                 rm, slot_ok, thr, ts=read_ts, pid=read_pid,
                 client=read_client, window_seconds=cfg.window_seconds,
                 rng=np.random.default_rng([int(cfg.serve.seed), int(w)]),
-                extra_ms=extra_ms)
+                extra_ms=extra_ms, slot_corrupt=slot_corrupt)
             rec.update(res.record_fields())
+            if res.corrupt_pairs is not None and len(res.corrupt_pairs):
+                # Detect-on-read feedback: quarantine the rotten copies
+                # the window's reads tripped over, and hint the scrubber
+                # at those files (their surviving copies are now suspect).
+                for fid, node in res.corrupt_pairs:
+                    self._cluster_state.quarantine(int(fid), int(node))
+                read_detect_copies = len(res.corrupt_pairs)
+                if self._scrub is not None:
+                    self._scrub.add_hints(res.corrupt_pairs[:, 0])
             self._last_latency_ms = res.latency_ms
             seconds["serve"] = time.perf_counter() - t0
+
+        if self._integrity_on:
+            # Ground-truth integrity digest AFTER the window's detections
+            # (scrub, repairs, reads) quarantined what they found: the
+            # rot still latent, and the true losses the blind durability
+            # tiers cannot see yet.
+            integ = self._cluster_state.integrity()
+            integ["detected_scrub"] = (rec.get("scrub") or {}).get(
+                "corrupt_found", 0)
+            integ["detected_repair"] = rec.get("repair_corrupt_sources", 0)
+            # Unique COPIES the read path exposed (record_fields'
+            # reads_corrupt_detected counts reads — a hot rotten copy can
+            # be hit thousands of times in one batch; the per-path
+            # detection totals must share one unit).
+            integ["detected_read"] = read_detect_copies
+            rec["integrity"] = integ
 
         t0 = time.perf_counter()
         rec["locality_before"] = rec["locality_after"] = None
@@ -937,6 +1024,26 @@ class ReplicationController:
         if rec.get("repair_rebalanced"):
             tel.counter_inc("repair.rebalanced_domain",
                             rec["repair_rebalanced"])
+        if rec.get("repair_corrupt_sources"):
+            tel.counter_inc("repair.corrupt_sources",
+                            rec["repair_corrupt_sources"])
+        sc = rec.get("scrub")
+        if sc is not None:
+            if sc["bytes"]:
+                tel.counter_inc("scrub.bytes", sc["bytes"])
+            if sc["copies_verified"]:
+                tel.counter_inc("scrub.copies_verified",
+                                sc["copies_verified"])
+            if sc["corrupt_found"]:
+                tel.counter_inc("scrub.corrupt_found", sc["corrupt_found"])
+            if sc["starved"]:
+                tel.counter_inc("scrub.starved_windows")
+            tel.gauge("scrub.cursor", sc["cursor"])
+        integ = rec.get("integrity")
+        if integ is not None:
+            tel.gauge("integrity.corrupt_copies", integ["corrupt_copies"])
+            tel.gauge("integrity.files_corrupt", integ["files_corrupt"])
+            tel.gauge("integrity.true_lost", integ["true_lost"])
         st = rec.get("storage")
         if st is not None:
             tel.gauge("storage.bytes_stored", st["bytes_stored"])
@@ -1257,6 +1364,8 @@ class ReplicationController:
             arrays.update(self._repairs.state_arrays())
         if self._hotspot is not None:
             arrays.update(self._hotspot.state_arrays())
+        if self._scrub is not None:
+            arrays.update(self._scrub.state_arrays())
         meta = {
             "window_index": self.window_index,
             "last_window_events": self._last_window_events,
@@ -1275,6 +1384,7 @@ class ReplicationController:
             "faults": self._cluster_state is not None,
             "serve": self._router is not None,
             "storage": self._storage is not None,
+            "scrub": self._scrub is not None,
         }
         if self.cfg.backend == "jax":
             meta["pad_events"] = self._state.pad_events
@@ -1323,6 +1433,14 @@ class ReplicationController:
                 f"{bool(meta.get('storage', False))} but the controller "
                 f"expects {self._storage is not None} — stale "
                 f"checkpoint? delete it to start over")
+        # Scrub flag, same posture: a scrubbing controller cannot resume
+        # bit-identically without its cursor/hint state.
+        if bool(meta.get("scrub", False)) != (self._scrub is not None):
+            raise ValueError(
+                f"checkpoint {path!r} has scrub="
+                f"{bool(meta.get('scrub', False))} but the controller "
+                f"expects {self._scrub is not None} — stale "
+                f"checkpoint? delete it to start over")
         if self.cfg.backend == "jax":
             import jax.numpy as jnp
 
@@ -1365,6 +1483,8 @@ class ReplicationController:
             self._repairs.load_state_arrays(arrays)
         if self._hotspot is not None:
             self._hotspot.load_state_arrays(arrays)
+        if self._scrub is not None:
+            self._scrub.load_state_arrays(arrays)
         self.window_index = int(meta["window_index"])
         self._last_window_events = int(meta.get("last_window_events", 0))
         self._t0 = meta.get("t0")

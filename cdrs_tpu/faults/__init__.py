@@ -14,15 +14,21 @@ Pieces:
 * ``RepairScheduler`` (repair.py) — HDFS-style re-replication under the
   same per-window churn budget as drift migrations, with deterministic
   flaky-failure rolls + exponential backoff, partition-stall deferral,
-  straggler-inflated budget charging and cross-domain spread rebalance.
+  straggler-inflated budget charging, cross-domain spread rebalance, and
+  verified-read source checks that refuse rotten copies.
+* ``Scrubber`` (scrub.py) — budgeted background verification of the data
+  itself: a checkpointed round-robin cursor (plus read-detection hints)
+  finds silent corruption and quarantines it into the repair queue.
 
 The online controller (control/controller.py) wires these into its window
 loop when ``ControllerConfig.fault_schedule`` is set; ``cdrs chaos`` is
-the CLI entry and ``benchmarks/chaos_bench.py`` the durability baseline.
+the CLI entry and ``benchmarks/chaos_bench.py`` /
+``benchmarks/integrity_bench.py`` the durability/integrity baselines.
 """
 
 from .repair import RepairReport, RepairScheduler, RepairTask
 from .schedule import FaultEvent, FaultSchedule
+from .scrub import ScrubConfig, ScrubReport, Scrubber
 from .state import ClusterState
 
 __all__ = [
@@ -32,4 +38,7 @@ __all__ = [
     "RepairReport",
     "RepairScheduler",
     "RepairTask",
+    "ScrubConfig",
+    "ScrubReport",
+    "Scrubber",
 ]

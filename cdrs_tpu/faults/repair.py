@@ -76,6 +76,17 @@ holder recovers (recovery makes them merely under-replicated).  The
 scheduler reports them as ``deferred_no_source`` so the degraded-mode
 accounting (controller + obs/audit.py ``durability_lost`` flag) sees them
 every window.
+
+Verified repair (the integrity contract, faults/scrub.py lineage): when
+the cluster carries silent corruption, every admitted repair first
+verification-reads the file's reachable copies and quarantines the rotten
+ones (``ClusterState.verify_sources``) before any copy streams — repair
+must never propagate rot.  The verification traffic is charged against
+the byte budget (the wasted best-source-first reads), quarantined copies
+count in ``corrupt_sources``, and a file whose every surviving source was
+rot defers as ``no_source`` — it is truly gone unless a clean holder
+recovers.  With no corruption anywhere the guard is one O(1) flag check
+and the pass is bit-identical to the pre-integrity behaviour.
 """
 
 from __future__ import annotations
@@ -131,6 +142,10 @@ class RepairReport:
     deferred_no_target: int = 0
     #: Files stranded behind a partition (live replicas, none reachable).
     deferred_partition: int = 0
+    #: Rotten sources the verified-read check caught and quarantined
+    #: before a copy could stream from them (integrity layer); their
+    #: verification reads are inside ``bytes_used``.
+    corrupt_sources: int = 0
 
 
 def _fail_roll(seed: int, window: int, fid: int, attempt: int,
@@ -456,6 +471,27 @@ class RepairScheduler:
                 rebalance = reach[f] >= eff[f] and bool(corr[f])
                 spread_fixed = False
                 task_touched = False
+                if state.has_corruption:
+                    # Verified read: quarantine rotten reachable copies of
+                    # this file BEFORE streaming a repair from them — rot
+                    # must never propagate.  The verification traffic is
+                    # real (charged), and the quarantines drop replicas,
+                    # so the scratch reach count re-reads the cache.
+                    nq, vbytes = state.verify_sources(f)
+                    if nq:
+                        rep.corrupt_sources += nq
+                        rep.bytes_used += vbytes
+                        reach[f] = int(state._reach_counts[f])
+                        rebalance = reach[f] >= eff[f] and bool(corr[f])
+                        task_touched = True
+                    if reach[f] < int(need[f]):
+                        # Every surviving source was rot: the file has no
+                        # clean reachable copy (or an EC stripe dropped
+                        # below k clean shards) — nothing to repair FROM.
+                        rep.deferred_no_source += 1
+                        if task_touched:
+                            touched += 1
+                        continue
                 while reach[f] < eff[f] or (rebalance and copy == 0):
                     target = state.pick_repair_target(
                         f, rotate=attempts + copy,

@@ -32,13 +32,22 @@ Event kinds (HDFS namenode vocabulary, Shvachko et al. MSST 2010):
                      routed through it are charged ``size/factor`` of the
                      churn budget — the wire time is real).
 * ``restore``      — clears the straggler multiplier back to 1.0.
+* ``corrupt``      — SILENT data fault: replicas/shards hosted on the node
+                     rot in place (bit flips, latent sector errors).  The
+                     node stays up and keeps serving the rotten bytes —
+                     nothing notices until a verified read (scrubber, read
+                     path, or repair source check) touches the copy.
+                     ``corrupt:dn2@3:0.25`` rots a seeded 25% fraction of
+                     dn2's copies; ``corrupt:dn2#17@3`` rots exactly file
+                     17's copy on dn2.  No span form: rot does not heal
+                     itself.
 
 Schedules come from three places: explicit specs (``crash:dn2@3``,
 ``crash:dn2@3-7`` = crash at 3 / recover at 8, ``flaky:dn1@2-6:0.5``,
 ``partition:dn2+dn3@4-6`` = partition at 4 / heal at 7,
-``degrade:dn3@2-6:0.25``), JSON round-trip (the ``cdrs chaos --schedule``
-contract), or the seeded ``random`` generator (chaos smoke tests), which
-never downs the last remaining node.
+``degrade:dn3@2-6:0.25``, ``corrupt:dn2@3:0.25``), JSON round-trip (the
+``cdrs chaos --schedule`` contract), or the seeded ``random`` generator
+(chaos smoke tests), which never downs the last remaining node.
 """
 
 from __future__ import annotations
@@ -54,7 +63,7 @@ __all__ = ["FaultEvent", "FaultSchedule"]
 #: order-independent by kind).
 KINDS: tuple[str, ...] = ("recover", "heal", "unflaky", "restore",
                           "crash", "partition", "flaky", "degrade",
-                          "decommission")
+                          "decommission", "corrupt")
 _KIND_ORDER = {k: i for i, k in enumerate(KINDS)}
 #: Kinds whose span form (``@lo-hi``) expands to (start kind, end kind).
 _SPAN_END = {"crash": "recover", "flaky": "unflaky",
@@ -70,11 +79,16 @@ class FaultEvent:
     #: Topology node name; ``partition``/``heal`` accept a ``+``-joined
     #: group (``dn2+dn3``) — the set drops/returns atomically.
     node: str
-    #: ``flaky`` only: probability a repair copy targeting the node fails.
+    #: ``flaky``: probability a repair copy targeting the node fails.
+    #: ``corrupt``: the seeded fraction of the node's copies that rot
+    #: (ignored when ``file`` targets one copy explicitly).
     fail_prob: float = 0.0
     #: ``degrade`` only: throughput multiplier in (0, 1] — 0.25 = the node
     #: moves repair bytes at a quarter of nominal speed.
     factor: float = 1.0
+    #: ``corrupt`` only: file index whose copy on ``node`` rots; -1 =
+    #: seeded ``fail_prob`` fraction of the node's copies instead.
+    file: int = -1
 
     def __post_init__(self):
         if self.kind not in _KIND_ORDER:
@@ -92,6 +106,10 @@ class FaultEvent:
             raise ValueError(
                 f"node groups ('+') are only valid for partition/heal, "
                 f"not {self.kind!r} ({self.node!r})")
+        if self.file >= 0 and self.kind != "corrupt":
+            raise ValueError(
+                f"file targeting is only valid for corrupt, not "
+                f"{self.kind!r}")
 
     @property
     def node_list(self) -> tuple[str, ...]:
@@ -99,6 +117,11 @@ class FaultEvent:
         return tuple(self.node.split("+"))
 
     def spec(self) -> str:
+        if self.kind == "corrupt":
+            if self.file >= 0:
+                return f"corrupt:{self.node}#{self.file}@{self.window}"
+            return (f"corrupt:{self.node}@{self.window}"
+                    f":{self.fail_prob:g}")
         s = f"{self.kind}:{self.node}@{self.window}"
         if self.kind == "flaky":
             s += f":{self.fail_prob:g}"
@@ -149,18 +172,30 @@ class FaultSchedule:
         partition at 4, heal at 7).  ``flaky:dn1@2-6:0.5`` expands to
         flaky(p=0.5) at 2 plus unflaky at 7 (probability defaults to 0.5);
         ``degrade:dn3@2-6:0.25`` to degrade(factor=0.25) at 2 plus restore
-        at 7 (factor defaults to 0.5).
+        at 7 (factor defaults to 0.5).  ``corrupt:dn2@3:0.25`` silently
+        rots a seeded 25% of dn2's copies at window 3 (fraction defaults
+        to 0.1); ``corrupt:dn2#17@3`` rots exactly file 17's copy.
         """
         events: list[FaultEvent] = []
         for spec in specs:
             try:
                 kind, rest = spec.split(":", 1)
-                if kind in ("flaky", "degrade") and rest.count(":") == 1:
+                if kind in ("flaky", "degrade", "corrupt") \
+                        and rest.count(":") == 1:
                     rest, prob_s = rest.rsplit(":", 1)
                     prob = float(prob_s)
                 else:
-                    prob = 0.5
+                    prob = 0.1 if kind == "corrupt" else 0.5
                 node, span = rest.split("@", 1)
+                file_idx = -1
+                if kind == "corrupt" and "#" in node:
+                    node, fid_s = node.split("#", 1)
+                    file_idx = int(fid_s)
+                    if file_idx < 0:
+                        # A negative pin would silently fall through to
+                        # fraction mode (FaultEvent treats -1 as "no
+                        # pin") — reject it as a bad spec instead.
+                        raise ValueError
                 if "-" in span:
                     lo, hi = (int(s) for s in span.split("-", 1))
                 else:
@@ -169,13 +204,17 @@ class FaultSchedule:
                 raise ValueError(
                     f"bad fault spec {spec!r} (want kind:node@window, e.g. "
                     f"'crash:dn2@3', 'crash:dn2@3-7', 'flaky:dn1@2-6:0.5', "
-                    f"'partition:dn2+dn3@4-6', 'degrade:dn3@2-6:0.25')"
+                    f"'partition:dn2+dn3@4-6', 'degrade:dn3@2-6:0.25', "
+                    f"'corrupt:dn2@3:0.25', 'corrupt:dn2#17@3')"
                 ) from None
             kw = {}
             if kind == "flaky":
                 kw["fail_prob"] = prob
             elif kind == "degrade":
                 kw["factor"] = prob
+            elif kind == "corrupt":
+                kw["fail_prob"] = prob
+                kw["file"] = file_idx
             if "-" in span:
                 if hi < lo:
                     raise ValueError(
@@ -196,18 +235,22 @@ class FaultSchedule:
                flaky_rate: float = 0.04,
                flaky_prob: float = 0.5,
                degrade_rate: float = 0.0,
-               degrade_factor: float = 0.25) -> "FaultSchedule":
+               degrade_factor: float = 0.25,
+               corrupt_rate: float = 0.0,
+               corrupt_frac: float = 0.05) -> "FaultSchedule":
         """Seeded random schedule for chaos smoke runs.
 
         Per window each UP node crashes with ``crash_rate`` (recovering a
         uniform ``recover_windows`` span later) and each up node turns
         flaky for one window with ``flaky_rate`` or — when
         ``degrade_rate`` > 0 — into a one-window straggler with
-        ``degrade_rate``.  The generator never downs the last remaining up
-        node, so the workload always has at least one replica target.
-        Deterministic in (nodes, n_windows, seed); ``degrade_rate=0`` (the
-        default) draws no extra rolls, so pre-existing (nodes, n_windows,
-        seed) schedules are unchanged.
+        ``degrade_rate``, or — when ``corrupt_rate`` > 0 — silently rots
+        a seeded ``corrupt_frac`` fraction of its copies with
+        ``corrupt_rate``.  The generator never downs the last remaining
+        up node, so the workload always has at least one replica target.
+        Deterministic in (nodes, n_windows, seed); ``degrade_rate=0`` and
+        ``corrupt_rate=0`` (the defaults) draw no extra rolls, so
+        pre-existing (nodes, n_windows, seed) schedules are unchanged.
         """
         rng = np.random.default_rng(seed)
         nodes = tuple(nodes)
@@ -237,6 +280,9 @@ class FaultSchedule:
                     events += [FaultEvent(w, "degrade", n,
                                           factor=degrade_factor),
                                FaultEvent(w + 1, "restore", n)]
+                elif corrupt_rate and rng.random() < corrupt_rate:
+                    events.append(FaultEvent(w, "corrupt", n,
+                                             fail_prob=corrupt_frac))
         # Flush recoveries scheduled past the horizon: a node crashed near
         # the end must still heal if the replayed log runs longer than
         # ``n_windows``.
@@ -247,16 +293,19 @@ class FaultSchedule:
     # -- serialization (the ``cdrs chaos --schedule`` JSON contract) --------
     def to_json(self) -> list[dict]:
         return [{"window": e.window, "kind": e.kind, "node": e.node,
-                 **({"fail_prob": e.fail_prob} if e.kind == "flaky"
-                    else {}),
-                 **({"factor": e.factor} if e.kind == "degrade" else {})}
+                 **({"fail_prob": e.fail_prob}
+                    if e.kind in ("flaky", "corrupt") else {}),
+                 **({"factor": e.factor} if e.kind == "degrade" else {}),
+                 **({"file": e.file}
+                    if e.kind == "corrupt" and e.file >= 0 else {})}
                 for e in self.events]
 
     @classmethod
     def from_json(cls, rows) -> "FaultSchedule":
         return cls([FaultEvent(int(r["window"]), r["kind"], r["node"],
                                fail_prob=float(r.get("fail_prob", 0.0)),
-                               factor=float(r.get("factor", 1.0)))
+                               factor=float(r.get("factor", 1.0)),
+                               file=int(r.get("file", -1)))
                     for r in rows])
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid

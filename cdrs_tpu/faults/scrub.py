@@ -1,0 +1,230 @@
+"""Budgeted background scrubbing: find silent corruption before reads do.
+
+Replication only protects data that is actually intact — a replica that
+rots in place (faults/state.py ``slot_corrupt``) still counts toward every
+durability tier until something READS it.  Production systems close that
+gap with a background scanner: HDFS pairs its block scanner with the
+re-replication queue, and Ceph's RADOS layer runs periodic scrub /
+deep-scrub over the same placement machinery this repo reproduces.  This
+module is that scanner in the controller's vocabulary:
+
+* **Round-robin cursor** — each window the scrubber verification-reads
+  every reachable copy of the next files in file-index order, wrapping at
+  the population end.  The cursor rides the npz checkpoint, so a
+  kill/resume resumes the scan bit-identically mid-lap.
+* **Budgeted** — verification reads are real traffic: each verified copy
+  charges ``shard_bytes / holder throughput`` (straggler wire-time
+  inflation, the repair scheduler's rule) against ``bytes_per_window``,
+  itself capped by what is LEFT of the shared per-window churn budget
+  after the window's repairs ran (repair heals known damage first; scrub
+  spends the remainder looking for unknown damage; migrations get what
+  survives both).  A window whose SHARED remainder undercuts
+  ``bytes_per_window`` and halts the scan early reports ``starved`` —
+  the auditor's ``scrub_starved`` flag (halting on the configured rate
+  itself is normal pacing).
+* **Detection -> quarantine -> repair** — a rotten copy found by the scan
+  is quarantined on the spot (``ClusterState.quarantine`` drops it), so
+  the very next repair sync sees the gap and re-replicates from a clean,
+  verified source.
+* **Read hints** — the serve router's detect-on-read path
+  (serve/router.py) reports the corrupt copies it tripped over; those
+  files jump the cursor queue next window (their OTHER copies are now
+  suspect — rot clusters by disk and by batch).  The hint queue is
+  checkpointed with the cursor.
+
+Everything is deterministic in (cluster state, cursor, hints, budget):
+no RNG, so kill/resume replays the same scan and the same detections.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["ScrubConfig", "ScrubReport", "Scrubber"]
+
+
+@dataclass(frozen=True)
+class ScrubConfig:
+    """Knobs of the background scrubber."""
+
+    #: Verification-read budget per window (bytes at nominal throughput;
+    #: straggler holders inflate the charge).  The scan rate: the whole
+    #: population's stored bytes divided by this is the worst-case
+    #: detection bound in windows (one full lap).
+    bytes_per_window: int
+
+    def __post_init__(self):
+        if self.bytes_per_window <= 0:
+            raise ValueError(
+                f"scrub bytes_per_window must be > 0, got "
+                f"{self.bytes_per_window}")
+
+
+@dataclass
+class ScrubReport:
+    """What one window's scrub pass did."""
+
+    #: Budget consumed (throughput-inflated verification reads).
+    bytes_used: int = 0
+    copies_verified: int = 0
+    files_verified: int = 0
+    #: Rotten copies found and quarantined this window.
+    corrupt_found: int = 0
+    #: Files verified from the read-detection hint queue (ahead of the
+    #: cursor scan).
+    hinted: int = 0
+    #: The SHARED churn budget's remainder (after repairs) cut this
+    #: window's allowance below the configured ``bytes_per_window`` and
+    #: the scan halted early on it: the cadence — and therefore the
+    #: detection-latency bound — is slipping behind the configured rate.
+    #: Halting on ``bytes_per_window`` itself is normal pacing, not
+    #: starvation.
+    starved: bool = False
+    #: Cursor position after the pass (next file the scan will touch).
+    cursor: int = 0
+
+
+class Scrubber:
+    """Checkpointed scrub cursor + hint queue over one ClusterState."""
+
+    def __init__(self, n_files: int, cfg: ScrubConfig):
+        self.n_files = int(n_files)
+        self.cfg = cfg
+        self.cursor = 0
+        #: Read-detection hints (sorted unique file ids), verified before
+        #: the cursor scan next window.
+        self.hints = np.zeros(0, dtype=np.int64)
+
+    def add_hints(self, fids) -> None:
+        fids = np.asarray(fids, dtype=np.int64)
+        if fids.size:
+            self.hints = np.union1d(self.hints, fids)
+
+    def run_window(self, window: int, state, *,
+                   shared_left: int | None = None) -> ScrubReport:
+        """One window's verification pass; mutates ``state`` (quarantines
+        what it finds) and the cursor/hint state.
+
+        ``shared_left``: bytes remaining of the shared churn budget after
+        repairs pre-charged it (None = unshared).  The effective allowance
+        is ``min(bytes_per_window, shared_left)``; the first copy of the
+        window is always admitted when any allowance exists (the
+        largest-file-must-not-starve rule repair and migration use).
+        """
+        cap = int(self.cfg.bytes_per_window)
+        if shared_left is not None:
+            cap = min(cap, max(int(shared_left), 0))
+        rep = ScrubReport()
+        if cap <= 0:
+            rep.starved = True
+            rep.cursor = self.cursor
+            return rep
+        reach = state.node_reachable()
+        thr = state.node_throughput
+
+        def verify_file(fid: int) -> bool:
+            """Verify every reachable copy of ``fid``; False = budget died
+            before the file finished (partial verifications are re-done
+            next window — the cursor does not advance past it)."""
+            row = state.replica_map[fid]
+            corr = state.slot_corrupt[fid]
+            checked = 0
+            for s in np.flatnonzero(row >= 0):
+                node = int(row[s])
+                if not reach[node]:
+                    continue
+                charge = int(np.ceil(int(state.shard_bytes[fid])
+                                     / max(float(thr[node]), 1e-9)))
+                if rep.bytes_used + charge > cap and rep.bytes_used > 0:
+                    return False
+                rep.bytes_used += charge
+                rep.copies_verified += 1
+                checked += 1
+                if corr[s]:
+                    state.quarantine(fid, node)
+                    rep.corrupt_found += 1
+            if checked:
+                rep.files_verified += 1
+            return True
+
+        # Hints first: a read already proved these files carry rot.  The
+        # queue is damage-proportional (files whose copies reads tripped
+        # over), so the per-copy Python loop is fine here.
+        halted = False
+        consumed = 0
+        for fid in self.hints:
+            if not verify_file(int(fid)):
+                halted = True
+                break
+            consumed += 1
+            rep.hinted += 1
+        self.hints = self.hints[consumed:]
+
+        # Round-robin cursor scan with what remains of the allowance —
+        # one full lap per window at most.  Vectorized (copy-level
+        # cumsum + one searchsorted budget cut, the SoA repair-admission
+        # pattern) so the clean scan costs O(population) numpy work, not
+        # O(copies) Python iterations; only the rot actually found (a
+        # damage-proportional handful) is quarantined in a loop.  Copy
+        # admission reproduces the per-copy loop exactly: admit while
+        # the running charge stays inside ``cap``, the lap's very first
+        # copy is admitted regardless (largest-file-must-not-starve,
+        # only when no hint bytes were spent), a partially-verified
+        # boundary file is charged but not completed — the cursor holds
+        # on it for next window.
+        if not halted:
+            n = self.n_files
+            order = (self.cursor + np.arange(n)) % n     # lap order
+            rm = state.replica_map[order]                # (n, R)
+            ok = (rm >= 0) & reach[np.clip(rm, 0, None)]
+            rows, slots = np.nonzero(ok)                 # copy-level
+            charge = np.ceil(
+                state.shard_bytes[order[rows]]
+                / np.maximum(thr[rm[rows, slots]], 1e-9)).astype(np.int64)
+            csum = rep.bytes_used + np.cumsum(charge)
+            kpre = int(np.searchsorted(csum, cap, side="right"))
+            if kpre == 0 and rep.bytes_used == 0 and charge.size:
+                kpre = 1
+            if kpre:
+                rep.bytes_used = int(csum[kpre - 1])
+                rep.copies_verified += kpre
+                fids = order[rows[:kpre]]
+                corr = state.slot_corrupt[fids, slots[:kpre]]
+                nodes = rm[rows[:kpre], slots[:kpre]]
+                for f, nd in zip(fids[corr].tolist(),
+                                 nodes[corr].tolist()):
+                    state.quarantine(int(f), int(nd))
+                    rep.corrupt_found += 1
+            # File completion: a lap file is done when its LAST copy is
+            # inside the admitted prefix (zero-copy files complete for
+            # free behind a completed neighbour, hold behind a partial
+            # one — the loop's visit order).
+            ends = np.cumsum(np.bincount(rows, minlength=n))
+            n_done = int(np.searchsorted(ends, kpre, side="right"))
+            counts = ends[:n_done]
+            if n_done:
+                rep.files_verified += int(
+                    (np.diff(np.concatenate(([0], counts))) > 0).sum())
+            self.cursor = (self.cursor + n_done) % n
+            halted = kpre < charge.size
+        # Starvation is about the SHARED budget, not the configured rate:
+        # halting because bytes_per_window ran out is normal pacing.
+        rep.starved = halted and cap < int(self.cfg.bytes_per_window)
+        rep.cursor = self.cursor
+        return rep
+
+    # -- checkpoint (rides the controller's utils/checkpoint npz) -----------
+    def state_arrays(self) -> dict[str, np.ndarray]:
+        return {
+            "scrub_cursor": np.asarray([self.cursor], dtype=np.int64),
+            "scrub_hints": self.hints.copy(),
+        }
+
+    def load_state_arrays(self, arrays: dict) -> None:
+        # Pre-scrub checkpoints lack the arrays: start a fresh lap.
+        cur = np.asarray(arrays.get("scrub_cursor", [0]), dtype=np.int64)
+        self.cursor = int(cur[0]) % max(self.n_files, 1)
+        self.hints = np.asarray(arrays.get("scrub_hints", ()),
+                                dtype=np.int64).copy()

@@ -29,6 +29,16 @@ Two masks tell the liveness story: ``live`` = the replica's node is up
 copies).  Without partitions they coincide, and every pre-partition
 behaviour is unchanged.
 
+A third, SILENT axis is ``slot_corrupt``: a copy whose holder is up and
+reachable but whose bytes have rotted (bit flips, latent sector errors —
+the HDFS block-scanner / Ceph scrub threat model).  Undetected rot still
+counts as live — that blindness is the point: the blind tiers can report
+"0 lost" while the cluster serves garbage.  Detection (background scrub,
+verified read, repair source check) calls ``quarantine``, which drops the
+copy so the ordinary tiers and the repair planner heal the gap; the
+``true_lost_mask``/``integrity`` accessors expose the ground truth the
+blind report cannot see.
+
 Everything is deterministic and the whole state round-trips through
 ``state_arrays``/``load_state_arrays`` so a controller checkpoint taken
 mid-fault resumes bit-identically (pre-partition checkpoints load with the
@@ -45,6 +55,20 @@ import numpy as np
 from ..cluster.placement import ClusterTopology, PlacementResult
 
 __all__ = ["ClusterState"]
+
+
+def _corrupt_roll(window: int, nid: int, fids: np.ndarray) -> np.ndarray:
+    """Deterministic uniform [0, 1) per file for the seeded ``corrupt``
+    fraction selection — stateless (splitmix64 over (window, node, file))
+    so a resumed controller replaying the same fault event selects the
+    same copies; numpy uint64 arithmetic wraps silently by design."""
+    base = ((window + 1) * 0x9E3779B97F4A7C15
+            + (nid + 1) * 0xC2B2AE3D27D4EB4F) & 0xFFFFFFFFFFFFFFFF
+    z = np.asarray(fids, dtype=np.uint64) + np.uint64(base)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> np.uint64(31))
+    return (z >> np.uint64(11)).astype(np.float64) / float(1 << 53)
 
 
 class ClusterState:
@@ -82,6 +106,17 @@ class ClusterState:
         #: old intent, so repair never tops a file up toward a target
         #: whose re-encode would drop the copies.
         self.installed_shards = placement.rf.astype(np.int32).copy()
+        #: Ground-truth SILENT corruption per replica slot (parallel to
+        #: ``replica_map``): the copy exists and its holder serves it, but
+        #: the bytes are rot.  An undetected corrupt copy still counts as
+        #: live/reachable — that blindness is the threat model; detection
+        #: (scrub, verified read, repair source check) quarantines the
+        #: copy via ``quarantine``, which drops it so the ordinary
+        #: durability tiers and the repair planner pick up the gap.
+        self.slot_corrupt = np.zeros((n, n_nodes), dtype=bool)
+        #: Incrementally maintained count of set ``slot_corrupt`` bits —
+        #: the O(1) "is integrity machinery needed at all" guard.
+        self._n_corrupt = 0
         self.node_up = np.ones(n_nodes, dtype=bool)
         self.node_decommissioned = np.zeros(n_nodes, dtype=bool)
         self.node_partitioned = np.zeros(n_nodes, dtype=bool)
@@ -241,6 +276,95 @@ class ClusterState:
         repair-amplification tradeoff, HDFS-EC/Ceph semantics)."""
         return int(self.shard_bytes[fid]) * max(int(self.ec_k[fid]), 1)
 
+    # -- data integrity (silent corruption) ----------------------------------
+    @property
+    def has_corruption(self) -> bool:
+        """Any slot currently holds rot — the O(1) guard that keeps every
+        integrity code path free when no corruption was ever injected."""
+        return self._n_corrupt > 0
+
+    def corrupt_replica(self, fid: int, node: int) -> bool:
+        """Silently rot ``fid``'s copy on ``node`` (no-op when the slot is
+        unassigned or already rotten).  Nothing else changes: the copy
+        still counts as live/reachable until something VERIFIES it."""
+        row = self.replica_map[fid]
+        slots = np.flatnonzero(row == node)
+        if slots.size == 0:
+            return False
+        s = int(slots[0])
+        if self.slot_corrupt[fid, s]:
+            return False
+        self.slot_corrupt[fid, s] = True
+        self._n_corrupt += 1
+        self.version += 1
+        return True
+
+    def quarantine(self, fid: int, node: int) -> None:
+        """DETECTED corruption: drop the copy (the bytes are garbage — a
+        quarantined slot is an empty slot as far as durability and repair
+        are concerned) and clear its rot bit.  The existing tiers and the
+        repair planner pick the gap up with no special-casing."""
+        self.drop_replica(fid, node)
+
+    def verify_sources(self, fid: int) -> tuple[int, int]:
+        """Verified-read source check for a repair of ``fid``: quarantine
+        every corrupt REACHABLE copy (rot on down/partitioned holders
+        stays latent — nothing can read it) so the repair never streams
+        from a rotten source.  Returns ``(n_quarantined, charge_bytes)``
+        where the charge is one verification read per rotten copy found
+        (``shard_bytes`` over the holder's throughput — the traffic the
+        sequential best-source-first read spent before failing the
+        checksum); clean sources verify as part of the copy read itself.
+        """
+        if not self._n_corrupt:
+            return 0, 0
+        row = self.replica_map[fid]
+        corr = self.slot_corrupt[fid]
+        reach = self.node_reachable()
+        found = 0
+        charge = 0
+        for s in np.flatnonzero((row >= 0) & corr):
+            node = int(row[s])
+            if not reach[node]:
+                continue
+            charge += int(np.ceil(
+                int(self.shard_bytes[fid])
+                / max(float(self.node_throughput[node]), 1e-9)))
+            self.quarantine(fid, node)
+            found += 1
+        return found, charge
+
+    def corrupt_file_counts(self) -> np.ndarray:
+        """(n,) int32: LIVE corrupt copies per file (ground truth).  Rot
+        on a down-but-not-decommissioned holder is excluded while the
+        node is down but the bit persists — the disk returns with the
+        rot intact on recovery (only decommission destroys it)."""
+        if not self._n_corrupt:
+            return np.zeros(self.replica_map.shape[0], dtype=np.int32)
+        live = self.live_mask() & self.slot_corrupt
+        return live.sum(axis=1).astype(np.int32)
+
+    def true_lost_mask(self) -> np.ndarray:
+        """(n,) bool GROUND TRUTH loss: fewer than ``min_live`` live
+        CLEAN copies — the file is gone (or will be, the moment the rot
+        is detected) even if the blind ``lost`` tier still reports it
+        alive.  Equals ``lost_mask`` when nothing is corrupt."""
+        if not self._n_corrupt:
+            return self.lost_mask()
+        clean = self.live_mask() & ~self.slot_corrupt
+        return clean.sum(axis=1).astype(np.int32) < self.min_live
+
+    def integrity(self) -> dict:
+        """Ground-truth integrity digest for the window record: corrupt
+        copies still in place, files carrying any rot, and the true-loss
+        count the blind durability tiers cannot see."""
+        cf = self.corrupt_file_counts()
+        return {
+            "corrupt_copies": int(cf.sum()),
+            "files_corrupt": int((cf > 0).sum()),
+            "true_lost": int(self.true_lost_mask().sum()),
+        }
+
     # -- node status ---------------------------------------------------------
     def _nid(self, node: str) -> int:
         try:
@@ -276,6 +400,8 @@ class ClusterState:
                 gone = self.replica_map == i
                 self.node_bytes[i] = 0
                 self.replica_map[gone] = -1
+                self._n_corrupt -= int((gone & self.slot_corrupt).sum())
+                self.slot_corrupt[gone] = False
             elif ev.kind == "partition":
                 self.node_partitioned[i] = True
             elif ev.kind == "heal":
@@ -288,6 +414,26 @@ class ClusterState:
                 self.node_throughput[i] = float(ev.factor)
             elif ev.kind == "restore":
                 self.node_throughput[i] = 1.0
+            elif ev.kind == "corrupt":
+                if ev.file >= 0:
+                    if ev.file >= self.replica_map.shape[0]:
+                        # Fail fast with the spec, not an IndexError
+                        # several windows into the run (node names are
+                        # validated up front; file pins can only be
+                        # checked against the population here).
+                        raise ValueError(
+                            f"corrupt event {ev.spec()!r} pins file "
+                            f"{ev.file} but the population has "
+                            f"{self.replica_map.shape[0]} files")
+                    self.corrupt_replica(int(ev.file), i)
+                else:
+                    # Seeded fraction of the node's assigned copies —
+                    # stateless selection, so resume replays it exactly.
+                    holds = np.flatnonzero(
+                        (self.replica_map == i).any(axis=1))
+                    roll = _corrupt_roll(ev.window, i, holds)
+                    for f in holds[roll < float(ev.fail_prob)]:
+                        self.corrupt_replica(int(f), i)
             else:  # pragma: no cover - FaultEvent validates kinds
                 raise ValueError(f"unknown fault kind {ev.kind!r}")
         if affected:
@@ -469,6 +615,11 @@ class ClusterState:
         if free.size == 0:  # pragma: no cover - width==n_nodes prevents this
             raise RuntimeError(f"file {fid} has no free replica slot")
         row[free[0]] = node
+        # A freshly written copy is clean by construction (repair streams
+        # from a verified source; migration writes new bytes).
+        if self.slot_corrupt[fid, free[0]]:  # pragma: no cover - drops clear
+            self.slot_corrupt[fid, free[0]] = False
+            self._n_corrupt -= 1
         self.node_bytes[node] += self.shard_bytes[fid]
         self._refresh_files(np.asarray([fid]))
         self.version += 1
@@ -478,6 +629,9 @@ class ClusterState:
         slots = np.flatnonzero(row == node)
         if slots.size:
             row[slots[0]] = -1
+            if self.slot_corrupt[fid, slots[0]]:
+                self.slot_corrupt[fid, slots[0]] = False
+                self._n_corrupt -= 1
             self.node_bytes[node] -= self.shard_bytes[fid]
             self._refresh_files(np.asarray([fid]))
             self.version += 1
@@ -603,6 +757,9 @@ class ClusterState:
             "fault_shard_bytes": self.shard_bytes.copy(),
             "fault_ec_k": self.ec_k.copy(),
             "fault_installed_shards": self.installed_shards.copy(),
+            # Latent-rot ground truth (integrity layer): a mid-outage
+            # resume must keep serving/refusing exactly the same copies.
+            "fault_slot_corrupt": self.slot_corrupt.copy(),
         }
 
     def load_state_arrays(self, arrays: dict) -> None:
@@ -645,6 +802,12 @@ class ClusterState:
             arrays.get("fault_installed_shards",
                        np.maximum((rm >= 0).sum(axis=1), self.min_live)),
             dtype=np.int32).copy()
+        # Pre-integrity checkpoints lack the rot mask: default to clean.
+        self.slot_corrupt = np.asarray(
+            arrays.get("fault_slot_corrupt",
+                       np.zeros(self.replica_map.shape, bool)),
+            dtype=bool).copy()
+        self._n_corrupt = int(self.slot_corrupt.sum())
         self._recompute_node_bytes()
         self._refresh_all()
         self.version += 1

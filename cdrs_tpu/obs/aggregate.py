@@ -16,7 +16,7 @@ from __future__ import annotations
 __all__ = ["collect", "span_forest", "ordered_span_paths", "percentile",
            "bucket_percentile", "merge_hist_buckets", "dedup_windows",
            "final_counters", "roofline_rows", "fmt_bytes", "serve_digest",
-           "storage_digest", "pacing_digest"]
+           "storage_digest", "pacing_digest", "integrity_digest"]
 
 
 def fmt_bytes(b, sep: str = " ") -> str:
@@ -271,6 +271,12 @@ def serve_digest(windows: list[dict]) -> dict | None:
         "hotspot_reclusters": sum(
             1 for w in sw if w.get("recluster_trigger") == "hotspot"),
         "locality_last": sw[-1].get("serve_locality"),
+        # Integrity layer (0 on rot-free runs): garbage served by the
+        # unverified baseline vs detections the verified path redirected.
+        "reads_corrupt_served": sum(
+            int(w.get("reads_corrupt_served") or 0) for w in sw),
+        "reads_corrupt_detected": sum(
+            int(w.get("reads_corrupt_detected") or 0) for w in sw),
     }
 
 
@@ -298,6 +304,47 @@ def storage_digest(windows: list[dict]) -> dict | None:
         "per_tier_bytes_final": dict(last.get("per_tier_bytes") or {}),
         "per_category_bytes_final": dict(
             last.get("per_category_bytes") or {}),
+    }
+
+
+def integrity_digest(windows: list[dict]) -> dict | None:
+    """Data-integrity digest over window records carrying ``integrity``
+    (a corrupt-fault or scrub-enabled run — control/controller.py).
+    None when the stream has no integrity accounting, so pre-integrity
+    streams render unchanged everywhere.  ``corrupt_copies``/``true_lost``
+    are GROUND TRUTH the blind durability tiers cannot see; the detection
+    totals split by path (scrub scan, verified read, repair source
+    check), and ``corrupt_reads_served`` counts the garbage an
+    unverified read path put on the wire."""
+    iw = [w for w in windows if w.get("integrity")]
+    if not iw:
+        return None
+    last = iw[-1]["integrity"]
+    scrubs = [w["scrub"] for w in iw if w.get("scrub")]
+    det_scrub = sum(int(w["integrity"].get("detected_scrub", 0))
+                    for w in iw)
+    det_read = sum(int(w["integrity"].get("detected_read", 0)) for w in iw)
+    det_repair = sum(int(w["integrity"].get("detected_repair", 0))
+                     for w in iw)
+    return {
+        "windows": len(iw),
+        "corrupt_copies_final": last.get("corrupt_copies", 0),
+        "corrupt_copies_max": max(
+            int(w["integrity"].get("corrupt_copies", 0)) for w in iw),
+        "files_corrupt_final": last.get("files_corrupt", 0),
+        "true_lost_final": last.get("true_lost", 0),
+        "true_lost_max": max(int(w["integrity"].get("true_lost", 0))
+                             for w in iw),
+        "detected_scrub": det_scrub,
+        "detected_read": det_read,
+        "detected_repair": det_repair,
+        "detected_total": det_scrub + det_read + det_repair,
+        "corrupt_reads_served": sum(
+            int(w.get("reads_corrupt_served") or 0) for w in iw),
+        "scrub_bytes_total": sum(int(s.get("bytes", 0)) for s in scrubs),
+        "scrub_copies_verified": sum(int(s.get("copies_verified", 0))
+                                     for s in scrubs),
+        "scrub_starved_windows": sum(1 for s in scrubs if s.get("starved")),
     }
 
 

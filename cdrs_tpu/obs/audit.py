@@ -45,6 +45,14 @@ Threshold-based anomaly flags turn the metrics into verdicts:
 * ``partition_stalled_repairs`` — repairs were deferred this window
   because every copy source is stranded behind a network partition; the
   backlog cannot drain until the partition heals.
+* ``corruption_detected`` — integrity mode (control + faults corruption):
+  this window's scrub scan, verified reads, or repair source checks
+  caught silently rotten copies and quarantined them — the audit-trail
+  proof the integrity layer, not luck, kept rot off the wire.
+* ``scrub_starved`` — the background scrubber ran out of its (shared)
+  byte allowance before finishing the window's verification quota: the
+  scan cadence — and therefore the detection-latency bound — is
+  slipping behind the configured rate.
 * ``hotspot_recluster`` — serve mode (control + serve/): this window's
   re-cluster was triggered by the HOTSPOT detector, not feature drift — a
   flash crowd the cumulative fold had not yet surfaced.  The flag is the
@@ -243,6 +251,18 @@ class DecisionAuditor:
                 flags.append("domain_diversity_violated")
         if rec.get("repair_deferred_partition"):
             flags.append("partition_stalled_repairs")
+        integ = rec.get("integrity")
+        if integ is not None:
+            event["integrity"] = {
+                k: integ.get(k, 0) for k in
+                ("corrupt_copies", "true_lost", "detected_scrub",
+                 "detected_read", "detected_repair")}
+            if (integ.get("detected_scrub", 0)
+                    + integ.get("detected_read", 0)
+                    + integ.get("detected_repair", 0)):
+                flags.append("corruption_detected")
+        if (rec.get("scrub") or {}).get("starved"):
+            flags.append("scrub_starved")
         if rec.get("recluster_trigger") == "hotspot":
             flags.append("hotspot_recluster")
         if rec.get("latency_p99_ms") is not None:

@@ -196,6 +196,29 @@ def _render_durability(windows: list[dict], out) -> None:
               f"(final {last.get('correlated_risk', 0)})", file=out)
 
 
+def _render_integrity(windows: list[dict], out) -> None:
+    """Integrity digest: silent corruption vs detection (window records
+    from a corrupt-fault / scrub-enabled run)."""
+    from .aggregate import integrity_digest
+
+    d = integrity_digest(windows)
+    if d is None:
+        return
+    print(f"\nIntegrity: {d['corrupt_copies_max']} corrupt copies max "
+          f"(final {d['corrupt_copies_final']}), true losses max "
+          f"{d['true_lost_max']} (final {d['true_lost_final']})", file=out)
+    print(f"  detected: {d['detected_total']} "
+          f"(scrub {d['detected_scrub']}, read {d['detected_read']}, "
+          f"repair {d['detected_repair']}); "
+          f"{d['corrupt_reads_served']} corrupt reads served", file=out)
+    if d["scrub_copies_verified"]:
+        line = (f"  scrub: {d['scrub_copies_verified']} copies verified, "
+                f"{_fmt_bytes(d['scrub_bytes_total'])} read")
+        if d["scrub_starved_windows"]:
+            line += f", starved {d['scrub_starved_windows']} windows"
+        print(line, file=out)
+
+
 def _render_audit(audits: list[dict], out) -> None:
     if not audits:
         return
@@ -281,6 +304,7 @@ def summarize_events(events: list[dict], out=None, peak_flops=None,
     _render_serving(digest["windows"], out)
     _render_storage(digest["windows"], out)
     _render_durability(digest["windows"], out)
+    _render_integrity(digest["windows"], out)
 
     windows = digest["windows"]
     if windows:

@@ -382,6 +382,56 @@ def _durability_section(digest: dict) -> str:
             "</tr>" + "".join(rows) + "</table>")
 
 
+def _integrity_section(digest: dict) -> str:
+    """Silent-corruption vs detection timeline (window records carrying
+    ``integrity`` — a corrupt-fault / scrub-enabled run): ground-truth
+    rot and true losses the blind durability tiers cannot see, the
+    per-path detection totals, and the scrub scan's progress.  Absent
+    for pre-integrity streams — older reports render unchanged."""
+    from .aggregate import integrity_digest
+
+    d = integrity_digest(digest["windows"])
+    if d is None:
+        return ""
+    tiles = "".join(
+        f'<div class="tile"><div class="v">{v}</div>'
+        f'<div class="l">{_esc(label)}</div></div>'
+        for label, v in (
+            ("corrupt copies (max)", _fmt(d["corrupt_copies_max"])),
+            ("true losses (max)", _fmt(d["true_lost_max"])),
+            ("detected", _fmt(d["detected_total"])),
+            ("corrupt reads served", _fmt(d["corrupt_reads_served"])),
+            ("scrub read", _fmt_bytes(d["scrub_bytes_total"])),
+        ))
+    note = (f'<p class="muted">detections: scrub {d["detected_scrub"]}, '
+            f'read {d["detected_read"]}, repair {d["detected_repair"]}'
+            + (f' · scrub starved {d["scrub_starved_windows"]} windows'
+               if d["scrub_starved_windows"] else "") + "</p>")
+    rows = []
+    for w in digest["windows"]:
+        integ = w.get("integrity")
+        if integ is None:
+            continue
+        sc = w.get("scrub") or {}
+        rows.append(
+            f"<tr><td>{_esc(w.get('window'))}</td>"
+            f'<td class="num">{_fmt(integ.get("corrupt_copies"))}</td>'
+            f'<td class="num">{_fmt(integ.get("true_lost"))}</td>'
+            f'<td class="num">{_fmt(integ.get("detected_scrub"))}</td>'
+            f'<td class="num">{_fmt(integ.get("detected_read"))}</td>'
+            f'<td class="num">{_fmt(integ.get("detected_repair"))}</td>'
+            f'<td class="num">{_fmt(w.get("reads_corrupt_served"))}</td>'
+            f'<td class="num">{_fmt_bytes(sc.get("bytes"))}</td>'
+            f"<td>{'⚠ starved' if sc.get('starved') else '—'}</td></tr>")
+    return ("<h2>Data integrity (silent corruption)</h2>"
+            f'<div class="tiles">{tiles}</div>' + note
+            + "<table><tr><th>window</th><th class=num>corrupt</th>"
+            "<th class=num>true lost</th><th class=num>det. scrub</th>"
+            "<th class=num>det. read</th><th class=num>det. repair</th>"
+            "<th class=num>served rotten</th><th class=num>scrub bytes"
+            "</th><th>scrub</th></tr>" + "".join(rows) + "</table>")
+
+
 def _storage_section(digest: dict) -> str:
     """Tier/byte-cost digest (window records carrying ``storage`` — a
     ``ControllerConfig.storage`` run): stored vs raw bytes, overhead
@@ -502,6 +552,7 @@ def render_html(events: list[dict], title: str = "cdrs telemetry report"
         + _serve_section(digest)
         + _storage_section(digest)
         + _durability_section(digest)
+        + _integrity_section(digest)
         + _window_section(digest)
         + _trace_section(digest)
         + _gauge_section(digest)

@@ -88,6 +88,12 @@ def emit_window_telemetry(tel, rec: dict, latency_ms=None) -> None:
     if rec.get("reads_unavailable"):
         tel.counter_inc("serve.reads_unavailable",
                         rec["reads_unavailable"])
+    if rec.get("reads_corrupt_served"):
+        tel.counter_inc("integrity.reads_corrupt_served",
+                        rec["reads_corrupt_served"])
+    if rec.get("reads_corrupt_detected"):
+        tel.counter_inc("integrity.reads_corrupt_detected",
+                        rec["reads_corrupt_detected"])
     if rec.get("latency_p99_ms") is not None:
         tel.gauge("serve.latency_p50_ms", rec["latency_p50_ms"])
         tel.gauge("serve.latency_p99_ms", rec["latency_p99_ms"])
@@ -151,6 +157,14 @@ class ServeConfig:
     #: trigger: a flash crowd forces a re-cluster the window it lands,
     #: without waiting for the cumulative feature fold to notice.
     recluster_on_hotspot: bool = True
+    #: Verify reads against the integrity layer (faults ``slot_corrupt``):
+    #: a read that selects a rotten copy DETECTS it (checksum mismatch),
+    #: redirects to a clean reachable copy with one extra service-time of
+    #: latency, and reports the copy for quarantine.  False = the
+    #: unverified baseline: rotten copies are served as if they were fine
+    #: and only ``reads_corrupt_served`` records the damage.  Irrelevant
+    #: (and bit-identical either way) when no corruption exists.
+    verify_reads: bool = True
 
     def __post_init__(self):
         if self.policy not in POLICIES:
@@ -193,6 +207,16 @@ class WindowServeResult:
     utilization: np.ndarray       # (n_nodes,) busy-time / window span
     slo_violations: int           # over-target + unavailable
     slo_burn: float               # violation fraction / error budget
+    #: Integrity layer (``slot_corrupt`` passed): reads that selected a
+    #: rotten copy and were SERVED anyway (verification off — garbage on
+    #: the wire) vs DETECTED (verification on: redirected to a clean
+    #: copy, or refused when none exists).
+    n_corrupt_served: int = 0
+    n_corrupt_detected: int = 0
+    #: (k, 2) int64 unique (file, node) pairs of detected rotten copies —
+    #: the caller quarantines them and feeds the files to the scrubber as
+    #: hints.  None when verification was off or nothing was detected.
+    corrupt_pairs: np.ndarray | None = None
 
     @property
     def locality(self) -> float:
@@ -219,6 +243,8 @@ class WindowServeResult:
             "utilization_max": round(self.utilization_max, 6),
             "slo_violations": self.slo_violations,
             "slo_burn": round(self.slo_burn, 6),
+            "reads_corrupt_served": self.n_corrupt_served,
+            "reads_corrupt_detected": self.n_corrupt_detected,
         }
 
 
@@ -321,7 +347,8 @@ class ReadRouter:
               pid: np.ndarray, client: np.ndarray,
               window_seconds: float | None = None,
               rng: np.random.Generator | None = None,
-              extra_ms: np.ndarray | None = None) -> WindowServeResult:
+              extra_ms: np.ndarray | None = None,
+              slot_corrupt: np.ndarray | None = None) -> WindowServeResult:
         """Route one time-ordered batch of reads.
 
         ``replica_map``: (n_files, R) int32 node ids, -1 = empty slot.
@@ -341,6 +368,15 @@ class ReadRouter:
         on the CLIENT side of the queue, so it does not occupy the
         chosen server — queue waits are unchanged, the latency sample
         (and therefore the percentiles and SLO burn) carries it.
+
+        ``slot_corrupt``: optional (n_files, R) bool — slots whose copy
+        has silently rotted (``ClusterState.slot_corrupt``).  With
+        ``cfg.verify_reads`` the router detects a rotten selection
+        (checksum on read), redirects it to the first clean reachable
+        slot at one extra service-time of latency — or refuses it
+        (unavailable) when no clean copy survives — and reports the
+        detected (file, node) pairs for quarantine.  Without
+        verification the read is served rotten and only counted.
         """
         rng = rng or np.random.default_rng(self.cfg.seed)
         ts = np.asarray(ts, dtype=np.float64)
@@ -372,6 +408,46 @@ class ReadRouter:
         # holder was charged inside one chunk.)
         server = np.where(local, client.astype(np.int32), server)
 
+        # Integrity: reads whose SELECTED copy is rot (detect-on-read).
+        n_corrupt_served = n_corrupt_detected = 0
+        corrupt_pairs = None
+        retry_ms = None
+        if slot_corrupt is not None:
+            corr = slot_corrupt[pid]                   # (e, R)
+            sel_corrupt = (((cand == server[:, None]) & corr).any(axis=1)
+                           & (server >= 0))
+            if sel_corrupt.any():
+                if self.cfg.verify_reads:
+                    n_corrupt_detected = int(sel_corrupt.sum())
+                    pairs = np.stack([pid[sel_corrupt].astype(np.int64),
+                                      server[sel_corrupt].astype(np.int64)],
+                                     axis=1)
+                    corrupt_pairs = np.unique(pairs, axis=0)
+                    # Redirect to the first clean reachable slot; the
+                    # wasted rotten read costs one extra service time.
+                    clean_ok = ok & ~corr
+                    rows = np.arange(e)
+                    alt = cand[rows, np.argmax(clean_ok, axis=1)]
+                    has_clean = clean_ok.any(axis=1)
+                    redirect = sel_corrupt & has_clean
+                    server = np.where(redirect, alt.astype(np.int32),
+                                      server)
+                    # No clean copy left: refuse the read (unavailable)
+                    # rather than serve garbage.
+                    server[sel_corrupt & ~has_clean] = -1
+                    retry_ms = np.where(redirect,
+                                        float(self.cfg.service_ms), 0.0)
+                    # Redirects/refusals moved reads off (or onto) the
+                    # client node: locality is a fact about the FINAL
+                    # server.  Without corruption this reconstruction
+                    # equals the pre-selection mask exactly (a selected
+                    # client node implies an ok client slot).
+                    local = (server >= 0) & (server
+                                             == client.astype(np.int32))
+                else:
+                    # Unverified baseline: garbage goes out on the wire.
+                    n_corrupt_served = int(sel_corrupt.sum())
+
         unavailable = server < 0
         n_unavail = int(unavailable.sum())
         lat_s = self._latency(server, ts, service_s)
@@ -380,6 +456,8 @@ class ReadRouter:
         if extra_ms is not None:
             latency_ms = latency_ms + np.asarray(extra_ms,
                                                  dtype=np.float64)[routed]
+        if retry_ms is not None:
+            latency_ms = latency_ms + retry_ms[routed]
 
         counts = np.bincount(server[routed], minlength=self.n_nodes
                              ).astype(np.int64)
@@ -403,4 +481,7 @@ class ReadRouter:
             server=server, latency_ms=latency_ms,
             p50_ms=p50, p95_ms=p95, p99_ms=p99,
             reads_per_node=counts, utilization=utilization,
-            slo_violations=violations, slo_burn=float(burn))
+            slo_violations=violations, slo_burn=float(burn),
+            n_corrupt_served=n_corrupt_served,
+            n_corrupt_detected=n_corrupt_detected,
+            corrupt_pairs=corrupt_pairs)

@@ -52,6 +52,13 @@ def save_state(path: str, arrays: dict, meta: dict | None = None) -> None:
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **payload)
+            # fsync BEFORE the rename: os.replace is atomic in the
+            # namespace but says nothing about the data — a host crash
+            # between write and rename can land a zero-length/torn npz
+            # at ``path``, which the NEXT save would then hardlink into
+            # ``.prev``, poisoning the last-good fallback too.
+            f.flush()
+            os.fsync(f.fileno())
         if os.path.exists(path):
             prev = path + ".prev"
             try:
@@ -68,6 +75,15 @@ def save_state(path: str, arrays: dict, meta: dict | None = None) -> None:
                 shutil.copyfile(path, prev + ".cp")
                 os.replace(prev + ".cp", prev)
         os.replace(tmp, path)
+        try:
+            # Make the rename itself durable (the directory entry).
+            dirfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+        except OSError:  # pragma: no cover - platform without dir-fsync
+            pass
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)

@@ -66,6 +66,34 @@ def test_save_state_retains_prev_snapshot(tmp_path):
     assert meta_prev["gen"] == 2 and arrays_prev["a"][0] == 2
 
 
+def test_save_state_fsyncs_before_rename(tmp_path, monkeypatch):
+    """The temp npz is fsynced BEFORE the atomic rename: os.replace is
+    atomic in the namespace but says nothing about the data, so a host
+    crash between write and rename could otherwise land a zero-length/
+    torn snapshot at ``path`` — which the NEXT save would hardlink into
+    ``.prev``, poisoning the last-good fallback too."""
+    import os
+
+    calls: list[tuple[str, object]] = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(
+        os, "fsync",
+        lambda fd: (calls.append(("fsync", fd)), real_fsync(fd))[1])
+    monkeypatch.setattr(
+        os, "replace",
+        lambda a, b: (calls.append(("replace", b)), real_replace(a, b))[1])
+    p = str(tmp_path / "durable.npz")
+    save_state(p, {"a": np.arange(3)}, {"gen": 1})
+    kinds = [k for k, _ in calls]
+    assert "fsync" in kinds, "save_state never fsynced the temp file"
+    # The FILE fsync must precede the rename that publishes it (the
+    # trailing directory fsync after the rename is fine and expected).
+    assert kinds.index("fsync") < kinds.index("replace")
+    arrays, meta = load_state(p)
+    np.testing.assert_array_equal(arrays["a"], np.arange(3))
+    assert meta == {"gen": 1}
+
+
 def test_checkpointed_matches_uninterrupted(blobs, tmp_path):
     init = kmeans_plusplus_init(blobs, 4, random_state=0)
     p1 = str(tmp_path / "a.npz")

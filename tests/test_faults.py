@@ -391,6 +391,45 @@ def test_degraded_kernel_falls_back_to_numpy(workload, monkeypatch):
                       ReplicationPolicyModel)
 
 
+def test_degraded_recluster_direct(workload):
+    """DIRECT coverage of ``_degraded_recluster`` (previously only
+    exercised through a full monkeypatched controller run): counter per
+    invocation, one-time warning, lazy per-variant fallback-model cache,
+    and a real numpy ClusterDecision out."""
+    pytest.importorskip("jax")
+    import warnings
+
+    from cdrs_tpu.models.replication import ClusterDecision
+    from cdrs_tpu.obs import Telemetry
+
+    manifest, _ = workload
+    ctl = ReplicationController(manifest, _cfg(backend="jax"))
+    rng = np.random.default_rng(SEED)
+    X = rng.uniform(size=(len(manifest), 5)).astype(np.float32)
+
+    tel = Telemetry()
+    with tel:
+        with pytest.warns(RuntimeWarning, match="numpy backend"):
+            dec = ctl._degraded_recluster(
+                False, X, None, RuntimeError("device lost"))
+        assert isinstance(dec, ClusterDecision)
+        assert dec.labels.shape == (len(manifest),)
+        assert (dec.category_idx >= 0).all()
+        full_model = ctl._fallback_models[False]
+        # Second failure (warm variant): counter again, NO second warning,
+        # a separate warm fallback model is built and cached.
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            dec2 = ctl._degraded_recluster(
+                True, X, dec.centroids, RuntimeError("device lost again"))
+        assert isinstance(dec2, ClusterDecision)
+        assert ctl._fallback_models[True] is not full_model
+        # Third failure reuses the cached model object — no rebuild.
+        ctl._degraded_recluster(False, X, None, RuntimeError("again"))
+        assert ctl._fallback_models[False] is full_model
+    assert tel.counters["degraded.kernel_fallback"] == 3
+
+
 # -- scheduler load validation (satellite) -----------------------------------
 
 def test_migration_scheduler_rejects_malformed_arrays():

@@ -1,0 +1,831 @@
+"""Data-integrity layer (ISSUE 9): silent-corruption faults, budgeted
+background scrubbing, verified repair, detect-on-read, and the checkpoint
+robustness satellites.
+
+``CDRS_CHAOS_SEED`` varies the workload seeds — CI's integrity smoke step
+sweeps it over three values so the invariants here are not single-seed
+accidents.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.config import (
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from cdrs_tpu.control import ControllerConfig, ReplicationController
+from cdrs_tpu.faults import (
+    ClusterState,
+    FaultEvent,
+    FaultSchedule,
+    RepairScheduler,
+    ScrubConfig,
+    Scrubber,
+)
+from cdrs_tpu.sim.access import simulate_access
+from cdrs_tpu.sim.generator import generate_population
+
+SEED = int(os.environ.get("CDRS_CHAOS_SEED", "0"))
+NODES = ("dn1", "dn2", "dn3", "dn4")
+
+
+@pytest.fixture(scope="module")
+def workload():
+    manifest = generate_population(
+        GeneratorConfig(n_files=120, seed=41 + SEED, nodes=NODES))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=420.0, seed=42 + SEED))
+    return manifest, events
+
+
+def _rf2_scoring():
+    """Every category at rf >= 2 — no rf=1 singletons muddying the
+    one-rotten-copy-is-recoverable invariants."""
+    base = validated_scoring_config()
+    return dataclasses.replace(
+        base, replication_factors={c: max(2, r) for c, r in
+                                   base.replication_factors.items()})
+
+
+def _cfg(schedule=None, **kw):
+    base = dict(window_seconds=60.0, kmeans=KMeansConfig(k=8, seed=42),
+                scoring=validated_scoring_config(), fault_schedule=schedule)
+    base.update(kw)
+    return ControllerConfig(**base)
+
+
+def _strip(records):
+    return [{k: v for k, v in r.items() if k != "seconds"} for r in records]
+
+
+def _toy_state(n=8, rf=2, seed=0, n_nodes=4):
+    manifest = generate_population(
+        GeneratorConfig(n_files=n, seed=seed, nodes=NODES[:n_nodes]))
+    from cdrs_tpu.cluster import ClusterTopology, place_replicas
+
+    placement = place_replicas(
+        manifest, np.full(n, rf, dtype=np.int32),
+        ClusterTopology(nodes=NODES[:n_nodes]), seed=0)
+    return ClusterState(placement, manifest.size_bytes)
+
+
+# -- corrupt fault events ----------------------------------------------------
+
+def test_corrupt_spec_parse_and_roundtrip():
+    s = FaultSchedule.from_specs(
+        ["corrupt:dn2@3:0.25", "corrupt:dn1#17@4", "corrupt:dn3@5"])
+    frac, pin, default = s.events[0], s.events[1], s.events[2]
+    assert (frac.kind, frac.node, frac.window) == ("corrupt", "dn2", 3)
+    assert frac.fail_prob == 0.25 and frac.file == -1
+    assert pin.file == 17 and pin.node == "dn1"
+    assert default.fail_prob == 0.1  # corrupt's default fraction
+    # spec() and JSON both round-trip the file pin and the fraction.
+    assert FaultSchedule.from_specs(
+        [e.spec() for e in s.events]).events == s.events
+    assert FaultSchedule.from_json(s.to_json()).events == s.events
+
+
+def test_corrupt_event_validation():
+    with pytest.raises(ValueError, match="file targeting"):
+        FaultEvent(0, "crash", "dn1", file=3)
+    with pytest.raises(ValueError, match="spans"):
+        FaultSchedule.from_specs(["corrupt:dn2@3-5"])  # rot does not heal
+    with pytest.raises(ValueError, match="node groups"):
+        FaultEvent(0, "corrupt", "dn1+dn2")
+    # A negative pin must not silently fall through to fraction mode.
+    with pytest.raises(ValueError, match="bad fault spec"):
+        FaultSchedule.from_specs(["corrupt:dn2#-5@3"])
+    # An out-of-range pin fails fast at apply time, naming the spec —
+    # not an IndexError several windows into the run.
+    st = _toy_state(n=8)
+    with pytest.raises(ValueError, match="pins file 99999"):
+        st.apply_event(FaultEvent(1, "corrupt", "dn2", file=99999))
+
+
+def test_random_schedule_corrupt_rolls():
+    a = FaultSchedule.random(NODES, 30, seed=SEED, corrupt_rate=0.3,
+                             corrupt_frac=0.2)
+    b = FaultSchedule.random(NODES, 30, seed=SEED, corrupt_rate=0.3,
+                             corrupt_frac=0.2)
+    assert a.events == b.events
+    cor = [e for e in a if e.kind == "corrupt"]
+    assert cor and all(e.fail_prob == 0.2 for e in cor)
+    # corrupt_rate=0 (the default) draws no extra rolls: pre-existing
+    # (nodes, n_windows, seed) schedules are bit-identical.
+    plain = FaultSchedule.random(NODES, 30, seed=SEED)
+    assert plain.events == FaultSchedule.random(NODES, 30,
+                                                seed=SEED).events
+    assert not any(e.kind == "corrupt" for e in plain)
+
+
+# -- cluster state: the silent axis ------------------------------------------
+
+def test_corruption_is_invisible_until_quarantined():
+    st = _toy_state(rf=2)
+    base_live = st.live_counts().copy()
+    assert not st.has_corruption
+    node = int(st.replica_map[0][st.replica_map[0] >= 0][0])
+    assert st.corrupt_replica(0, node)
+    assert not st.corrupt_replica(0, node)  # already rotten: no-op
+    assert st.has_corruption
+    # The blindness IS the threat model: live counts and the blind
+    # durability tiers do not move.
+    np.testing.assert_array_equal(st.live_counts(), base_live)
+    assert not st.lost_mask().any()
+    integ = st.integrity()
+    assert integ == {"corrupt_copies": 1, "files_corrupt": 1,
+                     "true_lost": 0}
+    # Detection drops the copy: ordinary tiers now see the gap.
+    st.quarantine(0, node)
+    assert not st.has_corruption
+    assert st.live_counts()[0] == base_live[0] - 1
+    assert st.integrity()["corrupt_copies"] == 0
+
+
+def test_corrupt_fraction_is_seeded_and_replayable():
+    ev = FaultEvent(3, "corrupt", "dn2", fail_prob=0.5)
+    a, b = _toy_state(n=60, seed=7), _toy_state(n=60, seed=7)
+    a.apply_event(ev)
+    b.apply_event(ev)
+    np.testing.assert_array_equal(a.slot_corrupt, b.slot_corrupt)
+    n_rot = int(a.slot_corrupt.sum())
+    held = int((a.replica_map == a._nid("dn2")).any(axis=1).sum())
+    assert 0 < n_rot < held  # a fraction, not all or nothing
+    # A different window re-rolls the selection.
+    c = _toy_state(n=60, seed=7)
+    c.apply_event(FaultEvent(4, "corrupt", "dn2", fail_prob=0.5))
+    assert (c.slot_corrupt != a.slot_corrupt).any()
+
+
+def test_true_lost_sees_through_the_blind_tiers():
+    st = _toy_state(n=10, rf=1)
+    node = int(st.replica_map[2][st.replica_map[2] >= 0][0])
+    st.corrupt_replica(2, node)
+    # Blind tier: 1 live copy = fine.  Ground truth: the only copy is rot.
+    assert not st.lost_mask()[2]
+    assert st.true_lost_mask()[2]
+    assert st.integrity()["true_lost"] == 1
+
+
+def test_rot_survives_crash_but_not_decommission():
+    st = _toy_state(rf=2)
+    node = int(st.replica_map[1][st.replica_map[1] >= 0][0])
+    name = NODES[node]
+    st.corrupt_replica(1, node)
+    st.apply_event(FaultEvent(0, "crash", name))
+    assert st.corrupt_file_counts()[1] == 0  # down copies are not live...
+    st.apply_event(FaultEvent(1, "recover", name))
+    assert st.corrupt_file_counts()[1] == 1  # ...but the disk returns rotten
+    st.apply_event(FaultEvent(2, "decommission", name))
+    assert not st.has_corruption  # destroyed replicas take their rot along
+
+
+def test_corruption_rides_the_checkpoint():
+    st = _toy_state(rf=2)
+    node = int(st.replica_map[3][st.replica_map[3] >= 0][0])
+    st.corrupt_replica(3, node)
+    st2 = _toy_state(rf=2)
+    st2.load_state_arrays(st.state_arrays())
+    np.testing.assert_array_equal(st2.slot_corrupt, st.slot_corrupt)
+    assert st2.has_corruption
+    # Pre-integrity checkpoints (no rot mask) load clean.
+    arrays = {k: v for k, v in st.state_arrays().items()
+              if k != "fault_slot_corrupt"}
+    st3 = _toy_state(rf=2)
+    st3.load_state_arrays(arrays)
+    assert not st3.has_corruption
+
+
+def test_verify_sources_quarantines_reachable_rot_only():
+    st = _toy_state(rf=2)
+    row = st.replica_map[0]
+    n1, n2 = (int(x) for x in row[row >= 0][:2])
+    st.corrupt_replica(0, n1)
+    st.corrupt_replica(0, n2)
+    # Straggler holder: the verification read is charged size/throughput.
+    st.apply_event(FaultEvent(0, "degrade", NODES[n1], factor=0.25))
+    # Partitioned holder: its rot is unreachable — stays latent.
+    st.apply_event(FaultEvent(0, "partition", NODES[n2]))
+    found, charge = st.verify_sources(0)
+    assert found == 1
+    assert charge == int(np.ceil(int(st.shard_bytes[0]) / 0.25))
+    assert st.slot_corrupt[0].sum() == 1  # the stranded copy still rots
+    st.apply_event(FaultEvent(1, "heal", NODES[n2]))
+    found2, charge2 = st.verify_sources(0)
+    assert found2 == 1 and charge2 == int(st.shard_bytes[0])
+    assert not st.has_corruption
+
+
+# -- the scrubber ------------------------------------------------------------
+
+def test_scrub_cursor_paces_and_wraps():
+    st = _toy_state(n=12, rf=2)
+    budget = int(max(st.shard_bytes)) * 4
+    sc = Scrubber(12, ScrubConfig(bytes_per_window=budget))
+    seen_cursors = [sc.cursor]
+    total_copies = 0
+    wrapped = False
+    for w in range(30):
+        rep = sc.run_window(w, st)
+        assert rep.bytes_used <= budget or rep.copies_verified == 1
+        assert not rep.starved  # bytes_per_window-bound halt = pacing
+        total_copies += rep.copies_verified
+        seen_cursors.append(sc.cursor)
+        if sc.cursor < seen_cursors[-2]:
+            wrapped = True  # a full lap completed
+            break
+    assert wrapped
+    assert any(b > a for a, b in zip(seen_cursors, seen_cursors[1:]))
+    assert total_copies >= 12  # a lap verifies every file's copies
+
+
+def test_scrub_detects_and_quarantines():
+    st = _toy_state(n=10, rf=2)
+    rot = []
+    for f in (1, 4, 7):
+        node = int(st.replica_map[f][st.replica_map[f] >= 0][0])
+        st.corrupt_replica(f, node)
+        rot.append((f, node))
+    big = int(st.shard_bytes.sum()) * 4  # whole lap in one window
+    sc = Scrubber(10, ScrubConfig(bytes_per_window=big))
+    rep = sc.run_window(0, st)
+    assert rep.corrupt_found == 3
+    assert not st.has_corruption
+    assert rep.files_verified == 10
+    # The quarantined gaps are ordinary repair work now.
+    assert (st.live_counts() < 2).sum() == 3
+
+
+def test_scrub_starvation_is_about_the_shared_budget():
+    st = _toy_state(n=12, rf=2)
+    cfg = ScrubConfig(bytes_per_window=int(max(st.shard_bytes)) * 3)
+    # Plenty of shared budget left: halting on bytes_per_window is pacing.
+    sc = Scrubber(12, cfg)
+    assert not sc.run_window(0, st, shared_left=10**12).starved
+    # Repairs ate the shared budget down below the configured rate and
+    # the scan halted on it: starved.
+    sc2 = Scrubber(12, cfg)
+    rep = sc2.run_window(0, st, shared_left=int(max(st.shard_bytes)))
+    assert rep.starved and rep.bytes_used <= int(max(st.shard_bytes))
+    # Nothing left at all: starved with zero work.
+    sc3 = Scrubber(12, cfg)
+    rep0 = sc3.run_window(0, st, shared_left=0)
+    assert rep0.starved and rep0.copies_verified == 0
+    assert sc3.cursor == 0  # cursor holds — next window re-scans
+
+
+def test_scrub_hints_jump_the_queue():
+    st = _toy_state(n=20, rf=2)
+    node = int(st.replica_map[15][st.replica_map[15] >= 0][0])
+    st.corrupt_replica(15, node)
+    sc = Scrubber(20, ScrubConfig(
+        bytes_per_window=int(max(st.shard_bytes)) * 3))
+    sc.add_hints([15])
+    rep = sc.run_window(0, st)  # the cursor alone would reach 15 late
+    assert rep.hinted == 1 and rep.corrupt_found == 1
+    assert sc.hints.size == 0
+    assert not st.has_corruption
+
+
+def test_scrubber_checkpoint_roundtrip():
+    sc = Scrubber(50, ScrubConfig(bytes_per_window=1000))
+    sc.cursor = 23
+    sc.add_hints([7, 3, 7])
+    arrays = sc.state_arrays()
+    sc2 = Scrubber(50, ScrubConfig(bytes_per_window=1000))
+    sc2.load_state_arrays(arrays)
+    assert sc2.cursor == 23
+    np.testing.assert_array_equal(sc2.hints, [3, 7])
+    # Pre-scrub checkpoints: fresh lap, empty hints.
+    sc3 = Scrubber(50, ScrubConfig(bytes_per_window=1000))
+    sc3.load_state_arrays({})
+    assert sc3.cursor == 0 and sc3.hints.size == 0
+
+
+# -- verified repair ---------------------------------------------------------
+
+def test_repair_refuses_corrupt_sources():
+    """A file whose only reachable source is rot defers as no_source
+    (with the rotten copy quarantined and the verification read charged)
+    instead of propagating the rot into a fresh copy."""
+    st = _toy_state(n=6, rf=2)
+    row = st.replica_map[0]
+    n1, n2 = (int(x) for x in row[row >= 0][:2])
+    st.corrupt_replica(0, n1)
+    st.apply_event(FaultEvent(0, "crash", NODES[n2]))  # clean copy down
+    target = np.full(6, 2, dtype=np.int64)
+    cat = np.zeros(6, dtype=np.int64)
+    rs = RepairScheduler(seed=SEED)
+    rs.sync(st, target)
+    rep = rs.schedule(0, st, target, cat)
+    assert rep.corrupt_sources == 1
+    assert rep.deferred_no_source >= 1
+    assert rep.bytes_used > 0  # the wasted verification read is real
+    assert not st.slot_corrupt[0].any()  # quarantined, not copied
+    # The clean holder recovers: repair streams from it, file heals.
+    st.apply_event(FaultEvent(1, "recover", NODES[n2]))
+    rs.sync(st, target)
+    rep2 = rs.schedule(1, st, target, cat)
+    assert rep2.corrupt_sources == 0
+    assert st.live_counts()[0] >= 2
+    assert not st.true_lost_mask()[0]
+
+
+def test_repair_with_no_corruption_is_flag_check_only():
+    """The verified-read guard is one O(1) has_corruption check when no
+    rot exists: repair reports are bit-identical to a pre-integrity
+    pass."""
+    st = _toy_state(n=20, rf=2)
+    st.apply_event(FaultEvent(0, "crash", "dn2"))
+    target = np.full(20, 2, dtype=np.int64)
+    cat = np.zeros(20, dtype=np.int64)
+    rs = RepairScheduler(seed=SEED)
+    rs.sync(st, target)
+    rep = rs.schedule(0, st, target, cat)
+    assert rep.corrupt_sources == 0
+    assert rep.applied  # normal healing unobstructed
+
+
+# -- detect-on-read (router) -------------------------------------------------
+
+def _router(verify=True, n_nodes=3, policy="primary"):
+    from cdrs_tpu.serve import ReadRouter, ServeConfig, SloSpec
+
+    return ReadRouter(n_nodes, ServeConfig(
+        policy=policy, seed=SEED, service_ms=1.0,
+        slo=SloSpec(target_ms=50.0, availability=0.999),
+        verify_reads=verify))
+
+
+def _route(router, rm, corrupt, pid):
+    e = len(pid)
+    return router.route(
+        rm, rm >= 0, np.ones(3), ts=np.arange(e, dtype=np.float64) * 10.0,
+        pid=np.asarray(pid), client=np.full(e, -1, dtype=np.int64),
+        window_seconds=60.0, rng=np.random.default_rng(SEED),
+        slot_corrupt=corrupt)
+
+
+def test_router_detects_redirects_and_reports():
+    rm = np.asarray([[0, 1], [1, 2]], dtype=np.int32)
+    corrupt = np.zeros((2, 2), dtype=bool)
+    corrupt[0, 0] = True  # file 0's primary (node 0) is rot
+    res = _route(_router(verify=True), rm, corrupt, [0, 0, 1])
+    assert res.n_corrupt_detected == 2
+    assert res.n_corrupt_served == 0
+    np.testing.assert_array_equal(res.corrupt_pairs, [[0, 0]])
+    # Both reads of file 0 were redirected to the clean copy on node 1.
+    np.testing.assert_array_equal(res.server, [1, 1, 1])
+    # The wasted rotten read costs one extra service time on the sample.
+    clean = _route(_router(verify=True), rm, np.zeros((2, 2), bool),
+                   [0, 0, 1])
+    assert res.latency_ms[0] == pytest.approx(
+        clean.latency_ms[0] + 1.0)
+
+
+def test_router_refuses_when_no_clean_copy():
+    rm = np.asarray([[0, 1], [1, 2]], dtype=np.int32)
+    corrupt = np.zeros((2, 2), dtype=bool)
+    corrupt[0] = True  # every copy of file 0 is rot
+    res = _route(_router(verify=True), rm, corrupt, [0, 1])
+    assert res.n_corrupt_detected == 1
+    assert res.n_unavailable == 1  # refused, not served rotten
+    assert res.server[0] == -1
+    assert len(res.corrupt_pairs) == 1
+
+
+def test_router_unverified_baseline_serves_garbage():
+    rm = np.asarray([[0, 1], [1, 2]], dtype=np.int32)
+    corrupt = np.zeros((2, 2), dtype=bool)
+    corrupt[0, 0] = True
+    res = _route(_router(verify=False), rm, corrupt, [0, 0, 1])
+    assert res.n_corrupt_served == 2
+    assert res.n_corrupt_detected == 0
+    assert res.corrupt_pairs is None
+    np.testing.assert_array_equal(res.server, [0, 0, 1])  # rot on the wire
+    assert res.record_fields()["reads_corrupt_served"] == 2
+
+
+def test_router_no_corruption_bit_identical():
+    """slot_corrupt=None and an all-clean mask route identically —
+    pre-integrity callers are unchanged."""
+    rm = np.asarray([[0, 1], [1, 2]], dtype=np.int32)
+    a = _route(_router(verify=True, policy="p2c"), rm, None, [0, 1, 0, 1])
+    b = _route(_router(verify=True, policy="p2c"), rm,
+               np.zeros((2, 2), bool), [0, 1, 0, 1])
+    np.testing.assert_array_equal(a.server, b.server)
+    np.testing.assert_array_equal(a.latency_ms, b.latency_ms)
+
+
+# -- controller end to end ---------------------------------------------------
+
+def test_scrub_requires_fault_schedule():
+    with pytest.raises(ValueError, match="scrub requires"):
+        _cfg(None, scrub=ScrubConfig(bytes_per_window=1000))
+    with pytest.raises(ValueError, match="bytes_per_window"):
+        ScrubConfig(bytes_per_window=0)
+
+
+def test_controller_scrub_detects_and_heals(workload):
+    """The flagship contract: rot lands silently, the scrubber finds all
+    of it within one budget lap, verified repair re-replicates from the
+    clean copies, and the run ends with zero latent rot and zero true
+    losses."""
+    manifest, events = workload
+    sched = FaultSchedule.from_specs(["corrupt:dn2@1:1.0"])
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+    res = ReplicationController(manifest, _cfg(
+        sched, default_rf=2, scoring=_rf2_scoring(),
+        scrub=ScrubConfig(bytes_per_window=int(sizes.sum()) * 3),
+    )).run(events)
+    summ = res.summary()
+    integ = summ["integrity"]
+    # The integrity record is POST-detection ground truth: with a
+    # full-lap budget the same window that lands the rot also finds all
+    # of it, so detections (not residual corrupt_copies) prove it landed.
+    assert integ["detected_scrub"] > 0
+    assert integ["corrupt_copies_final"] == 0    # all found
+    assert integ["true_lost_final"] == 0         # all healed
+    assert integ["scrub_starved_windows"] == 0
+    assert summ["durability"]["lost_final"] == 0
+    # Scrub accounting rode the records.
+    scrubbed = [r["scrub"] for r in res.records if r.get("scrub")]
+    assert scrubbed and all(s["cursor"] >= 0 for s in scrubbed)
+    assert sum(s["corrupt_found"] for s in scrubbed) == \
+        integ["detected_scrub"]
+
+
+def test_unscrubbed_rot_plus_kill_loses_files(workload):
+    """The baseline the bench contrasts: without scrubbing, rot stays
+    latent until a node kill takes the clean copies — ground-truth
+    losses and garbage served on the read path; the same schedule WITH
+    scrubbing heals before the kill and loses nothing."""
+    from cdrs_tpu.serve import ServeConfig, SloSpec
+
+    manifest, events = workload
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+
+    def run(scrub_on, verify):
+        sched = FaultSchedule.from_specs(
+            ["corrupt:dn2@1:1.0", "crash:dn3@3"])
+        serve = ServeConfig(policy="p2c", seed=0, service_ms=0.5,
+                            slo=SloSpec(target_ms=10.0,
+                                        availability=0.999),
+                            verify_reads=verify)
+        cfg = _cfg(sched, default_rf=2, scoring=_rf2_scoring(),
+                   serve=serve,
+                   scrub=ScrubConfig(bytes_per_window=int(sizes.sum()) * 3)
+                   if scrub_on else None)
+        res = ReplicationController(manifest, cfg).run(events)
+        return res.summary()
+
+    blind = run(scrub_on=False, verify=False)
+    # Rot was served on the wire and the kill turned latent rot into
+    # ground-truth loss.  (The blind tiers may partially catch up — the
+    # repair pass verified-reads sources when healing the kill damage —
+    # but they never OVERSTATE the ground truth.)
+    assert blind["integrity"]["corrupt_reads_served"] > 0
+    assert blind["integrity"]["true_lost_final"] >= 1
+    assert blind["integrity"]["detected_read"] == 0  # verification was off
+    assert blind["durability"]["lost_final"] <= \
+        blind["integrity"]["true_lost_final"]
+
+    healed = run(scrub_on=True, verify=True)
+    assert healed["integrity"]["true_lost_final"] == 0
+    assert healed["integrity"]["corrupt_reads_served"] == 0
+    assert healed["integrity"]["detected_total"] > 0
+
+
+def test_detect_on_read_feeds_scrub_hints(workload):
+    """Serve-path detections quarantine the copy AND hint the scrubber;
+    with a tiny scrub budget the hint queue is what gets verified."""
+    from cdrs_tpu.serve import ServeConfig, SloSpec
+
+    manifest, events = workload
+    serve = ServeConfig(policy="p2c", seed=0, service_ms=0.5,
+                        slo=SloSpec(target_ms=10.0, availability=0.999))
+    sched = FaultSchedule.from_specs(["corrupt:dn1@1:1.0"])
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+    # Budget sized so the hint queue drains a file or two per window but
+    # the cursor lap crawls — hints must be what finds the rot.
+    res = ReplicationController(manifest, _cfg(
+        sched, default_rf=2, scoring=_rf2_scoring(), serve=serve,
+        scrub=ScrubConfig(bytes_per_window=int(sizes.max()) * 3),
+        max_bytes_per_window=None,
+    )).run(events)
+    integ = res.summary()["integrity"]
+    assert integ["detected_read"] > 0
+    # detected_read counts unique COPIES quarantined (the per-path
+    # totals share one unit); reads_corrupt_detected counts READS — a
+    # hot rotten copy hit many times in one batch bounds it from above.
+    reads_detected = sum(r.get("reads_corrupt_detected") or 0
+                         for r in res.records)
+    assert 0 < integ["detected_read"] <= reads_detected
+    hinted = sum((r.get("scrub") or {}).get("hinted", 0)
+                 for r in res.records)
+    assert hinted > 0  # the read detections became scrub work
+
+
+def test_kill_resume_mid_scrub_bit_identical(tmp_path, workload):
+    """A controller killed mid-scrub-lap (rot latent, cursor mid-flight,
+    hints queued) resumes bit-identically — scrub cursor + hint queue +
+    rot masks all ride the npz checkpoint."""
+    from cdrs_tpu.serve import ServeConfig, SloSpec
+
+    manifest, events = workload
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+
+    def mk():
+        sched = FaultSchedule.from_specs(
+            ["corrupt:dn2@1:0.6", "crash:dn3@2-3"])
+        serve = ServeConfig(policy="p2c", seed=0, service_ms=0.5,
+                            slo=SloSpec(target_ms=10.0,
+                                        availability=0.999))
+        return ReplicationController(manifest, _cfg(
+            sched, default_rf=2, scoring=_rf2_scoring(), serve=serve,
+            scrub=ScrubConfig(bytes_per_window=int(sizes.mean()) * 4),
+            max_bytes_per_window=int(3 * sizes.max())))
+
+    ref = mk().run(events)
+    assert len(ref.records) >= 4
+    ck = str(tmp_path / "scrub.npz")
+    a = mk().run(events, checkpoint_path=ck, max_windows=2)  # mid-lap
+    b = mk().run(events, checkpoint_path=ck)
+    assert _strip(a.records) + _strip(b.records) == _strip(ref.records)
+    np.testing.assert_array_equal(b.rf, ref.rf)
+
+
+def test_scrub_checkpoint_flag_mismatch(tmp_path, workload):
+    """A scrubbing controller cannot resume from a scrub-less checkpoint
+    (and vice versa) — cursor/hint state would silently vanish."""
+    manifest, events = workload
+    ck = str(tmp_path / "c.npz")
+    sched = ["corrupt:dn2@1:0.5"]
+    ReplicationController(manifest, _cfg(
+        FaultSchedule.from_specs(sched))).run(
+        events, checkpoint_path=ck, max_windows=2)
+    with pytest.raises(ValueError, match="scrub"):
+        ReplicationController(manifest, _cfg(
+            FaultSchedule.from_specs(sched),
+            scrub=ScrubConfig(bytes_per_window=10**9))).run(
+            events, checkpoint_path=ck)
+
+
+# -- digests, auditor, CLI ---------------------------------------------------
+
+def test_integrity_digest_shape_and_absence():
+    from cdrs_tpu.obs.aggregate import integrity_digest
+
+    assert integrity_digest([{"window": 0}]) is None  # pre-integrity
+    rows = [
+        {"window": 0,
+         "integrity": {"corrupt_copies": 5, "files_corrupt": 5,
+                       "true_lost": 1, "detected_scrub": 2,
+                       "detected_read": 1, "detected_repair": 0},
+         "scrub": {"bytes": 100, "copies_verified": 4,
+                   "corrupt_found": 2, "starved": True, "cursor": 4},
+         "reads_corrupt_served": 3},
+        {"window": 1,
+         "integrity": {"corrupt_copies": 1, "files_corrupt": 1,
+                       "true_lost": 0, "detected_scrub": 1,
+                       "detected_read": 0, "detected_repair": 1},
+         "scrub": {"bytes": 80, "copies_verified": 3,
+                   "corrupt_found": 1, "starved": False, "cursor": 7}},
+    ]
+    d = integrity_digest(rows)
+    assert d["corrupt_copies_max"] == 5
+    assert d["corrupt_copies_final"] == 1
+    assert d["true_lost_max"] == 1 and d["true_lost_final"] == 0
+    assert d["detected_total"] == 5
+    assert d["detected_scrub"] == 3 and d["detected_read"] == 1
+    assert d["corrupt_reads_served"] == 3
+    assert d["scrub_bytes_total"] == 180
+    assert d["scrub_starved_windows"] == 1
+
+
+def test_auditor_flags_corruption_and_starvation():
+    from cdrs_tpu.obs import Telemetry
+    from cdrs_tpu.obs.audit import DecisionAuditor
+
+    tel = Telemetry()
+    aud = DecisionAuditor(np.ones(10, dtype=np.int64), 4)
+    rec = {"integrity": {"corrupt_copies": 2, "true_lost": 0,
+                         "detected_scrub": 1, "detected_read": 0,
+                         "detected_repair": 0},
+           "scrub": {"starved": True}}
+    ev = aud.audit_window(tel, window=0, rec=rec, X=None, centroids=None,
+                          rf=np.ones(10, dtype=np.int64),
+                          cat=np.zeros(10, dtype=np.int64))
+    assert "corruption_detected" in ev["flags"]
+    assert "scrub_starved" in ev["flags"]
+    assert ev["integrity"]["corrupt_copies"] == 2
+    # No detections, no starvation: neither flag.
+    ev2 = aud.audit_window(tel, window=1, rec={
+        "integrity": {"corrupt_copies": 2, "true_lost": 0,
+                      "detected_scrub": 0, "detected_read": 0,
+                      "detected_repair": 0},
+        "scrub": {"starved": False}},
+        X=None, centroids=None, rf=np.ones(10, dtype=np.int64),
+        cat=np.zeros(10, dtype=np.int64))
+    assert "corruption_detected" not in ev2["flags"]
+    assert "scrub_starved" not in ev2["flags"]
+
+
+def test_summarize_and_report_render_integrity(tmp_path, workload,
+                                               capsys):
+    """`cdrs metrics summarize` prints the Integrity digest and `report`
+    emits the Data-integrity section for an integrity stream — and both
+    stay silent for pre-integrity streams."""
+    from cdrs_tpu.obs.metrics_cli import summarize_events
+    from cdrs_tpu.obs.report import render_html
+
+    manifest, events = workload
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+    sched = FaultSchedule.from_specs(["corrupt:dn2@1:1.0"])
+    from cdrs_tpu.obs import Telemetry
+    from cdrs_tpu.obs.sink import JsonlSink
+
+    mpath = str(tmp_path / "m.jsonl")
+    with Telemetry(sink=JsonlSink(mpath)):
+        ReplicationController(manifest, _cfg(
+            sched, default_rf=2, scoring=_rf2_scoring(),
+            scrub=ScrubConfig(bytes_per_window=int(sizes.sum()) * 3),
+        )).run(events, metrics_path=mpath)
+    rows = [json.loads(line) for line in
+            open(mpath, encoding="utf-8") if line.strip()]
+    import io
+
+    out = io.StringIO()
+    summarize_events(rows, out=out)
+    text = out.getvalue()
+    assert "Integrity:" in text
+    assert "detected:" in text
+    html = render_html(rows)
+    assert "Data integrity (silent corruption)" in html
+    # scrub.* and integrity.* counters landed in the stream.
+    names = {r.get("name") for r in rows if r.get("kind") == "counter"}
+    assert "scrub.corrupt_found" in names
+    gauge_names = {r.get("name") for r in rows if r.get("kind") == "gauge"}
+    assert "integrity.corrupt_copies" in gauge_names
+    # Pre-integrity streams render without the section.
+    plain = [r for r in rows if r.get("kind") != "window"]
+    assert "Data integrity" not in render_html(plain)
+
+
+def test_cli_chaos_corrupt_scrub_end_to_end(tmp_path, capsys):
+    from cdrs_tpu.cli import main
+
+    m = str(tmp_path / "m.csv")
+    log = str(tmp_path / "a.log")
+    assert main(["gen", "--n", "80", "--nodes", ",".join(NODES),
+                 "--seed", str(50 + SEED), "--out_manifest", m]) == 0
+    assert main(["simulate", "--manifest", m, "--out", log,
+                 "--duration_seconds", "300", "--seed",
+                 str(51 + SEED)]) == 0
+    sched_out = str(tmp_path / "sched.json")
+    capsys.readouterr()
+    assert main(["chaos", "--manifest", m, "--access_log", log,
+                 "--window_seconds", "60", "--scoring_config", "validated",
+                 "--default_rf", "2", "--corrupt", "dn2@1:0.8",
+                 "--scrub", "200000000", "--schedule_out",
+                 sched_out]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "integrity" in out
+    assert out["integrity"]["detected_scrub"] > 0
+    rows = json.load(open(sched_out))
+    assert {r["kind"] for r in rows} == {"corrupt"}
+    assert rows[0]["fail_prob"] == 0.8
+    # Pinned-file spec round-trips through the CLI too.
+    assert main(["chaos", "--manifest", m, "--access_log", log,
+                 "--window_seconds", "60", "--scoring_config", "validated",
+                 "--corrupt", "dn1#3@1", "--max_windows", "2"]) == 0
+    out2 = json.loads(capsys.readouterr().out)
+    assert "integrity" in out2
+
+
+def test_cli_serve_corrupt_baseline_vs_verified(tmp_path, capsys):
+    from cdrs_tpu.cli import main
+
+    m = str(tmp_path / "m.csv")
+    log = str(tmp_path / "a.log")
+    main(["gen", "--n", "60", "--nodes", ",".join(NODES),
+          "--seed", str(60 + SEED), "--out_manifest", m])
+    main(["simulate", "--manifest", m, "--out", log,
+          "--duration_seconds", "240", "--seed", str(61 + SEED)])
+    capsys.readouterr()
+    base = ["serve", "--manifest", m, "--access_log", log,
+            "--window_seconds", "60", "--default_rf", "2",
+            "--corrupt", "dn1@0:1.0"]
+    assert main(base + ["--no_verify_reads"]) == 0
+    blind = json.loads(capsys.readouterr().out)
+    assert blind["reads_corrupt_served"] > 0
+    assert main(base) == 0
+    verified = json.loads(capsys.readouterr().out)
+    assert verified["reads_corrupt_served"] == 0
+    assert verified["reads_corrupt_detected"] > 0
+
+
+# -- checkpoint fuzz (satellite) ---------------------------------------------
+
+def _fuzz_corrupt_file(path: str, seed: int) -> None:
+    """Truncate or bit-flip the file at a seeded random offset, then
+    guarantee the damage actually broke the npz (fall back to a hard
+    truncation when the flip landed in dead zip padding)."""
+    from cdrs_tpu.utils.checkpoint import CheckpointError, load_state
+
+    rng = np.random.default_rng(seed)
+    size = os.path.getsize(path)
+    offset = int(rng.integers(1, max(size - 1, 2)))
+    if rng.random() < 0.5:
+        with open(path, "r+b") as f:
+            f.truncate(offset)
+    else:
+        with open(path, "r+b") as f:
+            f.seek(offset)
+            chunk = f.read(64)
+            f.seek(offset)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+    try:
+        load_state(path)
+    except CheckpointError:
+        return
+    with open(path, "r+b") as f:  # flip hit dead bytes: truncate instead
+        f.truncate(max(size // 2, 1))
+    with pytest.raises(CheckpointError):
+        load_state(path)
+
+
+@pytest.mark.slow
+def test_checkpoint_fuzz_prev_fallback_across_modes(tmp_path, workload):
+    """Fuzz the live checkpoint (truncate/bit-flip at seeded random
+    offsets, seeds 0/1/2) across control/chaos/serve/storage flag
+    combinations: every resume degrades to the retained ``.prev``
+    last-good snapshot, increments ``degraded.checkpoint_fallback``, and
+    re-converges bit-identically to the uninterrupted run."""
+    import shutil
+
+    from cdrs_tpu.obs import Telemetry
+    from cdrs_tpu.serve import ServeConfig, SloSpec
+    from cdrs_tpu.storage import StorageConfig, Strategy
+
+    manifest, events = workload
+    sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+    serve = ServeConfig(policy="p2c", seed=0, service_ms=0.5,
+                        slo=SloSpec(target_ms=10.0, availability=0.999))
+    scoring = _rf2_scoring()
+    # ec(2,1) fits the 4-node toy topology (ec_archival's 6+3 does not).
+    storage = StorageConfig(strategies={
+        **{c: Strategy(kind="replicate", rf=r)
+           for c, r in scoring.replication_factors.items()
+           if c != "Archival"},
+        "Archival": Strategy.from_spec("ec(2,1):cold")})
+    combos = {
+        "control": dict(),
+        "chaos_scrub": dict(
+            fault_schedule=FaultSchedule.from_specs(
+                ["corrupt:dn2@1:0.5", "crash:dn3@2-3"]),
+            scrub=ScrubConfig(bytes_per_window=int(sizes.mean()) * 4)),
+        "chaos_serve": dict(
+            fault_schedule=FaultSchedule.from_specs(["crash:dn2@1-2"]),
+            serve=serve),
+        "chaos_storage": dict(
+            fault_schedule=FaultSchedule.from_specs(["crash:dn2@1-2"]),
+            storage=storage),
+    }
+    for name, extra in combos.items():
+        kw = dict(default_rf=2, scoring=scoring)
+        kw.update(extra)
+        sched = kw.pop("fault_schedule", None)
+
+        def mk():
+            return ReplicationController(manifest, _cfg(sched, **kw))
+
+        ref = mk().run(events)
+        ck = str(tmp_path / f"{name}.npz")
+        mk().run(events, checkpoint_path=ck, max_windows=3)
+        assert os.path.exists(ck + ".prev"), name
+        pristine = ck + ".pristine"
+        shutil.copyfile(ck, pristine)
+        shutil.copyfile(ck + ".prev", pristine + ".prev")
+        for seed in (0, 1, 2):
+            shutil.copyfile(pristine, ck)
+            shutil.copyfile(pristine + ".prev", ck + ".prev")
+            _fuzz_corrupt_file(ck, seed)
+            tel = Telemetry()
+            with tel, pytest.warns(RuntimeWarning, match="last-good"):
+                res = mk().run(events, checkpoint_path=ck)
+            assert tel.counters.get("degraded.checkpoint_fallback") == 1, \
+                (name, seed)
+            # Bit-identical re-convergence from the one-older snapshot.
+            np.testing.assert_array_equal(res.rf, ref.rf, err_msg=name)
+            np.testing.assert_array_equal(res.category_idx,
+                                          ref.category_idx, err_msg=name)
+            assert _strip(res.records) == \
+                _strip(ref.records)[-len(res.records):], (name, seed)
