@@ -34,9 +34,12 @@ accounting must keep telemetry inside the repo's ≤ 1.05x budget.
 
 ``python -m cdrs_tpu.benchmarks.integrity_bench`` writes
 ``data/integrity_bench.json``; ``--quick`` shrinks sizes for the CI
-smoke.  The round-9 bench_record (detection-margin ratio at the half-lap
-budget) is appended to ``data/bench_history.jsonl`` manually (the
-append-only contract — ``regress --ingest`` re-sorts).
+smoke.  The bench_record (detection-margin ratio at the half-lap
+budget) is auto-appended to ``data/bench_history.jsonl`` through
+``benchmarks/regress.append_history`` — append-only, deduplicated on
+(round, metric, platform), so re-runs never double-append.  ``--quick``
+runs never append (a smoke-scale row must not become the ledger entry a
+real run is deduped against); ``--history ''`` disables explicitly.
 """
 
 from __future__ import annotations
@@ -358,6 +361,9 @@ def main(argv=None) -> int:
     p.add_argument("--kill_window", type=int, default=6)
     p.add_argument("--k", type=int, default=12)
     p.add_argument("--round_no", type=int, default=9)
+    from .regress import add_history_argument
+
+    add_history_argument(p)
     p.add_argument("--no_overhead", action="store_true",
                    help="skip the paired telemetry-overhead rounds")
     p.add_argument("--quick", action="store_true",
@@ -422,8 +428,17 @@ def main(argv=None) -> int:
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(out, f, indent=2)
         f.write("\n")
+    from .regress import append_history, extract_records, \
+        resolve_history_path
+
+    history = resolve_history_path(args)
+    appended = 0
+    if history:
+        appended = append_history(
+            history, extract_records(out, os.path.basename(args.out)))
     print(json.dumps({
         "out": args.out, **out["criteria"],
+        "history_appended": appended,
         "mttd_margin_half_lap": out["bench_records"][0]["value"],
         "unscrubbed_true_lost": overlap["unscrubbed"]["true_lost_final"],
         "unscrubbed_corrupt_reads":

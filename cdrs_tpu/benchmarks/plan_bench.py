@@ -30,9 +30,12 @@ windows/sec plus record bit-identity (the overlap acceptance contract; on
 the numpy backend the overlap pipeline is exercised as a no-op schedule).
 
 ``python -m cdrs_tpu.benchmarks.plan_bench`` writes
-``data/plan_bench.json``.  Append its bench_record line to
-``data/bench_history.jsonl`` MANUALLY — ``regress --ingest`` re-sorts the
-history and breaks the canonical-history test.
+``data/plan_bench.json`` and auto-appends its bench_record to
+``data/bench_history.jsonl`` through ``benchmarks/regress.append_history``
+— append-only, deduplicated on (round, metric, platform), so re-runs
+never double-append.  ``--quick`` runs never append (a smoke-scale row
+must not become the ledger entry a real run is deduped against);
+``--history ''`` disables explicitly.
 """
 
 from __future__ import annotations
@@ -330,6 +333,9 @@ def main(argv=None) -> int:
     p.add_argument("--out", default="data/plan_bench.json")
     p.add_argument("--round", type=int, default=8, dest="round_no",
                    help="PR-round stamp for the regress history")
+    from .regress import add_history_argument
+
+    add_history_argument(p)
     p.add_argument("--rounds", type=int, default=3,
                    help="interleaved paired timing rounds per scale")
     p.add_argument("--seed", type=int, default=8)
@@ -352,7 +358,16 @@ def main(argv=None) -> int:
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(out, f, indent=2)
         f.write("\n")
+    from .regress import append_history, extract_records, \
+        resolve_history_path
+
+    history = resolve_history_path(args)
+    appended = 0
+    if history:
+        appended = append_history(
+            history, extract_records(out, os.path.basename(args.out)))
     print(json.dumps({"out": args.out, **out["criteria"],
+                      "history_appended": appended,
                       "top_scale_speedup":
                           out["scales"][-1]["planner_speedup"],
                       "windows_per_sec_overlap":

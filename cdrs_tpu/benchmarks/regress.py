@@ -34,7 +34,8 @@ import re
 import sys
 
 __all__ = ["extract_records", "ingest_files", "load_history", "check_run",
-           "main"]
+           "append_history", "history_key", "add_history_argument",
+           "resolve_history_path", "main"]
 
 #: Units where smaller is better (wall-clock style metrics); everything
 #: else (iter/s, files/s, events/s) is throughput, larger is better.
@@ -64,7 +65,10 @@ def _record_from(detail: dict, source: str, round_no: int | None
         "metric": detail["metric"],
         "value": float(detail["value"]),
         "unit": detail.get("unit"),
-        "direction": _direction(detail["metric"], detail.get("unit")),
+        # An explicit direction wins: the scenario sweep pins e.g. churn
+        # bytes as lower-is-better, which no unit heuristic can know.
+        "direction": detail.get("direction")
+        or _direction(detail["metric"], detail.get("unit")),
         "platform": detail.get("jax_platform")
         or ("numpy" if detail.get("backend") == "numpy" else None),
         "devices": detail.get("jax_devices"),
@@ -177,6 +181,69 @@ def write_history(path: str, records: list[dict]) -> None:
     with open(path, "w", encoding="utf-8") as f:
         for r in records:
             f.write(json.dumps(r, sort_keys=True) + "\n")
+
+
+def history_key(rec: dict) -> tuple:
+    """The identity of one history row: (round, metric, platform).
+
+    One bench measurement per PR round per platform — re-ingesting the
+    same artifact (or re-running a sweep) must be a no-op, and a
+    re-measured value for an existing key keeps the ORIGINAL row (the
+    history is an append-only ledger, not a cache)."""
+    return (rec.get("round"), rec.get("metric"), rec.get("platform"))
+
+
+def append_history(path: str, records: list[dict]) -> int:
+    """Append ``records`` to the history, deduplicated on
+    ``history_key`` — the shared helper behind the scenario sweep and
+    the bench drivers (plan_bench/integrity_bench used to note "appended
+    manually").  Existing rows are never rewritten or re-sorted (the
+    append-only artifact-order contract tests/test_regress.py pins);
+    new rows append in the given order.  Returns the number of rows
+    actually appended."""
+    have: set[tuple] = set()
+    if os.path.exists(path):
+        have = {history_key(r) for r in load_history(path)}
+    fresh = []
+    for rec in records:
+        key = history_key(rec)
+        if key in have:
+            continue
+        have.add(key)
+        fresh.append(rec)
+    if not fresh:
+        return 0
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "a", encoding="utf-8") as f:
+        for r in fresh:
+            f.write(json.dumps(r, sort_keys=True) + "\n")
+    return len(fresh)
+
+
+def add_history_argument(parser) -> None:
+    """The shared ``--history`` flag of the auto-appending benches
+    (plan_bench, integrity_bench, the scenario sweep's drivers): one
+    definition so the ledger policy cannot drift between them."""
+    parser.add_argument(
+        "--history", default=None, metavar="JSONL",
+        help="append the bench_records here (regress.append_history: "
+             "deduped on (round, metric, platform), so re-runs never "
+             "double-append). Default: data/bench_history.jsonl for "
+             "full runs, DISABLED for --quick — a smoke-scale "
+             "measurement must never become the ledger row a real run "
+             "is then deduped against; '' disables explicitly")
+
+
+def resolve_history_path(args) -> str:
+    """The ledger path the parsed ``--history`` flag means: the given
+    path verbatim when set ('' = disabled), else the default ledger —
+    unless the run is ``--quick``, which never auto-appends."""
+    if args.history is not None:
+        return args.history
+    return "" if getattr(args, "quick", False) \
+        else "data/bench_history.jsonl"
 
 
 def load_history(path: str) -> list[dict]:
@@ -307,8 +374,11 @@ def main(argv: list[str] | None = None) -> int:
                              "(default: data/bench_history.jsonl)")
     parser.add_argument("--ingest", nargs="+", default=None,
                         metavar="JSON",
-                        help="(re)build the history from these BENCH "
-                             "artifacts instead of checking a run")
+                        help="ingest these BENCH artifacts into the "
+                             "history instead of checking a run: an "
+                             "existing history is appended to, deduped "
+                             "on (round, metric, platform) — idempotent "
+                             "— and built fresh when absent")
     parser.add_argument("--tolerance", type=float, default=0.15,
                         help="band half-width as a fraction of the "
                              "baseline (default 0.15)")
@@ -329,11 +399,16 @@ def main(argv: list[str] | None = None) -> int:
             print("error: no bench records found in the given files",
                   file=sys.stderr)
             return 2
-        write_history(args.history, records)
-        rounds = sorted({r.get("round") for r in records})
-        print(f"ingested {len(records)} records from "
-              f"{len(args.ingest)} files (rounds {rounds}) -> "
-              f"{args.history}")
+        # Idempotent by (round, metric, platform) on BOTH paths: an
+        # existing history is appended to (never re-sorted — the
+        # append-only artifact-order contract), a fresh one is built
+        # with the same within-batch dedup, and re-ingesting the same
+        # artifacts is a no-op either way.
+        appended = append_history(args.history, records)
+        skipped = len(records) - appended
+        print(f"ingested {appended} records from "
+              f"{len(args.ingest)} files -> {args.history}"
+              + (f" ({skipped} already present)" if skipped else ""))
         return 0
 
     if not args.run:

@@ -11,6 +11,8 @@ subcommand of ``python -m cdrs_tpu`` (or the ``cdrs`` console script):
   pipeline  all of the above end-to-end      (reference: run_pipeline.sh)
             (alias: run)
   storage   storage strategies: EC/tier config resolution + cost estimate
+  scenarios declarative scenario matrix: invariant-gated chaos sweeps
+            (new; cdrs_tpu/scenarios)
   bench     benchmark harness                          (new; BASELINE.md configs)
   metrics   inspect telemetry JSONL streams            (new; obs/metrics_cli.py)
 
@@ -805,6 +807,134 @@ def _cmd_storage(args) -> int:
     return 0
 
 
+def _cmd_scenarios(args) -> int:
+    """Declarative scenario matrix (cdrs_tpu/scenarios): list the named
+    presets and suites, run one cell, or sweep a suite's matrix — every
+    cell runs the controller end to end and is gated on INVARIANTS (zero
+    silent loss, churn-budget conservation, domain diversity, SLO
+    bounds, sampled kill/resume bit-identity); a failing cell prints a
+    one-line seeded repro command."""
+    from .scenarios import (
+        PRESETS,
+        SUITES,
+        ScenarioSpec,
+        preset,
+        run_cell,
+        suite_cells,
+    )
+
+    if args.action == "list":
+        print("presets:")
+        for name in sorted(PRESETS):
+            sp = PRESETS[name]
+            axes = [(sp.workload or {}).get("kind", "poisson")]
+            if sp.drift:
+                axes.append(f"drift={sp.drift['kind']}")
+            if sp.faults:
+                axes.append("faults")
+            if sp.racks:
+                axes.append("racks")
+            if sp.storage:
+                axes.append(f"storage={sp.storage}")
+            if sp.serve:
+                axes.append(f"serve={sp.serve.get('policy', 'p2c')}")
+            if sp.scrub:
+                axes.append("scrub")
+            if sp.resume_window is not None:
+                axes.append("resume-check")
+            print(f"  {name:<22} n={sp.n_files:<5} "
+                  f"windows={sp.n_windows:<3} seed={sp.seed:<3} "
+                  + " ".join(axes))
+        print("suites:")
+        for name, (names, n_random) in SUITES.items():
+            print(f"  {name:<22} {len(names)} presets "
+                  f"+ {n_random} random cells")
+        return 0
+
+    if args.action == "run":
+        suite = None
+        if args.preset:
+            if args.seed:
+                # A preset names its PINNED workload; the shifted
+                # variants the multi-seed CI sweep runs are suite cells.
+                print("error: --preset runs the pinned cell and takes "
+                      "no --seed — use --suite ... --seed N --cell "
+                      f"{args.preset} for the shifted variant",
+                      file=sys.stderr)
+                return 2
+            spec = preset(args.preset)
+        elif args.cell:
+            suite = args.suite
+            cells = {c.name: c for c in suite_cells(suite, args.seed)}
+            if args.cell not in cells:
+                print(f"error: no cell {args.cell!r} in suite {suite!r} "
+                      f"(have {sorted(cells)})", file=sys.stderr)
+                return 2
+            spec = cells[args.cell]
+        elif args.spec:
+            text = args.spec
+            if not text.lstrip().startswith("{"):
+                with open(text, encoding="utf-8") as f:
+                    text = f.read()
+            spec = ScenarioSpec.from_dict(json.loads(text))
+        else:
+            print("error: scenarios run needs --preset NAME, --cell NAME "
+                  "(with --suite), or --spec JSON|FILE", file=sys.stderr)
+            return 2
+        cell = run_cell(spec, suite=suite,
+                        suite_seed=args.seed if suite else 0)
+        print(json.dumps(cell, indent=2))
+        if not cell["ok"]:
+            print(f"FAILED; repro: {cell['repro']}", file=sys.stderr)
+            return 1
+        return 0
+
+    # sweep
+    from .scenarios.sweep import format_cell_line, run_sweep
+
+    try:
+        out = run_sweep(
+            args.suite, seed=args.seed, round_no=args.round_no,
+            history=args.history or None,
+            progress=lambda line: print(line, file=sys.stderr,
+                                        flush=True))
+    except ValueError as e:
+        # run_cells validates the seed/round/history combination before
+        # any cell runs (per-cell baselines are defined at seed 0).
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.metrics:
+        from .obs import JsonlSink
+
+        sink = JsonlSink(args.metrics)
+        try:
+            for c in out["cells"]:
+                sink.emit({"kind": "cell",
+                           **{k: v for k, v in c.items() if k != "spec"}})
+        finally:
+            sink.close()
+    if args.out:
+        parent = os.path.dirname(args.out)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    digest = {k: out[k] for k in ("suite", "seed", "n_cells", "n_failed",
+                                  "invariants_checked", "ok", "seconds")}
+    if "round" in out:
+        digest["round"] = out["round"]
+    if "history_appended" in out:
+        digest["history_appended"] = out["history_appended"]
+    print(json.dumps(digest, indent=2))
+    if not out["ok"]:
+        for c in out["cells"]:
+            if not c["ok"]:
+                print(format_cell_line(c), file=sys.stderr)
+        return 1
+    return 0
+
+
 def _cmd_bench(args) -> int:
     import contextlib
 
@@ -1201,6 +1331,48 @@ def main(argv: list[str] | None = None) -> int:
                    help="scoring config supplying the replicate-fallback "
                         "rf table")
     p.set_defaults(fn=_cmd_storage)
+
+    p = sub.add_parser("scenarios", help="declarative scenario matrix: "
+                       "list presets/suites, run one cell, or sweep a "
+                       "suite gated on invariants (zero silent loss, "
+                       "churn budget, domain diversity, SLO, sampled "
+                       "kill/resume bit-identity)")
+    p.add_argument("action", choices=["list", "run", "sweep"],
+                   help="list = named presets + suites; run = one cell "
+                        "(--preset / --suite+--cell / --spec); sweep = "
+                        "every cell of --suite, nonzero exit on any "
+                        "invariant failure")
+    p.add_argument("--suite", default="ci-smoke",
+                   help="cell suite (default ci-smoke; see 'scenarios "
+                        "list')")
+    p.add_argument("--seed", type=int, default=0,
+                   help="suite seed: deterministically parameterizes the "
+                        "random cells")
+    p.add_argument("--preset", default=None, metavar="NAME",
+                   help="(run) a named preset cell")
+    p.add_argument("--cell", default=None, metavar="NAME",
+                   help="(run) one cell of --suite — the failing-cell "
+                        "repro path")
+    p.add_argument("--spec", default=None, metavar="JSON|FILE",
+                   help="(run) an inline spec JSON object or a path to "
+                        "one")
+    p.add_argument("--out", default=None, metavar="JSON",
+                   help="(sweep) write the full sweep artifact here "
+                        "(per-cell invariants, metrics, bench_records)")
+    p.add_argument("--round", type=int, default=None, dest="round_no",
+                   help="(sweep) PR-round stamp: appends the per-cell "
+                        "bench_records to --history (regress."
+                        "append_history, deduped — re-runs never "
+                        "double-append)")
+    p.add_argument("--history", default="data/bench_history.jsonl",
+                   metavar="JSONL",
+                   help="(sweep) trajectory history the per-cell records "
+                        "append to when --round is given")
+    p.add_argument("--metrics", default=None, metavar="JSONL",
+                   help="(sweep) emit per-cell records as 'cell' events "
+                        "here; 'cdrs metrics summarize' renders a "
+                        "Scenarios digest")
+    p.set_defaults(fn=_cmd_scenarios)
 
     p = sub.add_parser("bench", help="benchmark harness (BASELINE.md configs)")
     p.add_argument("--config", type=int, default=1)

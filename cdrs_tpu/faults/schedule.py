@@ -230,6 +230,53 @@ class FaultSchedule:
         return cls(events)
 
     @classmethod
+    def cascade(cls, nodes, start: int, spacing: int = 1,
+                recover_after: int | None = None) -> "FaultSchedule":
+        """Cascading failure template: ``nodes[i]`` crashes at window
+        ``start + i * spacing`` — the correlated rolling outage (power
+        strip, bad kernel rollout) that a single-crash scenario never
+        exercises: each crash lands while the repair backlog from the
+        previous one is still draining, so the churn budget is contested
+        the whole way down.  ``recover_after`` windows later each node
+        returns (None = the cascade is permanent — but never pass ALL
+        nodes then, or the cluster ends empty)."""
+        nodes = tuple(nodes)
+        if not nodes:
+            raise ValueError("cascade needs at least one node")
+        if spacing < 1:
+            raise ValueError(f"spacing must be >= 1, got {spacing}")
+        events = []
+        for i, n in enumerate(nodes):
+            w = int(start) + i * int(spacing)
+            events.append(FaultEvent(w, "crash", n))
+            if recover_after is not None:
+                if recover_after < 1:
+                    raise ValueError(
+                        f"recover_after must be >= 1, got {recover_after}")
+                events.append(FaultEvent(w + int(recover_after),
+                                         "recover", n))
+        return cls(events)
+
+    @classmethod
+    def rolling_decommission(cls, nodes, start: int,
+                             spacing: int = 2) -> "FaultSchedule":
+        """Rolling-decommission template: ``nodes[i]`` is PERMANENTLY
+        removed (replicas destroyed) at window ``start + i * spacing`` —
+        the planned fleet-drain scenario: data must be re-replicated off
+        each node before the next one goes, entirely out of the shared
+        churn budget, with zero loss as the pass/fail line.  The caller
+        must leave enough surviving nodes for the target replication
+        factors."""
+        nodes = tuple(nodes)
+        if not nodes:
+            raise ValueError("rolling_decommission needs at least one node")
+        if spacing < 1:
+            raise ValueError(f"spacing must be >= 1, got {spacing}")
+        return cls([FaultEvent(int(start) + i * int(spacing),
+                               "decommission", n)
+                    for i, n in enumerate(nodes)])
+
+    @classmethod
     def random(cls, nodes, n_windows: int, seed: int = 0,
                crash_rate: float = 0.08, recover_windows=(2, 5),
                flaky_rate: float = 0.04,

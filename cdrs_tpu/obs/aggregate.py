@@ -16,7 +16,8 @@ from __future__ import annotations
 __all__ = ["collect", "span_forest", "ordered_span_paths", "percentile",
            "bucket_percentile", "merge_hist_buckets", "dedup_windows",
            "final_counters", "roofline_rows", "fmt_bytes", "serve_digest",
-           "storage_digest", "pacing_digest", "integrity_digest"]
+           "storage_digest", "pacing_digest", "integrity_digest",
+           "cells_digest"]
 
 
 def fmt_bytes(b, sep: str = " ") -> str:
@@ -186,6 +187,7 @@ def collect(events: list[dict]) -> dict:
     traces: dict[tuple, list[dict]] = {}
     xla: dict[tuple, dict] = {}
     meta: dict = {}
+    cells: dict[str, dict] = {}
     for e in events:
         kind = e.get("kind")
         if kind == "gauge":
@@ -218,6 +220,12 @@ def collect(events: list[dict]) -> dict:
                         row[key] = e[key]
         elif kind == "meta" and isinstance(e.get("run"), dict):
             meta = e["run"]
+        elif kind == "cell":
+            # Scenario-matrix cell records (cdrs scenarios sweep
+            # --metrics): last observation per cell name wins, stream
+            # order preserved — the rerun-a-failing-cell workflow appends
+            # to the same stream.
+            cells[str(e.get("cell"))] = e
     return {
         "spans": span_forest(events),
         "counters": final_counters(events),
@@ -228,9 +236,31 @@ def collect(events: list[dict]) -> dict:
         "traces": traces,
         "windows": dedup_windows(events, "window"),
         "audits": dedup_windows(events, "audit"),
+        "cells": list(cells.values()),
         "xla": [xla[k] for k in sorted(xla, key=lambda t: (str(t[0]),
                                                            str(t[1])))],
         "meta": meta,
+    }
+
+
+def cells_digest(cells: list[dict]) -> dict | None:
+    """Scenario-matrix digest over sweep cell records (``kind: cell`` —
+    scenarios/sweep.py).  None when the stream has no cells, so
+    non-sweep streams render unchanged everywhere."""
+    if not cells:
+        return None
+    failed = [c for c in cells if not c.get("ok")]
+    return {
+        "cells": len(cells),
+        "invariants_checked": sum(len(c.get("invariants") or {})
+                                  for c in cells),
+        "failed": sorted(str(c.get("cell")) for c in failed),
+        "failed_invariants": sorted({
+            k for c in failed
+            for k, v in (c.get("invariants") or {}).items() if not v}),
+        "ok": not failed,
+        "seconds_total": round(sum(float(c.get("seconds", 0.0))
+                                   for c in cells), 3),
     }
 
 

@@ -219,6 +219,23 @@ def _render_integrity(windows: list[dict], out) -> None:
         print(line, file=out)
 
 
+def _render_cells(cells: list[dict], out) -> None:
+    """Scenario-matrix digest (sweep cell records, ``kind: cell``)."""
+    from .aggregate import cells_digest
+
+    d = cells_digest(cells)
+    if d is None:
+        return
+    verdict = "all green" if d["ok"] else \
+        f"FAILED {len(d['failed'])}: {', '.join(d['failed'])}"
+    print(f"\nScenarios: {d['cells']} cells, "
+          f"{d['invariants_checked']} invariants checked — {verdict} "
+          f"({d['seconds_total']:.1f}s)", file=out)
+    if d["failed_invariants"]:
+        print(f"  failed invariants: "
+              f"{', '.join(d['failed_invariants'])}", file=out)
+
+
 def _render_audit(audits: list[dict], out) -> None:
     if not audits:
         return
@@ -301,6 +318,7 @@ def summarize_events(events: list[dict], out=None, peak_flops=None,
                   f"{inertia}, final shift {last['shift']:.3g}", file=out)
 
     _render_audit(digest["audits"], out)
+    _render_cells(digest.get("cells") or [], out)
     _render_serving(digest["windows"], out)
     _render_storage(digest["windows"], out)
     _render_durability(digest["windows"], out)

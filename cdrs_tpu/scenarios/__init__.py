@@ -1,0 +1,39 @@
+"""Declarative scenario matrix: invariant-gated chaos sweeps.
+
+The fault machinery grown in PRs 4-9 (crashes, partitions, stragglers,
+silent rot) was only ever exercised in the exact combinations a bench
+author thought of.  This package replaces that posture with a matrix: a
+declarative spec (spec.py) over workload curve x drift pattern x fault
+schedule x topology x storage strategy x scale x serve config, ONE
+harness (harness.py) that runs any cell end to end and checks
+invariants — zero silent loss, churn-budget conservation, placement
+domain diversity, SLO bounds, sampled kill/resume bit-identity — and
+named presets + seeded random cells (presets.py) swept by ``cdrs
+scenarios sweep``.
+
+Why a matrix and not more hand-picked configs: CRUSH (Weil et al., SC
+2006 — PAPERS.md) argues placement properties must hold across the
+space of cluster maps, not at sampled points; and Yuan et al., "Simple
+Testing Can Prevent Most Critical Failures" (OSDI 2014 — PAPERS.md)
+found that the majority of catastrophic distributed-system failures
+stem from error-handling code that was never exercised — systematic,
+not incidental, coverage of the failure paths is exactly what the
+invariant-gated sweep provides.  Every cell is seeded and every failing
+cell prints a one-line repro command.
+"""
+
+from .harness import run_cell
+from .presets import PRESETS, SUITES, preset, random_cell, suite_cells
+from .spec import ScenarioSpec
+from .sweep import run_sweep
+
+__all__ = [
+    "PRESETS",
+    "SUITES",
+    "ScenarioSpec",
+    "preset",
+    "random_cell",
+    "run_cell",
+    "run_sweep",
+    "suite_cells",
+]

@@ -1,0 +1,449 @@
+"""ONE harness for every scenario cell: build, run, check invariants.
+
+``run_cell`` turns a declarative ``ScenarioSpec`` into a controller run —
+manifest, workload curve, drift phases, fault schedule, topology, storage
+strategy, serve config, scrubber — and then checks **invariants**, not
+just metrics:
+
+* **zero silent loss** — no file ends the run lost (blind durability
+  tier), and when the integrity layer is active no file ends TRULY lost
+  (``true_lost``: clean copies below the survivable minimum — the state
+  the blind tiers cannot see) and no rot survives an active scrubber.
+* **churn-budget conservation** — every window's repair + migration +
+  scrub traffic fits the one shared byte budget (integrity runs: to
+  within ONE verified boundary task — verified repair deliberately
+  charges the budget-crossing task's source-verification reads, see
+  ``_check_invariants``).
+* **domain diversity** — with a multi-rack topology, no file ends with
+  all its reachable replicas in one domain (``correlated_risk``).
+* **SLO bounds** — when serving, the final (post-heal) window routed
+  every read (none unavailable), its p99 is finite, and optional
+  per-cell ``p99_max_ms`` / ``burn_max`` bounds hold.
+* **kill/resume bit-identity** — cells sampled with ``resume_window``
+  re-run killed mid-cell and resumed from the checkpoint; the stitched
+  record stream and final plan must equal the uninterrupted run's
+  bit-for-bit.
+* **positive engagement** — the axes must actually FIRE (fault events
+  applied, corruption rotted/detected, EC stripes stored, reads routed,
+  drift re-clustered): a cell whose injection silently became a no-op
+  fails instead of passing every negative check vacuously.
+
+A failing cell's result carries a one-line seeded repro command
+(``repro_line``) so the sweep output alone is enough to rerun exactly
+that cell.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from ..config import (
+    GeneratorConfig,
+    KMeansConfig,
+    ScoringConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from ..control import ControllerConfig, ReplicationController
+from ..faults import FaultSchedule
+from ..io.events import EventLog, Manifest
+from ..sim.access import (
+    simulate_access,
+    simulate_access_phased,
+    simulate_diurnal,
+    simulate_flash_crowd,
+)
+from ..sim.generator import generate_population
+from .spec import ScenarioSpec
+
+__all__ = ["build_events", "build_schedule", "run_cell", "repro_line"]
+
+_DEFAULT_FLIP = {"hot": "archival", "archival": "hot"}
+
+
+def _scoring(spec: ScenarioSpec) -> ScoringConfig:
+    """The cell's scoring table.  ``min_rf2`` = the chaos-bench posture
+    (validated tables with Moderate raised to rf 2 so no category
+    trivially loses a node's singletons)."""
+    import dataclasses
+
+    if spec.scoring == "default":
+        return ScoringConfig()
+    base = validated_scoring_config()
+    if spec.scoring == "validated":
+        return base
+    rf = dict(base.replication_factors)
+    rf["Moderate"] = max(2, rf["Moderate"])
+    return dataclasses.replace(base, replication_factors=rf)
+
+
+def build_events(spec: ScenarioSpec,
+                 manifest: Manifest) -> tuple[EventLog, np.ndarray]:
+    """The cell's event log from the workload x drift axes.
+
+    Returns ``(events, changed)`` — ``changed`` marks files whose final
+    planted category differs from the initial one (all-False for
+    drift-free curves and fully reverted adversarial cycles)."""
+    cfg = SimulatorConfig(duration_seconds=float(spec.duration),
+                          seed=int(spec.seed) + 1)
+    wl = spec.workload or {"kind": "poisson"}
+    kind = wl.get("kind", "poisson")
+    none = np.zeros(len(manifest), dtype=bool)
+    if kind == "diurnal":
+        period = float(wl.get("period_frac", 1.0)) * float(spec.duration)
+        ev = simulate_diurnal(manifest, cfg,
+                              amplitude=float(wl.get("amplitude", 0.8)),
+                              period=period,
+                              phase=float(wl.get("phase", 0.0)))
+        return ev, none
+    if kind == "flash_crowd":
+        cat = wl.get("cohort", "archival")
+        cohort = np.asarray([c == cat for c in manifest.category])
+        ev, _ = simulate_flash_crowd(
+            manifest, cfg, cohort=cohort,
+            start=float(wl.get("start_frac", 0.5)) * float(spec.duration),
+            duration=float(wl.get("duration_frac", 0.1))
+            * float(spec.duration),
+            boost=float(wl.get("boost", 40.0)))
+        return ev, none
+    if spec.drift is None:
+        return simulate_access(manifest, cfg), none
+    return simulate_access_phased(manifest, cfg,
+                                  _drift_shifts(spec, manifest))
+
+
+def _drift_shifts(spec: ScenarioSpec, manifest: Manifest) -> list[tuple]:
+    """The drift axis as ``simulate_access_phased`` shifts."""
+    d = spec.drift
+    flip = d.get("flip", _DEFAULT_FLIP)
+    duration = float(spec.duration)
+    if d["kind"] == "flip":
+        return [(float(d.get("at_frac", 0.5)) * duration, flip, None)]
+    start = float(d.get("start_frac", 0.3)) * duration
+    end = float(d.get("end_frac", 0.8)) * duration
+    if d["kind"] == "adversarial":
+        cycles = int(d.get("cycles", 3))
+        times = np.linspace(start, end, cycles)
+        return [(float(t), flip, None) for t in times]
+    # gradual: the cohort (files whose planted category is a flip key)
+    # migrates in `steps` index-ordered waves.
+    steps = int(d.get("steps", 3))
+    cohort = np.flatnonzero(
+        np.asarray([c in flip and flip[c] != c
+                    for c in manifest.category]))
+    chunks = np.array_split(cohort, steps)
+    times = np.linspace(start, end, steps)
+    shifts = []
+    for t, chunk in zip(times, chunks):
+        mask = np.zeros(len(manifest), dtype=bool)
+        mask[chunk] = True
+        shifts.append((float(t), flip, mask))
+    return shifts
+
+
+def build_schedule(spec: ScenarioSpec) -> FaultSchedule | None:
+    """The fault axis: explicit specs, templates and the seeded random
+    generator merged into one window-keyed schedule."""
+    f = spec.faults
+    if f is None:
+        return None
+    events: list = []
+    if f.get("specs"):
+        events.extend(FaultSchedule.from_specs(f["specs"]))
+    t = f.get("template")
+    if t == "cascade":
+        events.extend(FaultSchedule.cascade(
+            f["nodes"], int(f["start"]), int(f.get("spacing", 1)),
+            f.get("recover_after")))
+    elif t == "rolling_decommission":
+        events.extend(FaultSchedule.rolling_decommission(
+            f["nodes"], int(f["start"]), int(f.get("spacing", 2))))
+    elif t is not None:
+        raise ValueError(
+            f"cell {spec.name!r}: unknown fault template {t!r}")
+    if f.get("random"):
+        r = dict(f["random"])
+        r.setdefault("seed", spec.seed)
+        events.extend(FaultSchedule.random(
+            spec.nodes, int(r.pop("n_windows")), **r))
+    if not events:
+        raise ValueError(
+            f"cell {spec.name!r}: faults axis present but empty")
+    return FaultSchedule(events)
+
+
+def _controller(spec: ScenarioSpec, manifest: Manifest,
+                schedule: FaultSchedule | None) -> ReplicationController:
+    scoring = _scoring(spec)
+    topology = None
+    if spec.racks:
+        from ..cluster import ClusterTopology
+
+        topology = ClusterTopology.from_rack_spec(manifest.nodes,
+                                                  spec.racks)
+    storage = None
+    if spec.storage:
+        from ..storage import resolve_storage_config
+
+        storage = resolve_storage_config(spec.storage, scoring)
+    serve = None
+    if spec.serve is not None:
+        from ..serve import ServeConfig, SloSpec
+
+        s = spec.serve
+        serve = ServeConfig(
+            policy=s.get("policy", "p2c"), seed=int(s.get("seed", 0)),
+            service_ms=float(s.get("service_ms", 0.5)),
+            slo=SloSpec(target_ms=float(s.get("slo_ms", 10.0)),
+                        availability=float(s.get("availability", 0.999))),
+            recluster_on_hotspot=bool(s.get("recluster_on_hotspot", True)),
+            verify_reads=bool(s.get("verify_reads", True)))
+    scrub = None
+    if spec.scrub is not None:
+        from ..faults import ScrubConfig
+
+        scrub = ScrubConfig(bytes_per_window=int(spec.scrub))
+    max_bytes = None
+    if spec.budget_frac is not None:
+        sizes = np.asarray(manifest.size_bytes, dtype=np.int64)
+        max_bytes = int(float(spec.budget_frac) * float(sizes.sum()))
+    cfg = ControllerConfig(
+        window_seconds=spec.window_seconds,
+        drift_threshold=spec.drift_threshold,
+        full_recluster_drift=spec.full_recluster_drift,
+        hysteresis_windows=spec.hysteresis,
+        max_bytes_per_window=max_bytes,
+        max_files_per_window=spec.max_files,
+        decay=spec.decay,
+        default_rf=spec.default_rf,
+        backend=spec.backend,
+        kmeans=KMeansConfig(k=spec.k, seed=42),
+        scoring=scoring,
+        topology=topology,
+        fault_schedule=(None if schedule is None
+                        else FaultSchedule(schedule.events)),
+        storage=storage,
+        serve=serve,
+        scrub=scrub,
+    )
+    return ReplicationController(manifest, cfg)
+
+
+def _strip(records: list[dict]) -> list[dict]:
+    """Records minus wall-clock noise: the bit-identity comparison key."""
+    return [{k: v for k, v in r.items() if k != "seconds"}
+            for r in records]
+
+
+def _served_windows(records: list[dict]) -> list[dict]:
+    """Windows where reads HAPPENED: routed or refused.  The ONE
+    definition behind both the SLO invariants and the reported p99
+    metric — filtering on routed>0 alone would retarget "final" onto
+    the last healthy window when an outage refuses every read to the
+    end of the run."""
+    return [r for r in records if r.get("reads_routed") is not None
+            and (int(r.get("reads_routed", 0))
+                 + int(r.get("reads_unavailable", 0))) > 0]
+
+
+def _check_invariants(spec: ScenarioSpec, records: list[dict],
+                      max_bytes: int | None, budget_slack: int,
+                      multi_domain: bool, has_corrupt: bool,
+                      has_ec: bool) -> dict:
+    inv: dict[str, bool] = {}
+    dur = [r for r in records if r.get("durability")]
+    if dur:
+        inv["zero_lost_final"] = dur[-1]["durability"]["lost"] == 0
+    # Positive engagement: a cell whose axis silently failed to inject
+    # must not pass vacuously — the invariants below only bite when the
+    # machinery they guard actually fired (the replaced CI steps
+    # asserted detected_total > 0 / ec_files > 0 for the same reason).
+    if spec.faults is not None:
+        inv["faults_engaged"] = any(r.get("fault_events")
+                                    for r in records)
+    if spec.drift is not None:
+        # Cold start is one re-cluster; a drift pattern that never
+        # triggers another means the detector slept through the shift.
+        inv["drift_engaged"] = \
+            sum(1 for r in records if r.get("recluster")) >= 2
+    integ = [r for r in records if r.get("integrity")]
+    if integ:
+        inv["zero_silent_loss"] = integ[-1]["integrity"]["true_lost"] == 0
+        if spec.scrub is not None:
+            inv["rot_cleaned"] = \
+                integ[-1]["integrity"]["corrupt_copies"] == 0
+    if has_corrupt:
+        rotted = any(int(r["integrity"].get("corrupt_copies", 0)) > 0
+                     for r in integ)
+        detected = sum(
+            int(r["integrity"].get(k, 0)) for r in integ
+            for k in ("detected_scrub", "detected_read",
+                      "detected_repair"))
+        inv["corruption_engaged"] = rotted or detected > 0
+    if has_ec:
+        st = [r for r in records if r.get("storage")]
+        inv["ec_engaged"] = bool(
+            st and st[-1]["storage"]["ec_files"] > 0
+            and st[-1]["storage"]["bytes_stored"]
+            > st[-1]["storage"]["bytes_raw"])
+    if max_bytes is not None:
+        # Integrity runs are allowed ONE verified boundary task past the
+        # line (``budget_slack``): verified repair (faults/repair.py,
+        # PR 9) charges the source-verification reads of the task that
+        # crosses the budget — the traffic is real and rot must never
+        # propagate — so the admission check sees the budget already
+        # consumed and defers the copy.  Everything else (repair copies,
+        # scrub rate, migration admission) checks BEFORE charging, so
+        # corruption-free runs are gated strictly (slack 0).
+        slack = budget_slack if integ else 0
+        inv["budget_conserved"] = all(
+            r.get("repair_bytes", 0) + r["bytes_migrated"]
+            + (r.get("scrub") or {}).get("bytes", 0) <= max_bytes + slack
+            for r in records)
+    if multi_domain and dur:
+        inv["domain_diversity"] = \
+            dur[-1]["durability"].get("correlated_risk", 0) == 0
+    if spec.serve is not None:
+        served = _served_windows(records)
+        inv["serve_engaged"] = sum(int(r.get("reads_routed", 0))
+                                   for r in served) > 0
+        if served:
+            last = served[-1]
+            p99 = last.get("latency_p99_ms")
+            # A final window that routed nothing has no latency sample —
+            # that is an SLO failure, not a vacuous pass.
+            ok = p99 is not None and np.isfinite(p99)
+            bound = spec.serve.get("p99_max_ms")
+            if ok and bound is not None:
+                ok = p99 <= float(bound)
+            inv["slo_p99"] = bool(ok)
+            inv["slo_no_unavailable_final"] = \
+                last.get("reads_unavailable", 0) == 0
+            burn_max = spec.serve.get("burn_max")
+            if burn_max is not None:
+                inv["slo_burn"] = \
+                    last.get("slo_burn", 0.0) <= float(burn_max)
+    return inv
+
+
+def repro_line(spec: ScenarioSpec, suite: str | None = None,
+               suite_seed: int = 0) -> str:
+    """One line that reruns exactly this cell.  The suite form carries
+    the sweep's ``--seed`` explicitly: a random cell is a function of
+    (suite seed, index), so a repro without the seed would silently
+    rebuild a DIFFERENT scenario under the same name."""
+    if suite:
+        return (f"python -m cdrs_tpu scenarios run --suite {suite} "
+                f"--seed {int(suite_seed)} --cell {spec.name}")
+    if getattr(spec, "_preset", None):
+        return (f"python -m cdrs_tpu scenarios run "
+                f"--preset {spec._preset}")
+    return ("python -m cdrs_tpu scenarios run --spec '"
+            + json.dumps(spec.to_dict()) + "'")
+
+
+def run_cell(spec: ScenarioSpec, *, suite: str | None = None,
+             suite_seed: int = 0) -> dict:
+    """Run one cell end to end; returns the cell record (invariants,
+    headline metrics, per-cell regress bench_records, repro line)."""
+    t0 = time.perf_counter()
+    manifest = generate_population(GeneratorConfig(
+        n_files=spec.n_files, seed=spec.seed, nodes=spec.nodes))
+    events, changed = build_events(spec, manifest)
+    schedule = build_schedule(spec)
+    ctl = _controller(spec, manifest, schedule)
+    max_bytes = ctl.cfg.max_bytes_per_window
+    res = ctl.run(events)
+    records = res.records
+
+    multi_domain = False
+    if spec.racks:
+        multi_domain = len(set(
+            ctl.cfg.topology.domains)) > 1 if ctl.cfg.topology else False
+    has_corrupt = schedule is not None and any(
+        ev.kind == "corrupt" for ev in schedule)
+    has_ec = ctl._storage is not None and bool(
+        (np.asarray(ctl._storage.ec_k) > 0).any())
+    # One verified boundary task's worst-case charge: every reachable
+    # copy of the largest file verification-read through the slowest
+    # straggler the schedule ever installs (verify_sources charges
+    # shard_bytes / throughput per copy; copies <= node count).
+    budget_slack = 0
+    if has_corrupt:
+        min_factor = min([float(ev.factor) for ev in schedule
+                          if ev.kind == "degrade"] + [1.0])
+        budget_slack = int(
+            len(spec.nodes)
+            * int(np.max(np.asarray(manifest.size_bytes))) / min_factor)
+    inv = _check_invariants(spec, records, max_bytes, budget_slack,
+                            multi_domain, has_corrupt, has_ec)
+
+    if spec.resume_window is not None:
+        import os
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            ck = os.path.join(td, "cell.npz")
+            a = _controller(spec, manifest, schedule).run(
+                events, checkpoint_path=ck,
+                max_windows=int(spec.resume_window))
+            b = _controller(spec, manifest, schedule).run(
+                events, checkpoint_path=ck)
+            inv["resume_bit_identical"] = bool(
+                _strip(a.records) + _strip(b.records) == _strip(records)
+                and np.array_equal(b.rf, res.rf)
+                and np.array_equal(b.category_idx, res.category_idx))
+
+    summary = res.summary()
+    churn = int(summary["bytes_migrated"]
+                + summary.get("durability", {}).get("repair_bytes_total", 0))
+    metrics: dict = {
+        "windows": summary["windows"],
+        "events": summary["events"],
+        "reclusters": summary["reclusters"],
+        "bytes_migrated_total": summary["bytes_migrated"],
+        "churn_bytes_total": churn,
+        "plan_hash": summary["final_plan_hash"],
+        "files_changed_planted": int(changed.sum()),
+    }
+    if "durability" in summary:
+        d = summary["durability"]
+        metrics.update({
+            "repair_bytes_total": d["repair_bytes_total"],
+            "files_lost_max": d["files_lost_max"],
+            "lost_final": d["lost_final"],
+            "unavailable_reads": d["unavailable_reads"],
+        })
+    served = _served_windows(records)
+    if served:
+        metrics["latency_p99_ms_final"] = served[-1].get("latency_p99_ms")
+    if "integrity" in summary:
+        metrics["true_lost_final"] = summary["integrity"][
+            "true_lost_final"]
+        metrics["corrupt_copies_final"] = summary["integrity"][
+            "corrupt_copies_final"]
+    bench_records = [{
+        "metric": f"scenario_{spec.name}_churn_bytes",
+        "value": float(churn), "unit": "bytes", "direction": "lower",
+        "backend": "numpy",
+    }]
+    if served and metrics.get("latency_p99_ms_final") is not None:
+        bench_records.append({
+            "metric": f"scenario_{spec.name}_p99_ms",
+            "value": float(metrics["latency_p99_ms_final"]), "unit": "ms",
+            "backend": "numpy",
+        })
+    return {
+        "cell": spec.name,
+        "seed": spec.seed,
+        "spec": spec.to_dict(),
+        "invariants": inv,
+        "ok": all(inv.values()),
+        "metrics": metrics,
+        "bench_records": bench_records,
+        "seconds": round(time.perf_counter() - t0, 3),
+        "repro": repro_line(spec, suite, suite_seed),
+    }

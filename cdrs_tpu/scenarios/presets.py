@@ -1,0 +1,235 @@
+"""Named scenario cells and sweep suites.
+
+Two kinds of cells:
+
+* **Presets** — named, hand-written specs.  The first two re-express the
+  legacy benches over the scenario harness: ``control-shift`` is
+  benchmarks/control_bench.py's controller side and ``chaos-kill`` is
+  benchmarks/chaos_bench.py's kill-one-node scenario — same seeds, same
+  knobs, so they reproduce the pinned artifacts
+  (data/control_bench.json, data/chaos_bench.json) bit-identically
+  (asserted in tests/test_scenarios.py).  The rest cover the fault /
+  partition / storage / integrity / serving domains the CI smoke steps
+  used to exercise one hand-wired config at a time, plus the new
+  workload curves (diurnal, flash crowd) and drift patterns (gradual,
+  adversarial) and fault templates (cascade, rolling decommission).
+* **Random cells** — seeded compositions over ALL axes
+  (``random_cell``): workload x topology x faults x serve x storage
+  drawn from a deterministic per-(suite seed, index) stream, so the
+  matrix keeps covering combinations no author thought to hand-wire —
+  the CRUSH posture: robustness must hold across the space, not at
+  sampled points.
+
+``suite_cells("ci-smoke")`` is the CI matrix: >= 12 cells spanning at
+least the five legacy smoke domains, each checked against the harness
+invariants (zero silent loss, churn-budget conservation, domain
+diversity, SLO bounds, sampled kill/resume bit-identity).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spec import ScenarioSpec
+
+__all__ = ["PRESETS", "SUITES", "preset", "random_cell", "suite_cells"]
+
+_RACKS6 = "r0=dn1,dn2;r1=dn3,dn4;r2=dn5,dn6"
+_NODES6 = ("dn1", "dn2", "dn3", "dn4", "dn5", "dn6")
+_NODES12 = tuple(f"dn{i}" for i in range(1, 13))
+_RACKS12 = ("r0=dn1,dn2,dn3;r1=dn4,dn5,dn6;"
+            "r2=dn7,dn8,dn9;r3=dn10,dn11,dn12")
+
+
+def _presets() -> dict[str, ScenarioSpec]:
+    p: dict[str, ScenarioSpec] = {}
+
+    # -- legacy benches re-expressed (pinned-artifact reproduction) --------
+    p["control-shift"] = ScenarioSpec(
+        name="control-shift", n_files=300, seed=7, duration=2400.0,
+        n_windows=20, k=12, nodes=("dn1", "dn2", "dn3"),
+        drift={"kind": "flip", "at_frac": 0.5},
+        scoring="validated", default_rf=1, decay=0.7,
+        drift_threshold=0.02, budget_frac=0.30)
+    p["chaos-kill"] = ScenarioSpec(
+        name="chaos-kill", n_files=400, seed=11, duration=1800.0,
+        n_windows=15, k=12,
+        faults={"specs": ["crash:dn2@6"]}, resume_window=8)
+
+    # -- failure domains / partitions (chaos_rack_bench lineage) -----------
+    p["rack-kill"] = ScenarioSpec(
+        name="rack-kill", n_files=400, seed=13, duration=1800.0,
+        n_windows=15, k=12, nodes=_NODES6, racks=_RACKS6,
+        faults={"specs": ["crash:dn3@5", "crash:dn4@5"]})
+    p["rack-partition"] = ScenarioSpec(
+        name="rack-partition", n_files=400, seed=13, duration=1800.0,
+        n_windows=15, k=12, nodes=_NODES6, racks=_RACKS6,
+        faults={"specs": ["partition:dn3+dn4@4-6",
+                          "degrade:dn5@4-6:0.25"]},
+        resume_window=6)
+
+    # -- fault templates ---------------------------------------------------
+    p["cascade"] = ScenarioSpec(
+        name="cascade", n_files=300, seed=3, duration=1800.0,
+        n_windows=15, k=12,
+        faults={"template": "cascade", "nodes": ["dn2", "dn3"],
+                "start": 4, "spacing": 2, "recover_after": 3})
+    p["rolling-decommission"] = ScenarioSpec(
+        name="rolling-decommission", n_files=300, seed=4,
+        duration=1800.0, n_windows=15, k=12, nodes=_NODES6,
+        faults={"template": "rolling_decommission",
+                "nodes": ["dn2", "dn3"], "start": 4, "spacing": 4})
+
+    # -- storage strategies (storage_bench lineage) ------------------------
+    p["storage-ec"] = ScenarioSpec(
+        name="storage-ec", n_files=400, seed=13, duration=1800.0,
+        n_windows=15, k=12, nodes=_NODES12, racks=_RACKS12,
+        storage="ec_archival",
+        faults={"specs": ["crash:dn4@5", "crash:dn5@5", "crash:dn6@5"]})
+
+    # -- serving / SLO -----------------------------------------------------
+    p["serve-chaos"] = ScenarioSpec(
+        name="serve-chaos", n_files=300, seed=5, duration=1800.0,
+        n_windows=15, k=12,
+        serve={"policy": "p2c", "p99_max_ms": 50.0, "burn_max": 1.0},
+        faults={"specs": ["partition:dn2@4-7", "degrade:dn3@4-7:0.25"]})
+    p["flash-crowd"] = ScenarioSpec(
+        name="flash-crowd", n_files=300, seed=6, duration=1800.0,
+        n_windows=15, k=12,
+        workload={"kind": "flash_crowd", "start_frac": 0.5,
+                  "duration_frac": 0.1, "boost": 40.0,
+                  "cohort": "archival"},
+        serve={"policy": "p2c", "p99_max_ms": 50.0})
+
+    # -- data integrity (integrity_bench lineage) --------------------------
+    p["integrity-scrub"] = ScenarioSpec(
+        name="integrity-scrub", n_files=300, seed=9, duration=1800.0,
+        n_windows=15, k=12,
+        faults={"specs": ["corrupt:dn2@3:0.5"]},
+        scrub=200_000_000, resume_window=7)
+    p["integrity-read"] = ScenarioSpec(
+        name="integrity-read", n_files=300, seed=9, duration=1800.0,
+        n_windows=15, k=12,
+        faults={"specs": ["corrupt:dn2@3:0.5"]},
+        serve={"policy": "p2c", "verify_reads": True})
+
+    # -- workload curves / drift patterns ----------------------------------
+    p["diurnal"] = ScenarioSpec(
+        name="diurnal", n_files=300, seed=10, duration=1800.0,
+        n_windows=15, k=12,
+        workload={"kind": "diurnal", "amplitude": 0.8},
+        serve={"policy": "p2c", "p99_max_ms": 50.0},
+        faults={"specs": ["crash:dn2@5-8"]})
+    p["adversarial-drift"] = ScenarioSpec(
+        name="adversarial-drift", n_files=300, seed=11, duration=2400.0,
+        n_windows=20, k=12, decay=0.7, drift_threshold=0.02,
+        drift={"kind": "adversarial", "cycles": 3,
+               "start_frac": 0.3, "end_frac": 0.8})
+    p["gradual-drift"] = ScenarioSpec(
+        name="gradual-drift", n_files=300, seed=12, duration=2400.0,
+        n_windows=20, k=12, decay=0.7, drift_threshold=0.02,
+        drift={"kind": "gradual", "steps": 3,
+               "start_frac": 0.3, "end_frac": 0.7})
+
+    for name, spec in p.items():
+        spec._preset = name
+    return p
+
+
+PRESETS: dict[str, ScenarioSpec] = _presets()
+
+
+def preset(name: str) -> ScenarioSpec:
+    if name not in PRESETS:
+        raise KeyError(
+            f"unknown preset {name!r} (have {sorted(PRESETS)})")
+    return PRESETS[name]
+
+
+def random_cell(index: int, seed: int = 0) -> ScenarioSpec:
+    """A seeded random cell composing all axes (deterministic in
+    ``(seed, index)``).  Draws stay inside the invariant-satisfiable
+    region by construction: random faults are crash/flaky/straggler
+    spans confined to the first ~60% of windows (data is never
+    destroyed and every node is back before the run ends), budgets stay
+    at the standard quarter-of-population per window."""
+    rng = np.random.default_rng([int(seed), int(index)])
+    n_windows = 12
+    wl_kind = ("poisson", "diurnal", "flash_crowd")[int(rng.integers(3))]
+    workload: dict = {"kind": wl_kind}
+    if wl_kind == "diurnal":
+        workload.update(amplitude=float(rng.uniform(0.4, 0.9)),
+                        phase=float(rng.uniform(0.0, 6.28)))
+    elif wl_kind == "flash_crowd":
+        workload.update(start_frac=float(rng.uniform(0.3, 0.6)),
+                        duration_frac=0.1,
+                        boost=float(rng.uniform(20.0, 60.0)))
+    drift = None
+    if wl_kind == "poisson" and rng.random() < 0.7:
+        drift = {"kind": ("flip", "gradual",
+                          "adversarial")[int(rng.integers(3))]}
+    racked = bool(rng.random() < 0.5)
+    faults = {"random": {
+        "n_windows": 7, "seed": int(rng.integers(2**31)),
+        "crash_rate": 0.08, "recover_windows": [1, 2],
+        "flaky_rate": 0.05, "degrade_rate": 0.05,
+    }}
+    serve = None
+    if rng.random() < 0.5:
+        serve = {"policy": ("p2c", "least_loaded",
+                            "random")[int(rng.integers(3))]}
+    storage = "replicate" if rng.random() < 0.3 else None
+    # The name carries the suite seed: the cell IS a function of
+    # (seed, index), so its history/regress metric keys
+    # (scenario_random-s<seed>-<i>_*) must never alias a different
+    # seed's scenario, and a repro with a mismatched --seed fails the
+    # cell lookup instead of silently running something else.
+    return ScenarioSpec(
+        name=f"random-s{seed}-{index}",
+        n_files=int(rng.integers(200, 400)),
+        seed=int(rng.integers(1000)),
+        duration=1440.0, n_windows=n_windows, k=10,
+        nodes=_NODES6 if racked else ("dn1", "dn2", "dn3", "dn4", "dn5"),
+        racks=_RACKS6 if racked else None,
+        workload=workload, drift=drift, faults=faults,
+        serve=serve, storage=storage)
+
+
+#: Suite name -> (preset names, number of random cells).
+SUITES: dict[str, tuple[tuple[str, ...], int]] = {
+    # The CI matrix: every legacy smoke domain (chaos, partition, serve,
+    # storage, integrity) plus the new curves/templates, and two random
+    # compositions.  >= 12 cells.
+    "ci-smoke": (("chaos-kill", "rack-kill", "rack-partition", "cascade",
+                  "rolling-decommission", "storage-ec", "serve-chaos",
+                  "flash-crowd", "integrity-scrub", "integrity-read",
+                  "diurnal", "adversarial-drift", "gradual-drift"), 2),
+    # Everything, including the slow legacy-reproduction preset.
+    "full": (tuple(PRESETS), 4),
+}
+
+
+def suite_cells(suite: str, seed: int = 0) -> list[ScenarioSpec]:
+    """The suite's cell list (deterministic in ``seed``).
+
+    ``seed`` parameterizes the whole matrix, not just the random cells:
+    a non-zero suite seed SHIFTS every preset cell's workload seed
+    (``spec.seed + suite seed``) so a 3-seed CI loop re-checks the
+    invariants against three different workloads per preset — the
+    multi-seed "not a single-seed accident" dimension — instead of
+    re-running 13 byte-identical cells.  Seed 0 keeps the presets'
+    pinned workloads (the per-cell history baseline keys, and the
+    control-shift/chaos-kill artifact reproduction) untouched."""
+    if suite not in SUITES:
+        raise KeyError(f"unknown suite {suite!r} (have {sorted(SUITES)})")
+    names, n_random = SUITES[suite]
+    cells = []
+    for n in names:
+        sp = preset(n)
+        if seed:
+            shifted = sp.replace(seed=sp.seed + int(seed))
+            shifted._preset = n
+            sp = shifted
+        cells.append(sp)
+    cells += [random_cell(i, seed) for i in range(n_random)]
+    return cells

@@ -1,0 +1,174 @@
+"""Declarative scenario cells: the matrix axes as one JSON-able spec.
+
+A ``ScenarioSpec`` names one point in the robustness matrix — workload
+curve x drift pattern x fault schedule x topology x storage strategy x
+scale x serve config — and nothing else: no cell owns simulation or
+controller code.  ONE harness (scenarios/harness.py) consumes every
+spec, so a new axis value (a new drift pattern, a new fault template)
+is instantly crossable with every other axis instead of waiting for a
+bench author to hand-wire the combination.
+
+Every field is a plain scalar/dict/list, so a spec round-trips through
+JSON (``to_dict``/``from_dict``) — the repro contract: a failing sweep
+cell prints one line that reruns exactly that cell.
+
+Axes
+----
+* ``workload`` — the base traffic curve:
+  ``{"kind": "poisson"}`` (the reference's homogeneous stream),
+  ``{"kind": "diurnal", "amplitude": 0.8, "period_frac": 1.0,
+  "phase": 0.0}`` (sinusoidal time-of-day intensity, total mass
+  conserved — sim/access.simulate_diurnal), or
+  ``{"kind": "flash_crowd", "start_frac": 0.5, "duration_frac": 0.1,
+  "boost": 40.0, "cohort": "archival"}`` (transient read burst on a
+  planted-category cohort — sim/access.simulate_flash_crowd).
+* ``drift`` — how the category ground truth moves (poisson base only;
+  sim/access.simulate_access_phased):
+  ``{"kind": "flip", "at_frac": 0.5, "flip": {...}}`` (the classic
+  one-step shift), ``{"kind": "gradual", "steps": 3, ...}`` (the
+  cohort migrates in waves), or ``{"kind": "adversarial", "cycles": 3,
+  ...}`` (the flip oscillates — the anti-flap hysteresis scenario).
+* ``faults`` — any of ``specs`` (faults/schedule spec strings),
+  ``template`` (``cascade`` / ``rolling_decommission`` with their
+  parameters), and ``random`` (the seeded chaos generator), merged
+  into one window-keyed FaultSchedule.
+* ``racks`` — failure-domain topology (the ``cdrs chaos --racks``
+  spec string); None = flat.
+* ``storage`` — ``replicate`` / ``ec_archival`` / JSON path; None =
+  historical rf semantics.
+* ``serve`` — read-router config dict (policy/slo_ms/...); None = no
+  serving.
+* ``scrub`` — background-scrubber bytes/window; None = off.
+* scale — ``n_files`` / ``duration`` / ``n_windows`` / ``k``.
+
+Controller knobs (budget fraction, scoring table, decay, thresholds)
+ride along so a legacy bench scenario is exactly re-expressible: the
+``control-shift`` and ``chaos-kill`` presets (scenarios/presets.py)
+reproduce data/control_bench.json and data/chaos_bench.json
+bit-identically on the same seeds.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from dataclasses import dataclass, field
+
+__all__ = ["ScenarioSpec"]
+
+_WORKLOAD_KINDS = ("poisson", "diurnal", "flash_crowd")
+_DRIFT_KINDS = ("flip", "gradual", "adversarial")
+_SCORINGS = ("default", "validated", "min_rf2")
+
+
+@dataclass
+class ScenarioSpec:
+    """One matrix cell (see module docstring for the axes)."""
+
+    name: str
+    # -- scale -------------------------------------------------------------
+    n_files: int = 300
+    seed: int = 0
+    duration: float = 1800.0
+    n_windows: int = 15
+    k: int = 12
+    nodes: tuple[str, ...] = ("dn1", "dn2", "dn3", "dn4", "dn5")
+    # -- axes --------------------------------------------------------------
+    workload: dict = field(default_factory=lambda: {"kind": "poisson"})
+    drift: dict | None = None
+    faults: dict | None = None
+    racks: str | None = None
+    storage: str | None = None
+    serve: dict | None = None
+    scrub: int | None = None
+    # -- controller knobs --------------------------------------------------
+    #: Per-window churn budget as a fraction of the population's total
+    #: bytes (None = unbounded) — repair + migration + scrub share it.
+    budget_frac: float | None = 0.25
+    max_files: int | None = None
+    default_rf: int = 2
+    scoring: str = "min_rf2"
+    decay: float = 1.0
+    drift_threshold: float = 0.05
+    full_recluster_drift: float = 0.30
+    hysteresis: int = 1
+    backend: str = "numpy"
+    #: Mid-cell kill/resume bit-identity check: kill after this window and
+    #: resume from the checkpoint, asserting the stitched record stream
+    #: equals the uninterrupted run's.  None = not sampled for this cell.
+    resume_window: int | None = None
+
+    def __post_init__(self):
+        kind = (self.workload or {}).get("kind", "poisson")
+        if kind not in _WORKLOAD_KINDS:
+            raise ValueError(
+                f"cell {self.name!r}: unknown workload kind {kind!r} "
+                f"(want one of {_WORKLOAD_KINDS})")
+        if self.drift is not None:
+            dk = self.drift.get("kind")
+            if dk not in _DRIFT_KINDS:
+                raise ValueError(
+                    f"cell {self.name!r}: unknown drift kind {dk!r} "
+                    f"(want one of {_DRIFT_KINDS})")
+            if kind != "poisson":
+                raise ValueError(
+                    f"cell {self.name!r}: drift patterns compose with the "
+                    f"poisson workload only (got workload {kind!r})")
+        if self.scoring not in _SCORINGS:
+            raise ValueError(
+                f"cell {self.name!r}: unknown scoring {self.scoring!r} "
+                f"(want one of {_SCORINGS})")
+        if self.n_windows < 1:
+            raise ValueError(
+                f"cell {self.name!r}: n_windows must be >= 1")
+        if self.budget_frac is not None and self.budget_frac <= 0:
+            raise ValueError(
+                f"cell {self.name!r}: budget_frac must be > 0 or None")
+        if self.scrub is not None and self.faults is None:
+            raise ValueError(
+                f"cell {self.name!r}: scrub requires a faults axis (the "
+                f"scrubber verifies the fault path's cluster state)")
+
+    @property
+    def window_seconds(self) -> float:
+        return float(self.duration) / int(self.n_windows)
+
+    # -- JSON round trip (the repro contract) ------------------------------
+    def to_dict(self) -> dict:
+        """Spec as plain JSON, omitting fields that equal their DEFAULT
+        (not fields that are None: ``budget_frac=None`` means an
+        unbounded budget and must survive the round trip — dropping
+        Nones would silently rebuild a budgeted cell from a repro
+        line).  ``from_dict`` refills omitted fields with the same
+        defaults, so the round trip is exact for every field."""
+        out: dict = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if f.name != "name":
+                if f.default is not dataclasses.MISSING \
+                        and v == f.default:
+                    continue
+                if f.default_factory is not dataclasses.MISSING \
+                        and v == f.default_factory():
+                    continue
+            if f.name == "nodes":
+                v = list(v)
+            elif isinstance(v, dict):
+                v = copy.deepcopy(v)  # never hand out live axis dicts
+            out[f.name] = v
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ScenarioSpec":
+        allowed = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - allowed
+        if unknown:
+            raise ValueError(
+                f"unknown scenario spec keys: {sorted(unknown)}")
+        kwargs = dict(d)
+        if "nodes" in kwargs:
+            kwargs["nodes"] = tuple(kwargs["nodes"])
+        return cls(**kwargs)
+
+    def replace(self, **kw) -> "ScenarioSpec":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,89 @@
+"""Matrix sweep driver: run cells, gate on invariants, feed the history.
+
+``run_sweep`` runs a suite's cells through the one harness and returns
+the sweep artifact: per-cell invariant verdicts + headline metrics, the
+flattened ``bench_records`` list ``cdrs metrics regress`` bands per
+cell, and an ``ok`` flag the CLI turns into the exit code.  Failing
+cells carry their one-line seeded repro command — the sweep output
+alone is enough to rerun exactly the failing point of the matrix.
+
+When ``history`` is given, each cell's records append to
+``data/bench_history.jsonl`` through ``benchmarks/regress.append_history``
+— append-only, deduplicated on (round, metric, platform), so re-running
+a sweep (or CI re-running it) never double-appends rows.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .harness import run_cell
+from .presets import suite_cells
+from .spec import ScenarioSpec
+
+__all__ = ["run_sweep", "format_cell_line"]
+
+
+def format_cell_line(cell: dict) -> str:
+    """One human line per cell: verdict, name, failed invariants, repro."""
+    inv = cell["invariants"]
+    if cell["ok"]:
+        checked = len(inv)
+        return (f"  [ok  ] {cell['cell']:<22} {checked} invariants, "
+                f"{cell['metrics']['windows']} windows, "
+                f"{cell['seconds']:.1f}s")
+    failed = sorted(k for k, v in inv.items() if not v)
+    return (f"  [FAIL] {cell['cell']:<22} {','.join(failed)}\n"
+            f"         repro: {cell['repro']}")
+
+
+def run_sweep(suite: str, *, seed: int = 0, round_no: int | None = None,
+              history: str | None = None,
+              progress=None) -> dict:
+    """Run every cell of ``suite``; returns the sweep artifact dict."""
+    cells = suite_cells(suite, seed)
+    return run_cells(cells, suite=suite, seed=seed, round_no=round_no,
+                     history=history, progress=progress)
+
+
+def run_cells(cells: list[ScenarioSpec], *, suite: str | None = None,
+              seed: int = 0, round_no: int | None = None,
+              history: str | None = None, progress=None) -> dict:
+    # Validate the history combination BEFORE any cell runs: per-cell
+    # baseline keys are defined at suite seed 0 (a shifted sweep
+    # re-seeds every workload, so its records would alias them), and
+    # failing after the multi-second sweep would discard every result.
+    if history and round_no is not None and seed:
+        raise ValueError(
+            "history append (--round) is only valid at suite seed 0 "
+            "— non-zero seeds shift every cell's workload, so their "
+            "records would alias the seed-0 baseline keys")
+    t0 = time.perf_counter()
+    results = []
+    for spec in cells:
+        cell = run_cell(spec, suite=suite, suite_seed=seed)
+        results.append(cell)
+        if progress is not None:
+            progress(format_cell_line(cell))
+    ok = all(c["ok"] for c in results)
+    bench_records = [r for c in results for r in c["bench_records"]]
+    out = {
+        "suite": suite,
+        "seed": seed,
+        "cells": results,
+        "n_cells": len(results),
+        "n_failed": sum(1 for c in results if not c["ok"]),
+        "invariants_checked": sum(len(c["invariants"]) for c in results),
+        "ok": ok,
+        "seconds": round(time.perf_counter() - t0, 3),
+        "bench_records": bench_records,
+    }
+    if round_no is not None:
+        out["round"] = int(round_no)
+    if history and round_no is not None:
+        from ..benchmarks.regress import append_history, extract_records
+
+        appended = append_history(
+            history, extract_records(out, f"scenarios_{suite or 'cells'}"))
+        out["history_appended"] = appended
+    return out

@@ -31,6 +31,7 @@ from ..config import SimulatorConfig
 from ..io.events import EventLog, Manifest
 
 __all__ = ["simulate_access", "simulate_access_with_shift",
+           "simulate_access_phased", "simulate_diurnal",
            "simulate_flash_crowd", "jittered_rates"]
 
 
@@ -58,55 +59,24 @@ def jittered_rates(
     return read, write, loc
 
 
-def simulate_access(
-    manifest: Manifest,
-    cfg: SimulatorConfig,
-    sim_start: float | None = None,
-    engine: str = "numpy",
-) -> EventLog:
-    """``engine='native'`` runs the threaded C++ generator (runtime/native.py)
-    — same distributional semantics, its own deterministic RNG stream; for
-    the 1B-event scale where even vectorized NumPy becomes the bottleneck."""
-    rng = np.random.default_rng(cfg.seed)
+def _poisson_stream(manifest: Manifest, cfg: SimulatorConfig, rng,
+                    sim_start: float, time_of_u) -> EventLog:
+    """The one vectorized draw core behind every numpy workload curve:
+    jittered rates -> Poisson counts -> op mix -> locality-biased client
+    -> global time sort.  ``time_of_u`` places the per-event uniform
+    draws on the time axis (flat curve: ``u * duration``; diurnal:
+    the intensity curve's inverse CDF over the SAME uniforms) — curves
+    that differ only here share every other draw by construction, which
+    is what makes ``simulate_diurnal``'s count mass bit-identical to the
+    flat stream's."""
     n = len(manifest)
-    if sim_start is None:
-        # Anchor to the *manifest's* timebase (latest creation timestamp):
-        # deterministic whenever the manifest is (see utils/params
-        # .SEEDED_EPOCH) and always just after every file exists.  This also
-        # holds when a seeded manifest (anchored to SEEDED_EPOCH, ~2023) is
-        # simulated without a seed — the reference's wall clock
-        # (src/access_simulator.py:21) would put the window years after
-        # creation and flatten every age_seconds to the epoch gap.  For
-        # unseeded manifests creation is within the past year of wall clock,
-        # so this matches the reference's behavior up to that year.
-        sim_start = float(np.ceil(manifest.creation_ts.max())) + 1.0
-
     read, write, loc = jittered_rates(manifest, cfg, rng)
-
-    if engine == "native":
-        from ..io.events import client_vocabulary
-        from ..runtime.native import simulate_events_native
-
-        clients, pool = client_vocabulary(manifest, cfg.clients)
-        # Unseeded runs must stay independent: derive a fresh 64-bit seed from
-        # the (entropy-seeded) numpy generator instead of pinning 0.
-        seed = int(cfg.seed) if cfg.seed is not None else int(
-            rng.integers(0, 2**63 - 1))
-        ts, pid, op, client = simulate_events_native(
-            read, write, loc, manifest.primary_node_id, pool,
-            cfg.duration_seconds, sim_start, seed=seed,
-        )
-        return EventLog(ts=ts, path_id=pid, op=op, client_id=client,
-                        clients=clients)
-    if engine != "numpy":
-        raise ValueError(f"unknown simulator engine {engine!r}")
     lam = read + write
     counts = rng.poisson(lam * cfg.duration_seconds)
     total = int(counts.sum())
 
     path_id = np.repeat(np.arange(n, dtype=np.int32), counts)
-    t = rng.random(total) * cfg.duration_seconds
-    ts = sim_start + t
+    ts = sim_start + time_of_u(rng.random(total))
 
     p_read = read / (lam + 1e-12)
     op = (rng.random(total) >= p_read[path_id]).astype(np.int8)  # 1 = WRITE
@@ -128,6 +98,179 @@ def simulate_access(
         client_id=client_id[order].astype(np.int32),
         clients=clients,
     )
+
+
+def simulate_access(
+    manifest: Manifest,
+    cfg: SimulatorConfig,
+    sim_start: float | None = None,
+    engine: str = "numpy",
+) -> EventLog:
+    """``engine='native'`` runs the threaded C++ generator (runtime/native.py)
+    — same distributional semantics, its own deterministic RNG stream; for
+    the 1B-event scale where even vectorized NumPy becomes the bottleneck."""
+    rng = np.random.default_rng(cfg.seed)
+    if sim_start is None:
+        # Anchor to the *manifest's* timebase (latest creation timestamp):
+        # deterministic whenever the manifest is (see utils/params
+        # .SEEDED_EPOCH) and always just after every file exists.  This also
+        # holds when a seeded manifest (anchored to SEEDED_EPOCH, ~2023) is
+        # simulated without a seed — the reference's wall clock
+        # (src/access_simulator.py:21) would put the window years after
+        # creation and flatten every age_seconds to the epoch gap.  For
+        # unseeded manifests creation is within the past year of wall clock,
+        # so this matches the reference's behavior up to that year.
+        sim_start = float(np.ceil(manifest.creation_ts.max())) + 1.0
+
+    if engine == "native":
+        from ..io.events import client_vocabulary
+        from ..runtime.native import simulate_events_native
+
+        read, write, loc = jittered_rates(manifest, cfg, rng)
+        clients, pool = client_vocabulary(manifest, cfg.clients)
+        # Unseeded runs must stay independent: derive a fresh 64-bit seed from
+        # the (entropy-seeded) numpy generator instead of pinning 0.
+        seed = int(cfg.seed) if cfg.seed is not None else int(
+            rng.integers(0, 2**63 - 1))
+        ts, pid, op, client = simulate_events_native(
+            read, write, loc, manifest.primary_node_id, pool,
+            cfg.duration_seconds, sim_start, seed=seed,
+        )
+        return EventLog(ts=ts, path_id=pid, op=op, client_id=client,
+                        clients=clients)
+    if engine != "numpy":
+        raise ValueError(f"unknown simulator engine {engine!r}")
+    return _poisson_stream(manifest, cfg, rng, sim_start,
+                           lambda u: u * cfg.duration_seconds)
+
+
+def simulate_diurnal(
+    manifest: Manifest,
+    cfg: SimulatorConfig,
+    *,
+    period: float | None = None,
+    amplitude: float = 0.8,
+    phase: float = 0.0,
+    sim_start: float | None = None,
+) -> EventLog:
+    """Diurnal workload: the Poisson stream with a sinusoidal time-of-day
+    intensity curve ``f(t) = 1 + amplitude * sin(2*pi*t/period + phase)``.
+
+    Per-file event COUNTS are drawn exactly as ``simulate_access`` draws
+    them (same rng stream, same Poisson(lambda * duration)) — the curve
+    conserves total mass bit-for-bit and only re-times the events through
+    the curve's inverse CDF (the order-statistics view of an
+    inhomogeneous Poisson process conditioned on its count).  The
+    controller therefore sees the same cumulative features by the end of
+    the log, but per-window event volume swings ``1 +- amplitude`` — the
+    load shape a per-window churn budget and the serving queue model must
+    absorb.  ``period`` defaults to the full duration (one day == one
+    log); deterministic in ``cfg.seed``.
+    """
+    if not 0.0 <= float(amplitude) < 1.0:
+        raise ValueError(
+            f"amplitude must be in [0, 1) (the intensity must stay "
+            f"positive), got {amplitude}")
+    duration = float(cfg.duration_seconds)
+    period = duration if period is None else float(period)
+    if period <= 0:
+        raise ValueError(f"period must be > 0, got {period}")
+    rng = np.random.default_rng(cfg.seed)
+    if sim_start is None:
+        sim_start = float(np.ceil(manifest.creation_ts.max())) + 1.0
+
+    # Inverse-CDF time warp: uniform u -> t with density proportional to
+    # the curve (grid CDF exact up to interpolation, 4096 knots).  The
+    # shared draw core hands this warp the SAME uniforms simulate_access
+    # turns into times, so amplitude=0 degenerates to the flat stream
+    # bit-for-bit and the count mass is conserved by construction.
+    grid = np.linspace(0.0, duration, 4097)
+    dens = 1.0 + float(amplitude) * np.sin(
+        2.0 * np.pi * grid / period + float(phase))
+    cdf = np.concatenate([[0.0], np.cumsum((dens[1:] + dens[:-1]) * 0.5
+                                           * np.diff(grid))])
+    cdf /= cdf[-1]
+    return _poisson_stream(manifest, cfg, rng, sim_start,
+                           lambda u: np.interp(u, cdf, grid))
+
+
+def simulate_access_phased(
+    manifest: Manifest,
+    cfg: SimulatorConfig,
+    shifts,
+    *,
+    sim_start: float | None = None,
+    engine: str = "numpy",
+) -> tuple[EventLog, np.ndarray]:
+    """N-phase workload: CUMULATIVE category flips at successive times.
+
+    ``shifts`` is a sequence of ``(shift_at, category_flip, cohort)``
+    tuples (cohort None = every file whose current category is a key),
+    strictly increasing in time inside ``(0, duration)``; each flip
+    applies on top of the previous phase's categories, so an oscillating
+    ``{hot: archival, archival: hot}`` flip models ADVERSARIAL drift
+    (flip, revert, flip again — the anti-flap hysteresis scenario) and a
+    sequence of disjoint-cohort flips models GRADUAL drift (the
+    population migrates in waves rather than one step).  Phase ``i``
+    draws from seed ``cfg.seed + i * 0x5F17``, making the single-shift
+    case bit-identical to ``simulate_access_with_shift`` (which
+    delegates here).
+
+    Returns ``(events, changed)``: the concatenated globally time-sorted
+    log and the bool mask of files whose FINAL category differs from the
+    planted one (empty-handed for a fully reverted adversarial cycle —
+    by design: the workload really is back to normal).
+    """
+    import dataclasses
+
+    duration = float(cfg.duration_seconds)
+    shifts = list(shifts)
+    times = [float(s[0]) for s in shifts]
+    for t in times:
+        if not 0.0 < t < duration:
+            raise ValueError(
+                f"shift_at must fall inside (0, {duration}), got {t}")
+    if any(b <= a for a, b in zip(times, times[1:])):
+        raise ValueError(
+            f"shift times must be strictly increasing, got {times}")
+    for _, flip, _ in shifts:
+        unknown = (set(flip) | set(flip.values())) - set(cfg.rate_profiles)
+        if unknown:
+            raise ValueError(
+                f"category_flip names categories without a rate profile: "
+                f"{sorted(unknown)}")
+    if sim_start is None:
+        sim_start = float(np.ceil(manifest.creation_ts.max())) + 1.0
+
+    cats = list(manifest.category)
+    bounds = [0.0] + times + [duration]
+    logs: list[EventLog] = []
+    cur_manifest = manifest
+    for i in range(len(bounds) - 1):
+        if i > 0:
+            _, flip, cohort = shifts[i - 1]
+            in_cohort = np.ones(len(manifest), dtype=bool) if cohort is None \
+                else np.asarray(cohort, dtype=bool)
+            if in_cohort.shape != (len(manifest),):
+                raise ValueError(
+                    f"cohort mask shape {in_cohort.shape} != "
+                    f"({len(manifest)},)")
+            cats = [flip[c] if in_cohort[j] and c in flip and flip[c] != c
+                    else c for j, c in enumerate(cats)]
+            cur_manifest = dataclasses.replace(manifest, category=cats)
+        seed_i = None if cfg.seed is None else int(cfg.seed) + i * 0x5F17
+        cfg_i = dataclasses.replace(
+            cfg, duration_seconds=bounds[i + 1] - bounds[i], seed=seed_i)
+        ev = simulate_access(cur_manifest, cfg_i,
+                             sim_start=sim_start + bounds[i], engine=engine)
+        if logs and ev.clients != logs[0].clients:  # pragma: no cover
+            raise AssertionError("phase client vocabularies diverged")
+        logs.append(ev)
+    # Every phase interns clients against the same (manifest nodes, cfg
+    # clients) vocabulary and phase i+1 starts where phase i ends, so the
+    # concatenation is globally time-sorted.
+    changed = np.asarray([a != b for a, b in zip(manifest.category, cats)])
+    return EventLog.concat(logs), changed
 
 
 def simulate_flash_crowd(
@@ -236,47 +379,10 @@ def simulate_access_with_shift(
 
     Returns ``(events, flipped)``: the concatenated, globally time-sorted log
     (phase B starts exactly at ``sim_start + shift_at``) and the bool mask of
-    files whose planted category actually changed.
+    files whose planted category actually changed.  The single-shift case of
+    ``simulate_access_phased`` (to which this delegates, bit-identically —
+    phase B's seed is ``cfg.seed + 0x5F17`` either way).
     """
-    import dataclasses
-
-    duration = float(cfg.duration_seconds)
-    if not 0.0 < float(shift_at) < duration:
-        raise ValueError(
-            f"shift_at must fall inside (0, {duration}), got {shift_at}")
-    unknown = set(category_flip) | set(category_flip.values())
-    unknown -= set(cfg.rate_profiles)
-    if unknown:
-        raise ValueError(
-            f"category_flip names categories without a rate profile: "
-            f"{sorted(unknown)}")
-    if sim_start is None:
-        sim_start = float(np.ceil(manifest.creation_ts.max())) + 1.0
-
-    in_cohort = np.ones(len(manifest), dtype=bool) if cohort is None \
-        else np.asarray(cohort, dtype=bool)
-    if in_cohort.shape != (len(manifest),):
-        raise ValueError(
-            f"cohort mask shape {in_cohort.shape} != ({len(manifest)},)")
-    flipped_cat = list(manifest.category)
-    flipped = np.zeros(len(manifest), dtype=bool)
-    for i, c in enumerate(manifest.category):
-        if in_cohort[i] and c in category_flip and category_flip[c] != c:
-            flipped_cat[i] = category_flip[c]
-            flipped[i] = True
-
-    cfg_a = dataclasses.replace(cfg, duration_seconds=float(shift_at))
-    seed_b = None if cfg.seed is None else int(cfg.seed) + 0x5F17  # decorrelate
-    cfg_b = dataclasses.replace(cfg, duration_seconds=duration - float(shift_at),
-                                seed=seed_b)
-    manifest_b = dataclasses.replace(manifest, category=flipped_cat)
-
-    ev_a = simulate_access(manifest, cfg_a, sim_start=sim_start, engine=engine)
-    ev_b = simulate_access(manifest_b, cfg_b,
-                           sim_start=sim_start + float(shift_at), engine=engine)
-    # Both phases intern clients against the same (manifest nodes, cfg
-    # clients) vocabulary, so the id columns concatenate directly; phase B
-    # starts after phase A ends, so the concatenation is globally sorted.
-    if ev_a.clients != ev_b.clients:  # pragma: no cover - defensive
-        raise AssertionError("phase client vocabularies diverged")
-    return EventLog.concat([ev_a, ev_b]), flipped
+    return simulate_access_phased(
+        manifest, cfg, [(float(shift_at), category_flip, cohort)],
+        sim_start=sim_start, engine=engine)

@@ -4,8 +4,10 @@
 import json
 
 from cdrs_tpu.benchmarks.regress import (
+    append_history,
     check_run,
     extract_records,
+    history_key,
     ingest_files,
     load_history,
     main as regress_main,
@@ -181,6 +183,88 @@ def test_ingest_real_bench_files_builds_history(tmp_path):
             storage_rows = [h for h in have if str(
                 h.get("metric", "")).startswith("storage_")]
             assert storage_rows == storage_recs
+
+
+# -- append/dedup (the automated-bench-history satellite) --------------------
+
+def test_append_history_dedups_and_keeps_order(tmp_path):
+    """append_history is the append-only ledger writer: existing rows are
+    never rewritten or re-sorted, new rows append in the given order,
+    and a (round, metric, platform) key that already exists is skipped —
+    re-running a bench or sweep never double-appends."""
+    path = str(tmp_path / "h.jsonl")
+    first = _hist([100.0, 110.0])  # rounds 1, 2
+    assert append_history(path, first) == 2
+    assert load_history(path) == first
+    newer = _hist([100.0, 110.0, 120.0])  # rounds 1-3: 1, 2 dup
+    assert append_history(path, newer) == 1
+    rows = load_history(path)
+    assert rows == first + [newer[2]]
+    # Idempotent: nothing new, file untouched.
+    assert append_history(path, newer) == 0
+    assert load_history(path) == rows
+    # A re-measured value for an existing key keeps the ORIGINAL row.
+    remeasured = dict(newer[2], value=999.0)
+    assert append_history(path, [remeasured]) == 0
+    assert load_history(path) == rows
+    assert history_key(remeasured) == history_key(newer[2])
+
+
+def test_ingest_cli_is_idempotent(tmp_path):
+    """`regress --ingest` over an EXISTING history appends-with-dedup
+    instead of rewriting: re-running the same ingest is a no-op and the
+    original row order survives (the append-only artifact-order
+    contract the canonical-history test pins)."""
+    hist = str(tmp_path / "h.jsonl")
+    b1 = tmp_path / "b1.json"
+    b1.write_text(json.dumps({"n": 1, "cmd": "c", "rc": 0, "tail": "",
+                              "parsed": {"metric": "m", "value": 100.0,
+                                         "unit": "iter/s",
+                                         "jax_platform": "tpu"}}))
+    assert regress_main(["--ingest", str(b1), "--history", hist]) == 0
+    rows = load_history(hist)
+    assert len(rows) == 1
+    # Same artifact again: no change at all.
+    assert regress_main(["--ingest", str(b1), "--history", hist]) == 0
+    assert load_history(hist) == rows
+    # A later round appends AFTER the existing rows (no re-sort, even
+    # though ingest_files sorts its own batch).
+    b2 = tmp_path / "b2.json"
+    b2.write_text(json.dumps({"n": 2, "cmd": "c", "rc": 0, "tail": "",
+                              "parsed": {"metric": "a_first", "value": 1.0,
+                                         "unit": "iter/s",
+                                         "jax_platform": "tpu"}}))
+    assert regress_main(["--ingest", str(b2), "--history", hist]) == 0
+    assert load_history(hist)[0] == rows[0]
+
+
+def test_ingest_fresh_build_dedups_within_batch(tmp_path):
+    """The fresh-build path runs through the same append/dedup writer:
+    ingesting the same artifact twice in ONE command writes one row."""
+    hist = str(tmp_path / "h.jsonl")
+    b1 = tmp_path / "b1.json"
+    b1.write_text(json.dumps({"n": 1, "cmd": "c", "rc": 0, "tail": "",
+                              "parsed": {"metric": "m", "value": 100.0,
+                                         "unit": "iter/s",
+                                         "jax_platform": "tpu"}}))
+    assert regress_main(["--ingest", str(b1), str(b1),
+                         "--history", hist]) == 0
+    assert len(load_history(hist)) == 1
+
+
+def test_explicit_direction_wins():
+    """A record carrying its own direction (the scenario sweep's
+    lower-is-better byte counts) overrides the unit heuristic."""
+    doc = {"bench_records": [
+        {"metric": "scenario_x_churn_bytes", "value": 100.0,
+         "unit": "bytes", "direction": "lower", "backend": "numpy"}]}
+    [rec] = extract_records(doc, "sweep.json")
+    assert rec["direction"] == "lower"
+    hist = [dict(rec, round=1)]
+    [v] = check_run([rec | {"value": 130.0}], hist)
+    assert v["status"] == "regression"  # more churn = worse
+    [v] = check_run([rec | {"value": 80.0}], hist)
+    assert v["status"] == "improved"
 
 
 # -- CLI ---------------------------------------------------------------------

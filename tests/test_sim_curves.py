@@ -1,0 +1,176 @@
+"""Scenario-matrix sim generators (sim/access.py): diurnal curve,
+phased drift patterns, flash-crowd burst — the property tests ISSUE 10
+requires, swept across workload seeds via ``CDRS_CHAOS_SEED`` (CI runs
+the scenario sweep itself; these pin the generators' contracts)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
+from cdrs_tpu.sim.access import (
+    jittered_rates,
+    simulate_access,
+    simulate_access_phased,
+    simulate_access_with_shift,
+    simulate_diurnal,
+    simulate_flash_crowd,
+)
+from cdrs_tpu.sim.generator import generate_population
+
+SEED = int(os.environ.get("CDRS_CHAOS_SEED", "0"))
+FLIP = {"hot": "archival", "archival": "hot"}
+
+
+@pytest.fixture(scope="module")
+def manifest():
+    return generate_population(GeneratorConfig(n_files=250, seed=SEED))
+
+
+def _cfg(duration=600.0, seed=SEED + 1):
+    return SimulatorConfig(duration_seconds=duration, seed=seed)
+
+
+def _eq(a, b) -> bool:
+    return (np.array_equal(a.ts, b.ts) and np.array_equal(a.path_id,
+                                                          b.path_id)
+            and np.array_equal(a.op, b.op)
+            and np.array_equal(a.client_id, b.client_id))
+
+
+# -- diurnal -----------------------------------------------------------------
+
+def test_diurnal_mass_conservation(manifest):
+    """The curve only re-times events: per-file counts (and so the whole
+    cumulative feature mass) equal the flat Poisson stream's bit-for-bit
+    — same rng draws, different inverse-CDF placement."""
+    flat = simulate_access(manifest, _cfg())
+    diur = simulate_diurnal(manifest, _cfg(), amplitude=0.8)
+    assert len(diur) == len(flat)
+    assert np.array_equal(
+        np.bincount(flat.path_id, minlength=len(manifest)),
+        np.bincount(diur.path_id, minlength=len(manifest)))
+
+
+def test_diurnal_zero_amplitude_is_flat(manifest):
+    flat = simulate_access(manifest, _cfg())
+    d0 = simulate_diurnal(manifest, _cfg(), amplitude=0.0)
+    assert np.array_equal(d0.path_id, flat.path_id)
+    assert np.array_equal(d0.op, flat.op)
+    assert np.allclose(d0.ts, flat.ts)
+
+
+def test_diurnal_shapes_time(manifest):
+    """phase=0 over one period puts the sine's positive half first: the
+    first half-window must carry measurably more than half the mass."""
+    diur = simulate_diurnal(manifest, _cfg(), amplitude=0.8)
+    t0 = float(np.ceil(manifest.creation_ts.max())) + 1.0
+    frac_front = float((diur.ts < t0 + 300.0).mean())
+    assert frac_front > 0.55
+    assert np.all(np.diff(diur.ts) >= 0)  # globally time-sorted
+
+
+def test_diurnal_validation(manifest):
+    with pytest.raises(ValueError, match="amplitude"):
+        simulate_diurnal(manifest, _cfg(), amplitude=1.0)
+    with pytest.raises(ValueError, match="period"):
+        simulate_diurnal(manifest, _cfg(), period=0.0)
+
+
+# -- flash crowd -------------------------------------------------------------
+
+def test_flash_crowd_burst_integral(manifest):
+    """The burst's extra events match its rate integral: boost x the
+    cohort's mean read rate x the burst span (Poisson mean), within the
+    sampling noise of a few thousand draws."""
+    cfg = _cfg(duration=600.0)
+    cohort = np.asarray([c == "hot" for c in manifest.category])
+    base = simulate_access(manifest, cfg)
+    boost, dur = 50.0, 120.0
+    ev, mask = simulate_flash_crowd(manifest, cfg, cohort=cohort,
+                                    start=200.0, duration=dur, boost=boost)
+    assert np.array_equal(mask, cohort)
+    extra = len(ev) - len(base)
+    read_mu = sum(cfg.rate_profiles[manifest.category[i]]["read_rate"]
+                  for i in np.flatnonzero(cohort))
+    expected = boost * read_mu * dur
+    assert expected > 300  # enough mass for the tolerance to be fair
+    assert abs(extra / expected - 1.0) < 0.2
+    # burst events land inside the burst span only
+    t0 = float(np.ceil(manifest.creation_ts.max())) + 1.0
+    in_burst = (ev.ts >= t0 + 200.0) & (ev.ts < t0 + 200.0 + dur)
+    base_in = ((base.ts >= t0 + 200.0) & (base.ts < t0 + 200.0 + dur)).sum()
+    assert int(in_burst.sum()) - int(base_in) == extra
+
+
+# -- drift patterns ----------------------------------------------------------
+
+def test_phased_single_shift_is_with_shift(manifest):
+    """simulate_access_with_shift delegates to the phased generator;
+    the single-shift case must stay bit-identical to the historical
+    two-phase output (the control_bench pinned artifact rides on it)."""
+    ev1, fl1 = simulate_access_with_shift(manifest, _cfg(), shift_at=300.0,
+                                          category_flip=FLIP)
+    ev2, fl2 = simulate_access_phased(manifest, _cfg(),
+                                      [(300.0, FLIP, None)])
+    assert _eq(ev1, ev2)
+    assert np.array_equal(fl1, fl2)
+
+
+def test_drift_determinism_per_seed(manifest):
+    """Same spec + seed => identical streams; different seed => not."""
+    shifts = [(150.0, FLIP, None), (300.0, FLIP, None), (450.0, FLIP, None)]
+    a, ca = simulate_access_phased(manifest, _cfg(), shifts)
+    b, cb = simulate_access_phased(manifest, _cfg(), shifts)
+    assert _eq(a, b) and np.array_equal(ca, cb)
+    c, _ = simulate_access_phased(manifest, _cfg(seed=SEED + 99), shifts)
+    assert not (len(a) == len(c) and np.array_equal(a.ts, c.ts))
+
+
+def test_adversarial_even_cycles_revert(manifest):
+    """An even number of self-inverse flips ends back at the planted
+    categories — the workload really is back to normal, and the changed
+    mask must say so."""
+    ev, changed = simulate_access_phased(
+        manifest, _cfg(), [(200.0, FLIP, None), (400.0, FLIP, None)])
+    assert not changed.any()
+    assert np.all(np.diff(ev.ts) >= 0)
+    ev3, changed3 = simulate_access_phased(
+        manifest, _cfg(),
+        [(150.0, FLIP, None), (300.0, FLIP, None), (450.0, FLIP, None)])
+    cohort = np.asarray([c in FLIP for c in manifest.category])
+    assert np.array_equal(changed3, cohort)
+
+
+def test_gradual_waves_are_cumulative(manifest):
+    """Disjoint-cohort waves accumulate: the final changed mask is the
+    union of the waves."""
+    cohort = np.asarray([c in FLIP for c in manifest.category])
+    ids = np.flatnonzero(cohort)
+    w1 = np.zeros(len(manifest), dtype=bool)
+    w1[ids[: len(ids) // 2]] = True
+    w2 = np.zeros(len(manifest), dtype=bool)
+    w2[ids[len(ids) // 2:]] = True
+    _, changed = simulate_access_phased(
+        manifest, _cfg(), [(200.0, FLIP, w1), (400.0, FLIP, w2)])
+    assert np.array_equal(changed, w1 | w2)
+
+
+def test_phased_validation(manifest):
+    with pytest.raises(ValueError, match="shift_at"):
+        simulate_access_phased(manifest, _cfg(), [(600.0, FLIP, None)])
+    with pytest.raises(ValueError, match="strictly increasing"):
+        simulate_access_phased(manifest, _cfg(),
+                               [(300.0, FLIP, None), (200.0, FLIP, None)])
+    with pytest.raises(ValueError, match="rate profile"):
+        simulate_access_phased(manifest, _cfg(),
+                               [(300.0, {"hot": "nope"}, None)])
+
+
+def test_jittered_rates_deterministic(manifest):
+    rng = np.random.default_rng(3)
+    a = jittered_rates(manifest, _cfg(), rng)
+    b = jittered_rates(manifest, _cfg(), np.random.default_rng(3))
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
