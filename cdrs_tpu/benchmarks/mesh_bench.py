@@ -1,0 +1,392 @@
+"""Mesh-sharded control loop: decision identity, scaling, data=1 overhead.
+
+ROADMAP item 1 (the "single biggest unlock for millions of users") shards
+the whole per-window device computation — Lloyd assignment + centroid
+update, scoring medians, the streaming feature fold, and the drift
+detector's one-Lloyd-step — data-parallel over files across a
+``jax.sharding.Mesh`` (one ``psum`` of the (k, d+1) sufficient statistics
+per iteration; the (n, k) distance matrix and the feature table never
+gather to one device).  This bench pins the three contracts that make the
+mesh a pure RUNTIME choice:
+
+* **decision identity** — a controller run at ``mesh_shape={"data": N}``
+  makes exactly the decisions of the single-device path on the same seed
+  (assignments, category populations, plan hashes, migrations; drift
+  scalars agree to fp tolerance — float psum association), asserted
+  in-bench across seeds 0/1/2, plus a checkpoint written at ``data=1``
+  resumed at ``data=N`` (mesh shape is not checkpoint state).
+* **throughput per device count** — Lloyd iter/s at the BASELINE
+  config-2/config-3 SHAPES (d=32/k=128 and d=128/k=1024; n scales to the
+  host so a CPU smoke terminates) across 1/2/4/8 devices.  On a real TPU
+  mesh this is the near-linear-scaling observable (MULTICHIP_r0*
+  lineage); on CPU's virtual devices the counts share one socket, so the
+  numbers check the harness, not the hardware.
+* **data=1 overhead** — the mesh path at ``data=1`` (the same shard_map
+  body with collectives compiled out, plus the device drift kernel) holds
+  within 5% of the historical single-device path on a config-2-shaped
+  device pass, measured with the repo's interleaved-paired-rounds /
+  best-of-rounds convention (the noisy-host methodology every overhead
+  artifact uses).
+
+``python -m cdrs_tpu.benchmarks.mesh_bench`` writes
+``data/mesh_bench.json`` and auto-appends its bench_records to
+``data/bench_history.jsonl`` via ``regress.append_history`` (``--quick``
+never appends).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+
+__all__ = ["run_mesh_bench"]
+
+#: BASELINE config-2 / config-3 kernel shapes (benchmarks/harness.CONFIGS);
+#: n is a bench parameter so the same shape runs at host-feasible scale.
+_SHAPES = {"config2": (32, 128), "config3": (128, 1024)}
+
+
+def _available_device_counts(want: list[int]) -> list[int]:
+    import jax
+
+    have = jax.device_count()
+    counts = [n for n in want if n <= have]
+    if not counts:
+        raise ValueError(
+            f"no requested device count {want} fits this host's {have} "
+            f"device(s); on CPU force virtual devices with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{max(want)}")
+    return counts
+
+
+# -- throughput per device count ---------------------------------------------
+
+def _time_lloyd(X, k: int, init, mesh, iters: int, rounds: int) -> float:
+    """Best-of-rounds wall seconds for ``iters`` fixed-trip Lloyd
+    iterations (tol=0 — the static-trip loop), warm (compile excluded)."""
+    from ..ops.kmeans_jax import kmeans_jax_full
+
+    def once():
+        c, _, it, _ = kmeans_jax_full(
+            X, k, tol=0.0, seed=0, max_iter=iters, init_centroids=init,
+            mesh_shape=mesh)
+        return c
+
+    import jax
+
+    jax.block_until_ready(once())  # compile + warm
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        jax.block_until_ready(once())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _throughput(shape_name: str, n: int, iters: int, rounds: int,
+                device_counts: list[int], seed: int) -> dict:
+    d, k = _SHAPES[shape_name]
+    rng = np.random.default_rng(seed)
+    X = rng.random((n, d), dtype=np.float32)
+    init = X[rng.choice(n, k, replace=False)].copy()
+    rows = []
+    for ndev in device_counts:
+        mesh = None if ndev == 1 else {"data": ndev}
+        secs = _time_lloyd(X, k, init, mesh, iters, rounds)
+        rows.append({
+            "devices": ndev,
+            "iters_per_sec": round(iters / secs, 3),
+            "seconds": round(secs, 4),
+        })
+    from ..parallel.mesh import collective_bytes_estimate
+
+    return {
+        "shape": shape_name, "n": n, "d": d, "k": k, "iters": iters,
+        "collective_bytes_per_iter_at_max": collective_bytes_estimate(
+            k * (d + 1) * 4, device_counts[-1]),
+        "per_device_count": rows,
+    }
+
+
+# -- decision identity --------------------------------------------------------
+
+def _strip(records: list[dict]) -> list[dict]:
+    """Decision view of the record stream: wall-clock, the mesh stamp and
+    the fp-tolerance drift scalars removed (compared separately)."""
+    drop = ("seconds", "mesh", "drift", "centroid_shift",
+            "population_delta")
+    return [{k: v for k, v in r.items() if k not in drop} for r in records]
+
+
+def _controller_scenario(seed: int):
+    from ..config import (GeneratorConfig, SimulatorConfig,
+                          validated_scoring_config)
+    from ..sim.access import simulate_access_with_shift
+    from ..sim.generator import generate_population
+
+    manifest = generate_population(
+        GeneratorConfig(n_files=400, seed=seed))
+    events, _ = simulate_access_with_shift(
+        manifest, SimulatorConfig(duration_seconds=1200.0, seed=seed + 1),
+        600.0, {"hot": "archival", "archival": "hot"})
+    # Pinned to histogram medians on BOTH sides: the medians are integer
+    # count statistics, bitwise identical at any mesh shape — whereas
+    # "auto" resolves to the exact sort single-device and hist sharded,
+    # which is a different (if equally valid) estimate per shape.
+    scoring = dataclasses.replace(validated_scoring_config(),
+                                  median_method="hist")
+    return manifest, events, scoring
+
+
+def _controller_run(manifest, events, scoring, mesh, seed,
+                    checkpoint_path=None, max_windows=None):
+    from ..config import KMeansConfig
+    from ..control import ControllerConfig, ReplicationController
+
+    cfg = ControllerConfig(
+        window_seconds=100.0, drift_threshold=0.02, backend="jax",
+        kmeans=KMeansConfig(k=12, seed=42), scoring=scoring,
+        mesh_shape=mesh, default_rf=2)
+    ctl = ReplicationController(manifest, cfg)
+    return ctl.run(events, checkpoint_path=checkpoint_path,
+                   max_windows=max_windows)
+
+
+def _decision_identity(seeds: list[int], ndev: int) -> dict:
+    """Mesh-vs-single-device controller equivalence + cross-shape resume."""
+    import tempfile
+
+    mesh = {"data": ndev}
+    out: dict = {"seeds": [], "devices": ndev}
+    all_ok = True
+    for seed in seeds:
+        manifest, events, scoring = _controller_scenario(seed)
+        r1 = _controller_run(manifest, events, scoring, None, seed)
+        rN = _controller_run(manifest, events, scoring, mesh, seed)
+        # Both sides guarded for None: a divergent acceptance schedule
+        # (one side's drift missing at some window) must surface as
+        # decisions_identical=false below, not a TypeError mid-artifact.
+        drift_diff = max(
+            (abs(a["drift"] - b["drift"])
+             for a, b in zip(r1.records, rN.records)
+             if a.get("drift") is not None
+             and b.get("drift") is not None), default=0.0)
+        # Model-level: same assignments and category populations on the
+        # final feature snapshot at both shapes.
+        decisions_ok = (
+            _strip(r1.records) == _strip(rN.records)
+            and bool(np.array_equal(r1.rf, rN.rf))
+            and bool(np.array_equal(r1.category_idx, rN.category_idx)))
+        # Checkpoint portability: killed at data=1 mid-run, resumed at
+        # data=N — decisions must stitch identically (mesh shape is a
+        # runtime choice, not checkpoint state).
+        with tempfile.TemporaryDirectory() as td:
+            ck = os.path.join(td, "mesh.npz")
+            a = _controller_run(manifest, events, scoring, None, seed,
+                                checkpoint_path=ck, max_windows=6)
+            b = _controller_run(manifest, events, scoring, mesh, seed,
+                                checkpoint_path=ck)
+            resume_ok = (
+                _strip(a.records) + _strip(b.records)
+                == _strip(rN.records)
+                and bool(np.array_equal(b.rf, rN.rf))
+                and bool(np.array_equal(b.category_idx, rN.category_idx)))
+        out["seeds"].append({
+            "seed": seed,
+            "windows": len(r1.records),
+            "decisions_identical": bool(decisions_ok),
+            "resume_across_shapes_identical": bool(resume_ok),
+            "drift_score_max_diff": float(drift_diff),
+        })
+        all_ok = all_ok and decisions_ok and resume_ok \
+            and drift_diff < 1e-5
+    out["ok"] = bool(all_ok)
+    return out
+
+
+# -- data=1 overhead ----------------------------------------------------------
+
+def _overhead(n: int, iters: int, rounds: int, seed: int) -> dict:
+    """One config-2-shaped device pass (Lloyd + fused classify + drift)
+    on the historical single-device path vs the mesh path at data=1,
+    interleaved paired rounds, best-of-rounds ratio."""
+    import jax
+
+    from ..config import ScoringConfig
+    from ..control.drift import detect_drift, detect_drift_jax
+    from ..ops.kmeans_jax import kmeans_jax_full
+    from ..ops.scoring_jax import classify_jax
+
+    d, k = _SHAPES["config2"]
+    rng = np.random.default_rng(seed)
+    X = rng.random((n, d), dtype=np.float32)
+    init = X[rng.choice(n, k, replace=False)].copy()
+    cat_idx = rng.integers(0, 4, k)
+    frac = np.full(4, 0.25)
+    # Scoring/drift always run at the controller's 5-feature width (the
+    # score tables are (C, 5) by construction); Lloyd carries the full
+    # config-2 shape.  Global medians from data: the stock per-feature
+    # table only covers the named 5 features.
+    scoring = ScoringConfig(median_method="hist",
+                            compute_global_medians_from_data=True)
+    X5 = np.ascontiguousarray(X[:, :5])
+    init5 = np.ascontiguousarray(init[:, :5])
+
+    def one_pass(mesh):
+        c, labels, _, _ = kmeans_jax_full(
+            X, k, tol=0.0, seed=0, max_iter=iters, init_centroids=init,
+            mesh_shape=mesh)
+        winner, scores, med = classify_jax(X5, labels, k, scoring,
+                                           mesh_shape=mesh)
+        if mesh is None:
+            detect_drift(X5, init5, cat_idx, frac, 4)
+        else:
+            detect_drift_jax(X5, init5, cat_idx, frac, 4, mesh_shape=mesh)
+        return jax.block_until_ready((winner, scores, med))
+
+    one_pass(None)          # compile + warm both sides
+    one_pass({"data": 1})
+    t = {"single": [], "mesh1": []}
+    for r in range(rounds):
+        order = (("single", None), ("mesh1", {"data": 1}))
+        if r % 2:
+            order = order[::-1]
+        for name, mesh in order:
+            t0 = time.perf_counter()
+            one_pass(mesh)
+            t[name].append(time.perf_counter() - t0)
+    best_single = min(t["single"])
+    best_mesh = min(t["mesh1"])
+    return {
+        "n": n, "d": d, "k": k, "iters": iters, "rounds": rounds,
+        "seconds_single_device": round(best_single, 4),
+        "seconds_mesh_data1": round(best_mesh, 4),
+        "overhead_ratio": round(best_mesh / best_single, 4),
+        "rounds_single_seconds": [round(x, 4) for x in t["single"]],
+        "rounds_mesh_seconds": [round(x, 4) for x in t["mesh1"]],
+        "methodology": "interleaved paired rounds, best-of-rounds ratio",
+    }
+
+
+# -- driver -------------------------------------------------------------------
+
+def run_mesh_bench(n2: int, n3: int, iters2: int, iters3: int,
+                   rounds: int, device_counts: list[int],
+                   seeds: list[int], overhead_rounds: int,
+                   seed: int = 0) -> dict:
+    import jax
+
+    device_counts = _available_device_counts(device_counts)
+    ndev_max = device_counts[-1]
+    out: dict = {
+        "jax_platform": jax.default_backend(),
+        "jax_devices": jax.device_count(),
+        "device_counts": device_counts,
+        "note": ("per-device scaling is meaningful on a real chip mesh; "
+                 "CPU virtual devices share one socket and check the "
+                 "harness, not the hardware"),
+    }
+    out["throughput"] = [
+        _throughput("config2", n2, iters2, rounds, device_counts, seed),
+        _throughput("config3", n3, iters3, rounds, device_counts, seed),
+    ]
+    for t in out["throughput"]:
+        print(json.dumps({"shape": t["shape"],
+                          "per_device_count": t["per_device_count"]}))
+    out["decision_identity"] = _decision_identity(seeds, ndev_max)
+    print(json.dumps({"decision_identity_ok":
+                      out["decision_identity"]["ok"]}))
+    # Full iteration budget for the overhead pass: per the noisy-host
+    # methodology each timed side must run for seconds, not hundreds of
+    # milliseconds, or jitter swamps a 5% effect.
+    out["overhead"] = _overhead(n2, iters2, overhead_rounds, seed)
+    print(json.dumps({"overhead_ratio": out["overhead"]["overhead_ratio"]}))
+
+    ratio = out["overhead"]["overhead_ratio"]
+    out["criteria"] = {
+        "decision_identity_all_seeds": out["decision_identity"]["ok"],
+        "data1_overhead_within_5pct": ratio <= 1.05,
+    }
+    # Only the throughput row feeds the trajectory ledger.  The overhead
+    # RATIO stays an in-bench criterion (<= 1.05, hard-gated above): its
+    # ideal value is ~1.0 with host jitter on both sides, so banding it
+    # against a best-of-history baseline (the luckiest draw) would flag
+    # phantom regressions forever — the same reason the telemetry/
+    # integrity overhead artifacts are criteria, not ledger rows.
+    top2 = out["throughput"][0]["per_device_count"][-1]
+    out["bench_records"] = [
+        {"metric": f"mesh_config2_iters_per_sec_d{top2['devices']}",
+         "value": top2["iters_per_sec"], "unit": "iter/s",
+         "backend": "jax", "jax_platform": out["jax_platform"],
+         "jax_devices": out["jax_devices"]},
+    ]
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--out", default="data/mesh_bench.json")
+    p.add_argument("--round", type=int, default=11, dest="round_no",
+                   help="PR-round stamp for the regress history")
+    from .regress import add_history_argument
+
+    add_history_argument(p)
+    p.add_argument("--n2", type=int, default=262_144,
+                   help="rows for the config-2 SHAPE (d=32, k=128)")
+    p.add_argument("--n3", type=int, default=65_536,
+                   help="rows for the config-3 SHAPE (d=128, k=1024)")
+    p.add_argument("--iters2", type=int, default=8)
+    p.add_argument("--iters3", type=int, default=4)
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--overhead_rounds", type=int, default=4)
+    p.add_argument("--devices", default="1,2,4,8",
+                   help="comma-separated device counts (clipped to the "
+                        "host's)")
+    p.add_argument("--seeds", default="0,1,2",
+                   help="decision-identity controller seeds")
+    p.add_argument("--quick", action="store_true",
+                   help="smoke sizes for CI: tiny shapes, 1 seed")
+    args = p.parse_args(argv)
+
+    counts = [int(x) for x in args.devices.split(",") if x]
+    if args.quick:
+        out = run_mesh_bench(
+            n2=16_384, n3=4_096, iters2=3, iters3=2, rounds=2,
+            device_counts=counts, seeds=[0], overhead_rounds=2)
+    else:
+        out = run_mesh_bench(
+            n2=args.n2, n3=args.n3, iters2=args.iters2, iters3=args.iters3,
+            rounds=args.rounds, device_counts=counts,
+            seeds=[int(s) for s in args.seeds.split(",") if s],
+            overhead_rounds=args.overhead_rounds)
+    out["round"] = args.round_no
+
+    parent = os.path.dirname(args.out)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    from .regress import (append_history, extract_records,
+                          resolve_history_path)
+
+    history = resolve_history_path(args)
+    appended = 0
+    if history:
+        appended = append_history(
+            history, extract_records(out, os.path.basename(args.out)))
+    print(json.dumps({"out": args.out, **out["criteria"],
+                      "history_appended": appended}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -456,6 +456,11 @@ def _controller_cfg(args, fault_schedule=None, topology=None):
     """ControllerConfig from the shared control/chaos argument set."""
     from .control import ControllerConfig
 
+    mesh_shape = _parse_mesh(args.mesh)
+    if mesh_shape and args.backend != "jax":
+        raise SystemExit(
+            "--mesh requires --backend jax (the numpy backend is the "
+            "single-host oracle)")
     scoring = _load_scoring(args)
     storage_cfg = None
     if getattr(args, "storage_config", None):
@@ -496,7 +501,7 @@ def _controller_cfg(args, fault_schedule=None, topology=None):
                             init_method=getattr(args, 'init_method', 'auto'),
                             dtype=getattr(args, 'dtype', None)),
         scoring=scoring,
-        mesh_shape=_parse_mesh(args.mesh),
+        mesh_shape=mesh_shape,
         evaluate=not args.no_evaluate,
         fault_schedule=fault_schedule,
         repair_seed=getattr(args, "repair_seed", 0),

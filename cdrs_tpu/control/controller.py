@@ -52,7 +52,7 @@ import numpy as np
 from ..config import CATEGORIES, KMeansConfig, ScoringConfig
 from ..io.events import EventLog, Manifest
 from ..models.replication import ReplicationPolicyModel
-from .drift import detect_drift
+from .drift import detect_drift, detect_drift_jax
 from .migrate import MigrationScheduler, plan_diff
 from .windows import iter_windows
 
@@ -86,6 +86,16 @@ class ControllerConfig:
     backend: str = "numpy"
     kmeans: KMeansConfig = field(default_factory=lambda: KMeansConfig(k=8))
     scoring: ScoringConfig = field(default_factory=ScoringConfig)
+    #: Device mesh for the per-window device computation (jax backend):
+    #: ``{"data": N}`` shards the cluster step, scoring medians, streaming
+    #: feature fold AND the drift detector's one-Lloyd-step data-parallel
+    #: over files (one psum of (k, d+1) sufficient statistics per
+    #: iteration; the (n, k) distance matrix and the feature table never
+    #: gather to one device).  A RUNTIME choice, not checkpoint state: a
+    #: checkpoint written at ``data=1`` resumes at ``data=8`` and vice
+    #: versa with identical decisions (drift scalars agree to fp
+    #: tolerance — float psum association).  ``None`` = the historical
+    #: single-device path, kept as the equivalence oracle.
     mesh_shape: dict[str, int] | None = None
     #: Replay window events against the simulated cluster before/after the
     #: window's moves (cluster/evaluate.py).
@@ -158,6 +168,18 @@ class ControllerConfig:
                 "cross-batch concurrency carry has no decayed analogue)")
         if self.drift_threshold < 0 or self.full_recluster_drift < 0:
             raise ValueError("drift thresholds must be >= 0")
+        if self.mesh_shape is not None:
+            # Backend check BEFORE the axis validation: validate_mesh_shape
+            # lives in parallel/ which imports jax, and a numpy-backend
+            # config must fail with the real reason, not an ImportError.
+            if self.backend != "jax":
+                raise ValueError(
+                    "mesh_shape requires backend='jax' (the numpy backend "
+                    "is the single-host oracle; drop mesh_shape or switch "
+                    "backends)")
+            from ..parallel.mesh import validate_mesh_shape
+
+            self.mesh_shape = validate_mesh_shape(self.mesh_shape)
         if self.scrub is not None and self.fault_schedule is None:
             raise ValueError(
                 "scrub requires a fault_schedule (the scrubber verifies "
@@ -418,6 +440,26 @@ class ReplicationController:
                 spike_factor=cfg.serve.hotspot_spike_factor,
                 min_reads=cfg.serve.hotspot_min_reads,
                 top_k=cfg.serve.hotspot_top_k)
+        #: Mesh telemetry template (mesh runs only): device count and the
+        #: per-Lloyd-iteration collective-traffic estimate — one psum of
+        #: the f32 (k, d+1) sufficient statistics over the data axis —
+        #: stamped on every window record so `cdrs metrics summarize` can
+        #: read windows/sec against mesh size.  Pre-mesh runs carry no
+        #: ``mesh`` key: their records stay byte-identical.
+        self._mesh_rec = None
+        if cfg.mesh_shape is not None:
+            from ..parallel.mesh import collective_bytes_estimate
+
+            ndev = 1
+            for v in cfg.mesh_shape.values():
+                ndev *= int(v)
+            d_feat = len(cfg.scoring.features)
+            payload = int(cfg.kmeans.k) * (d_feat + 1) * 4
+            self._mesh_rec = {
+                "devices": ndev,
+                "collective_bytes_per_iter": collective_bytes_estimate(
+                    payload, int(cfg.mesh_shape.get("data", 1))),
+            }
         #: One warning per controller when the jax kernel path degrades to
         #: the numpy fallback (fault-tolerance part 4).
         self._kernel_fallback_warned = False
@@ -529,6 +571,8 @@ class ReplicationController:
         seconds: dict[str, float] = {}
         t_start = time.perf_counter()
         rec: dict = {"window": int(w), "n_events": int(len(events))}
+        if self._mesh_rec is not None:
+            rec["mesh"] = dict(self._mesh_rec)
 
         t0 = time.perf_counter()
         if len(events):
@@ -573,9 +617,20 @@ class ReplicationController:
         self._ensure_accepted()
         if self._accepted_centroids is not None and len(events):
             X = self._feature_snapshot()
-            drift = detect_drift(X, self._accepted_centroids,
-                                 self._accepted_category_idx,
-                                 self._accepted_fractions, len(CATEGORIES))
+            if self._mesh_rec is not None:
+                # Mesh runs score drift on device, data-parallel over
+                # files (control/drift.detect_drift_jax) — the host
+                # oracle below stays the mesh-less path's detector.
+                drift = detect_drift_jax(
+                    X, self._accepted_centroids,
+                    self._accepted_category_idx,
+                    self._accepted_fractions, len(CATEGORIES),
+                    mesh_shape=cfg.mesh_shape)
+            else:
+                drift = detect_drift(X, self._accepted_centroids,
+                                     self._accepted_category_idx,
+                                     self._accepted_fractions,
+                                     len(CATEGORIES))
         seconds["drift"] = time.perf_counter() - t0
         rec["drift"] = None if drift is None else drift.score
         rec["centroid_shift"] = None if drift is None else drift.centroid_shift
@@ -979,6 +1034,14 @@ class ReplicationController:
         # safe to emit every window at any scale.
         tel.gauge("planner.backlog_files", rec["backlog_files"])
         tel.gauge("planner.backlog_bytes", rec["backlog_bytes"])
+        mesh = rec.get("mesh")
+        if mesh is not None:
+            ndata = max(1, int((self.cfg.mesh_shape or {}).get("data", 1)))
+            tel.gauge("mesh.devices", mesh["devices"])
+            tel.gauge("mesh.rows_per_device",
+                      -(-len(self.manifest) // ndata))
+            tel.gauge("mesh.collective_bytes_per_iter",
+                      mesh["collective_bytes_per_iter"])
         if rec.get("fault_events"):
             tel.counter_inc("fault.events", len(rec["fault_events"]))
             n_part_ev = sum(1 for s in rec["fault_events"]

@@ -16,17 +16,29 @@ computed from the current feature snapshot and the last ACCEPTED model
 
 ``score = max(centroid_shift, population_delta)``: either signal alone is
 grounds to re-cluster (a category flip can move populations with little
-centroid motion and vice versa).  Everything is plain NumPy — the detector
-runs every window, on host, regardless of the clustering backend.
+centroid motion and vice versa).  Two implementations:
+
+* :func:`detect_drift` — plain NumPy on host (float64), the oracle; runs
+  every window regardless of the clustering backend.
+* :func:`detect_drift_jax` — the same one-Lloyd-step math inside a
+  ``shard_map`` body data-parallel over files: each shard assigns its rows
+  and reduces local per-cluster (sum, count); ONE ``psum`` of the
+  ``(k, d+1)`` sufficient statistics per call merges them — the feature
+  table never gathers to one device, and the category fractions fall out
+  of the already-psum'd counts (no second data pass).  Float32 on device,
+  so scores agree with the oracle to fp tolerance while re-cluster/plan
+  decisions are identical (tests/test_mesh_control.py).  Used by the
+  controller when ``ControllerConfig.mesh_shape`` is set.
 """
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 
 import numpy as np
 
-__all__ = ["DriftReport", "detect_drift"]
+__all__ = ["DriftReport", "detect_drift", "detect_drift_jax"]
 
 
 @dataclass(frozen=True)
@@ -68,3 +80,94 @@ def detect_drift(
 
     return DriftReport(score=max(shift, pop_delta), centroid_shift=shift,
                        population_delta=pop_delta, fractions=frac)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_drift(n_valid: int, d: int, k: int, ncat: int, ndata: int):
+    """Compile the sharded drift kernel for one (shape, mesh) point.
+
+    ``ndata == 1`` compiles the same body under plain jit with the
+    collectives elided (the streaming fold's one-device-bypass pattern) —
+    the ``mesh_shape={"data": 1}`` path the overhead bench holds against
+    the host oracle.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.kmeans_jax import (_weighted_cluster_stats, assign_labels_jax)
+    from ..parallel.mesh import (DATA_AXIS, make_mesh, prefix_mask,
+                                 shard_map_compat)
+
+    sharded = ndata > 1
+
+    def local_fn(x, c, cat_idx, acc_frac):
+        w = prefix_mask(x, n_valid, sharded=sharded)
+        labels = assign_labels_jax(x, c)
+        # ``scatter`` (segment_sum) matches numpy bincount accumulation
+        # order, keeping the shard-local partials as close to the oracle
+        # as f32 allows.
+        sums, counts = _weighted_cluster_stats(x, w, labels, k, "scatter")
+        if sharded:
+            # THE one collective: (k, d+1) sufficient statistics — the
+            # same sums/counts identity the Lloyd update psums.
+            stats = lax.psum(
+                jnp.concatenate([sums, counts[:, None]], axis=1), DATA_AXIS)
+            sums, counts = stats[:, :d], stats[:, d]
+        means = jnp.where(counts[:, None] > 0,
+                          sums / jnp.maximum(counts, 1.0)[:, None], c)
+        shift = jnp.sqrt(jnp.mean(jnp.sum((means - c) ** 2, axis=1)))
+        # Category fractions fall out of the psum'd per-cluster counts —
+        # no per-file gather, no second pass.
+        frac = jnp.zeros((ncat,), sums.dtype).at[cat_idx].add(counts) \
+            / n_valid
+        pop_delta = 0.5 * jnp.sum(jnp.abs(frac - acc_frac))
+        return shift, pop_delta, frac
+
+    if not sharded:
+        return jax.jit(local_fn)
+    mesh = make_mesh(n_data=ndata)
+    return jax.jit(shard_map_compat(
+        local_fn, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(), P(), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    ))
+
+
+def detect_drift_jax(
+    X: np.ndarray,
+    centroids: np.ndarray,
+    category_idx: np.ndarray,
+    accepted_fractions: np.ndarray,
+    n_categories: int,
+    mesh_shape: dict[str, int] | None = None,
+) -> DriftReport:
+    """Mesh-sharded drift of ``X`` against the accepted model.
+
+    Same report as :func:`detect_drift` with the one-Lloyd-step statistics
+    reduced across the ``data`` mesh axis (see module docstring).  Rows
+    pad to a shard multiple with weight-0 tails (``pad_rows`` +
+    ``prefix_mask``); the centroid table is replicated (a model axis would
+    buy nothing at k·d drift scale, so only ``data`` is honored).
+    """
+    import jax.numpy as jnp
+
+    from ..parallel.mesh import DATA_AXIS, pad_rows, validate_mesh_shape
+
+    ndata = int(validate_mesh_shape(mesh_shape).get(DATA_AXIS, 1))
+    X = np.asarray(X, dtype=np.float32)
+    c = np.asarray(centroids, dtype=np.float32)
+    Xp, n_valid = pad_rows(X, ndata)
+    fn = _build_drift(n_valid, X.shape[1], c.shape[0], int(n_categories),
+                      ndata)
+    shift, pop_delta, frac = fn(
+        jnp.asarray(Xp), jnp.asarray(c),
+        jnp.asarray(np.asarray(category_idx), jnp.int32),
+        jnp.asarray(np.asarray(accepted_fractions), jnp.float32))
+    shift = float(shift)
+    pop_delta = float(pop_delta)
+    return DriftReport(score=max(shift, pop_delta), centroid_shift=shift,
+                       population_delta=pop_delta,
+                       fractions=np.asarray(frac, dtype=np.float64))

@@ -215,7 +215,8 @@ def collect(events: list[dict]) -> dict:
                 for key in ("flops", "bytes_accessed", "transcendentals",
                             "argument_bytes", "output_bytes", "temp_bytes",
                             "generated_code_bytes", "lower_seconds",
-                            "compile_seconds"):
+                            "compile_seconds", "devices",
+                            "collective_bytes_per_iter"):
                     if key in e:
                         row[key] = e[key]
         elif kind == "meta" and isinstance(e.get("run"), dict):
@@ -400,6 +401,15 @@ def pacing_digest(windows: list[dict]) -> dict | None:
         out["plan_p50_seconds"] = percentile(plan, 0.5)
         out["plan_seconds_fraction"] = (sum(plan) / plan_total
                                         if plan_total > 0 else 0.0)
+    # Mesh runs stamp devices + the per-Lloyd-iteration collective-bytes
+    # estimate on every window record (controller): surface them here so
+    # windows/sec reads against mesh size.  Mesh-less streams carry no
+    # ``mesh`` key and render unchanged.
+    mesh = [w["mesh"] for w in windows if isinstance(w.get("mesh"), dict)]
+    if mesh:
+        out["devices"] = int(mesh[-1].get("devices", 1))
+        out["collective_bytes_per_iter"] = int(
+            mesh[-1].get("collective_bytes_per_iter", 0))
     return out
 
 

@@ -339,6 +339,12 @@ def summarize_events(events: list[dict], out=None, peak_flops=None,
                          f"{pacing['plan_p50_seconds'] * 1e3:.2f} ms/window, "
                          f"{pacing['plan_seconds_fraction']:.1%} "
                          f"of host time)")
+            if "devices" in pacing:
+                # Mesh runs: windows/sec must be readable against mesh
+                # size and the per-iteration collective traffic it buys.
+                line += (f" across {pacing['devices']} devices "
+                         f"(~{pacing['collective_bytes_per_iter']} B/iter "
+                         f"collectives)")
             print(line, file=out)
 
 

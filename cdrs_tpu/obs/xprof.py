@@ -148,7 +148,8 @@ def _sig_id(kernel: str, signature) -> int:
 
 
 def instrumented_call(kernel: str, jitted, args: tuple, *, signature,
-                      n_static_trailing: int = 0):
+                      n_static_trailing: int = 0,
+                      extra: dict | None = None):
     """Invoke ``jitted(*args)``, capturing XLA cost analysis on the way.
 
     With no active instrument (or ``xprof`` off) this IS ``jitted(*args)``.
@@ -159,6 +160,10 @@ def instrumented_call(kernel: str, jitted, args: tuple, *, signature,
     ``block_until_ready``), and the AOT executable is cached for steady-state
     calls.  ``n_static_trailing`` names how many trailing entries of ``args``
     are jit-static (the AOT executable is invoked without them).
+    ``extra`` fields merge into the compile event — callers use it to stamp
+    mesh facts XLA's own analyses don't expose (``devices``,
+    ``collective_bytes_per_iter``), which the summarize/report digests
+    carry into the roofline rows.
     """
     tel = current()
     if tel is None or not getattr(tel, "xprof", False):
@@ -177,7 +182,7 @@ def instrumented_call(kernel: str, jitted, args: tuple, *, signature,
                 compiled = _COMPILED.get(key)
             if compiled is None:
                 return _capture_and_run(key, kernel, signature, jitted,
-                                        args, call_args, tel)
+                                        args, call_args, tel, extra)
     if compiled is _FALLBACK:
         return jitted(*args)
     try:
@@ -192,7 +197,8 @@ def instrumented_call(kernel: str, jitted, args: tuple, *, signature,
         return jitted(*args)
 
 
-def _capture_and_run(key, kernel, signature, jitted, args, call_args, tel):
+def _capture_and_run(key, kernel, signature, jitted, args, call_args, tel,
+                     extra=None):
     """Winner path of the per-key capture: lower+compile (wall-clocked),
     emit the cost events, cache the executable, time the first run."""
     sig_id = _sig_id(kernel, signature)
@@ -208,7 +214,10 @@ def _capture_and_run(key, kernel, signature, jitted, args, call_args, tel):
         return jitted(*args)
     with _LOCK:
         _COMPILED[key] = compiled
-    tel._emit(_cost_event(kernel, compiled, t1 - t0, t2 - t1, sig_id))
+    event = _cost_event(kernel, compiled, t1 - t0, t2 - t1, sig_id)
+    if extra:
+        event.update(extra)
+    tel._emit(event)
     tel.counter_inc(f"xla.compiles.{kernel}")
     tel.histogram("xla.compile.seconds", t2 - t1)
 

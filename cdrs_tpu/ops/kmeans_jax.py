@@ -233,23 +233,52 @@ def _pick_row_global(x: jnp.ndarray, scores: jnp.ndarray,
     return lax.psum(row, DATA_AXIS)
 
 
-def _d2_init_local(x, w, key, *, k, sharded=True):
+def _row_noise(key, round_idx, *, n_noise, n_loc, ndata, rank, dtype,
+               sharded):
+    """Per-row Gumbel noise for one sampling round, MESH-SHAPE-INVARIANT.
+
+    The draw is replicated over the ``n_noise`` valid-row prefix — a static
+    length independent of the mesh (threefry is NOT prefix-stable across
+    shapes, so the length must not depend on padding) — from a key that no
+    longer folds the shard rank, then zero-padded to the padded total and
+    sliced to this shard's rows.  The same (seed, round, global row) hence
+    draws the same noise at any ``data=N``, which is what makes the D²/
+    kmeans|| inits (and every controller decision downstream of a cold
+    re-cluster) identical across mesh shapes.  Padded rows get 0; every
+    caller masks them to -inf before the argmax.  Costs O(n) RNG per shard
+    per round (redundant across shards) — noise generation is noise next
+    to the O(n·d) distance pass each round already pays.
+    """
+    key_r = jax.random.fold_in(jax.random.fold_in(key, round_idx), 0)
+    g = jax.random.gumbel(key_r, (n_noise,), dtype)
+    n_pad = n_loc * ndata
+    if n_pad != n_noise:
+        g = jnp.concatenate([g, jnp.zeros((n_pad - n_noise,), dtype)])
+    if not sharded:
+        return g
+    return lax.dynamic_slice_in_dim(g, rank * n_loc, n_loc)
+
+
+def _d2_init_local(x, w, key, *, k, n_valid, ndata, sharded=True):
     """KMeans++ D² sampling, shard-local view (x: (n_loc, d) shard).
 
     Gumbel-max: argmax(log p_i + G_i) is a categorical draw ∝ p_i, and argmax
     distributes across shards (see _pick_row_global) — so each of the k rounds
     is pure on-device compute + two scalar collectives + one O(d) psum.
+    Noise is keyed to the GLOBAL row (``_row_noise``), so the selected
+    centroids are identical at any mesh shape on the same seed.
     Degenerate rounds (all residual distances 0) fall back to a uniform draw
     (reference: kmeans_np.kmeans_plusplus_init fallback).
     """
     rank = lax.axis_index(DATA_AXIS) if sharded else jnp.int32(0)
-    d = x.shape[1]
+    n_loc, d = x.shape
     x_sq = jnp.sum(x * x, axis=1)
     neg_inf = jnp.array(-jnp.inf, x.dtype)
 
     def sample(round_idx, logits):
-        noise_key = jax.random.fold_in(jax.random.fold_in(key, round_idx), rank)
-        g = jax.random.gumbel(noise_key, logits.shape, x.dtype)
+        g = _row_noise(key, round_idx, n_noise=n_valid, n_loc=n_loc,
+                       ndata=ndata, rank=rank, dtype=x.dtype,
+                       sharded=sharded)
         return _pick_row_global(x, jnp.where(w > 0, logits + g, neg_inf),
                                 sharded)
 
@@ -332,8 +361,8 @@ def _weighted_lloyd_small(c, wts, cent, iters):
     return lax.fori_loop(0, iters, body, cent)
 
 
-def _kmeans_par_init_local(x, w, key, *, k, rounds, per_round,
-                           cand_lloyd_iters=10, sharded=True):
+def _kmeans_par_init_local(x, w, key, *, k, rounds, per_round, n_valid,
+                           ndata, cand_lloyd_iters=10, sharded=True):
     """k-means|| init, shard-local view — O(rounds) passes instead of k.
 
     The reference's D² init is inherently sequential in k (1024 rounds at the
@@ -347,6 +376,8 @@ def _kmeans_par_init_local(x, w, key, *, k, rounds, per_round,
     number* of points — impossible under XLA's static shapes).  Candidates
     are then weighted by an assignment count pass and reduced to k with a
     replicated weighted D² + a few weighted Lloyd steps (Bahmani §3.3).
+    Round noise is keyed to the GLOBAL row (``_row_noise``), so the drawn
+    candidate set is identical at any mesh shape on the same seed.
     """
     rank = lax.axis_index(DATA_AXIS) if sharded else jnp.int32(0)
     n_loc, d = x.shape
@@ -356,19 +387,19 @@ def _kmeans_par_init_local(x, w, key, *, k, rounds, per_round,
 
     key_rounds, key_reduce = jax.random.split(key)
 
+    def noise(round_idx):
+        return _row_noise(key_rounds, round_idx, n_noise=n_valid,
+                          n_loc=n_loc, ndata=ndata, rank=rank,
+                          dtype=x.dtype, sharded=sharded)
+
     # Round 0: one uniform draw (same as D² round 0).
-    g0 = jax.random.gumbel(
-        jax.random.fold_in(jax.random.fold_in(key_rounds, 0), rank),
-        (n_loc,), x.dtype)
-    c0 = _pick_row_global(x, jnp.where(w > 0, g0, neg_inf), sharded)
+    c0 = _pick_row_global(x, jnp.where(w > 0, noise(0), neg_inf), sharded)
     cands = jnp.zeros((n_cand, d), x.dtype).at[0].set(c0)
     min_sq = _sq_dist_to_row(x, x_sq, c0)
 
     def round_body(r, carry):
         cands, min_sq = carry
-        noise_key = jax.random.fold_in(
-            jax.random.fold_in(key_rounds, r + 1), rank)
-        g = jax.random.gumbel(noise_key, (n_loc,), x.dtype)
+        g = noise(r + 1)
         total = jnp.sum(min_sq * w)
         if sharded:
             total = lax.psum(total, DATA_AXIS)
@@ -808,9 +839,11 @@ def _build_kmeans(n_valid, d, k, ndata, nmodel, max_iter, tol, with_init,
         elif init_method == "kmeans||":
             centroids = _kmeans_par_init_local(
                 x, w, init_key, k=k, rounds=init_rounds,
-                per_round=init_per_round, sharded=sharded)
+                per_round=init_per_round, n_valid=n_valid, ndata=ndata,
+                sharded=sharded)
         else:
-            centroids = _d2_init_local(x, w, init_key, k=k, sharded=sharded)
+            centroids = _d2_init_local(x, w, init_key, k=k, n_valid=n_valid,
+                                       ndata=ndata, sharded=sharded)
         # Centroids iterate in the stat dtype (f32 for bf16 points): the init
         # samples/averages in x's dtype, the Lloyd loop must not.
         centroids = centroids.astype(_stat_dtype(x.dtype))
@@ -1029,11 +1062,22 @@ def kmeans_jax_full(
     if _tel is not None and _tel.xprof:
         # XLA cost capture (obs/xprof.py): lower+compile explicitly once
         # per signature, emit flops/bytes/memory + compile wall-clock as
-        # xla.* events, reuse the AOT executable afterwards.
+        # xla.* events, reuse the AOT executable afterwards.  Mesh runs
+        # additionally stamp the facts XLA's cost model doesn't expose:
+        # device count and the per-Lloyd-iteration psum traffic estimate
+        # (the (k, d+1) sufficient-statistics all-reduce).
         from ..obs.xprof import instrumented_call
 
+        _extra = None
+        if ndata * nmodel > 1:
+            from ..parallel.mesh import collective_bytes_estimate
+
+            payload = int(k) * (d + 1) * jnp.dtype(_stat_dtype(dtype)).itemsize
+            _extra = {"devices": ndata * nmodel,
+                      "collective_bytes_per_iter":
+                          collective_bytes_estimate(payload, ndata)}
         out = instrumented_call("kmeans_jax_full", fn, call_args,
-                                signature=_sig)
+                                signature=_sig, extra=_extra)
     else:
         out = fn(*call_args)
     centroids, labels, it, shift = out[:4]

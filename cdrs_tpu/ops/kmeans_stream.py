@@ -52,7 +52,8 @@ def _build_init(n_rows, n_valid, d, k, ndata, dtype_name):
     mesh = make_mesh(n_data=ndata)
 
     def local_fn(x, key):
-        return _d2_init_local(x, prefix_mask(x, n_valid), key, k=k)
+        return _d2_init_local(x, prefix_mask(x, n_valid), key, k=k,
+                              n_valid=n_valid, ndata=ndata)
 
     return jax.jit(shard_map_compat(
         local_fn, mesh=mesh,

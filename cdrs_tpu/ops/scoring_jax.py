@@ -581,13 +581,19 @@ def classify_jax(
     if _tel is not None and _tel.xprof:
         # XLA cost capture for the fused classification program (medians ->
         # score table -> winner): flops/bytes/compile-seconds as xla.*
-        # events, once per abstract signature (obs/xprof.py).
+        # events, once per abstract signature (obs/xprof.py).  Sharded
+        # programs stamp the device count so the roofline rows read
+        # against mesh size.
         from ..obs.jaxtools import aval_signature
         from ..obs.xprof import instrumented_call
 
+        nmodel = int((mesh_shape or {}).get("model", 1))
+        extra = ({"devices": ndata * nmodel} if ndata * nmodel > 1
+                 else None)
         return instrumented_call(
             "classify_jax", fused, args,
-            signature=aval_signature(x, labels, gm, static=static))
+            signature=aval_signature(x, labels, gm, static=static),
+            extra=extra)
     return fused(*args)
 
 

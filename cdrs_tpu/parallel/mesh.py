@@ -22,7 +22,8 @@ import jax
 from jax.sharding import Mesh
 
 __all__ = ["make_mesh", "mesh_from_shape", "pad_rows", "prefix_mask",
-           "shard_map_compat", "DATA_AXIS", "MODEL_AXIS"]
+           "shard_map_compat", "collective_bytes_estimate",
+           "validate_mesh_shape", "DATA_AXIS", "MODEL_AXIS"]
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -57,7 +58,10 @@ def make_mesh(n_data: int = 1, n_model: int = 1, devices=None) -> Mesh:
     need = n_data * n_model
     if need > len(devices):
         raise ValueError(
-            f"mesh {n_data}x{n_model} needs {need} devices, have {len(devices)}"
+            f"mesh {DATA_AXIS}={n_data}, {MODEL_AXIS}={n_model} needs "
+            f"{need} devices, have {len(devices)} (on CPU, force virtual "
+            f"devices with XLA_FLAGS=--xla_force_host_platform_device_count"
+            f"={need})"
         )
     if n_model == 1:
         return Mesh(np.array(devices[:n_data]), (DATA_AXIS,))
@@ -70,9 +74,44 @@ def mesh_from_shape(mesh_shape: dict[str, int] | None, devices=None) -> Mesh:
 
     ``mesh_shape=None`` means a single-device mesh — the uniform code path:
     collectives over a 1-element axis are identity ops and XLA elides them.
+    Unknown axis names are an error (a typo'd ``{"dtaa": 8}`` must not
+    silently build a 1x1 mesh), as are non-positive sizes — the validation
+    gate for shapes arriving from CLI/scenario JSON.
     """
-    shape = dict(mesh_shape or {})
+    shape = validate_mesh_shape(mesh_shape)
     return make_mesh(shape.get(DATA_AXIS, 1), shape.get(MODEL_AXIS, 1), devices)
+
+
+def validate_mesh_shape(mesh_shape: dict[str, int] | None) -> dict[str, int]:
+    """Normalize a ``{"data": N, "model": M}`` spec: reject unknown axis
+    names (named in the message) and sizes < 1; values coerce to int."""
+    shape = {k: v for k, v in (mesh_shape or {}).items()}
+    unknown = set(shape) - {DATA_AXIS, MODEL_AXIS}
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axis {sorted(unknown)}: a mesh shape takes "
+            f"{DATA_AXIS!r} (rows sharded over devices) and "
+            f"{MODEL_AXIS!r} (centroid table sharded)")
+    for k, v in shape.items():
+        if int(v) < 1:
+            raise ValueError(
+                f"mesh axis {k!r} must be >= 1, got {v}")
+        shape[k] = int(v)
+    return shape
+
+
+def collective_bytes_estimate(payload_bytes: int, n_devices: int) -> int:
+    """Estimated bytes moved across the mesh by one all-reduce (``psum``)
+    of a ``payload_bytes`` buffer — the ring-allreduce model: each of the
+    N devices sends ``2·(N-1)/N · payload``, so the mesh total is
+    ``2·(N-1) · payload``.  0 on a single device (XLA elides the op).
+    Used by the controller/bench telemetry to read windows/sec against
+    mesh size; an estimate of wire traffic, not a measurement.
+    """
+    n = int(n_devices)
+    if n <= 1:
+        return 0
+    return int(2 * (n - 1) * int(payload_bytes))
 
 
 def pad_rows(x: np.ndarray, multiple: int) -> tuple[np.ndarray, int]:

@@ -220,6 +220,7 @@ def _controller(spec: ScenarioSpec, manifest: Manifest,
         decay=spec.decay,
         default_rf=spec.default_rf,
         backend=spec.backend,
+        mesh_shape=dict(spec.mesh) if spec.mesh else None,
         kmeans=KMeansConfig(k=spec.k, seed=42),
         scoring=scoring,
         topology=topology,
@@ -269,6 +270,21 @@ def _check_invariants(spec: ScenarioSpec, records: list[dict],
         # triggers another means the detector slept through the shift.
         inv["drift_engaged"] = \
             sum(1 for r in records if r.get("recluster")) >= 2
+    if spec.mesh is not None:
+        # The mesh axis must actually FIRE: every window record carries
+        # the mesh stamp at the requested device count (the controller
+        # only stamps it when the sharded path is wired in) and the
+        # cluster step ran at least once on it — a cell whose mesh
+        # silently fell back to single-device fails instead of passing
+        # its other checks vacuously.
+        ndev = 1
+        for v in spec.mesh.values():
+            ndev *= int(v)
+        inv["mesh_engaged"] = bool(
+            records
+            and all((r.get("mesh") or {}).get("devices") == ndev
+                    for r in records)
+            and any(r.get("recluster") for r in records))
     integ = [r for r in records if r.get("integrity")]
     if integ:
         inv["zero_silent_loss"] = integ[-1]["integrity"]["true_lost"] == 0

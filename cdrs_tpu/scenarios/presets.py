@@ -113,6 +113,19 @@ def _presets() -> dict[str, ScenarioSpec]:
         faults={"specs": ["corrupt:dn2@3:0.5"]},
         serve={"policy": "p2c", "verify_reads": True})
 
+    # -- scale: mesh-sharded control loop ----------------------------------
+    # The whole per-window device computation (cluster step, scoring
+    # medians, feature fold, drift one-Lloyd-step) data-parallel over an
+    # 8-device mesh, with a mid-cell kill/resume (mesh shape is a runtime
+    # choice, not checkpoint state) and the mesh_engaged positive check.
+    # On CPU this needs XLA_FLAGS=--xla_force_host_platform_device_count=8
+    # (tests/conftest.py and the CI sweep step set it).
+    p["scale-mesh"] = ScenarioSpec(
+        name="scale-mesh", n_files=300, seed=8, duration=1800.0,
+        n_windows=12, k=12, backend="jax", mesh={"data": 8},
+        drift={"kind": "flip", "at_frac": 0.5}, drift_threshold=0.02,
+        resume_window=7)
+
     # -- workload curves / drift patterns ----------------------------------
     p["diurnal"] = ScenarioSpec(
         name="diurnal", n_files=300, seed=10, duration=1800.0,
@@ -203,7 +216,8 @@ SUITES: dict[str, tuple[tuple[str, ...], int]] = {
     "ci-smoke": (("chaos-kill", "rack-kill", "rack-partition", "cascade",
                   "rolling-decommission", "storage-ec", "serve-chaos",
                   "flash-crowd", "integrity-scrub", "integrity-read",
-                  "diurnal", "adversarial-drift", "gradual-drift"), 2),
+                  "diurnal", "adversarial-drift", "gradual-drift",
+                  "scale-mesh"), 2),
     # Everything, including the slow legacy-reproduction preset.
     "full": (tuple(PRESETS), 4),
 }

@@ -39,7 +39,11 @@ Axes
 * ``serve`` — read-router config dict (policy/slo_ms/...); None = no
   serving.
 * ``scrub`` — background-scrubber bytes/window; None = off.
-* scale — ``n_files`` / ``duration`` / ``n_windows`` / ``k``.
+* scale — ``n_files`` / ``duration`` / ``n_windows`` / ``k`` / ``mesh``
+  (``{"data": N}`` runs the whole per-window device computation —
+  cluster step, scoring medians, feature fold, drift one-Lloyd-step —
+  data-parallel over an N-device mesh; requires ``backend: "jax"`` and,
+  on CPU, ``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
 
 Controller knobs (budget fraction, scoring table, decay, thresholds)
 ride along so a legacy bench scenario is exactly re-expressible: the
@@ -73,6 +77,9 @@ class ScenarioSpec:
     n_windows: int = 15
     k: int = 12
     nodes: tuple[str, ...] = ("dn1", "dn2", "dn3", "dn4", "dn5")
+    #: Device mesh for the per-window device computation
+    #: (ControllerConfig.mesh_shape); requires ``backend == "jax"``.
+    mesh: dict | None = None
     # -- axes --------------------------------------------------------------
     workload: dict = field(default_factory=lambda: {"kind": "poisson"})
     drift: dict | None = None
@@ -128,6 +135,22 @@ class ScenarioSpec:
             raise ValueError(
                 f"cell {self.name!r}: scrub requires a faults axis (the "
                 f"scrubber verifies the fault path's cluster state)")
+        if self.mesh is not None:
+            # Kept jax-import-free (specs parse anywhere): the full axis
+            # validation re-runs in ControllerConfig/validate_mesh_shape.
+            unknown = set(self.mesh) - {"data", "model"}
+            if unknown:
+                raise ValueError(
+                    f"cell {self.name!r}: unknown mesh axis "
+                    f"{sorted(unknown)} (want 'data'/'model')")
+            if any(int(v) < 1 for v in self.mesh.values()):
+                raise ValueError(
+                    f"cell {self.name!r}: mesh axis sizes must be >= 1, "
+                    f"got {self.mesh}")
+            if self.backend != "jax":
+                raise ValueError(
+                    f"cell {self.name!r}: a mesh axis requires "
+                    f"backend 'jax' (got {self.backend!r})")
 
     @property
     def window_seconds(self) -> float:

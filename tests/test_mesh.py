@@ -1,0 +1,196 @@
+"""Mesh-utility and sharded-drift-kernel tests (PR 11).
+
+``parallel/mesh.py`` carries the correctness of every uneven shard
+(``pad_rows``/``prefix_mask``) and the CLI/scenario-JSON mesh-spec
+validation (``mesh_from_shape``); ``control/drift.detect_drift_jax`` is
+the mesh half of the drift detector, checked against the NumPy oracle.
+Runs on the 8-device virtual CPU mesh (conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from cdrs_tpu.parallel.mesh import (
+    DATA_AXIS,
+    collective_bytes_estimate,
+    make_mesh,
+    mesh_from_shape,
+    pad_rows,
+    prefix_mask,
+    shard_map_compat,
+    validate_mesh_shape,
+)
+
+
+# -- make_mesh / mesh_from_shape ---------------------------------------------
+
+def test_make_mesh_error_names_axes():
+    with pytest.raises(ValueError, match=r"data=16, model=1"):
+        make_mesh(n_data=16)
+    with pytest.raises(ValueError, match="xla_force_host_platform"):
+        make_mesh(n_data=4, n_model=3)
+
+
+@pytest.mark.parametrize("n", [1, 2, 8])
+def test_mesh_from_shape_data_round_trip(n):
+    """{"data": N} specs from CLI/scenario JSON build an N-way data mesh."""
+    mesh = mesh_from_shape({"data": n})
+    assert mesh.shape[DATA_AXIS] == n
+    assert mesh.devices.size == n
+
+
+def test_mesh_from_shape_model_axis():
+    mesh = mesh_from_shape({"data": 4, "model": 2})
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+
+
+def test_mesh_from_shape_none_is_single_device():
+    assert mesh_from_shape(None).devices.size == 1
+
+
+def test_mesh_from_shape_rejects_unknown_axis():
+    with pytest.raises(ValueError, match=r"\['dtaa'\]"):
+        mesh_from_shape({"dtaa": 8})
+
+
+def test_mesh_from_shape_rejects_nonpositive():
+    with pytest.raises(ValueError, match="'data'"):
+        mesh_from_shape({"data": 0})
+
+
+def test_validate_mesh_shape_coerces_ints():
+    assert validate_mesh_shape({"data": "4"}) == {"data": 4}
+    assert validate_mesh_shape(None) == {}
+
+
+def test_cli_mesh_spec_round_trips_through_mesh_from_shape():
+    """The `--mesh` CLI parser and mesh_from_shape agree on the spec."""
+    from cdrs_tpu.cli import _parse_mesh
+
+    spec = _parse_mesh("data=4,model=2")
+    assert spec == {"data": 4, "model": 2}
+    assert dict(mesh_from_shape(spec).shape) == spec
+    assert dict(mesh_from_shape(_parse_mesh("8")).shape) == {"data": 8}
+
+
+# -- pad_rows / prefix_mask (the uneven-shard contract) ----------------------
+
+def test_pad_rows_empty():
+    x, n_valid = pad_rows(np.zeros((0, 3)), 8)
+    assert n_valid == 0
+    assert x.shape == (0, 3)
+
+
+def test_pad_rows_fewer_rows_than_devices():
+    x, n_valid = pad_rows(np.ones((3, 2)), 8)
+    assert n_valid == 3
+    assert x.shape == (8, 2)
+    assert (x[3:] == 0).all() and (x[:3] == 1).all()
+
+
+def test_pad_rows_exactly_divisible_is_identity():
+    a = np.arange(16.0).reshape(8, 2)
+    x, n_valid = pad_rows(a, 8)
+    assert x is a and n_valid == 8
+
+
+@pytest.mark.parametrize("n", [0, 1, 5, 7, 8, 9, 16, 997])
+def test_pad_rows_multiple_and_valid_count(n):
+    x, n_valid = pad_rows(np.ones((n, 2)), 8)
+    assert n_valid == n
+    assert x.shape[0] % 8 == 0
+    assert x.shape[0] - n < 8
+
+
+@pytest.mark.parametrize("n", [1, 5, 8, 9, 997])
+def test_prefix_mask_sharded_agrees_with_host(n):
+    """The in-program shard-local masks, concatenated in rank order, must
+    equal the host-side prefix mask of the padded array."""
+    x, n_valid = pad_rows(np.ones((n, 4), np.float32), 8)
+    mesh = make_mesh(n_data=8)
+
+    fn = jax.jit(shard_map_compat(
+        lambda xs: prefix_mask(xs, n_valid),
+        mesh=mesh, in_specs=(P(DATA_AXIS, None),),
+        out_specs=P(DATA_AXIS), check_vma=False))
+    got = np.asarray(fn(jnp.asarray(x)))
+    want = (np.arange(x.shape[0]) < n_valid).astype(np.float32)
+    np.testing.assert_array_equal(got, want)
+    # Host bypass (sharded=False) is the same mask without the axis.
+    host = np.asarray(prefix_mask(jnp.asarray(x), n_valid, sharded=False))
+    np.testing.assert_array_equal(host, want)
+
+
+def test_prefix_mask_zero_valid_rows():
+    x = jnp.ones((8, 2))
+    assert np.asarray(prefix_mask(x, 0, sharded=False)).sum() == 0
+
+
+# -- collective-bytes estimate -----------------------------------------------
+
+def test_collective_bytes_estimate():
+    assert collective_bytes_estimate(1000, 1) == 0
+    assert collective_bytes_estimate(1000, 2) == 2000   # 2·(N-1)·payload
+    assert collective_bytes_estimate(1000, 8) == 14000
+
+
+# -- sharded drift detector ---------------------------------------------------
+
+@pytest.fixture(scope="module")
+def drift_inputs():
+    rng = np.random.default_rng(3)
+    X = rng.random((997, 5)).astype(np.float32)
+    c = rng.random((12, 5)).astype(np.float32)
+    cat = rng.integers(0, 4, 12)
+    frac = np.asarray([0.4, 0.3, 0.2, 0.1])
+    return X, c, cat, frac
+
+
+@pytest.mark.parametrize("ndev", [1, 2, 8])
+def test_detect_drift_jax_matches_numpy_oracle(drift_inputs, ndev):
+    from cdrs_tpu.control.drift import detect_drift, detect_drift_jax
+
+    X, c, cat, frac = drift_inputs
+    a = detect_drift(X, c, cat, frac, 4)
+    b = detect_drift_jax(X, c, cat, frac, 4, mesh_shape={"data": ndev})
+    assert b.score == pytest.approx(a.score, abs=1e-5)
+    assert b.centroid_shift == pytest.approx(a.centroid_shift, abs=1e-5)
+    assert b.population_delta == pytest.approx(a.population_delta,
+                                               abs=1e-5)
+    # Fractions are ratios of integer-exact psum'd counts.
+    np.testing.assert_allclose(b.fractions, a.fractions, atol=1e-6)
+
+
+def test_detect_drift_jax_fractions_identical_across_shapes(drift_inputs):
+    from cdrs_tpu.control.drift import detect_drift_jax
+
+    X, c, cat, frac = drift_inputs
+    b1 = detect_drift_jax(X, c, cat, frac, 4, mesh_shape={"data": 1})
+    b8 = detect_drift_jax(X, c, cat, frac, 4, mesh_shape={"data": 8})
+    np.testing.assert_array_equal(b1.fractions, b8.fractions)
+    assert b8.centroid_shift == pytest.approx(b1.centroid_shift, abs=1e-6)
+
+
+def test_detect_drift_jax_fewer_rows_than_devices(drift_inputs):
+    """n < n_devices: every shard but the first is all padding."""
+    from cdrs_tpu.control.drift import detect_drift, detect_drift_jax
+
+    X, c, cat, frac = drift_inputs
+    a = detect_drift(X[:5], c, cat, frac, 4)
+    b = detect_drift_jax(X[:5], c, cat, frac, 4, mesh_shape={"data": 8})
+    assert b.score == pytest.approx(a.score, abs=1e-5)
+    np.testing.assert_allclose(b.fractions, a.fractions, atol=1e-6)
+
+
+def test_detect_drift_jax_rejects_bad_mesh(drift_inputs):
+    from cdrs_tpu.control.drift import detect_drift_jax
+
+    X, c, cat, frac = drift_inputs
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        detect_drift_jax(X, c, cat, frac, 4, mesh_shape={"rows": 8})

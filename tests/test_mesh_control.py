@@ -1,0 +1,195 @@
+"""Mesh-sharded control loop: decision identity vs the single-device oracle.
+
+PR 11 wires ``ControllerConfig.mesh_shape`` through the whole per-window
+device computation (cluster step, scoring medians, feature fold, drift).
+The single-device path stays the equivalence oracle — the PR-8 compat
+pattern: on the same seed a ``{"data": 8}`` run must make IDENTICAL
+decisions (assignments, category populations, plan hashes, migrations)
+while the drift scalars agree to fp tolerance (float psum association),
+and a checkpoint must be portable across mesh shapes (a runtime choice,
+not checkpoint state).
+
+``CDRS_CHAOS_SEED`` varies the workload seeds — CI's mesh smoke step
+sweeps it over 0/1/2 under
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (single process;
+the multiprocess-collective limitation that keeps
+test_distributed_smoke.py skipped does not apply).
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from cdrs_tpu.config import (
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from cdrs_tpu.control import ControllerConfig, ReplicationController
+from cdrs_tpu.sim.access import simulate_access_with_shift
+from cdrs_tpu.sim.generator import generate_population
+
+SEED = int(os.environ.get("CDRS_CHAOS_SEED", "0"))
+
+#: Drift scalars agree across mesh shapes only to float-psum tolerance.
+_DRIFT_FIELDS = ("drift", "centroid_shift", "population_delta")
+
+
+@pytest.fixture(scope="module")
+def scenario():
+    # 403 files: NOT divisible by 8, so every shard boundary exercises the
+    # pad_rows/prefix_mask contract.
+    manifest = generate_population(
+        GeneratorConfig(n_files=403, seed=7 + SEED))
+    events, _ = simulate_access_with_shift(
+        manifest, SimulatorConfig(duration_seconds=1200.0, seed=8 + SEED),
+        600.0, {"hot": "archival", "archival": "hot"})
+    # Histogram medians on BOTH sides: integer count statistics, bitwise
+    # identical at any mesh shape ("auto" would resolve to the exact sort
+    # single-device and hist sharded — different estimates per shape).
+    scoring = dataclasses.replace(validated_scoring_config(),
+                                  median_method="hist")
+    return manifest, events, scoring
+
+
+def _run(scenario, mesh, checkpoint_path=None, max_windows=None):
+    manifest, events, scoring = scenario
+    cfg = ControllerConfig(
+        window_seconds=100.0, drift_threshold=0.02, backend="jax",
+        kmeans=KMeansConfig(k=12, seed=42), scoring=scoring,
+        mesh_shape=mesh, default_rf=2)
+    ctl = ReplicationController(manifest, cfg)
+    return ctl.run(events, checkpoint_path=checkpoint_path,
+                   max_windows=max_windows)
+
+
+def _strip(records):
+    drop = ("seconds", "mesh") + _DRIFT_FIELDS
+    return [{k: v for k, v in r.items() if k not in drop}
+            for r in records]
+
+
+def test_mesh_run_decision_identical_to_single_device(scenario):
+    r1 = _run(scenario, None)
+    r8 = _run(scenario, {"data": 8})
+    assert _strip(r1.records) == _strip(r8.records)
+    assert np.array_equal(r1.rf, r8.rf)
+    assert np.array_equal(r1.category_idx, r8.category_idx)
+    # Same re-cluster decisions, same plan hash trail.
+    assert [r["plan_hash"] for r in r1.records] \
+        == [r["plan_hash"] for r in r8.records]
+    for a, b in zip(r1.records, r8.records):
+        for f in _DRIFT_FIELDS:
+            if a.get(f) is None:
+                assert b.get(f) is None
+            else:
+                assert b[f] == pytest.approx(a[f], abs=1e-5)
+    # The mesh stamp rides every mesh-run record and no oracle record.
+    assert all(r["mesh"]["devices"] == 8 for r in r8.records)
+    assert all("mesh" not in r for r in r1.records)
+
+
+def test_cold_init_identical_across_mesh_shapes(scenario):
+    """The D²/kmeans|| init noise is keyed to the global row, so a COLD
+    re-cluster draws identical centroids at any data=N (the piece that
+    makes controller decision-identity possible at all)."""
+    from cdrs_tpu.ops.kmeans_jax import kmeans_jax_full
+
+    rng = np.random.default_rng(11 + SEED)
+    X = rng.random((403, 5)).astype(np.float32)
+    for init in ("d2", "kmeans||"):
+        ref = kmeans_jax_full(X, 8, seed=SEED, max_iter=0, tol=0.0,
+                              init_method=init)
+        for ndev in (2, 8):
+            got = kmeans_jax_full(X, 8, seed=SEED, max_iter=0, tol=0.0,
+                                  init_method=init,
+                                  mesh_shape={"data": ndev})
+            np.testing.assert_array_equal(np.asarray(got[0]),
+                                          np.asarray(ref[0]), err_msg=init)
+
+
+@pytest.mark.parametrize("from_mesh,to_mesh",
+                         [(None, {"data": 8}), ({"data": 8}, None)])
+def test_checkpoint_portable_across_mesh_shapes(scenario, tmp_path,
+                                                from_mesh, to_mesh):
+    """Mesh shape is a runtime choice, not checkpoint state: a snapshot
+    written at one shape resumes at another with identical decisions
+    (records match the resuming shape's uninterrupted run exactly on
+    every decision field; drift scalars to fp tolerance)."""
+    full = _run(scenario, to_mesh)
+    ck = str(tmp_path / f"mesh_{from_mesh is None}.npz")
+    a = _run(scenario, from_mesh, checkpoint_path=ck, max_windows=6)
+    b = _run(scenario, to_mesh, checkpoint_path=ck)
+    stitched = _strip(a.records) + _strip(b.records)
+    assert stitched == _strip(full.records)
+    assert np.array_equal(b.rf, full.rf)
+    assert np.array_equal(b.category_idx, full.category_idx)
+    # Drift scalars of the resumed half agree with the uninterrupted
+    # run to fp tolerance only: the checkpoint carries the OTHER shape's
+    # accepted centroids, which differ in ULPs (float psum association).
+    tail = full.records[len(a.records):]
+    for got, want in zip(b.records, tail):
+        for f in _DRIFT_FIELDS:
+            if want.get(f) is None:
+                assert got.get(f) is None
+            else:
+                assert got[f] == pytest.approx(want[f], abs=1e-5)
+
+
+def test_model_assignments_and_populations_identical(scenario):
+    """Model-level oracle check: cluster + score at data=8 produces the
+    same labels and per-category populations as single-device."""
+    from cdrs_tpu.models.replication import ReplicationPolicyModel
+
+    manifest, events, scoring = scenario
+    rng = np.random.default_rng(5 + SEED)
+    X = rng.random((403, 5)).astype(np.float32)
+    km = KMeansConfig(k=12, seed=42)
+    d1 = ReplicationPolicyModel(km, scoring, backend="jax").run(X)
+    d8 = ReplicationPolicyModel(km, scoring, backend="jax",
+                                mesh_shape={"data": 8}).run(X)
+    np.testing.assert_array_equal(d1.labels, d8.labels)
+    np.testing.assert_array_equal(d1.category_idx, d8.category_idx)
+    np.testing.assert_array_equal(
+        np.bincount(d1.category_idx[d1.labels], minlength=4),
+        np.bincount(d8.category_idx[d8.labels], minlength=4))
+
+
+def test_mesh_requires_jax_backend(scenario):
+    manifest, events, scoring = scenario
+    with pytest.raises(ValueError, match="backend='jax'"):
+        ControllerConfig(backend="numpy", mesh_shape={"data": 8})
+
+
+def test_mesh_shape_validated_at_config(scenario):
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        ControllerConfig(backend="jax", mesh_shape={"rows": 8})
+
+
+def test_mesh_records_carry_collective_estimate(scenario):
+    """The windows/sec-vs-mesh-size observable: every record carries the
+    device count and the (k, d+1) psum traffic estimate."""
+    from cdrs_tpu.parallel.mesh import collective_bytes_estimate
+
+    r = _run(scenario, {"data": 4})
+    want = collective_bytes_estimate(12 * 6 * 4, 4)
+    for rec in r.records:
+        assert rec["mesh"] == {"devices": 4,
+                               "collective_bytes_per_iter": want}
+
+
+def test_pacing_digest_surfaces_devices(scenario):
+    from cdrs_tpu.obs.aggregate import pacing_digest
+
+    r = _run(scenario, {"data": 8})
+    pacing = pacing_digest(r.records)
+    assert pacing["devices"] == 8
+    assert pacing["collective_bytes_per_iter"] > 0
+    # Mesh-less streams render unchanged.
+    r1 = _run(scenario, None)
+    assert "devices" not in pacing_digest(r1.records)
