@@ -1,0 +1,412 @@
+"""Functional placement (cdrs_tpu/placement_fn): property tests +
+functional-vs-materialized equivalence.
+
+``CDRS_CHAOS_SEED`` varies the workloads below — CI sweeps it over 0/1/2
+so the equivalence claims (flat bit-for-bit degeneration, subset == full,
+controller decision identity, sparse-checkpoint kill/resume
+bit-identity) are checked against three genuinely different populations,
+not one lucky seed.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.cluster import ClusterTopology, place_replicas
+from cdrs_tpu.config import (
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from cdrs_tpu.control import ControllerConfig, ReplicationController
+from cdrs_tpu.faults import FaultEvent, FaultSchedule
+from cdrs_tpu.placement_fn import (
+    EpochMap,
+    FunctionalClusterState,
+    compute_placement,
+    primary_on_topology,
+)
+from cdrs_tpu.placement_fn.compute import (
+    file_keys,
+    hash_priorities,
+    node_salts,
+)
+from cdrs_tpu.sim.access import simulate_access
+from cdrs_tpu.sim.generator import generate_population
+
+SEED = int(os.environ.get("CDRS_CHAOS_SEED", "0"))
+
+_NODES6 = tuple(f"dn{i}" for i in range(1, 7))
+_RACKS6 = "r0=dn1,dn2;r1=dn3,dn4;r2=dn5,dn6"
+
+
+def _population(n=400, nodes=_NODES6):
+    return generate_population(
+        GeneratorConfig(n_files=n, seed=14 + SEED, nodes=nodes))
+
+
+def _rand_inputs(n=2000, n_nodes=6, rf_hi=5):
+    rng = np.random.default_rng(100 + SEED)
+    return (np.arange(n, dtype=np.int64),
+            rng.integers(1, rf_hi, n).astype(np.int32),
+            rng.integers(0, n_nodes, n).astype(np.int32))
+
+
+# -- chooser properties ------------------------------------------------------
+
+def test_flat_degenerates_bitforbit_to_priority_policy():
+    """Flat topology == the legacy distinct-node policy over the hash
+    priorities: an INDEPENDENT argsort reference (the legacy chooser's
+    order-by-key construction) must reproduce the chooser exactly."""
+    fids, rf, prim = _rand_inputs()
+    flat = ClusterTopology(_NODES6)
+    slots, rfc = compute_placement(fids, rf, prim, flat, SEED)
+    prio = hash_priorities(file_keys(fids, SEED),
+                           node_salts(_NODES6, SEED)).T.astype(np.int64)
+    key = prio.copy()
+    key[np.arange(len(fids)), prim] = -1          # replica 0: the primary
+    order = np.argsort(key, axis=1).astype(np.int32)
+    ref = order[:, :slots.shape[1]].copy()
+    ref[np.arange(slots.shape[1])[None, :] >= rfc[:, None]] = -1
+    assert np.array_equal(slots, ref)
+
+
+def test_subset_equals_full_rows():
+    fids, rf, prim = _rand_inputs()
+    topo = ClusterTopology.from_rack_spec(_NODES6, _RACKS6)
+    full, _ = compute_placement(fids, rf, prim, topo, SEED)
+    rng = np.random.default_rng(SEED)
+    sub = rng.choice(len(fids), 137, replace=False)
+    rows, _ = compute_placement(fids[sub], rf[sub], prim[sub], topo,
+                                SEED, out_width=full.shape[1])
+    assert np.array_equal(rows, full[sub])
+
+
+def test_chunk_size_invariance():
+    fids, rf, prim = _rand_inputs()
+    topo = ClusterTopology.from_rack_spec(_NODES6, _RACKS6)
+    a, _ = compute_placement(fids, rf, prim, topo, SEED)
+    b, _ = compute_placement(fids, rf, prim, topo, SEED, chunk=173)
+    assert np.array_equal(a, b)
+
+
+def test_place_replicas_hash_is_the_materialized_twin():
+    """place_replicas(method='hash') output == compute_placement over the
+    full population (one policy, two surfaces)."""
+    man = _population()
+    topo = ClusterTopology.from_rack_spec(_NODES6, _RACKS6)
+    rng = np.random.default_rng(SEED)
+    rf = rng.integers(1, 5, len(man)).astype(np.int32)
+    pr = place_replicas(man, rf, topo, seed=SEED, method="hash")
+    prim = primary_on_topology(man.nodes, man.primary_node_id, topo)
+    slots, rfc = compute_placement(np.arange(len(man)), rf, prim, topo,
+                                   SEED)
+    assert np.array_equal(pr.replica_map, slots)
+    assert np.array_equal(pr.rf, rfc)
+
+
+def test_domain_spread_invariant():
+    """Replica 0 and 1 never share a failure domain when another domain
+    exists, replicas 1 and 2 share the remote domain when it has two
+    members (the HDFS rack-aware shape), and every row is distinct
+    nodes."""
+    fids, rf, prim = _rand_inputs(rf_hi=6)
+    topo = ClusterTopology.from_rack_spec(_NODES6, _RACKS6)
+    slots, _ = compute_placement(fids, rf, prim, topo, SEED)
+    dom = topo.domain_index()
+    for i in range(len(fids)):
+        row = slots[i][slots[i] >= 0]
+        assert len(set(row.tolist())) == len(row)
+        assert row[0] == prim[i]
+        if len(row) >= 2:
+            assert dom[row[0]] != dom[row[1]]
+        if len(row) >= 3:
+            assert dom[row[1]] == dom[row[2]]
+
+
+def test_nested_in_rf():
+    fids, rf, prim = _rand_inputs()
+    topo = ClusterTopology.from_rack_spec(_NODES6, _RACKS6)
+    hi, _ = compute_placement(fids, rf, prim, topo, SEED)
+    lo, lo_rf = compute_placement(fids, np.maximum(rf - 1, 1), prim,
+                                  topo, SEED)
+    for i in range(len(fids)):
+        k = int(lo_rf[i])
+        assert np.array_equal(lo[i][:k], hi[i][:k])
+
+
+def test_balance_is_uniform():
+    """No node systematically over-draws: max/mean replica count within
+    a few percent at 200k files (the straw2 uniformity property)."""
+    fids, _, prim = _rand_inputs(n=200_000, n_nodes=12)
+    topo = ClusterTopology(tuple(f"dn{i}" for i in range(1, 13)))
+    slots, _ = compute_placement(
+        fids, np.full(len(fids), 3, dtype=np.int32), prim, topo, SEED)
+    counts = np.bincount(slots[slots >= 0], minlength=12)
+    assert counts.max() / counts.mean() < 1.05
+
+
+def test_determinism_across_processes_and_seeds():
+    """Seeds 0/1/2 give stable, distinct placements, and a fresh
+    interpreter reproduces the exact bytes (no salted-hash leakage)."""
+    fids, rf, prim = _rand_inputs(n=500)
+    topo = ClusterTopology.from_rack_spec(_NODES6, _RACKS6)
+    digests = []
+    for seed in (0, 1, 2):
+        a, _ = compute_placement(fids, rf, prim, topo, seed)
+        b, _ = compute_placement(fids, rf, prim, topo, seed)
+        assert np.array_equal(a, b)
+        digests.append(a.tobytes())
+    assert len({d for d in digests}) == 3
+    script = (
+        "import numpy as np\n"
+        "from cdrs_tpu.cluster import ClusterTopology\n"
+        "from cdrs_tpu.placement_fn import compute_placement\n"
+        f"rng = np.random.default_rng({100 + SEED})\n"
+        "n = 500\n"
+        "fids = np.arange(n, dtype=np.int64)\n"
+        "rf = rng.integers(1, 5, n).astype(np.int32)\n"
+        "prim = rng.integers(0, 6, n).astype(np.int32)\n"
+        f"topo = ClusterTopology.from_rack_spec({_NODES6!r}, "
+        f"{_RACKS6!r})\n"
+        "slots, _ = compute_placement(fids, rf, prim, topo, 0)\n"
+        "import hashlib, sys\n"
+        "sys.stdout.write(hashlib.blake2b(slots.tobytes(), "
+        "digest_size=8).hexdigest())\n")
+    got = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True,
+                         cwd=os.path.dirname(os.path.dirname(__file__)),
+                         check=True).stdout.strip()
+    import hashlib
+
+    rng2 = np.random.default_rng(100 + SEED)
+    rf2 = rng2.integers(1, 5, 500).astype(np.int32)
+    prim2 = rng2.integers(0, 6, 500).astype(np.int32)
+    a, _ = compute_placement(np.arange(500, dtype=np.int64), rf2, prim2,
+                             topo, 0)
+    assert got == hashlib.blake2b(a.tobytes(), digest_size=8).hexdigest()
+
+
+# -- epoch diff --------------------------------------------------------------
+
+def test_epoch_diff_minimality_and_prune():
+    man = _population(n=3000)
+    rng = np.random.default_rng(SEED)
+    shards = rng.integers(1, 5, len(man)).astype(np.int32)
+    topo = ClusterTopology.from_rack_spec(_NODES6, _RACKS6)
+    emap = EpochMap(man.nodes, topo, seed=0)
+    # Unchanged topology => zero moves, by construction.
+    assert len(emap.diff(0, 0, shards, man.primary_node_id)) == 0
+    emap.advance(ClusterTopology(topo.nodes, topo.domains))
+    assert len(emap.diff(0, 1, shards, man.primary_node_id)) == 0
+    # Remove one node: moved == full recompute compare, and every moved
+    # file's OLD slots involve the removed node (nobody else re-rolls).
+    survivors = tuple(x for x in _NODES6 if x != "dn4")
+    emap.advance(ClusterTopology.from_rack_spec(
+        survivors, "r0=dn1,dn2;r1=dn3;r2=dn5,dn6"))
+    pruned = emap.diff(0, 2, shards, man.primary_node_id)
+    full = emap.diff(0, 2, shards, man.primary_node_id, prune=False)
+    assert pruned.pruned and not full.pruned
+    assert np.array_equal(np.sort(pruned.moved), np.sort(full.moved))
+    removed_idx = list(topo.nodes).index("dn4")
+    old_all, _ = emap.placement(0, np.arange(len(man)), shards,
+                                man.primary_node_id)
+    holders = np.flatnonzero((old_all == removed_idx).any(axis=1))
+    assert set(pruned.moved.tolist()) <= set(holders.tolist())
+    # Untouched files keep identical rows across the epochs.
+    untouched = np.setdiff1d(np.arange(len(man)), holders)
+    new_rows, _ = emap.placement(2, untouched, shards[untouched],
+                                 man.primary_node_id[untouched],
+                                 out_width=old_all.shape[1])
+    # Compare as node-NAME sets (ids differ across epochs).
+    for i, f in enumerate(untouched[:200]):
+        old_names = {topo.nodes[x] for x in old_all[f] if x >= 0}
+        new_names = {survivors[x] for x in new_rows[i] if x >= 0}
+        assert old_names == new_names
+
+
+# -- functional cluster state ------------------------------------------------
+
+def _fn_state(man, topo, rf, sparse=True):
+    placement = place_replicas(man, rf, topo, seed=0, method="hash")
+    return FunctionalClusterState(
+        placement, np.asarray(man.size_bytes, dtype=np.int64),
+        primary=primary_on_topology(man.nodes, man.primary_node_id,
+                                    topo),
+        seed=0, sparse_checkpoint=sparse)
+
+
+def test_functional_state_sparse_roundtrip():
+    """A fault-damaged functional state round-trips through the sparse
+    snapshot bit-identically (map, corruption, strategy, caches)."""
+    man = _population()
+    topo = ClusterTopology.from_rack_spec(_NODES6, _RACKS6)
+    rng = np.random.default_rng(SEED)
+    rf = rng.integers(2, 4, len(man)).astype(np.int32)
+    state = _fn_state(man, topo, rf)
+    # Damage: crash, rf retargets (fast path), repairs, corruption.
+    state.apply_event(FaultEvent(0, "crash", "dn3"))
+    for f in rng.integers(0, len(man), 40):
+        state.apply_rf_target(int(f), int(rng.integers(1, 5)))
+    state.apply_event(FaultEvent(1, "corrupt", "dn2", fail_prob=0.3))
+    arrays = state.state_arrays(rf_hint=rf)
+    assert "fault_fn_sparse" in arrays
+    arrays["current_rf"] = rf  # the controller checkpoint carries it
+    fresh = _fn_state(man, topo, rf)
+    fresh.load_state_arrays(arrays)
+    for attr in ("replica_map", "slot_corrupt", "min_live",
+                 "shard_bytes", "ec_k", "installed_shards", "node_up",
+                 "node_bytes", "_live_counts", "_reach_counts",
+                 "_dom_spread"):
+        assert np.array_equal(getattr(fresh, attr),
+                              getattr(state, attr)), attr
+    assert fresh._n_corrupt == state._n_corrupt
+
+
+def test_healthy_retargets_stay_in_base_form():
+    """On a healthy cluster every rf migration rides the computed slot
+    order — zero exceptions, which is the O(exceptions) checkpoint."""
+    man = _population()
+    topo = ClusterTopology.from_rack_spec(_NODES6, _RACKS6)
+    rng = np.random.default_rng(SEED)
+    rf = rng.integers(2, 4, len(man)).astype(np.int32)
+    state = _fn_state(man, topo, rf)
+    for f in rng.integers(0, len(man), 100):
+        state.apply_rf_target(int(f), int(rng.integers(1, 5)))
+    assert state.exception_fids().size == 0
+
+
+# -- controller equivalence --------------------------------------------------
+
+def _controller_result(man, events, sizes, topo, mode, serve=True,
+                       ck=None, maxw=None):
+    from cdrs_tpu.serve import ServeConfig
+
+    cfg = ControllerConfig(
+        window_seconds=120.0, default_rf=2, drift_threshold=0.02,
+        max_bytes_per_window=int(sizes.sum() * 0.25),
+        kmeans=KMeansConfig(k=8, seed=42),
+        scoring=validated_scoring_config(),
+        topology=ClusterTopology(topo.nodes, topo.domains),
+        fault_schedule=FaultSchedule(
+            FaultSchedule.from_specs(["crash:dn3@3-6"])),
+        placement_mode=mode,
+        serve=ServeConfig(policy="p2c") if serve else None)
+    ctl = ReplicationController(man, cfg)
+    return ctl.run(events, checkpoint_path=ck, max_windows=maxw)
+
+
+def _strip(records, drop=("seconds", "placement")):
+    return [{k: v for k, v in r.items() if k not in drop}
+            for r in records]
+
+
+@pytest.fixture(scope="module")
+def chaos_world():
+    man = _population()
+    events = simulate_access(
+        man, SimulatorConfig(duration_seconds=1200.0, seed=15 + SEED))
+    sizes = np.asarray(man.size_bytes, dtype=np.int64)
+    topo = ClusterTopology.from_rack_spec(_NODES6, _RACKS6)
+    return man, events, sizes, topo
+
+
+def test_functional_decision_identical_to_materialized_oracle(
+        chaos_world):
+    """The acceptance contract: durability tiers, repair admissions,
+    plan hashes and serve locality identical between the functional
+    representation and the materialized oracle of the same policy."""
+    man, events, sizes, topo = chaos_world
+    fn = _controller_result(man, events, sizes, topo, "functional")
+    orc = _controller_result(man, events, sizes, topo,
+                             "materialized_hash")
+    assert _strip(fn.records) == _strip(orc.records)
+    assert np.array_equal(fn.rf, orc.rf)
+    assert np.array_equal(fn.category_idx, orc.category_idx)
+    # The engagement stamp: functional runs say so on every record.
+    assert all(r["placement"]["mode"] == "functional"
+               for r in fn.records)
+    assert all("exceptions" in r["placement"] for r in fn.records)
+
+
+def test_functional_kill_resume_bit_identity(chaos_world):
+    """Mid-fault kill/resume through the SPARSE snapshot reproduces the
+    uninterrupted run bit-for-bit — exceptions included (the stamped
+    count is part of the compared records)."""
+    man, events, sizes, topo = chaos_world
+    ref = _controller_result(man, events, sizes, topo, "functional")
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "c.npz")
+        a = _controller_result(man, events, sizes, topo, "functional",
+                               ck=ck, maxw=4)
+        b = _controller_result(man, events, sizes, topo, "functional",
+                               ck=ck)
+    strip_t = lambda r: _strip(r, drop=("seconds",))  # noqa: E731
+    assert strip_t(a.records) + strip_t(b.records) == strip_t(
+        ref.records)
+    assert np.array_equal(b.rf, ref.rf)
+    assert a.checkpoints and a.checkpoints[-1]["bytes"] > 0
+
+
+def test_mode_mismatch_checkpoint_refused(chaos_world):
+    man, events, sizes, topo = chaos_world
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "c.npz")
+        _controller_result(man, events, sizes, topo, "functional",
+                           ck=ck, maxw=2)
+        with pytest.raises(ValueError, match="placement"):
+            _controller_result(man, events, sizes, topo,
+                               "materialized_hash", ck=ck)
+
+
+def test_functional_serve_static_matches_oracle():
+    """No-fault serve: the O(unique pids) resolver routes bit-identically
+    to the materialized full map (locality, percentiles, everything)."""
+    man = _population()
+    events = simulate_access(
+        man, SimulatorConfig(duration_seconds=600.0, seed=21 + SEED))
+    sizes = np.asarray(man.size_bytes, dtype=np.int64)
+    from cdrs_tpu.serve import ServeConfig
+
+    def run(mode):
+        cfg = ControllerConfig(
+            window_seconds=120.0, default_rf=2,
+            kmeans=KMeansConfig(k=8, seed=42),
+            scoring=validated_scoring_config(),
+            placement_mode=mode, serve=ServeConfig(policy="p2c"))
+        return ReplicationController(man, cfg).run(events)
+
+    fn, orc = run("functional"), run("materialized_hash")
+    assert _strip(fn.records) == _strip(orc.records)
+
+
+# -- checkpoint gauges (utils/checkpoint satellite) --------------------------
+
+def test_save_state_returns_stats_and_emits_gauges(tmp_path):
+    from cdrs_tpu.obs import JsonlSink, Telemetry
+    from cdrs_tpu.utils.checkpoint import save_state
+
+    out = tmp_path / "tele.jsonl"
+    with Telemetry(JsonlSink(str(out))) as tel:  # noqa: F841
+        stats = save_state(str(tmp_path / "x.npz"),
+                           {"a": np.arange(10)}, {"k": 1})
+    assert stats["bytes"] > 0 and stats["seconds"] >= 0
+    text = out.read_text()
+    assert "checkpoint.bytes" in text
+    assert "checkpoint.save_seconds" in text
+    import io
+
+    from cdrs_tpu.obs.metrics_cli import main as metrics_main
+    import contextlib
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        metrics_main(["summarize", str(out)])
+    assert "Checkpoint:" in buf.getvalue()
