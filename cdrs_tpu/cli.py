@@ -485,6 +485,7 @@ def _controller_cfg(args, fault_schedule=None, topology=None):
         scrub_cfg = ScrubConfig(bytes_per_window=args.scrub)
     return ControllerConfig(
         topology=topology,
+        placement_mode=getattr(args, "placement", "materialized"),
         serve=serve_cfg,
         storage=storage_cfg,
         window_seconds=args.window_seconds,
@@ -624,6 +625,7 @@ def _cmd_serve(args) -> int:
         ServeConfig,
         SloSpec,
         emit_window_telemetry,
+        read_view,
     )
 
     manifest = Manifest.read_csv(args.manifest)
@@ -637,7 +639,8 @@ def _cmd_serve(args) -> int:
                     availability=args.slo_availability),
         verify_reads=not args.no_verify_reads)
     rf = np.full(len(manifest), args.default_rf, dtype=np.int32)
-    placement = place_replicas(manifest, rf, topology, seed=0)
+    placement_mode = getattr(args, "placement", "materialized")
+    method = "rng" if placement_mode == "materialized" else "hash"
 
     events = []
     for kind, flag in (("crash", args.kill), ("partition", args.partition),
@@ -647,11 +650,33 @@ def _cmd_serve(args) -> int:
             events.extend(FaultSchedule.from_specs([f"{kind}:{spec}"]))
     schedule = FaultSchedule(events) if events else None
     state = None
+    placement = None
+    resolver = None
     if schedule is not None:
+        # Faults need the mutable state either way; the hash family just
+        # swaps the base chooser.
+        placement = place_replicas(manifest, rf, topology, seed=0,
+                                   method=method)
         schedule.validate_nodes(topology.nodes)
         state = ClusterState(placement,
                              np.asarray(manifest.size_bytes,
                                         dtype=np.int64))
+    elif placement_mode == "functional":
+        # The O(1)-memory router: no materialized map at all — each
+        # window resolves only ITS files through the functional chooser
+        # (serve/view.read_view compacts the rows and remaps pids).
+        from .placement_fn import compute_placement, primary_on_topology
+
+        fn_primary = primary_on_topology(manifest.nodes,
+                                         manifest.primary_node_id,
+                                         topology)
+
+        def resolver(uniq):
+            return compute_placement(uniq, rf[uniq], fn_primary[uniq],
+                                     topology, 0)[0]
+    else:
+        placement = place_replicas(manifest, rf, topology, seed=0,
+                                   method=method)
 
     router = ReadRouter(len(topology), serve_cfg)
     hotspot = HotspotDetector(
@@ -685,21 +710,19 @@ def _cmd_serve(args) -> int:
                     client = _client_to_topology(ev, topology)[keep][is_read]
                     hs = hotspot.observe(
                         np.bincount(pid, minlength=len(manifest)))
-                    if state is not None:
-                        rm, ok = state.replica_map, state.reachable_mask()
-                        thr = state.node_throughput
-                    else:
-                        rm = placement.replica_map
-                        ok = rm >= 0
-                        thr = np.ones(len(topology))
-                    slot_corrupt = None
-                    if state is not None and state.has_corruption:
-                        slot_corrupt = state.slot_corrupt
+                    # The ONE state-vs-static resolution (serve/view.py)
+                    # shared with the controller's serve wiring — the
+                    # seam the functional mode plugs into.
+                    view = read_view(pid, state=state, resolver=resolver,
+                                     placement=placement,
+                                     n_nodes=len(topology))
                     res = router.route(
-                        rm, ok, thr, ts=ts, pid=pid, client=client,
+                        view.replica_map, view.slot_ok,
+                        view.node_throughput, ts=ts, pid=view.pid,
+                        client=client,
                         window_seconds=args.window_seconds,
                         rng=np.random.default_rng([args.seed, int(w)]),
-                        slot_corrupt=slot_corrupt)
+                        slot_corrupt=view.slot_corrupt)
                     if (res.corrupt_pairs is not None
                             and len(res.corrupt_pairs)):
                         # Detect-on-read: drop the rotten copies the
@@ -1164,6 +1187,20 @@ def main(argv: list[str] | None = None) -> int:
         p.add_argument("--no_hotspot_recluster", action="store_true",
                        help="observe hotspots without feeding them back "
                             "into the re-cluster trigger")
+        p.add_argument("--placement",
+                       choices=["materialized", "functional",
+                                "materialized_hash"],
+                       default="materialized",
+                       help="placement representation (placement_fn/): "
+                            "'materialized' = the historical rng chooser "
+                            "+ dense replica-map state; 'functional' = "
+                            "CRUSH-style stateless hash chooser — "
+                            "checkpoints store only per-file exceptions "
+                            "over the computed base and serve-mode reads "
+                            "resolve replicas on the fly; "
+                            "'materialized_hash' = the hash chooser over "
+                            "the dense representation (the equivalence "
+                            "oracle)")
         p.add_argument("--medians_from_data", action="store_true")
         p.add_argument("--scoring_config", default=None,
                        metavar="JSON|validated")
@@ -1291,6 +1328,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--racks", default=None, metavar="SPEC",
                    help="failure domains (the chaos --racks spec): "
                         "placement spreads replicas across racks")
+    p.add_argument("--placement",
+                   choices=["materialized", "functional",
+                            "materialized_hash"],
+                   default="materialized",
+                   help="placement source: 'functional' resolves each "
+                        "window's replicas on the fly through the "
+                        "CRUSH-style hash chooser (no materialized map "
+                        "— O(unique files) router memory); "
+                        "'materialized_hash' materializes the same "
+                        "chooser (the equivalence oracle); default is "
+                        "the historical rng chooser")
     p.add_argument("--kill", action="append", metavar="NODE@W[-W2]",
                    help="crash NODE over windows W..W2; repeatable")
     p.add_argument("--partition", action="append", metavar="NODES@W[-W2]",

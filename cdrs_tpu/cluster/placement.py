@@ -212,6 +212,7 @@ def place_replicas(
     topology: ClusterTopology | None = None,
     seed: int | None = 0,
     size_bytes: np.ndarray | None = None,
+    method: str = "rng",
 ) -> PlacementResult:
     """Place ``rf_per_file`` replicas of each file onto the topology.
 
@@ -223,23 +224,27 @@ def place_replicas(
     from the other nodes via per-file random priority sort.  On a flat
     topology every node is its own domain and the policy is exactly the
     historical distinct-node random chooser.
+
+    ``method`` selects the priority source: ``"rng"`` (default) is the
+    historical per-placement rng matrix — a function of the whole
+    population, so it can only be materialized; ``"hash"`` draws the
+    SAME structural policy's priorities from the stateless per-(file,
+    node-name) hash of ``placement_fn.compute_placement``, making this
+    call the materialized twin of the functional chooser (one
+    implementation, two surfaces — the equivalence oracle of
+    ``--placement functional``).
     """
     topology = topology or ClusterTopology()
     n = len(manifest)
     n_nodes = len(topology)
-    node_by_name = {nm: i for i, nm in enumerate(topology.nodes)}
 
-    # Manifest primary ids index manifest.nodes; remap onto the topology via
-    # a per-name LUT (O(vocabulary), not O(files)).  Unknown nodes spread over
-    # the topology via a *stable* hash (Python's str hash is salted per
-    # process and would break run-to-run determinism).
-    import zlib
+    # Manifest primary ids remap onto the topology via the shared
+    # per-name LUT (placement_fn.primary_on_topology): O(vocabulary),
+    # stable-hash spread for unknown names.
+    from ..placement_fn.compute import primary_on_topology
 
-    lut = np.asarray([
-        node_by_name.get(nm, zlib.crc32(nm.encode()) % n_nodes)
-        for nm in manifest.nodes
-    ], dtype=np.int32)
-    primary = lut[manifest.primary_node_id]
+    primary = primary_on_topology(manifest.nodes,
+                                  manifest.primary_node_id, topology)
 
     rf_want = np.asarray(rf_per_file, dtype=np.int32)
     n_capped = int((rf_want > n_nodes).sum())
@@ -258,6 +263,21 @@ def place_replicas(
     rf = np.minimum(rf_want, n_nodes)
     rf = np.maximum(rf, 1)
     max_rf = int(rf.max())
+
+    if method == "hash":
+        from ..placement_fn.compute import compute_placement
+
+        replica_map, rf = compute_placement(
+            np.arange(n, dtype=np.int64), rf, primary, topology,
+            0 if seed is None else int(seed))
+        result = PlacementResult(replica_map=replica_map, rf=rf,
+                                 topology=topology)
+        result.compute_storage(manifest.size_bytes if size_bytes is None
+                               else size_bytes)
+        return result
+    if method != "rng":
+        raise ValueError(f"unknown placement method {method!r} "
+                         f"(want 'rng' or 'hash')")
 
     rng = np.random.default_rng(seed)
     # Random priorities per (file, node); the sort key starts as the raw
@@ -305,6 +325,7 @@ def place_stripes(
     topology: ClusterTopology | None = None,
     seed: int | None = 0,
     shard_bytes: np.ndarray | None = None,
+    method: str = "rng",
 ) -> PlacementResult:
     """Vectorized stripe placement for storage strategies (cdrs_tpu/storage).
 
@@ -319,4 +340,4 @@ def place_stripes(
     ``storage_per_node`` is computed from ``shard_bytes`` when given.
     """
     return place_replicas(manifest, shards_per_file, topology, seed,
-                          size_bytes=shard_bytes)
+                          size_bytes=shard_bytes, method=method)

@@ -142,6 +142,21 @@ class ControllerConfig:
     #: the silent corruption it finds into the repair queue.  The scrub
     #: cursor and read-detection hint queue ride the npz checkpoint.
     scrub: object | None = None
+    #: Placement representation (placement_fn/, ROADMAP item 3):
+    #: ``"materialized"`` (default) is the historical rng chooser + dense
+    #: replica-map state — byte-identical to every pre-placement-mode
+    #: run.  ``"functional"`` switches the base placement to the
+    #: stateless hash chooser (``place_replicas(method="hash")`` /
+    #: ``placement_fn.compute_placement``): the fault path runs a
+    #: ``FunctionalClusterState`` whose checkpoints store only per-file
+    #: EXCEPTIONS over the computed base (npz size stops scaling with
+    #: file count), and serve-mode reads resolve their replica rows on
+    #: the fly (O(unique pids) router memory).  ``"materialized_hash"``
+    #: is the equivalence ORACLE: the same hash chooser and retarget
+    #: policy over the dense representation and dense checkpoints — a
+    #: functional run must be decision-identical to it (the PR-8 compat
+    #: pattern; enforced by tests/test_placement_fn.py on 3 seeds).
+    placement_mode: str = "materialized"
     #: Double-buffered windows: dispatch window t+1's (already jit'd)
     #: cluster step before window t's host-side planning runs, so JAX's
     #: async dispatch keeps the device busy while the host diffs plans,
@@ -184,6 +199,11 @@ class ControllerConfig:
             raise ValueError(
                 "scrub requires a fault_schedule (the scrubber verifies "
                 "the mutable ClusterState the fault path maintains)")
+        if self.placement_mode not in ("materialized", "functional",
+                                       "materialized_hash"):
+            raise ValueError(
+                f"unknown placement_mode {self.placement_mode!r} (want "
+                f"'materialized', 'functional' or 'materialized_hash')")
 
 
 @dataclass
@@ -194,6 +214,9 @@ class ControllerResult:
     rf: np.ndarray             # (n,) applied replication factor per file
     category_idx: np.ndarray   # (n,) applied category index, -1 = unplanned
     manifest: Manifest
+    #: Per-save checkpoint observations ({window, bytes, seconds}) — the
+    #: artifact behind the O(exceptions)-checkpoint claim (placement_fn).
+    checkpoints: list = field(default_factory=list)
 
     def plan_entries(self):
         """The applied plan as cluster/plan.PlanEntry rows (exportable)."""
@@ -278,6 +301,13 @@ class ControllerResult:
             storage_digest,
         )
 
+        if self.checkpoints:
+            last = self.checkpoints[-1]
+            out["checkpoint"] = {
+                "saves": len(self.checkpoints),
+                "bytes_last": int(last["bytes"]),
+                "save_seconds_last": float(last["seconds"]),
+            }
         serve = serve_digest(self.records)
         if serve is not None:
             out["serve"] = serve
@@ -384,6 +414,12 @@ class ReplicationController:
             hysteresis_windows=cfg.hysteresis_windows)
         self._placement_key: bytes | None = None
         self._placement = None
+        #: Placement representation (placement_fn/): "materialized" is
+        #: the historical rng chooser; the hash family ("functional",
+        #: "materialized_hash") shares the stateless chooser so replica
+        #: rows can be recomputed for any file subset.
+        self._hash_placement = cfg.placement_mode != "materialized"
+        self._placement_method = "hash" if self._hash_placement else "rng"
         #: Fault-tolerance state (faults/): only when a schedule is set.
         self._cluster_state = None
         self._repairs = None
@@ -400,8 +436,24 @@ class ReplicationController:
                     f"topology must cover exactly the manifest's node set")
             cfg.fault_schedule.validate_nodes(topology.nodes)
             placement = place_replicas(manifest, self.current_rf, topology,
-                                       seed=0)
-            self._cluster_state = ClusterState(placement, self._sizes)
+                                       seed=0,
+                                       method=self._placement_method)
+            if self._hash_placement:
+                from ..placement_fn import (
+                    FunctionalClusterState,
+                    primary_on_topology,
+                )
+
+                self._cluster_state = FunctionalClusterState(
+                    placement, self._sizes,
+                    primary=primary_on_topology(
+                        manifest.nodes, manifest.primary_node_id,
+                        topology),
+                    seed=0,
+                    sparse_checkpoint=(
+                        cfg.placement_mode == "functional"))
+            else:
+                self._cluster_state = ClusterState(placement, self._sizes)
             self._repairs = RepairScheduler(seed=cfg.repair_seed)
         #: Integrity layer: the background scrubber (faults/scrub.py) and
         #: the static "does this run care about integrity at all" flag —
@@ -440,6 +492,8 @@ class ReplicationController:
                 spike_factor=cfg.serve.hotspot_spike_factor,
                 min_reads=cfg.serve.hotspot_min_reads,
                 top_k=cfg.serve.hotspot_top_k)
+        #: Lazy primary LUT of the functional static-serve resolver.
+        self._fn_static_primary = None
         #: Mesh telemetry template (mesh runs only): device count and the
         #: per-Lloyd-iteration collective-traffic estimate — one psum of
         #: the f32 (k, d+1) sufficient statistics over the data axis —
@@ -468,6 +522,9 @@ class ReplicationController:
         #: Lazy decision-quality auditor (obs/audit.py); created at the
         #: first audited window so telemetry-off runs never import it.
         self._auditor = None
+        #: Per-save {window, bytes, seconds} observations (save_state
+        #: additionally emits checkpoint.* gauges when telemetry is on).
+        self.checkpoint_log: list[dict] = []
         self.window_index = 0
         #: Events folded from the FINAL processed window — lets a resume
         #: over a grown (append-only) log fold that window's late tail
@@ -884,9 +941,17 @@ class ReplicationController:
             # multipliers, and every read gets an exact FIFO-queue latency
             # sample (serve/router.py).
             t0 = time.perf_counter()
+            from ..serve import read_view
+
             if self._cluster_state is not None:
-                rm = self._cluster_state.replica_map
-                slot_ok = self._cluster_state.reachable_mask()
+                view = read_view(read_pid, state=self._cluster_state)
+                if not self._integrity_on:
+                    # The PR-9 contract: runs whose schedule never
+                    # injects corruption (and don't scrub) keep
+                    # byte-identical records even if a resumed snapshot
+                    # carries stale rot bits — the router must not
+                    # react to them.
+                    view.slot_corrupt = None
                 if self._storage is not None:
                     # An EC stripe below k reachable shards cannot serve
                     # a read from ANY surviving slot — mask the whole
@@ -894,25 +959,28 @@ class ReplicationController:
                     # with unreadable_mask()/unavailable_reads in the
                     # same window record.
                     readable = ~self._cluster_state.unreadable_mask()
-                    slot_ok = slot_ok & readable[:, None]
-                thr = self._cluster_state.node_throughput
+                    view.slot_ok = view.slot_ok & readable[:, None]
+            elif (cfg.placement_mode == "functional"
+                    and self._storage is None):
+                # The O(1)-memory router: resolve ONLY this window's
+                # files through the functional chooser instead of
+                # materializing the full map (routing is bit-identical —
+                # the router only ever indexes replica_map[pid]).
+                view = read_view(read_pid, resolver=self._fn_static_rows,
+                                 n_nodes=len(self._serve_topology.nodes))
             else:
-                placement = self._placement_for(self.current_rf)
-                rm = placement.replica_map
-                slot_ok = rm >= 0
-                thr = np.ones(len(self._serve_topology.nodes))
+                view = read_view(
+                    read_pid,
+                    placement=self._placement_for(self.current_rf))
             extra_ms = None
             if self._storage is not None:
-                extra_ms = self._serve_penalty_ms(slot_ok)[read_pid]
-            slot_corrupt = None
-            if (self._integrity_on
-                    and self._cluster_state.has_corruption):
-                slot_corrupt = self._cluster_state.slot_corrupt
+                extra_ms = self._serve_penalty_ms(view.slot_ok)[read_pid]
             res = self._router.route(
-                rm, slot_ok, thr, ts=read_ts, pid=read_pid,
+                view.replica_map, view.slot_ok, view.node_throughput,
+                ts=read_ts, pid=view.pid,
                 client=read_client, window_seconds=cfg.window_seconds,
                 rng=np.random.default_rng([int(cfg.serve.seed), int(w)]),
-                extra_ms=extra_ms, slot_corrupt=slot_corrupt)
+                extra_ms=extra_ms, slot_corrupt=view.slot_corrupt)
             rec.update(res.record_fields())
             if res.corrupt_pairs is not None and len(res.corrupt_pairs):
                 # Detect-on-read feedback: quarantine the rotten copies
@@ -972,6 +1040,22 @@ class ReplicationController:
                     rec["locality_after"] = rec["locality_before"]
                     rec["balance_after"] = rec["balance_before"]
         seconds["evaluate"] = time.perf_counter() - t0
+
+        if self._hash_placement:
+            # The positive-engagement stamp of the placement axis (the
+            # scenario matrix's functional_engaged invariant reads it).
+            # Pre-placement-mode runs carry no key: records stay
+            # byte-identical.  ``exceptions`` is the EXACT deviation
+            # count from the computed base — deterministic across
+            # kill/resume (exception_fids prunes to the verified set).
+            pl: dict = {"mode": cfg.placement_mode, "epoch": 0}
+            if self._cluster_state is not None:
+                pl["epoch"] = int(getattr(self._cluster_state,
+                                          "_fn_epoch", 0))
+                if cfg.placement_mode == "functional":
+                    pl["exceptions"] = int(
+                        self._cluster_state.exception_fids().size)
+            rec["placement"] = pl
 
         rec["plan_hash"] = _plan_hash(self.current_rf, self.current_cat)
         # ``plan`` = the host-side planning slice (plan diff/submit +
@@ -1358,6 +1442,23 @@ class ReplicationController:
             (k_file > 0) & primary_down,
             base * (k_file - 1) * pen, 0.0)
 
+    def _fn_static_rows(self, uniq: np.ndarray) -> np.ndarray:
+        """(k, R) computed slot rows of a file subset against the CURRENT
+        rf vector — the functional serve path's resolver (no fault state:
+        the static placement is a pure function, so there is no exception
+        overlay to consult)."""
+        from ..placement_fn import compute_placement, primary_on_topology
+
+        topology = self._serve_topology
+        if self._fn_static_primary is None:
+            self._fn_static_primary = primary_on_topology(
+                self.manifest.nodes, self.manifest.primary_node_id,
+                topology)
+        slots, _ = compute_placement(
+            uniq, self.current_rf[uniq], self._fn_static_primary[uniq],
+            topology, 0)
+        return slots
+
     def _placement_for(self, rf: np.ndarray):
         """Placement for an rf vector — a pure seeded function, cached so
         move-free windows (the common steady state), the before/after
@@ -1387,10 +1488,12 @@ class ReplicationController:
                 self._placement = place_stripes(
                     self.manifest, rf.copy(), topology, seed=0,
                     shard_bytes=self._storage.file_shard_bytes(
-                        self.current_cat, self._sizes))
+                        self.current_cat, self._sizes),
+                    method=self._placement_method)
             else:
-                self._placement = place_replicas(self.manifest, rf.copy(),
-                                                 topology, seed=0)
+                self._placement = place_replicas(
+                    self.manifest, rf.copy(), topology, seed=0,
+                    method=self._placement_method)
             self._placement_key = key
         return self._placement
 
@@ -1423,7 +1526,14 @@ class ReplicationController:
             arrays["accepted_fractions"] = self._accepted_fractions
         arrays.update(self.scheduler.state_arrays())
         if self._cluster_state is not None:
-            arrays.update(self._cluster_state.state_arrays())
+            if self.cfg.placement_mode == "functional":
+                # Sparse placement snapshot: exceptions over the
+                # computed base, with the shard-intent reconstruction
+                # anchored at current_rf (also in this checkpoint).
+                arrays.update(self._cluster_state.state_arrays(
+                    rf_hint=self.current_rf))
+            else:
+                arrays.update(self._cluster_state.state_arrays())
             arrays.update(self._repairs.state_arrays())
         if self._hotspot is not None:
             arrays.update(self._hotspot.state_arrays())
@@ -1448,10 +1558,15 @@ class ReplicationController:
             "serve": self._router is not None,
             "storage": self._storage is not None,
             "scrub": self._scrub is not None,
+            "placement": self.cfg.placement_mode,
         }
         if self.cfg.backend == "jax":
             meta["pad_events"] = self._state.pad_events
-        save_state(path, arrays, meta=meta)
+        stats = save_state(path, arrays, meta=meta)
+        # Per-save record (window-stamped): the checkpoint-size artifact
+        # the functional placement mode is measured by.
+        self.checkpoint_log.append(
+            {"window": int(self.window_index), **stats})
 
     def load_checkpoint(self, path: str) -> None:
         from ..utils.checkpoint import load_state
@@ -1503,6 +1618,16 @@ class ReplicationController:
                 f"checkpoint {path!r} has scrub="
                 f"{bool(meta.get('scrub', False))} but the controller "
                 f"expects {self._scrub is not None} — stale "
+                f"checkpoint? delete it to start over")
+        # Placement mode, same posture: pre-placement-mode checkpoints
+        # carry no key and keep loading in materialized controllers; a
+        # sparse functional snapshot cannot restore a dense state (or
+        # vice versa) and the base chooser must match.
+        ck_mode = meta.get("placement", "materialized")
+        if ck_mode != self.cfg.placement_mode:
+            raise ValueError(
+                f"checkpoint {path!r} has placement={ck_mode!r} but the "
+                f"controller expects {self.cfg.placement_mode!r} — stale "
                 f"checkpoint? delete it to start over")
         if self.cfg.backend == "jax":
             import jax.numpy as jnp
@@ -1732,4 +1857,5 @@ class ReplicationController:
             self.save_checkpoint(checkpoint_path)
         return ControllerResult(records=records, rf=self.current_rf.copy(),
                                 category_idx=self.current_cat.copy(),
-                                manifest=self.manifest)
+                                manifest=self.manifest,
+                                checkpoints=list(self.checkpoint_log))

@@ -98,6 +98,24 @@ def _render_roofline(digest, out, peak_flops=None, peak_gbps=None) -> None:
         print(" ".join(parts), file=out)
 
 
+def _render_checkpoint(digest, out) -> None:
+    """Checkpoint-size digest (utils/checkpoint.save_state gauges) — the
+    observable the functional placement mode's O(exceptions) snapshot
+    claim is measured by."""
+    g = digest["gauges"]
+    if "checkpoint.bytes" not in g:
+        return
+    saves = int(digest["counters"].get("checkpoint.saves", 0))
+    line = (f"\nCheckpoint: last snapshot "
+            f"{_fmt_bytes(g['checkpoint.bytes'])}")
+    if saves:
+        line += f" over {saves} saves"
+    secs = g.get("checkpoint.save_seconds")
+    if secs is not None:
+        line += f", last save {secs:.3f}s"
+    print(line, file=out)
+
+
 def _render_serving(windows: list[dict], out) -> None:
     """Read-path SLO digest (serving window records from a
     ``ControllerConfig.serve`` / ``cdrs serve`` run)."""
@@ -319,6 +337,7 @@ def summarize_events(events: list[dict], out=None, peak_flops=None,
 
     _render_audit(digest["audits"], out)
     _render_cells(digest.get("cells") or [], out)
+    _render_checkpoint(digest, out)
     _render_serving(digest["windows"], out)
     _render_storage(digest["windows"], out)
     _render_durability(digest["windows"], out)

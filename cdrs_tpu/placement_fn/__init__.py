@@ -1,0 +1,37 @@
+"""Functional placement (CRUSH-style): recompute replica/stripe maps
+instead of storing them.
+
+* :mod:`.compute` — the stateless hash chooser: any subset of files
+  re-places vectorized with NO per-file state, reproducing the
+  rack-aware policy's structural guarantees.
+* :mod:`.epoch` — cluster-map epochs; a topology change plans its
+  migrations by hashing twice and comparing.
+* :mod:`.state` — the ClusterState backend whose checkpoints store only
+  per-file exceptions over the computed base.
+
+See docs/ARCHITECTURE.md "Functional placement" for the hash scheme,
+the exception-overlay semantics, the epoch-diff contract and the
+equivalence fine print.
+"""
+
+from .compute import (
+    compute_placement,
+    file_keys,
+    hash_priorities,
+    node_salts,
+    primary_on_topology,
+)
+from .epoch import Epoch, EpochDiff, EpochMap
+from .state import FunctionalClusterState
+
+__all__ = [
+    "Epoch",
+    "EpochDiff",
+    "EpochMap",
+    "FunctionalClusterState",
+    "compute_placement",
+    "file_keys",
+    "hash_priorities",
+    "node_salts",
+    "primary_on_topology",
+]

@@ -1,0 +1,321 @@
+"""CRUSH-style functional placement: recompute, don't store.
+
+The materialized chooser (cluster/placement.place_replicas) draws one
+``(n_files, n_nodes)`` rng priority matrix per placement — correct, but a
+function of the WHOLE population: row i cannot be recomputed without
+generating rows 0..i-1, so every consumer (router, repair, durability,
+checkpoints) must drag the materialized map around.  Ceph's CRUSH (Weil
+et al., PAPERS.md) shows the alternative this module implements: the
+priority of node j for file f is a **pure stateless hash** of
+``(seed, file id, node name)`` — CRUSH's straw2 draw — so any subset of
+rows recomputes vectorized in O(subset) with NO per-file state, any
+process computes the same placement, and — because node salts are keyed
+by node *identity*, not index — a topology change moves only the files
+whose computed slots actually involve the changed nodes (the epoch-diff
+contract, placement_fn/epoch.py; a mod-N scheme would remap everyone).
+
+The structural policy is exactly the repo's rack-aware chooser: replica 0
+on the file's primary node; with failure domains, replica 1 on the
+best-priority node OUTSIDE the primary's domain and replica 2 on that
+same remote domain's second-best node (HDFS rack-aware: off-rack, then
+same remote rack); every further replica on distinct nodes in ascending
+priority order.  On a flat topology the domain machinery vanishes and the
+chooser degenerates bit-for-bit to the plain distinct-node priority
+policy (property-tested against an independent argsort reference in
+tests/test_placement_fn.py).
+
+Only the PRIORITY SOURCE differs from the legacy chooser (hash vs rng
+matrix), which is why the legacy rng path cannot be recomputed
+functionally and stays the default; ``place_replicas(method="hash")``
+materializes THIS chooser's output (one implementation, two surfaces —
+the equivalence oracle of the functional mode).
+
+Performance shape (the >= 50M placements/s CPU target of
+benchmarks/placement_bench.py, hit on one core):
+
+* priorities live in a **transposed (n_nodes, m) uint32 block** — each
+  node's vector is contiguous, so the 4-op finishing mix streams at
+  memory bandwidth instead of striding a row-major layout;
+* each value packs the node id into its LOW 6 bits under a 26-bit
+  priority, so taking a slot is one ``np.minimum.reduce`` over the node
+  axis — the winner's identity rides the minimum, no argmin pass, and
+  within a row values are all distinct (the node bits), so selection is
+  tie-free and deterministic by construction;
+* files process in L2-sized chunks (``chunk``, default 128k) with the
+  priority block reused across chunks — the difference between 13M and
+  20M files/s on one core.
+
+The 6 node bits cap a topology at 63 nodes (node id 63 is reserved so
+the all-ones sentinel can never collide with a live candidate); wider
+clusters belong to the hierarchical-topology ROADMAP item.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+__all__ = ["node_salts", "file_keys", "hash_priorities",
+           "compute_placement", "primary_on_topology",
+           "PRIO_MAX", "NODE_MASK", "MAX_NODES"]
+
+_SPLITMIX_GAMMA = np.uint64(0x9E3779B97F4A7C15)
+_MIX_1 = np.uint64(0xBF58476D1CE4E5B9)
+_MIX_2 = np.uint64(0x94D049BB133111EB)
+_C1 = np.uint32(0xCC9E2D51)   # murmur3 mixing constants
+_C2 = np.uint32(0x1B873593)
+_M32 = np.uint32(0x85EBCA6B)
+#: Low-bits node-id channel of a packed priority.
+NODE_MASK = np.uint32(0x3F)
+_PRIO_BITS_MASK = np.uint32(0xFFFFFFC0)
+#: Sentinel "already taken / masked" priority: all-ones.  Node id 63 is
+#: reserved (MAX_NODES = 63), so no live candidate can equal it.
+PRIO_MAX = np.uint32(0xFFFFFFFF)
+MAX_NODES = 63
+
+
+def _splitmix64(z: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64 (wrapping by design)."""
+    z = z + _SPLITMIX_GAMMA
+    z = (z ^ (z >> np.uint64(30))) * _MIX_1
+    z = (z ^ (z >> np.uint64(27))) * _MIX_2
+    return z ^ (z >> np.uint64(31))
+
+
+def node_salts(nodes, seed: int = 0) -> np.ndarray:
+    """(n_nodes,) uint32 per-node salt keyed by node IDENTITY.
+
+    blake2b of the node *name* (not its index), mixed with the seed: two
+    topologies sharing a node name give it the same salt, so priorities —
+    and therefore placements — of files that never touch the changed
+    nodes are identical across epochs (the CRUSH stability property the
+    epoch diff relies on).  Process- and platform-stable by construction
+    (Python's salted ``hash`` is neither).
+    """
+    if len(nodes) > MAX_NODES:
+        raise ValueError(
+            f"functional placement supports up to {MAX_NODES} nodes "
+            f"(6-bit packed node ids), got {len(nodes)}")
+    seed_key = _splitmix64(np.asarray([np.uint64(seed & 0xFFFFFFFFFFFFFFFF)],
+                                      dtype=np.uint64))[0]
+    out = np.empty(len(nodes), dtype=np.uint64)
+    for i, name in enumerate(nodes):
+        h = hashlib.blake2b(str(name).encode(), digest_size=8).digest()
+        out[i] = np.uint64(int.from_bytes(h, "little"))
+    mixed = _splitmix64(out ^ seed_key)
+    return (mixed ^ (mixed >> np.uint64(32))).astype(np.uint32)
+
+
+def file_keys(file_ids: np.ndarray, seed: int = 0) -> np.ndarray:
+    """(m,) uint32 well-mixed per-file keys (murmur3-style double round).
+
+    File ids hash through their low 32 bits — populations are capped at
+    4B files per controller, far past the 100M the bench drives.
+    """
+    x = np.asarray(file_ids).astype(np.uint32)
+    x = x ^ np.uint32((seed * 2654435761) & 0xFFFFFFFF)
+    x = x * _C1
+    x = x ^ (x >> np.uint32(16))
+    x = x * _C2
+    return x ^ (x >> np.uint32(16))
+
+
+def hash_priorities(keys: np.ndarray, salts: np.ndarray,
+                    out: np.ndarray | None = None) -> np.ndarray:
+    """(n_nodes, m) uint32 PACKED priorities, transposed layout.
+
+    Each value is ``(hash26 << 6) | node_id`` — lower is better, the
+    minimum over the node axis carries its winner's identity, and values
+    within a file's column are all distinct (the node bits), so
+    comparisons can never tie.  4 contiguous vector ops per node row —
+    the throughput-critical inner loop of the whole functional engine.
+    """
+    m = keys.shape[0]
+    n = salts.shape[0]
+    if out is None:
+        out = np.empty((n, m), dtype=np.uint32)
+    for j in range(n):
+        row = out[j]
+        np.bitwise_xor(keys, salts[j], out=row)
+        np.multiply(row, _M32, out=row)
+        np.bitwise_and(row, _PRIO_BITS_MASK, out=row)
+        np.bitwise_or(row, np.uint32(j), out=row)
+    return out
+
+
+def primary_on_topology(node_vocab, primary_node_id: np.ndarray,
+                        topology) -> np.ndarray:
+    """Remap manifest primary ids onto a topology via a per-NAME LUT.
+
+    The shared resolution (historically inlined in ``place_replicas``):
+    O(vocabulary), not O(files); names absent from the topology spread
+    over it via a stable crc32 hash (Python's salted str hash would break
+    run-to-run determinism).
+    """
+    import zlib
+
+    n_nodes = len(topology.nodes)
+    node_by_name = {nm: i for i, nm in enumerate(topology.nodes)}
+    lut = np.asarray([
+        node_by_name.get(nm, zlib.crc32(str(nm).encode()) % n_nodes)
+        for nm in node_vocab
+    ], dtype=np.int32)
+    return lut[np.asarray(primary_node_id)]
+
+
+def compute_placement(
+    file_ids: np.ndarray,
+    n_shards: np.ndarray,
+    primary: np.ndarray,
+    topology,
+    seed: int = 0,
+    *,
+    salts: np.ndarray | None = None,
+    out_width: int | None = None,
+    chunk: int = 1 << 17,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Functional placement of an arbitrary file-id subset.
+
+    Returns ``(slots, rf)``: ``slots`` is (m, width) int32 node ids with
+    -1 padding past each row's effective rf, ``rf`` is (m,) int32 =
+    ``clip(n_shards, 1, n_nodes)`` (the placement cap — distinct nodes
+    per replica, HDFS behaviour).  ``primary`` must already be resolved
+    onto ``topology`` (:func:`primary_on_topology`).
+
+    Row i depends ONLY on ``(seed, file_ids[i], n_shards[i],
+    primary[i], topology)`` — computing a subset yields exactly the
+    matching rows of the full-population computation, and the slot
+    sequence is NESTED in rf: ``slots(rf=4)[:3] == slots(rf=3)`` for the
+    same file (growing a file's rf only appends nodes; shrinking only
+    drops the tail) — the property the functional ClusterState's
+    exception accounting leans on.
+    """
+    fids = np.asarray(file_ids)
+    m_total = fids.shape[0]
+    n_nodes = len(topology)
+    if salts is None:
+        salts = node_salts(topology.nodes, seed)
+    rf = np.asarray(n_shards)
+    if rf.dtype != np.int32:
+        rf = rf.astype(np.int32)
+    rf = np.clip(rf, 1, n_nodes)
+    if rf.shape == ():  # scalar broadcast
+        rf = np.full(m_total, int(rf), dtype=np.int32)
+    max_rf = int(rf.max()) if m_total else 1
+    width = max_rf if out_width is None else int(out_width)
+    # np.empty, not np.full: every cell in [:, :max_rf] is written below
+    # (selection + rf padding), and the extra out_width columns get one
+    # explicit fill per chunk — at 10M+ files the avoided 2D -1 fill is
+    # a measurable slice of the whole computation.
+    slots = np.empty((m_total, width), dtype=np.int32)
+    if m_total == 0:
+        return slots, rf
+
+    primary_all = np.asarray(primary, dtype=np.int32)
+    dom = topology.domain_index()
+    n_domains = topology.n_domains
+    # All-singleton domains (the flat topology) degenerate exactly to
+    # the generic ascending-priority fill: "best node of a remote
+    # singleton domain" IS the best non-primary node, and a singleton
+    # remote domain has no second member — so skip the domain machinery
+    # wholesale (bit-identical, property-tested).
+    multi_domain = (1 < n_domains < n_nodes and max_rf >= 2)
+    uniform_rf = bool((rf == max_rf).all())
+    chunk = max(int(chunk), 1)
+    buf = min(chunk, m_total)
+    work = np.empty((n_nodes, buf), dtype=np.uint32)
+    dmin = dom_rows = None
+    if multi_domain:
+        # Per-domain row groups: the domain rules become grouped
+        # minimums over contiguous node rows instead of masked copies of
+        # the whole priority block (the masked np.where construction
+        # costs more than every reduction combined at 10M+ files).
+        dom_rows = [np.flatnonzero(dom == d) for d in range(n_domains)]
+        dmin = np.empty((n_domains, buf), dtype=np.uint32)
+
+    def _grouped_min(w, m):
+        """dmin[d] = min over domain d's rows of ``w`` (value carries the
+        winning node's packed id) — pairwise row mins, no copies."""
+        dv = dmin[:, :m]
+        for d, rows in enumerate(dom_rows):
+            np.copyto(dv[d], w[rows[0]])
+            for r in rows[1:]:
+                np.minimum(dv[d], w[r], out=dv[d])
+        return dv
+
+    all_cols = np.arange(buf)
+
+    for lo in range(0, m_total, chunk):
+        hi = min(lo + chunk, m_total)
+        m = hi - lo
+        w = work[:, :m]
+        hash_priorities(file_keys(fids[lo:hi], seed), salts, out=w)
+        prim = primary_all[lo:hi]
+        cols = all_cols[:m]
+        out = slots[lo:hi]
+
+        out[:, 0] = prim
+        w[prim, cols] = PRIO_MAX
+
+        start_col = 1
+        if multi_domain:
+            # Replica 1: best-priority node OUTSIDE the primary's
+            # domain; replica 2: that same remote domain's second-best
+            # (HDFS rack-aware).  Guarded per file — a file whose every
+            # other node shares the primary's domain (or whose remote
+            # domain has one member) falls through to the generic fill.
+            # Each step is a grouped per-domain minimum (identical
+            # values to the masked construction — min is associative).
+            dp = dom[prim]
+            dv = _grouped_min(w, m)
+            best = dv.copy()
+            best[dp, cols] = PRIO_MAX        # exclude the primary's domain
+            mn1 = np.minimum.reduce(best, axis=0)
+            has1 = mn1 != PRIO_MAX
+            sel1 = (mn1 & NODE_MASK).astype(np.int32)
+            if not has1.all():
+                gen = (np.minimum.reduce(w, axis=0)
+                       & NODE_MASK).astype(np.int32)
+                sel1 = np.where(has1, sel1, gen)
+            out[:, 1] = sel1
+            w[sel1, cols] = PRIO_MAX
+            start_col = 2
+            if max_rf >= 3:
+                # Second-best of sel1's domain: regroup after masking
+                # sel1, then gather each file's own remote-domain row.
+                dv = _grouped_min(w, m)
+                mn2 = dv[dom[sel1], cols]
+                # A file without a remote domain (has1 false) must not
+                # take a same-domain second copy here.
+                if not has1.all():
+                    mn2 = np.where(has1, mn2, PRIO_MAX)
+                has2 = mn2 != PRIO_MAX
+                sel2 = (mn2 & NODE_MASK).astype(np.int32)
+                if not has2.all():
+                    gen = (np.minimum.reduce(w, axis=0)
+                           & NODE_MASK).astype(np.int32)
+                    sel2 = np.where(has2, sel2, gen)
+                out[:, 2] = sel2
+                w[sel2, cols] = PRIO_MAX
+                start_col = 3
+
+        for c in range(start_col, max_rf):
+            mn = np.minimum.reduce(w, axis=0)
+            mn &= NODE_MASK
+            s = mn.astype(np.int32)
+            out[:, c] = s
+            if c + 1 < max_rf:      # the last slot needs no re-masking
+                w[s, cols] = PRIO_MAX
+
+        if not uniform_rf:
+            # Pad past each row's rf while the chunk is cache-hot —
+            # masked per-column stores, NOT a 2D boolean fancy-index
+            # (which costs more than the whole selection at scale).
+            rfc = rf[lo:hi]
+            for c in range(1, max_rf):
+                np.copyto(out[:, c], np.int32(-1), where=rfc <= c)
+        if width > max_rf:
+            out[:, max_rf:] = -1
+
+    return slots, rf

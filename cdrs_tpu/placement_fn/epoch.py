@@ -1,0 +1,236 @@
+"""Cluster-map epochs: a topology change as a hash-twice diff.
+
+With the materialized chooser, "what moves when the topology changes" is
+answered by building the new ``(n_files, max_rf)`` map and diffing it
+against the stored one — O(n_files x nodes) rng + a full argsort + two
+resident maps.  With the functional chooser the answer is *computed*:
+place every file under the old epoch and the new epoch (two vectorized
+hash passes, chunked so the working set stays cache-sized) and compare —
+the CRUSH posture where a cluster-map revision is data, not a rebuild.
+
+Because node salts are keyed by node identity (compute.node_salts), an
+unchanged topology hashes to an unchanged placement: ``diff`` between
+equal epochs is ZERO moves by construction (tested), and a pure node
+REMOVAL prunes to the files whose computed slots held a removed node —
+nobody else's priorities changed, so nobody else can move (the legacy
+chooser cannot make this argument: its priority matrix is indexed by
+node position, so removing one node re-rolls everyone).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .compute import compute_placement, node_salts, primary_on_topology
+
+__all__ = ["Epoch", "EpochDiff", "EpochMap"]
+
+
+@dataclass(frozen=True)
+class Epoch:
+    """One immutable cluster-map revision."""
+
+    epoch_id: int
+    topology: object            # cluster.placement.ClusterTopology
+
+
+@dataclass
+class EpochDiff:
+    """Files whose computed placement moved between two epochs."""
+
+    moved: np.ndarray           # (k,) int64 file ids that must migrate
+    old_slots: np.ndarray       # (k, w) int32 placement under the old epoch
+    new_slots: np.ndarray       # (k, w) int32 placement under the new epoch
+    n_checked: int              # files the diff actually resolved
+    pruned: bool                # True when the removal fast path applied
+
+    def __len__(self) -> int:
+        return int(self.moved.shape[0])
+
+
+def _node_bitmask(slots: np.ndarray, gids: np.ndarray) -> np.ndarray:
+    """(m,) uint64 bitmask of each row's node SET in a global id space.
+
+    Placement identity across epochs is set-identity (a migration moves
+    bytes between nodes; slot order is a local detail), and a <= 64-node
+    global vocabulary packs the comparison into one integer per file."""
+    out = np.zeros(slots.shape[0], dtype=np.uint64)
+    for c in range(slots.shape[1]):
+        col = slots[:, c]
+        assigned = col >= 0
+        out[assigned] |= np.uint64(1) << gids[col[assigned]].astype(np.uint64)
+    return out
+
+
+class EpochMap:
+    """The cluster's topology history + the vectorized epoch diff.
+
+    ``vocab`` is the node-name vocabulary the manifest's
+    ``primary_node_id`` indexes (``manifest.nodes``); every epoch
+    re-resolves primaries onto its own topology through the shared
+    per-name LUT, so a removed primary re-homes deterministically
+    (stable crc spread — compute.primary_on_topology).
+    """
+
+    def __init__(self, vocab, topology, seed: int = 0):
+        self.vocab = tuple(vocab)
+        self.seed = int(seed)
+        self.epochs: list[Epoch] = [Epoch(0, topology)]
+
+    @property
+    def current(self) -> Epoch:
+        return self.epochs[-1]
+
+    def advance(self, topology) -> Epoch:
+        """Install a new cluster-map revision; returns the new epoch."""
+        ep = Epoch(len(self.epochs), topology)
+        self.epochs.append(ep)
+        return ep
+
+    def topology(self, epoch_id: int):
+        return self.epochs[epoch_id].topology
+
+    def placement(self, epoch_id: int, file_ids: np.ndarray,
+                  n_shards: np.ndarray, primary_node_id: np.ndarray,
+                  out_width: int | None = None):
+        """Computed slots of ``file_ids`` under one epoch (subset-safe)."""
+        topo = self.topology(epoch_id)
+        prim = primary_on_topology(self.vocab,
+                                   np.asarray(primary_node_id), topo)
+        return compute_placement(file_ids, n_shards, prim, topo,
+                                 self.seed, out_width=out_width)
+
+    # -- the diff ------------------------------------------------------------
+    def diff(self, old_id: int, new_id: int, n_shards: np.ndarray,
+             primary_node_id: np.ndarray, *, chunk: int = 1 << 20,
+             prune: bool = True) -> EpochDiff:
+        """Migration plan between two epochs: hash twice, compare.
+
+        ``n_shards``/``primary_node_id`` are full-population vectors (the
+        strategy state the controller already owns).  Chunked so the
+        per-chunk priority blocks stay cache-resident at any population
+        size.  ``prune=True`` engages the removal fast path when the new
+        node set is a subset of the old one: only old holders of removed
+        nodes are re-placed (plus files whose rf the shrink re-caps).
+        """
+        n = int(np.asarray(n_shards).shape[0])
+        topo_old, topo_new = self.topology(old_id), self.topology(new_id)
+        names_old, names_new = set(topo_old.nodes), set(topo_new.nodes)
+        if old_id == new_id or (
+                tuple(topo_old.nodes) == tuple(topo_new.nodes)
+                and tuple(topo_old.domains) == tuple(topo_new.domains)):
+            w = 0
+            empty = np.zeros((0, w), dtype=np.int32)
+            return EpochDiff(np.zeros(0, dtype=np.int64), empty, empty,
+                             n_checked=0, pruned=True)
+
+        # Global node-id space spanning both epochs (order: old, then new
+        # additions) for the set-identity bitmasks.
+        union = list(topo_old.nodes) + [x for x in topo_new.nodes
+                                        if x not in names_old]
+        if len(union) > 64:
+            raise ValueError(
+                f"epoch diff supports up to 64 distinct nodes across the "
+                f"two epochs, got {len(union)}")
+        gid_old = np.asarray([union.index(x) for x in topo_old.nodes],
+                             dtype=np.int64)
+        gid_new = np.asarray([union.index(x) for x in topo_new.nodes],
+                             dtype=np.int64)
+
+        # Pure removal = surviving nodes keep their names AND domains (a
+        # node that changed racks re-rolls its priorities' meaning for
+        # the domain rules, so the pruning argument no longer holds).
+        dom_old = dict(zip(topo_old.nodes,
+                           topo_old.domains or topo_old.nodes))
+        dom_new = dict(zip(topo_new.nodes,
+                           topo_new.domains or topo_new.nodes))
+        # The survivors must also keep their RELATIVE ORDER: packed
+        # priorities break the (astronomically rare) 26-bit tie by node
+        # index, and a removal shifts indices monotonically — any other
+        # reorder could flip a tie and move a non-holder.
+        survivors_in_old_order = [x for x in topo_old.nodes
+                                  if x in names_new]
+        removal_only = (names_new <= names_old
+                        and survivors_in_old_order == list(topo_new.nodes)
+                        and all(dom_new[nd] == dom_old[nd]
+                                for nd in topo_new.nodes))
+        n_removed = len(names_old - names_new)
+        use_prune = bool(prune and removal_only and n_removed)
+
+        shards = np.asarray(n_shards)
+        prim = np.asarray(primary_node_id)
+        width = int(min(int(shards.max()) if n else 1,
+                        max(len(topo_old), len(topo_new))))
+        moved_parts: list[np.ndarray] = []
+        old_parts: list[np.ndarray] = []
+        new_parts: list[np.ndarray] = []
+        n_checked = 0
+        salts_old = node_salts(topo_old.nodes, self.seed)
+        salts_new = node_salts(topo_new.nodes, self.seed)
+        prim_lut_old = primary_on_topology(self.vocab,
+                                           np.arange(len(self.vocab)),
+                                           topo_old)
+        prim_lut_new = primary_on_topology(self.vocab,
+                                           np.arange(len(self.vocab)),
+                                           topo_new)
+        recap = len(topo_new) < len(topo_old)  # rf caps can shrink
+        removed_old_idx = np.asarray(
+            [list(topo_old.nodes).index(x) for x in names_old - names_new],
+            dtype=np.int32)
+        for lo in range(0, n, int(chunk)):
+            hi = min(lo + int(chunk), n)
+            fids = np.arange(lo, hi, dtype=np.int64)
+            sh = shards[lo:hi]
+            old_slots, _ = compute_placement(
+                fids, sh, prim_lut_old[prim[lo:hi]], topo_old, self.seed,
+                salts=salts_old, out_width=width)
+            if use_prune:
+                # A candidate is a file whose computed slots hold a
+                # removed node, or whose rf the shrunken node count
+                # re-caps — and for a pure removal every candidate MUST
+                # move (its old set contains a node the new epoch cannot
+                # place, or strictly more slots than the new cap allows)
+                # while nobody else CAN (survivors' priorities and their
+                # tie-break order are untouched), so candidacy IS the
+                # moved set: no bitmask compare at all.
+                cand = np.zeros(hi - lo, dtype=bool)
+                for ri in removed_old_idx:
+                    cand |= (old_slots == ri).any(axis=1)
+                if recap:
+                    cand |= sh > len(topo_new)
+                idx = np.flatnonzero(cand)
+                n_checked += int(idx.size)
+                if idx.size == 0:
+                    continue
+                fids_c = fids[idx]
+                new_slots, _ = compute_placement(
+                    fids_c, sh[idx], prim_lut_new[prim[fids_c]], topo_new,
+                    self.seed, salts=salts_new, out_width=width)
+                moved_parts.append(fids_c)
+                old_parts.append(old_slots[idx])
+                new_parts.append(new_slots)
+            else:
+                bm_old = _node_bitmask(old_slots, gid_old)
+                new_slots, _ = compute_placement(
+                    fids, sh, prim_lut_new[prim[lo:hi]], topo_new,
+                    self.seed, salts=salts_new, out_width=width)
+                bm_new = _node_bitmask(new_slots, gid_new)
+                moved_loc = np.flatnonzero(bm_old != bm_new)
+                n_checked += int(hi - lo)
+                if moved_loc.size:
+                    moved_parts.append(fids[moved_loc])
+                    old_parts.append(old_slots[moved_loc])
+                    new_parts.append(new_slots[moved_loc])
+
+        if moved_parts:
+            moved = np.concatenate(moved_parts)
+            old_s = np.concatenate(old_parts)
+            new_s = np.concatenate(new_parts)
+        else:
+            moved = np.zeros(0, dtype=np.int64)
+            old_s = np.zeros((0, width), dtype=np.int32)
+            new_s = np.zeros((0, width), dtype=np.int32)
+        return EpochDiff(moved, old_s, new_s, n_checked=n_checked,
+                         pruned=use_prune)

@@ -1,0 +1,352 @@
+"""Functional ClusterState backend: exceptions over a computed base.
+
+``faults.ClusterState`` is the mutable source of truth of the fault path;
+its checkpoint is the ``(n_files, n_nodes)`` replica map plus a parallel
+corruption mask — the npz term that scales with file count (ROADMAP
+item 3).  This backend keeps the WHOLE mutation machinery (and therefore
+every repair/durability/serving decision) bit-identical while changing
+what a checkpoint *is*: placement state is serialized as
+
+* the functional base — ``(seed, epoch)`` plus the per-file shard intent,
+  re-derivable from vectors the controller checkpoint already carries;
+* per-file **exceptions** — the rows whose current placement differs from
+  the computed base (repair retargets onto live nodes, quarantine drops,
+  deferred strategy conversions, decommission wipes);
+* sparse corruption ``(file, slot)`` pairs and sparse strategy overrides.
+
+In memory the dense map stays resident as a CACHE of computed-base +
+exceptions (the mutation primitives, blast-radius refreshes and
+vectorized durability tiers all index it; shrinking the resident cache
+is the noted follow-up) — the O(exceptions) wins land on the checkpoint,
+the epoch-diff planner and the serve router, which is where the
+materialized representation actually bottlenecked.
+
+Two representation modes share this class: ``sparse_checkpoint=True`` is
+the functional mode; ``False`` keeps the dense npz contract and serves
+as the **materialized equivalence oracle** (the PR-8 compat pattern —
+same chooser, same retarget policy, dense serialization), so a
+functional run resumed mid-fault must reproduce the oracle's records
+bit-for-bit.
+
+The one behavioural difference from the legacy ``ClusterState`` policy
+(shared by BOTH modes of the hash family, which is what keeps them
+decision-identical) is the **base-form retarget**: an rf change applied
+to a file whose row is in base form on a fully reachable node set moves
+along the computed slot order — the nested-in-rf property of
+``compute_placement`` means growth appends computed nodes and shrink
+drops the computed tail, so steady-state migrations never create
+exceptions.  Any fault in the way (unreachable target or holder, prior
+exception) falls back to the legacy stateful path, and the file becomes
+an exception until topology health lets a later retarget reconverge it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..faults.state import ClusterState
+from .compute import compute_placement, node_salts
+
+__all__ = ["FunctionalClusterState"]
+
+
+class FunctionalClusterState(ClusterState):
+    """ClusterState whose placement state round-trips as exceptions."""
+
+    def __init__(self, placement, size_bytes, *, primary: np.ndarray,
+                 seed: int = 0, epoch: int = 0,
+                 sparse_checkpoint: bool = True):
+        super().__init__(placement, size_bytes)
+        self._fn_primary = np.asarray(primary, dtype=np.int32)
+        if self._fn_primary.shape[0] != self.replica_map.shape[0]:
+            raise ValueError(
+                f"primary shape {self._fn_primary.shape} != "
+                f"({self.replica_map.shape[0]},)")
+        self._fn_seed = int(seed)
+        self._fn_epoch = int(epoch)
+        self._fn_sparse = bool(sparse_checkpoint)
+        self._fn_salts = node_salts(self.topology.nodes, self._fn_seed)
+        #: Files whose row MAY deviate from base since the last verify
+        #: (every mutated fid lands here) — ``exception_fids`` classifies
+        #: them into ``_fn_exceptions`` and clears the set, so the
+        #: stamp/checkpoint cost is O(mutations since last verify) plus a
+        #: cached read of the standing exceptions, not O(files) and not
+        #: O(standing exceptions) per window.
+        self._fn_touched: set[int] = set()
+        #: VERIFIED standing exceptions (row != computed base).
+        self._fn_exceptions: set[int] = set()
+        #: Sorted-array cache of ``_fn_exceptions``; invalidated when the
+        #: classification changes.
+        self._fn_exc_array: np.ndarray | None = None
+
+    # -- base placement ------------------------------------------------------
+    def _fn_base_rows(self, fids: np.ndarray) -> np.ndarray:
+        """(k, n_nodes) computed-base rows (padded to map width) for a
+        file subset — the pure recompute every consumer shares."""
+        fids = np.asarray(fids, dtype=np.int64)
+        slots, _ = compute_placement(
+            fids, self.installed_shards[fids], self._fn_primary[fids],
+            self.topology, self._fn_seed, salts=self._fn_salts,
+            out_width=len(self.nodes))
+        return slots
+
+    def exception_fids(self, verify_chunk: int = 1 << 18) -> np.ndarray:
+        """Sorted int64 fids whose row differs from the computed base —
+        EXACT.  Only fids mutated since the last call are re-verified
+        against a fresh base recompute (a row repaired back into base
+        form stops being an exception); the standing set is returned
+        from a cache, so a mass fault's exceptions are classified once,
+        not re-hashed every window.  Callers must treat the returned
+        array as read-only."""
+        if self._fn_touched:
+            cand = np.fromiter(self._fn_touched, dtype=np.int64,
+                               count=len(self._fn_touched))
+            cand.sort()
+            self._fn_exceptions.difference_update(self._fn_touched)
+            self._fn_touched.clear()
+            for lo in range(0, cand.size, verify_chunk):
+                part = cand[lo:lo + verify_chunk]
+                base = self._fn_base_rows(part)
+                diff = (self.replica_map[part] != base).any(axis=1)
+                self._fn_exceptions.update(
+                    int(f) for f in part[diff])
+            self._fn_exc_array = None
+        if self._fn_exc_array is None:
+            arr = np.fromiter(self._fn_exceptions, dtype=np.int64,
+                              count=len(self._fn_exceptions))
+            arr.sort()
+            self._fn_exc_array = arr
+        return self._fn_exc_array
+
+    # -- mutation tracking ---------------------------------------------------
+    def add_replica(self, fid: int, node: int) -> None:
+        self._fn_touched.add(int(fid))
+        super().add_replica(fid, node)
+
+    def drop_replica(self, fid: int, node: int) -> None:
+        self._fn_touched.add(int(fid))
+        super().drop_replica(fid, node)
+
+    def apply_event(self, ev) -> None:
+        if ev.kind == "decommission":
+            # Decommission wipes rows in bulk (no drop_replica calls).
+            for name in ev.node_list:
+                i = self._nid(name)
+                self._fn_touched.update(
+                    int(f) for f in np.flatnonzero(
+                        (self.replica_map == i).any(axis=1)))
+        super().apply_event(ev)
+
+    # -- base-form retarget --------------------------------------------------
+    def apply_rf_target(self, fid: int, rf_new: int,
+                        record_intent: bool = True) -> int:
+        if record_intent:
+            # An intent change moves the file's BASE even when the row
+            # itself does not move (e.g. a shrink whose surplus copy sits
+            # on a down node the legacy policy refuses to drop) — the
+            # exception verifier must re-check it either way.
+            self._fn_touched.add(int(fid))
+            if self._fn_can_retarget(fid, rf_new):
+                return self._fn_retarget(fid, rf_new)
+        return super().apply_rf_target(fid, rf_new, record_intent)
+
+    def _fn_can_retarget(self, fid: int, rf_new: int) -> bool:
+        """Fast path only when it cannot change semantics vs a healthy
+        cluster: current row in base form, every holder AND every would-be
+        computed target reachable (a fault anywhere defers to the legacy
+        stateful policy and its partial-placement semantics)."""
+        row = self.replica_map[fid]
+        cur = int(self.installed_shards[fid])
+        base = self._fn_order(fid, max(cur, int(rf_new)))
+        n_cur = int((row >= 0).sum())
+        if n_cur != min(max(cur, 1), len(self.nodes)) \
+                or not np.array_equal(row[:n_cur], base[:n_cur]):
+            return False
+        reach = self.node_reachable()
+        target = min(max(int(rf_new), 1), len(self.nodes))
+        need = base[:max(n_cur, target)]
+        return bool(reach[need].all())
+
+    def _fn_order(self, fid: int, shards: int) -> np.ndarray:
+        """(min(shards, n_nodes),) computed slot order of one file."""
+        slots, _ = compute_placement(
+            np.asarray([fid], dtype=np.int64), np.asarray([shards]),
+            self._fn_primary[fid:fid + 1], self.topology, self._fn_seed,
+            salts=self._fn_salts)
+        row = slots[0]
+        return row[row >= 0]
+
+    def _fn_retarget(self, fid: int, rf_new: int) -> int:
+        """Move ``fid`` along its computed slot order (nested in rf:
+        growth appends computed nodes, shrink drops the computed tail) —
+        the add/drop primitives keep bytes, corruption bits and cached
+        counts consistent, and the row stays in base form."""
+        cur = int((self.replica_map[fid] >= 0).sum())
+        self.installed_shards[fid] = int(rf_new)
+        target = min(max(int(rf_new), 1), len(self.nodes))
+        if target == cur:
+            return 0
+        order = self._fn_order(fid, max(cur, target))
+        delta = 0
+        for node in order[cur:target]:
+            self.add_replica(fid, int(node))
+            delta += 1
+        for node in order[target:cur][::-1]:
+            self.drop_replica(fid, int(node))
+            delta -= 1
+        return delta
+
+    # -- checkpoint ----------------------------------------------------------
+    def state_arrays(self, rf_hint: np.ndarray | None = None
+                     ) -> dict[str, np.ndarray]:
+        """Sparse placement snapshot (functional mode); the dense parent
+        contract when ``sparse_checkpoint=False`` (the oracle).
+
+        ``rf_hint`` (the controller's ``current_rf``) anchors the
+        shard-intent reconstruction: intents are stored only where they
+        deviate from ``clip(current_rf, 1, ...)`` — never-applied files
+        and every plain rf migration reconstruct for free; deferred
+        conversions and capped-topology corners ride the sparse override.
+        Without a hint the intent vector is stored densely (correct, just
+        not O(exceptions) — direct library use outside the controller).
+        """
+        if not self._fn_sparse:
+            return super().state_arrays()
+        exc = self.exception_fids()
+        arrays: dict[str, np.ndarray] = {
+            "fault_fn_sparse": np.asarray([1], dtype=np.int8),
+            "fault_fn_seed": np.asarray([self._fn_seed], dtype=np.int64),
+            "fault_fn_epoch": np.asarray([self._fn_epoch], dtype=np.int64),
+            "fault_fn_exc_fids": exc,
+            "fault_fn_exc_rows": self.replica_map[exc].copy(),
+            "fault_node_up": self.node_up.copy(),
+            "fault_node_decommissioned": self.node_decommissioned.copy(),
+            "fault_node_partitioned": self.node_partitioned.copy(),
+            "fault_node_fail_prob": self.node_fail_prob.copy(),
+            "fault_node_throughput": self.node_throughput.copy(),
+        }
+        # Latent rot as sparse (file, slot) pairs.
+        if self._n_corrupt:
+            cf, cs = np.nonzero(self.slot_corrupt)
+            arrays["fault_fn_corrupt_fid"] = cf.astype(np.int64)
+            arrays["fault_fn_corrupt_slot"] = cs.astype(np.int32)
+        # Shard intent: sparse vs the current_rf reconstruction, or dense
+        # without a hint.
+        if rf_hint is not None:
+            default = np.clip(np.asarray(rf_hint, dtype=np.int64),
+                              1, None).astype(np.int32)
+            dev = np.flatnonzero(self.installed_shards != default)
+            arrays["fault_fn_intent_fids"] = dev.astype(np.int64)
+            arrays["fault_fn_intent_vals"] = \
+                self.installed_shards[dev].copy()
+        else:
+            arrays["fault_fn_intent_dense"] = self.installed_shards.copy()
+        # Storage-strategy state: sparse vs the replicate construction
+        # defaults (min_live=1, shard_bytes=size, ec_k=0) — empty for
+        # replicate-only runs, O(converted files) otherwise.
+        dev = np.flatnonzero((self.min_live != 1)
+                             | (self.shard_bytes != self.sizes)
+                             | (self.ec_k != 0))
+        arrays["fault_fn_strat_fids"] = dev.astype(np.int64)
+        arrays["fault_fn_strat_min_live"] = self.min_live[dev].copy()
+        arrays["fault_fn_strat_shard_bytes"] = self.shard_bytes[dev].copy()
+        arrays["fault_fn_strat_ec_k"] = self.ec_k[dev].copy()
+        return arrays
+
+    def load_state_arrays(self, arrays: dict) -> None:
+        if "fault_fn_sparse" not in arrays:
+            # A dense snapshot (the oracle's, or a hand-built one): the
+            # parent contract loads it; exception tracking restarts from
+            # a full-row verify of nothing (rows may deviate from base —
+            # mark everything deviating by one vectorized sweep).
+            super().load_state_arrays(arrays)
+            self._fn_touched = set()
+            self._fn_exceptions = set()
+            self._fn_exc_array = None
+            self._fn_mark_deviations()
+            return
+        n = self.replica_map.shape[0]
+        n_nodes = len(self.nodes)
+        if int(arrays["fault_fn_seed"][0]) != self._fn_seed:
+            raise ValueError(
+                f"checkpoint placement seed "
+                f"{int(arrays['fault_fn_seed'][0])} != {self._fn_seed} — "
+                f"stale checkpoint? delete it to start over")
+        self._fn_epoch = int(arrays["fault_fn_epoch"][0])
+        # Shard intent first: the base recompute depends on it.
+        if "fault_fn_intent_dense" in arrays:
+            self.installed_shards = np.asarray(
+                arrays["fault_fn_intent_dense"], dtype=np.int32).copy()
+        else:
+            if "current_rf" not in arrays:
+                raise ValueError(
+                    "sparse functional checkpoint needs the controller's "
+                    "current_rf for intent reconstruction")
+            self.installed_shards = np.clip(
+                np.asarray(arrays["current_rf"], dtype=np.int64), 1,
+                None).astype(np.int32)
+            fids = np.asarray(arrays["fault_fn_intent_fids"],
+                              dtype=np.int64)
+            self.installed_shards[fids] = np.asarray(
+                arrays["fault_fn_intent_vals"], dtype=np.int32)
+        # Strategy state from the replicate defaults + sparse overrides.
+        self.min_live = np.ones(n, dtype=np.int32)
+        self.shard_bytes = self.sizes.copy()
+        self.ec_k = np.zeros(n, dtype=np.int32)
+        sf = np.asarray(arrays.get("fault_fn_strat_fids",
+                                   np.zeros(0, np.int64)), dtype=np.int64)
+        if sf.size:
+            self.min_live[sf] = np.asarray(
+                arrays["fault_fn_strat_min_live"], dtype=np.int32)
+            self.shard_bytes[sf] = np.asarray(
+                arrays["fault_fn_strat_shard_bytes"], dtype=np.int64)
+            self.ec_k[sf] = np.asarray(
+                arrays["fault_fn_strat_ec_k"], dtype=np.int32)
+        # Recompute the base, then lay the exceptions over it.
+        self.replica_map = np.full((n, n_nodes), -1, dtype=np.int32)
+        chunk = 1 << 20
+        for lo in range(0, n, chunk):
+            hi = min(lo + chunk, n)
+            self.replica_map[lo:hi] = self._fn_base_rows(
+                np.arange(lo, hi, dtype=np.int64))
+        exc = np.asarray(arrays["fault_fn_exc_fids"], dtype=np.int64)
+        self.replica_map[exc] = np.asarray(arrays["fault_fn_exc_rows"],
+                                           dtype=np.int32)
+        # The snapshot's exceptions were verified at save time and the
+        # base recompute is deterministic — restore them as the standing
+        # set, nothing pending.
+        self._fn_touched = set()
+        self._fn_exceptions = set(int(f) for f in exc)
+        self._fn_exc_array = None
+        # Corruption + node status.
+        self.slot_corrupt = np.zeros((n, n_nodes), dtype=bool)
+        if "fault_fn_corrupt_fid" in arrays:
+            self.slot_corrupt[
+                np.asarray(arrays["fault_fn_corrupt_fid"], dtype=np.int64),
+                np.asarray(arrays["fault_fn_corrupt_slot"],
+                           dtype=np.int64)] = True
+        self._n_corrupt = int(self.slot_corrupt.sum())
+        self.node_up = np.asarray(arrays["fault_node_up"],
+                                  dtype=bool).copy()
+        self.node_decommissioned = np.asarray(
+            arrays["fault_node_decommissioned"], dtype=bool).copy()
+        self.node_partitioned = np.asarray(
+            arrays["fault_node_partitioned"], dtype=bool).copy()
+        self.node_fail_prob = np.asarray(
+            arrays["fault_node_fail_prob"], dtype=np.float64).copy()
+        self.node_throughput = np.asarray(
+            arrays["fault_node_throughput"], dtype=np.float64).copy()
+        self._recompute_node_bytes()
+        self._refresh_all()
+        self.version += 1
+
+    def _fn_mark_deviations(self, chunk: int = 1 << 20) -> None:
+        """Seed the standing-exception set with every row deviating from
+        base (one vectorized sweep) — dense-snapshot loads only."""
+        n = self.replica_map.shape[0]
+        for lo in range(0, n, chunk):
+            hi = min(lo + chunk, n)
+            base = self._fn_base_rows(np.arange(lo, hi, dtype=np.int64))
+            dev = np.flatnonzero((self.replica_map[lo:hi] != base)
+                                 .any(axis=1))
+            self._fn_exceptions.update(int(lo + f) for f in dev)

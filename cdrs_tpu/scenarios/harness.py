@@ -220,6 +220,7 @@ def _controller(spec: ScenarioSpec, manifest: Manifest,
         decay=spec.decay,
         default_rf=spec.default_rf,
         backend=spec.backend,
+        placement_mode=spec.placement,
         mesh_shape=dict(spec.mesh) if spec.mesh else None,
         kmeans=KMeansConfig(k=spec.k, seed=42),
         scoring=scoring,
@@ -285,6 +286,20 @@ def _check_invariants(spec: ScenarioSpec, records: list[dict],
             and all((r.get("mesh") or {}).get("devices") == ndev
                     for r in records)
             and any(r.get("recluster") for r in records))
+    if spec.placement != "materialized":
+        # The placement axis must actually FIRE: every window record
+        # carries the mode stamp (the controller only stamps it when the
+        # hash-chooser path is wired in), and a functional fault run
+        # additionally reports its exception count — a cell whose
+        # placement silently fell back to the legacy path fails instead
+        # of passing its other checks vacuously.
+        inv["functional_engaged"] = bool(
+            records
+            and all((r.get("placement") or {}).get("mode")
+                    == spec.placement for r in records)
+            and (spec.faults is None or spec.placement != "functional"
+                 or all("exceptions" in (r.get("placement") or {})
+                        for r in records)))
     integ = [r for r in records if r.get("integrity")]
     if integ:
         inv["zero_silent_loss"] = integ[-1]["integrity"]["true_lost"] == 0

@@ -126,6 +126,20 @@ def _presets() -> dict[str, ScenarioSpec]:
         drift={"kind": "flip", "at_frac": 0.5}, drift_threshold=0.02,
         resume_window=7)
 
+    # -- scale: functional placement ---------------------------------------
+    # A drift flip under --placement functional: the CRUSH-style hash
+    # chooser with exception-overlay checkpoints and a fault in the way
+    # (repair retargets ARE the exceptions), gated on the
+    # functional_engaged positive check plus a mid-cell kill/resume —
+    # the sparse-snapshot round trip must be bit-identical.
+    p["scale-placement"] = ScenarioSpec(
+        name="scale-placement", n_files=400, seed=14, duration=1800.0,
+        n_windows=15, k=12, nodes=_NODES6, racks=_RACKS6,
+        placement="functional",
+        drift={"kind": "flip", "at_frac": 0.5}, drift_threshold=0.02,
+        faults={"specs": ["crash:dn3@6-9"]},
+        serve={"policy": "p2c"}, resume_window=8)
+
     # -- workload curves / drift patterns ----------------------------------
     p["diurnal"] = ScenarioSpec(
         name="diurnal", n_files=300, seed=10, duration=1800.0,
@@ -217,7 +231,7 @@ SUITES: dict[str, tuple[tuple[str, ...], int]] = {
                   "rolling-decommission", "storage-ec", "serve-chaos",
                   "flash-crowd", "integrity-scrub", "integrity-read",
                   "diurnal", "adversarial-drift", "gradual-drift",
-                  "scale-mesh"), 2),
+                  "scale-mesh", "scale-placement"), 2),
     # Everything, including the slow legacy-reproduction preset.
     "full": (tuple(PRESETS), 4),
 }

@@ -100,6 +100,11 @@ class ScenarioSpec:
     full_recluster_drift: float = 0.30
     hysteresis: int = 1
     backend: str = "numpy"
+    #: Placement representation (ControllerConfig.placement_mode):
+    #: "materialized" (historical), "functional" (CRUSH-style hash
+    #: chooser + exception-overlay checkpoints + on-the-fly serve
+    #: resolution), or "materialized_hash" (the equivalence oracle).
+    placement: str = "materialized"
     #: Mid-cell kill/resume bit-identity check: kill after this window and
     #: resume from the checkpoint, asserting the stitched record stream
     #: equals the uninterrupted run's.  None = not sampled for this cell.
@@ -135,6 +140,12 @@ class ScenarioSpec:
             raise ValueError(
                 f"cell {self.name!r}: scrub requires a faults axis (the "
                 f"scrubber verifies the fault path's cluster state)")
+        if self.placement not in ("materialized", "functional",
+                                  "materialized_hash"):
+            raise ValueError(
+                f"cell {self.name!r}: unknown placement "
+                f"{self.placement!r} (want 'materialized', 'functional' "
+                f"or 'materialized_hash')")
         if self.mesh is not None:
             # Kept jax-import-free (specs parse anywhere): the full axis
             # validation re-runs in ControllerConfig/validate_mesh_shape.

@@ -25,14 +25,17 @@ from .router import (
     WindowServeResult,
     emit_window_telemetry,
 )
+from .view import ReadView, read_view
 
 __all__ = [
     "POLICIES",
     "HotspotDetector",
     "HotspotResult",
     "ReadRouter",
+    "ReadView",
     "ServeConfig",
     "SloSpec",
     "WindowServeResult",
     "emit_window_telemetry",
+    "read_view",
 ]

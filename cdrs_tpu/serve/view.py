@@ -1,0 +1,73 @@
+"""One shared resolution of "what can serve this window's reads".
+
+Three surfaces used to hand the router its inputs with their own copies
+of the same branch — ``cdrs serve`` (cli.py), the controller's serve
+wiring (control/controller.py) and the chaos replay — each deciding
+between the mutable fault state and a static placement inline.  That
+duplication is exactly where the functional placement mode must plug in
+(resolve ONLY the window's files, O(unique pids) memory instead of the
+O(n_files x rf) materialized map), so the branch lives here once:
+
+* ``state=``      — the fault path: the live ``ClusterState``'s dense
+  map, reachability mask, straggler factors and (when rot exists) the
+  corruption mask;
+* ``resolver=``   — the functional path: a callable mapping unique file
+  ids to their computed slot rows; the view's map is (n_unique, R) and
+  ``pid`` is remapped onto it — the O(1)-memory router;
+* ``placement=``  — the materialized static path (legacy behaviour).
+
+The router (serve/router.py) only ever indexes ``replica_map[pid]``, so
+a compacted per-window map with remapped pids routes bit-identically to
+the full map — the equivalence the functional mode's serve-locality
+check rests on (tests/test_placement_fn.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["ReadView", "read_view"]
+
+
+@dataclass
+class ReadView:
+    """Router inputs for one window's reads (see module docstring)."""
+
+    replica_map: np.ndarray          # (n_files | n_unique, R) int32
+    slot_ok: np.ndarray              # same shape, bool
+    node_throughput: np.ndarray      # (n_nodes,) float64
+    slot_corrupt: np.ndarray | None  # same shape as replica_map, or None
+    pid: np.ndarray                  # read file ids, remapped if compacted
+
+
+def read_view(pid: np.ndarray, *, state=None, placement=None,
+              resolver=None, n_nodes: int | None = None) -> ReadView:
+    """Resolve the serving view for ``pid`` from exactly one source.
+
+    ``state`` wins (the live fault path), then ``resolver`` (functional
+    subset resolution; needs ``n_nodes``), then ``placement`` (static
+    materialized map).  ``resolver(unique_pids) -> (k, R) int32 rows``
+    must return -1-padded slot rows — ``placement_fn.compute_placement``
+    output, plus any exception overlay the caller maintains.
+    """
+    if state is not None:
+        corrupt = state.slot_corrupt if state.has_corruption else None
+        return ReadView(state.replica_map, state.reachable_mask(),
+                        state.node_throughput, corrupt, pid)
+    if resolver is not None:
+        if n_nodes is None:
+            raise ValueError("read_view(resolver=...) needs n_nodes for "
+                             "the throughput vector")
+        uniq, inv = np.unique(pid, return_inverse=True)
+        rows = np.asarray(resolver(uniq), dtype=np.int32)
+        return ReadView(rows, rows >= 0, np.ones(n_nodes), None,
+                        inv.astype(pid.dtype if pid.dtype.kind == "i"
+                                   else np.int64))
+    if placement is None:
+        raise ValueError("read_view needs one of state=, resolver=, "
+                         "placement=")
+    rm = placement.replica_map
+    return ReadView(rm, rm >= 0, np.ones(len(placement.topology)), None,
+                    pid)

@@ -33,7 +33,8 @@ class CheckpointError(RuntimeError):
     ``save_state`` keeps (the controller does — control/controller.py)."""
 
 
-def save_state(path: str, arrays: dict, meta: dict | None = None) -> None:
+def save_state(path: str, arrays: dict,
+               meta: dict | None = None) -> dict:
     """Atomic npz snapshot (write temp + rename) with a JSON meta blob.
 
     The previous snapshot, when one exists, is retained as ``<path>.prev``
@@ -42,7 +43,18 @@ def save_state(path: str, arrays: dict, meta: dict | None = None) -> None:
     one-older fallback behind it.  ``path`` itself never transiently
     disappears: the link is created first and the new snapshot replaces
     ``path`` atomically — deleting ``path`` by hand therefore always means
-    "start over", never "resume from .prev"."""
+    "start over", never "resume from .prev".
+
+    Returns ``{"bytes": <on-disk size>, "seconds": <wall clock>}`` and —
+    when a telemetry instrument is active (obs/) — emits the
+    ``checkpoint.bytes`` / ``checkpoint.save_seconds`` gauges and a
+    ``checkpoint.saves`` counter: checkpoint size is the observable the
+    functional placement mode exists to shrink (O(exceptions) vs
+    O(n_files x rf) — ROADMAP item 3), so every save reports it.
+    """
+    import time
+
+    t_start = time.perf_counter()
     payload = {k: np.asarray(v) for k, v in arrays.items()}
     payload["__meta__"] = np.frombuffer(
         json.dumps(meta or {}).encode(), dtype=np.uint8)
@@ -88,6 +100,16 @@ def save_state(path: str, arrays: dict, meta: dict | None = None) -> None:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    stats = {"bytes": int(os.path.getsize(path)),
+             "seconds": round(time.perf_counter() - t_start, 6)}
+    from ..obs import current as _obs_current
+
+    tel = _obs_current()
+    if tel is not None:
+        tel.gauge("checkpoint.bytes", stats["bytes"])
+        tel.gauge("checkpoint.save_seconds", stats["seconds"])
+        tel.counter_inc("checkpoint.saves")
+    return stats
 
 
 def load_state(path: str) -> tuple[dict, dict]:
