@@ -147,20 +147,27 @@ def _bench_recompute(n: int, rounds: int) -> dict:
 
 def _damaged_state(n: int, sparse: bool) -> FunctionalClusterState:
     """A fault-damaged functional state (same base + same mutations on
-    both representations — only the serialization differs)."""
+    both representations).  ``sparse`` builds the OVERLAY backend —
+    what ``--placement functional`` actually runs since the resident
+    dense cache was retired (ROADMAP item 3's leftover): no dense map
+    is materialized at any point, so both the checkpoint bytes AND the
+    resident state are O(exceptions) + O(n) count caches."""
     from ..faults import FaultEvent, RepairScheduler
-    from ..placement_fn import primary_on_topology
+    from ..placement_fn import OverlayClusterState, primary_on_topology
 
     topo = ClusterTopology.from_racks(_NODES12, _RACKS12)
     man = _ArrayManifest(n, _NODES12, seed=5)
     rng = np.random.default_rng(5)
     rf = rng.integers(2, 4, n).astype(np.int32)
-    placement = place_replicas(man, rf, topo, seed=0, method="hash")
-    state = FunctionalClusterState(
-        placement, man.size_bytes,
-        primary=primary_on_topology(man.nodes, man.primary_node_id,
-                                    topo),
-        seed=0, sparse_checkpoint=sparse)
+    primary = primary_on_topology(man.nodes, man.primary_node_id, topo)
+    if sparse:
+        state = OverlayClusterState.from_base(
+            topo, man.size_bytes, n_shards=rf, primary=primary, seed=0)
+    else:
+        placement = place_replicas(man, rf, topo, seed=0, method="hash")
+        state = FunctionalClusterState(
+            placement, man.size_bytes, primary=primary,
+            seed=0, sparse_checkpoint=False)
     state.apply_event(FaultEvent(0, "crash", "dn4"))
     # One budgeted repair window: the retargets it admits are exactly
     # the exceptions the sparse snapshot must carry.
@@ -174,10 +181,19 @@ def _damaged_state(n: int, sparse: bool) -> FunctionalClusterState:
 
 
 def _bench_checkpoint(n: int) -> dict:
+    """Checkpoint bytes AND resident placement-state bytes, dense vs
+    overlay: the overlay (what functional mode runs) holds no
+    (n, n_nodes) map or corruption mask at all, so its resident
+    placement arrays are the O(n) count caches plus O(exceptions) —
+    the ROADMAP item 3 leftover, measured."""
     out: dict = {"n_files": n}
     rf_hint = None
-    for label, sparse in (("dense", False), ("sparse", True)):
+    # Overlay FIRST: peak RSS is monotonic, so its resident footprint
+    # must be observed before the dense twin allocates its map.
+    for label, sparse in (("sparse", True), ("dense", False)):
         state = _damaged_state(n, sparse)
+        out[f"{label}_resident_mb"] = round(
+            _state_resident_bytes(state) / 1e6, 1)
         if sparse:
             rf_hint = np.maximum(state.installed_shards, 1)
             arrays = state.state_arrays(rf_hint=rf_hint)
@@ -194,7 +210,30 @@ def _bench_checkpoint(n: int) -> dict:
         del state, arrays
     out["bytes_ratio"] = round(out["dense_bytes"]
                                / max(out["sparse_bytes"], 1), 2)
+    out["resident_ratio"] = round(
+        out["dense_resident_mb"] / max(out["sparse_resident_mb"], 0.1),
+        2)
     return out
+
+
+def _state_resident_bytes(state) -> int:
+    """Resident bytes of a ClusterState's PLACEMENT arrays (dense map +
+    corruption mask when they exist as real arrays, count caches,
+    overlay rows) — the term the lowmem backend exists to shrink."""
+    total = 0
+    for name in ("replica_map", "slot_corrupt"):
+        arr = state.__dict__.get(name)   # properties don't count
+        if arr is not None:
+            total += arr.nbytes
+    for name in ("_live_counts", "_reach_counts", "_dom_spread",
+                 "installed_shards", "min_live", "ec_k"):
+        arr = getattr(state, name, None)
+        if arr is not None:
+            total += arr.nbytes
+    ov = getattr(state, "_ov", None)
+    if ov:
+        total += sum(r.nbytes for r in ov.values())
+    return total
 
 
 # -- epoch diff vs materialized plan diff ------------------------------------
@@ -356,6 +395,9 @@ def run_placement_bench(*, recompute_n: int, checkpoint_n: int,
          "unit": "M/s", "backend": "numpy"},
         {"metric": "placement_checkpoint_ratio",
          "value": out["checkpoint"]["bytes_ratio"], "unit": "x",
+         "backend": "numpy"},
+        {"metric": "placement_resident_ratio",
+         "value": out["checkpoint"]["resident_ratio"], "unit": "x",
          "backend": "numpy"},
         {"metric": "placement_epoch_diff_speedup",
          "value": out["epoch_diff"]["speedup"], "unit": "x",

@@ -564,7 +564,30 @@ def _cmd_chaos(args) -> int:
 
     manifest = Manifest.read_csv(args.manifest)
     topology = None
-    if args.racks:
+    if getattr(args, "topology", None):
+        if args.racks:
+            print("error: --topology and --racks are mutually exclusive "
+                  "(the hierarchy spec subsumes the rack map)",
+                  file=sys.stderr)
+            return 2
+        from .cluster import ClusterTopology
+
+        text = args.topology
+        if not text.lstrip().startswith("{"):
+            with open(text, encoding="utf-8") as f:
+                text = f.read()
+        try:
+            topology = ClusterTopology.from_hierarchy(json.loads(text))
+        except ValueError as e:
+            # from_hierarchy names the offending level/node/group.
+            print(f"error: bad --topology spec: {e}", file=sys.stderr)
+            return 2
+        unknown = sorted(set(manifest.nodes) - set(topology.nodes))
+        if unknown:
+            print(f"error: --topology is missing manifest nodes "
+                  f"{unknown}", file=sys.stderr)
+            return 2
+    elif args.racks:
         from .cluster import ClusterTopology
 
         topology = ClusterTopology.from_rack_spec(manifest.nodes,
@@ -1246,6 +1269,19 @@ def main(argv: list[str] | None = None) -> int:
                         "'r0=dn1,dn2;r1=dn3,dn4' — placement spreads "
                         "replicas across racks, durability accounting "
                         "gains the correlated-risk tier")
+    p.add_argument("--topology", default=None, metavar="JSON|FILE",
+                   help="geo-hierarchical failure domains (inline JSON "
+                        "or a file): {'nodes': [...], 'levels': "
+                        "['rack', 'region'], 'rack': {'r0': "
+                        "['dn1','dn2'], ...}, 'region': {'eu': "
+                        "['r0','r1'], ...}, 'edge_bytes': {...}, "
+                        "'edge_latency': {...}} — placement spreads "
+                        "replicas across the HIGHEST level first, "
+                        "repair charges WAN copies their edge byte "
+                        "cost, durability reports per-level correlated "
+                        "risk, and fault specs accept domain scopes "
+                        "(crash:region:eu@3-7).  Mutually exclusive "
+                        "with --racks")
     p.add_argument("--partition", action="append",
                    metavar="NODES@W[-W2]",
                    help="network-partition a '+'-joined node set over "

@@ -66,13 +66,42 @@ class ClusterTopology:
     one real datanode and imagines three (SURVEY.md §5 note); here the node
     set is explicit, and each node maps to a failure domain (rack/zone) so
     correlated failures — a rack losing power, a switch partitioning half
-    the cluster — are expressible."""
+    the cluster — are expressible.
+
+    **Hierarchy** (geo-hierarchical topologies, ROADMAP item 6): ``levels``
+    stacks coarser failure domains ON TOP of the base ``domains`` level —
+    CRUSH's host -> rack -> row/region -> datacenter bucket tree.  Each
+    entry is ``(level_name, per-node domain names)``, finest first, and
+    every level must be a strict coarsening of the level below (a rack
+    split across two regions is a spec bug, rejected by name).  Per-edge
+    ``edge_bytes``/``edge_latency`` multipliers price a copy/read that
+    crosses each boundary class (off-rack, off-region, ...; WAN ≫ rack);
+    empty = all 1.0, which keeps every byte/latency account bit-identical
+    to the pre-hierarchy behaviour.  A topology without ``levels``
+    degenerates bit-for-bit to the historical one-level semantics."""
 
     nodes: tuple[str, ...] = ("dn1", "dn2", "dn3")
     #: Per-node failure-domain name, parallel to ``nodes``.  Empty = every
     #: node is its own domain (the flat topology: node loss IS domain loss,
     #: and domain-aware placement reduces to the distinct-node policy).
     domains: tuple[str, ...] = ()
+    #: Hierarchy levels ABOVE the base domain, finest first: each entry is
+    #: ``(level_name, per-node domain names parallel to nodes)``.  Empty =
+    #: the historical one-level topology.
+    levels: tuple = ()
+    #: Byte-cost multipliers per boundary class, one per hierarchy level
+    #: including the base (``(off-domain, off-level-1, ...)``): a repair
+    #: copy whose route crosses class ``c`` charges ``edge_bytes[c-1]`` x
+    #: its wire bytes against the churn budget.  Empty = all 1.0.
+    edge_bytes: tuple = ()
+    #: Latency multipliers, same indexing: a read served across class
+    #: ``c`` adds ``(edge_latency[c-1] - 1) x service_ms`` propagation
+    #: delay.  Empty = all 1.0.
+    edge_latency: tuple = ()
+    #: Name of the base ``domains`` level (hierarchy specs; cosmetic for
+    #: flat topologies).  Region-scoped fault events (``crash:region:eu``)
+    #: resolve level tokens against this plus the ``levels`` names.
+    domain_level_name: str = "rack"
 
     def __post_init__(self):
         self.nodes = tuple(self.nodes)
@@ -91,25 +120,154 @@ class ClusterTopology:
                 f"domains has {len(self.domains)} entries for "
                 f"{len(self.nodes)} nodes — must be parallel to nodes "
                 f"(one failure-domain name per node)")
+        self.levels = tuple((str(nm), tuple(str(d) for d in doms))
+                            for nm, doms in self.levels)
+        if self.levels and not self.domains:
+            raise ValueError(
+                "hierarchy levels require a base domains level (the "
+                "finest failure domain) — give every node a domain")
+        for nm, doms in self.levels:
+            if len(doms) != len(self.nodes):
+                raise ValueError(
+                    f"hierarchy level {nm!r} has {len(doms)} entries for "
+                    f"{len(self.nodes)} nodes — must be parallel to nodes")
+        # Strict coarsening: two nodes sharing a domain at level i must
+        # share it at every level above, or a "rack" straddles two
+        # "regions" and the failure-domain math silently lies.
+        below_name = self.domain_level_name or "domain"
+        below = self.domains
+        for nm, doms in self.levels:
+            owner: dict[str, tuple[str, str]] = {}
+            for node, lo, hi in zip(self.nodes, below, doms):
+                if lo in owner and owner[lo][0] != hi:
+                    raise ValueError(
+                        f"hierarchy level {nm!r}: {below_name} {lo!r} "
+                        f"spans {hi!r} (node {node!r}) and "
+                        f"{owner[lo][0]!r} (node {owner[lo][1]!r}) — "
+                        f"each {below_name} must nest inside exactly "
+                        f"one {nm}")
+                owner.setdefault(lo, (hi, node))
+            below_name, below = nm, doms
+        # Domain LUTs once (the former per-call rebuild in
+        # n_domains/domain_spread was O(nodes) per query): one names
+        # tuple + one int32 index array per level, base first.  Built
+        # BEFORE the edge validation below, which names the boundary
+        # classes in its error message.
+        self._level_names = (self.domain_level_name or "rack",) + tuple(
+            nm for nm, _ in self.levels)
+        for label, edges in (("edge_bytes", self.edge_bytes),
+                             ("edge_latency", self.edge_latency)):
+            edges = tuple(float(x) for x in edges)
+            setattr(self, label, edges)
+            if edges and len(edges) != self.n_levels + 1:
+                raise ValueError(
+                    f"{label} has {len(edges)} entries for "
+                    f"{self.n_levels + 1} boundary classes "
+                    f"({self._class_names()}) — one multiplier per class")
+            if any(x < 1.0 for x in edges):
+                raise ValueError(
+                    f"{label} multipliers must be >= 1.0 (crossing a "
+                    f"boundary is never cheaper than staying inside), "
+                    f"got {edges}")
+        self._dom_names: list[tuple[str, ...]] = []
+        self._dom_index: list[np.ndarray] = []
+        for doms in (self.domains if self.domains else self.nodes,
+                     *(d for _, d in self.levels)):
+            names = tuple(dict.fromkeys(doms))
+            idx = {d: i for i, d in enumerate(names)}
+            self._dom_names.append(names)
+            self._dom_index.append(np.asarray([idx[d] for d in doms],
+                                              dtype=np.int32))
 
     def __len__(self) -> int:
         return len(self.nodes)
 
+    # -- hierarchy accessors (level 0 = base domains) -----------------------
+    @property
+    def n_levels(self) -> int:
+        """Hierarchy levels ABOVE the base domain (0 = historical)."""
+        return len(self.levels)
+
+    @property
+    def level_names(self) -> tuple[str, ...]:
+        """Level names, base first (``rack`` unless renamed)."""
+        return self._level_names
+
+    def domain_names_at(self, level: int) -> tuple[str, ...]:
+        """Distinct domain names of one level, first-appearance order."""
+        return self._dom_names[level]
+
+    def domain_index_at(self, level: int) -> np.ndarray:
+        """(n_nodes,) int32 domain ids at ``level`` (cached; read-only)."""
+        return self._dom_index[level]
+
+    def n_domains_at(self, level: int) -> int:
+        return len(self._dom_names[level])
+
+    def top_domain_index(self) -> np.ndarray:
+        """(n_nodes,) int32 ids at the COARSEST level (regions when the
+        hierarchy has them; the base domains otherwise)."""
+        return self._dom_index[self.n_levels]
+
+    def nodes_in(self, level_name: str, domain: str) -> tuple[str, ...]:
+        """Node names inside one named domain of one named level — the
+        region-scoped fault expansion (``crash:region:eu``)."""
+        if level_name not in self._level_names:
+            raise ValueError(
+                f"unknown hierarchy level {level_name!r} (this topology "
+                f"has {self._level_names})")
+        lvl = self._level_names.index(level_name)
+        if domain not in self._dom_names[lvl]:
+            raise ValueError(
+                f"level {level_name!r} has no domain {domain!r} "
+                f"(have {self._dom_names[lvl]})")
+        want = self._dom_names[lvl].index(domain)
+        idx = self._dom_index[lvl]
+        return tuple(n for n, d in zip(self.nodes, idx) if d == want)
+
+    def _class_names(self) -> tuple[str, ...]:
+        return tuple(f"off-{nm}" for nm in self._level_names)
+
+    def separation(self) -> np.ndarray:
+        """(n_nodes, n_nodes) int8 boundary class between node pairs:
+        0 = same base domain, c >= 1 = the pair first reunites at level
+        ``c`` (c = n_levels + 1: different top-level domains — WAN)."""
+        n = len(self.nodes)
+        sep = np.zeros((n, n), dtype=np.int8)
+        for lvl in range(self.n_levels + 1):
+            idx = self._dom_index[lvl]
+            sep[idx[:, None] != idx[None, :]] = lvl + 1
+        return sep
+
+    def byte_cost_matrix(self) -> np.ndarray:
+        """(n_nodes, n_nodes) float64 per-copy byte-cost multiplier (all
+        ones without ``edge_bytes`` — bit-identical accounting)."""
+        return self._edge_matrix(self.edge_bytes)
+
+    def latency_matrix(self) -> np.ndarray:
+        """(n_nodes, n_nodes) float64 read-latency multiplier."""
+        return self._edge_matrix(self.edge_latency)
+
+    def _edge_matrix(self, edges: tuple) -> np.ndarray:
+        n = len(self.nodes)
+        if not edges:
+            return np.ones((n, n), dtype=np.float64)
+        mult = np.asarray((1.0,) + tuple(edges), dtype=np.float64)
+        return mult[self.separation()]
+
     @property
     def domain_names(self) -> tuple[str, ...]:
-        """Distinct domain names in first-appearance order."""
-        src = self.domains if self.domains else self.nodes
-        return tuple(dict.fromkeys(src))
+        """Distinct base-domain names in first-appearance order."""
+        return self._dom_names[0]
 
     @property
     def n_domains(self) -> int:
-        return len(self.domain_names)
+        return len(self._dom_names[0])
 
     def domain_index(self) -> np.ndarray:
-        """(n_nodes,) int32: each node's domain id (domain_names order)."""
-        src = self.domains if self.domains else self.nodes
-        idx = {d: i for i, d in enumerate(self.domain_names)}
-        return np.asarray([idx[d] for d in src], dtype=np.int32)
+        """(n_nodes,) int32: each node's base-domain id (cached —
+        computed once in ``__post_init__``; treat as read-only)."""
+        return self._dom_index[0]
 
     @classmethod
     def from_racks(cls, nodes, racks: dict) -> "ClusterTopology":
@@ -160,6 +318,142 @@ class ClusterTopology:
         if not racks:
             raise ValueError(f"rack spec {spec!r} names no nodes")
         return cls.from_racks(nodes, racks)
+
+    @classmethod
+    def from_hierarchy(cls, spec: dict) -> "ClusterTopology":
+        """Topology from a hierarchy spec dict (the ``--topology JSON``
+        CLI contract)::
+
+            {"nodes": ["dn1", ...],
+             "levels": ["rack", "region"],          # finest first
+             "rack":   {"r0": ["dn1", "dn2"], ...}, # groups NODES
+             "region": {"eu": ["r0", "r1"], ...},   # groups racks
+             "edge_bytes":   {"rack": 1.0, "region": 4.0},   # optional
+             "edge_latency": {"rack": 2.0, "region": 20.0}}  # optional
+
+        Level 0 groups nodes; level i groups level i-1's domain names.
+        Every validation error names the offending level and node/group —
+        a mis-typed hierarchy must fail loudly, not flatten silently.
+        A one-entry ``levels`` list degenerates to the plain rack
+        topology (``levels=()`` — bit-for-bit the historical policy).
+        """
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"topology spec must be a JSON object, got "
+                f"{type(spec).__name__}")
+        known = {"nodes", "levels", "edge_bytes", "edge_latency"}
+        level_names = [str(x) for x in spec.get("levels", ())]
+        if not level_names:
+            raise ValueError(
+                "topology spec needs 'levels': an ordered list of "
+                "hierarchy level names, finest first (e.g. "
+                "['rack', 'region'])")
+        dupes = sorted({x for x in level_names
+                        if level_names.count(x) > 1})
+        if dupes:
+            raise ValueError(f"duplicate level names in spec: {dupes}")
+        unknown = sorted(set(spec) - known - set(level_names))
+        if unknown:
+            raise ValueError(
+                f"unknown topology spec keys {unknown} (want nodes/"
+                f"levels/edge_bytes/edge_latency plus one group map per "
+                f"level in {level_names})")
+        nodes = tuple(str(n) for n in spec.get("nodes", ()))
+        if not nodes:
+            raise ValueError("topology spec needs a non-empty 'nodes'")
+        # Resolve each level bottom-up: members of level i are level
+        # i-1's domain names (nodes at i = 0).
+        member_domain: dict[str, str] = {}   # member -> its domain, per lvl
+        members = nodes
+        per_node: list[tuple[str, ...]] = []   # per-level node domains
+        node_dom = {n: n for n in nodes}       # node -> domain at lvl-1
+        for lvl, name in enumerate(level_names):
+            groups = spec.get(name)
+            if not isinstance(groups, dict) or not groups:
+                raise ValueError(
+                    f"level {name!r}: spec needs a non-empty group map "
+                    f"{{domain: [members]}} under the {name!r} key")
+            member_domain = {}
+            for dom, mem in groups.items():
+                for m in mem:
+                    m = str(m)
+                    if m not in members:
+                        kind = "node" if lvl == 0 else level_names[lvl - 1]
+                        raise ValueError(
+                            f"level {name!r}: group {dom!r} names "
+                            f"unknown {kind} {m!r} (have "
+                            f"{sorted(members)})")
+                    if m in member_domain:
+                        raise ValueError(
+                            f"level {name!r}: {m!r} appears in both "
+                            f"{member_domain[m]!r} and {dom!r} — a "
+                            f"member belongs to exactly one domain")
+                    member_domain[m] = str(dom)
+            missing = sorted(set(members) - set(member_domain))
+            if missing:
+                kind = "node" if lvl == 0 else level_names[lvl - 1]
+                raise ValueError(
+                    f"level {name!r}: {kind} {missing[0]!r} is not "
+                    f"assigned to any {name} group "
+                    f"(unassigned: {missing})")
+            node_dom = {n: member_domain[node_dom[n]] for n in nodes}
+            per_node.append(tuple(node_dom[n] for n in nodes))
+            members = tuple(dict.fromkeys(member_domain.values()))
+
+        def _edges(key: str) -> tuple:
+            raw = spec.get(key)
+            if raw is None:
+                return ()
+            if isinstance(raw, dict):
+                bad = sorted(set(raw) - set(level_names))
+                if bad:
+                    raise ValueError(
+                        f"{key} names unknown level {bad[0]!r} "
+                        f"(levels: {level_names})")
+                miss = [x for x in level_names if x not in raw]
+                if miss:
+                    raise ValueError(
+                        f"{key} is missing a multiplier for level "
+                        f"{miss[0]!r} — give one per level or omit the "
+                        f"key entirely")
+                return tuple(float(raw[x]) for x in level_names)
+            return tuple(float(x) for x in raw)
+
+        return cls(
+            nodes=nodes, domains=per_node[0],
+            levels=tuple((level_names[i], per_node[i])
+                         for i in range(1, len(level_names))),
+            edge_bytes=_edges("edge_bytes"),
+            edge_latency=_edges("edge_latency"),
+            domain_level_name=level_names[0])
+
+    def to_hierarchy_dict(self) -> dict:
+        """The ``from_hierarchy`` spec of this topology (round-trip)."""
+        out: dict = {"nodes": list(self.nodes),
+                     "levels": list(self.level_names)}
+        for lvl, name in enumerate(self.level_names):
+            doms = (self.domains if lvl == 0 else self.levels[lvl - 1][1])
+            groups: dict[str, list[str]] = {}
+            if lvl == 0:
+                for n, d in zip(self.nodes, doms):
+                    groups.setdefault(d, []).append(n)
+            else:
+                lower = (self.domains if lvl == 1
+                         else self.levels[lvl - 2][1])
+                seen = set()
+                for lo, hi in zip(lower, doms):
+                    if lo not in seen:
+                        seen.add(lo)
+                        groups.setdefault(hi, []).append(lo)
+            out[name] = groups
+        if self.edge_bytes:
+            out["edge_bytes"] = {nm: x for nm, x in
+                                 zip(self.level_names, self.edge_bytes)}
+        if self.edge_latency:
+            out["edge_latency"] = {nm: x for nm, x in
+                                   zip(self.level_names,
+                                       self.edge_latency)}
+        return out
 
 
 @dataclass
@@ -213,6 +507,7 @@ def place_replicas(
     seed: int | None = 0,
     size_bytes: np.ndarray | None = None,
     method: str = "rng",
+    local_mask: np.ndarray | None = None,
 ) -> PlacementResult:
     """Place ``rf_per_file`` replicas of each file onto the topology.
 
@@ -269,7 +564,7 @@ def place_replicas(
 
         replica_map, rf = compute_placement(
             np.arange(n, dtype=np.int64), rf, primary, topology,
-            0 if seed is None else int(seed))
+            0 if seed is None else int(seed), local_mask=local_mask)
         result = PlacementResult(replica_map=replica_map, rf=rf,
                                  topology=topology)
         result.compute_storage(manifest.size_bytes if size_bytes is None
@@ -283,6 +578,46 @@ def place_replicas(
     # Random priorities per (file, node); the sort key starts as the raw
     # priorities and gets the structured slots forced to the front.
     prio = rng.random((n, n_nodes))
+    if topology.n_levels > 0:
+        # Geo-hierarchical topology: the SAME greedy highest-level-first
+        # policy as the hash chooser (placement_fn.hierarchical_fill —
+        # one structural policy, two priority sources), fed rng-packed
+        # priorities.  One-level topologies never reach here: the legacy
+        # path below stays bit-for-bit.
+        from ..placement_fn.compute import (
+            PRIO_MAX,
+            clip_shards_for_locality,
+            hierarchical_fill,
+        )
+
+        if n_nodes > 63:
+            raise ValueError(
+                f"hierarchical placement supports up to 63 nodes "
+                f"(6-bit packed node ids), got {n_nodes}")
+        rf = clip_shards_for_locality(rf, primary, topology, local_mask)
+        max_rf = int(rf.max()) if n else 1
+        packed = ((prio * (1 << 26)).astype(np.uint32) << np.uint32(6)) \
+            | np.arange(n_nodes, dtype=np.uint32)[None, :]
+        w = np.ascontiguousarray(packed.T)
+        cols = np.arange(n)
+        replica_map = np.empty((n, max_rf), dtype=np.int32)
+        replica_map[:, 0] = primary
+        w[primary, cols] = PRIO_MAX
+        if local_mask is not None:
+            lc = np.asarray(local_mask, dtype=bool)
+            if lc.any():
+                dt = topology.top_domain_index()
+                w[(dt[:, None] != dt[primary][None, :])
+                  & lc[None, :]] = PRIO_MAX
+        if max_rf >= 2:
+            hierarchical_fill(w, replica_map, primary, max_rf, topology)
+        mask = np.arange(max_rf)[None, :] < rf[:, None]
+        replica_map[~mask] = -1
+        result = PlacementResult(replica_map=replica_map, rf=rf,
+                                 topology=topology)
+        result.compute_storage(manifest.size_bytes if size_bytes is None
+                               else size_bytes)
+        return result
     key = prio.copy()
     key[np.arange(n), primary] = -3.0           # replica 0: the primary
     dom = topology.domain_index()
@@ -326,6 +661,7 @@ def place_stripes(
     seed: int | None = 0,
     shard_bytes: np.ndarray | None = None,
     method: str = "rng",
+    local_mask: np.ndarray | None = None,
 ) -> PlacementResult:
     """Vectorized stripe placement for storage strategies (cdrs_tpu/storage).
 
@@ -340,4 +676,5 @@ def place_stripes(
     ``storage_per_node`` is computed from ``shard_bytes`` when given.
     """
     return place_replicas(manifest, shards_per_file, topology, seed,
-                          size_bytes=shard_bytes, method=method)
+                          size_bytes=shard_bytes, method=method,
+                          local_mask=local_mask)

@@ -4,12 +4,14 @@ re-cluster -> bounded-churn migration (see control/controller.py)."""
 from .controller import ControllerConfig, ControllerResult, \
     ReplicationController
 from .drift import DriftReport, detect_drift
+from .elastic import ElasticPolicy
 from .migrate import MigrationScheduler, PlanMove, plan_diff
 from .windows import iter_windows
 
 __all__ = [
     "ControllerConfig", "ControllerResult", "ReplicationController",
     "DriftReport", "detect_drift",
+    "ElasticPolicy",
     "MigrationScheduler", "PlanMove", "plan_diff",
     "iter_windows",
 ]

@@ -142,6 +142,16 @@ class ControllerConfig:
     #: the silent corruption it finds into the repair queue.  The scrub
     #: cursor and read-detection hint queue ride the npz checkpoint.
     scrub: object | None = None
+    #: Elastic capacity (control/elastic.ElasticPolicy): when set, the
+    #: controller watches the serve layer's per-window SLO burn and
+    #: utilization; sustained heat activates the standby pool (topology
+    #: grows, the addition-pruned epoch diff becomes a budgeted
+    #: rebalance queue) and sustained cool rolls the added nodes back
+    #: out via rolling decommission.  Requires ``serve`` (the telemetry
+    #: source) and a hash placement mode (the epoch diff); implies the
+    #: fault machinery (an empty schedule is synthesized when none is
+    #: given).
+    elastic: object | None = None
     #: Placement representation (placement_fn/, ROADMAP item 3):
     #: ``"materialized"`` (default) is the historical rng chooser + dense
     #: replica-map state — byte-identical to every pre-placement-mode
@@ -204,6 +214,17 @@ class ControllerConfig:
             raise ValueError(
                 f"unknown placement_mode {self.placement_mode!r} (want "
                 f"'materialized', 'functional' or 'materialized_hash')")
+        if self.elastic is not None:
+            if self.serve is None:
+                raise ValueError(
+                    "elastic requires serve (the SLO-burn/utilization "
+                    "telemetry that drives the scale decisions)")
+            if self.placement_mode == "materialized":
+                raise ValueError(
+                    "elastic scale-out requires a hash placement mode "
+                    "('functional' or 'materialized_hash') — the "
+                    "rebalance plan is the addition-pruned epoch diff, "
+                    "which only the stateless chooser can answer")
 
 
 @dataclass
@@ -420,12 +441,19 @@ class ReplicationController:
         #: rows can be recomputed for any file subset.
         self._hash_placement = cfg.placement_mode != "materialized"
         self._placement_method = "hash" if self._hash_placement else "rng"
-        #: Fault-tolerance state (faults/): only when a schedule is set.
+        #: Fault-tolerance state (faults/): when a schedule is set, or
+        #: when elasticity needs the mutable cluster (drains decommission
+        #: through it; growth extends it).
         self._cluster_state = None
         self._repairs = None
-        if cfg.fault_schedule is not None:
+        #: The RESOLVED schedule (region scopes expanded against the
+        #: topology) — the one phase B consumes.
+        self._fault_schedule = None
+        self._elastic = None
+        if cfg.fault_schedule is not None or cfg.elastic is not None:
             from ..cluster import ClusterTopology, place_replicas
-            from ..faults import ClusterState, RepairScheduler
+            from ..faults import ClusterState, FaultSchedule, \
+                RepairScheduler
 
             topology = cfg.topology or ClusterTopology(
                 nodes=tuple(manifest.nodes))
@@ -434,25 +462,55 @@ class ReplicationController:
                     f"topology nodes {tuple(topology.nodes)} != manifest "
                     f"nodes {tuple(manifest.nodes)} — the failure-domain "
                     f"topology must cover exactly the manifest's node set")
-            cfg.fault_schedule.validate_nodes(topology.nodes)
-            placement = place_replicas(manifest, self.current_rf, topology,
-                                       seed=0,
-                                       method=self._placement_method)
-            if self._hash_placement:
+            schedule = (cfg.fault_schedule if cfg.fault_schedule
+                        is not None else FaultSchedule(()))
+            # Region-scoped events (crash:region:eu) resolve against the
+            # hierarchy here — unknown levels/domains fail at
+            # construction naming the offending token.
+            schedule = schedule.expand_domains(topology)
+            schedule.validate_nodes(topology.nodes)
+            self._fault_schedule = schedule
+            if cfg.elastic is not None:
+                from .elastic import _ElasticRuntime
+
+                cfg.elastic.validate_against(topology)
+                self._elastic = _ElasticRuntime(cfg.elastic)
+            if cfg.placement_mode == "functional":
+                # Lowmem functional backend: NO dense map is ever
+                # materialized — construction is pure base form
+                # (placement_fn.OverlayClusterState), and the resident
+                # placement state is the exception overlay itself.
+                from ..placement_fn import (
+                    OverlayClusterState,
+                    primary_on_topology,
+                )
+
+                self._cluster_state = OverlayClusterState.from_base(
+                    topology, self._sizes,
+                    n_shards=self.current_rf,
+                    primary=primary_on_topology(
+                        manifest.nodes, manifest.primary_node_id,
+                        topology),
+                    seed=0)
+            elif self._hash_placement:
                 from ..placement_fn import (
                     FunctionalClusterState,
                     primary_on_topology,
                 )
 
+                placement = place_replicas(manifest, self.current_rf,
+                                           topology, seed=0,
+                                           method=self._placement_method)
                 self._cluster_state = FunctionalClusterState(
                     placement, self._sizes,
                     primary=primary_on_topology(
                         manifest.nodes, manifest.primary_node_id,
                         topology),
-                    seed=0,
-                    sparse_checkpoint=(
-                        cfg.placement_mode == "functional"))
+                    seed=0, sparse_checkpoint=False)
             else:
+                placement = place_replicas(manifest, self.current_rf,
+                                           topology, seed=0,
+                                           method=self._placement_method)
                 self._cluster_state = ClusterState(placement, self._sizes)
             self._repairs = RepairScheduler(seed=cfg.repair_seed)
         #: Integrity layer: the background scrubber (faults/scrub.py) and
@@ -467,7 +525,7 @@ class ReplicationController:
             self._scrub = Scrubber(n, cfg.scrub)
         self._integrity_on = self._cluster_state is not None and (
             self._scrub is not None
-            or any(ev.kind == "corrupt" for ev in cfg.fault_schedule))
+            or any(ev.kind == "corrupt" for ev in self._fault_schedule))
         #: Serving layer (serve/): router + hotspot detector, only when a
         #: ServeConfig is set.  The router is stateless per window; the
         #: hotspot EWMA is the ONLY serve state and rides the checkpoint.
@@ -487,6 +545,7 @@ class ReplicationController:
                     nodes=tuple(manifest.nodes))
             self._router = ReadRouter(len(self._serve_topology.nodes),
                                       cfg.serve)
+            self._edge_ms = self._edge_latency_ms(self._serve_topology)
             self._hotspot = HotspotDetector(
                 n, alpha=cfg.serve.hotspot_alpha,
                 spike_factor=cfg.serve.hotspot_spike_factor,
@@ -775,7 +834,14 @@ class ReplicationController:
 
         if self._cluster_state is not None:
             t0 = time.perf_counter()
-            fault_events = cfg.fault_schedule.for_window(w)
+            fault_events = list(
+                self._fault_schedule.for_window(w))
+            if self._elastic is not None:
+                # Scale decision first (reads LAST window's serving
+                # telemetry; may grow the topology and seed the
+                # rebalance queue), then any due rolling-drain
+                # decommissions join this window's fault events.
+                fault_events += self._elastic_step(w, rec)
             for ev in fault_events:
                 self._cluster_state.apply_event(ev)
             rec["fault_events"] = [ev.spec() for ev in fault_events]
@@ -838,6 +904,21 @@ class ReplicationController:
             bytes_reserved = rr.bytes_used
             files_reserved = rr.files_touched
 
+        # Elastic rebalance drains the epoch-diff moved set on what
+        # remains of the shared churn budget after repairs (repairs
+        # outrank rebalance; rebalance outranks scrub and migrations —
+        # capacity the crowd needs beats hunting latent rot).
+        if self._elastic is not None and self._elastic.queue.size:
+            t0 = time.perf_counter()
+            rb_bytes, rb_files = self._elastic_rebalance(bytes_reserved)
+            seconds["rebalance"] = time.perf_counter() - t0
+            plan_seconds += seconds["rebalance"]
+            rec["elastic"]["rebalanced"] = rb_files
+            rec["elastic"]["rebalance_bytes"] = rb_bytes
+            rec["elastic"]["queue"] = int(self._elastic.queue.size)
+            bytes_reserved += rb_bytes
+            files_reserved += rb_files
+
         # Background scrub runs AFTER repairs (healing known damage
         # outranks hunting unknown damage) on what remains of the shared
         # churn budget, capped by its own bytes_per_window rate; its
@@ -891,12 +972,15 @@ class ReplicationController:
                     cs = self._cluster_state
                     want = self._file_strategy(int(m.cat_new),
                                                m.file_index)
-                    cs.apply_strategy_target(m.file_index, *want,
-                                             m.rf_new)
+                    cs.apply_strategy_target(
+                        m.file_index, want[0], want[1], want[2],
+                        m.rf_new, want[3])
                     installed = (
                         int(cs.min_live[m.file_index]) == want[0]
                         and int(cs.shard_bytes[m.file_index]) == want[1]
-                        and int(cs.ec_k[m.file_index]) == want[2])
+                        and int(cs.ec_k[m.file_index]) == want[2]
+                        and bool(cs.region_local[m.file_index])
+                        == want[3])
                     if installed:
                         self._installed_cat[m.file_index] = m.cat_new
         seconds["schedule"] = time.perf_counter() - t0
@@ -959,6 +1043,8 @@ class ReplicationController:
                     # with unreadable_mask()/unavailable_reads in the
                     # same window record.
                     readable = ~self._cluster_state.unreadable_mask()
+                    if view.file_ids is not None:   # compacted view
+                        readable = readable[view.file_ids]
                     view.slot_ok = view.slot_ok & readable[:, None]
             elif (cfg.placement_mode == "functional"
                     and self._storage is None):
@@ -974,25 +1060,42 @@ class ReplicationController:
                     placement=self._placement_for(self.current_rf))
             extra_ms = None
             if self._storage is not None:
-                extra_ms = self._serve_penalty_ms(view.slot_ok)[read_pid]
+                if view.file_ids is not None:   # compacted (lowmem) view
+                    extra_ms = self._serve_penalty_ms(
+                        view.slot_ok, fids=view.file_ids)[view.pid]
+                else:
+                    extra_ms = self._serve_penalty_ms(
+                        view.slot_ok)[read_pid]
             res = self._router.route(
                 view.replica_map, view.slot_ok, view.node_throughput,
                 ts=read_ts, pid=view.pid,
                 client=read_client, window_seconds=cfg.window_seconds,
                 rng=np.random.default_rng([int(cfg.serve.seed), int(w)]),
-                extra_ms=extra_ms, slot_corrupt=view.slot_corrupt)
+                extra_ms=extra_ms, edge_ms=self._edge_ms,
+                slot_corrupt=view.slot_corrupt)
             rec.update(res.record_fields())
             if res.corrupt_pairs is not None and len(res.corrupt_pairs):
                 # Detect-on-read feedback: quarantine the rotten copies
                 # the window's reads tripped over, and hint the scrubber
-                # at those files (their surviving copies are now suspect).
-                for fid, node in res.corrupt_pairs:
+                # at those files (their surviving copies are now
+                # suspect).  A compacted view's pairs carry ROW ids —
+                # map them back to population file ids first.
+                pair_fids = res.corrupt_pairs[:, 0]
+                if view.file_ids is not None:
+                    pair_fids = view.file_ids[pair_fids]
+                for fid, node in zip(pair_fids,
+                                     res.corrupt_pairs[:, 1]):
                     self._cluster_state.quarantine(int(fid), int(node))
                 read_detect_copies = len(res.corrupt_pairs)
                 if self._scrub is not None:
-                    self._scrub.add_hints(res.corrupt_pairs[:, 0])
+                    self._scrub.add_hints(pair_fids)
             self._last_latency_ms = res.latency_ms
             seconds["serve"] = time.perf_counter() - t0
+            if self._elastic is not None:
+                # The decision inputs of NEXT window's scale step.
+                self._elastic.last_burn = float(rec.get("slo_burn", 0.0))
+                self._elastic.last_util = float(
+                    rec.get("utilization_max", 0.0))
 
         if self._integrity_on:
             # Ground-truth integrity digest AFTER the window's detections
@@ -1315,7 +1418,9 @@ class ReplicationController:
             convert = ((sv.file_min_live(old_cat)
                         != sv.file_min_live(new_cat))
                        | (shard_old != shard_new)
-                       | (sv.file_ec_k(old_cat) != sv.file_ec_k(new_cat)))
+                       | (sv.file_ec_k(old_cat) != sv.file_ec_k(new_cat))
+                       | (sv.file_region_local(old_cat)
+                          != sv.file_region_local(new_cat)))
             move_bytes = np.where(
                 convert, new_rf * shard_new,
                 shard_new * np.maximum(new_rf - self.current_rf, 0))
@@ -1324,15 +1429,149 @@ class ReplicationController:
                           move_bytes=move_bytes)
         self.scheduler.submit(moves)
 
+    def _edge_latency_ms(self, topology) -> np.ndarray | None:
+        """(n_nodes, n_nodes) cross-hierarchy propagation delay for the
+        router (``edge_latency`` multipliers x service_ms, zero on the
+        diagonal classes) — None for flat-latency topologies, keeping
+        their routing byte-identical."""
+        if not getattr(topology, "edge_latency", ()):
+            return None
+        return (float(self.cfg.serve.service_ms)
+                * (topology.latency_matrix() - 1.0))
+
+    # -- elastic capacity (control/elastic.py) -----------------------------
+    def _elastic_step(self, w: int, rec: dict) -> list:
+        """One window's autoscale decision.  Reads LAST window's serving
+        telemetry, updates the hot/cool streaks, fires scale-out (grow +
+        epoch diff -> rebalance queue) or lays down the rolling drain,
+        and returns the drain decommissions due THIS window.  Stamps the
+        ``elastic`` record (the black-friday cell's engagement
+        invariant)."""
+        from ..faults.schedule import FaultEvent
+
+        es = self._elastic
+        pol = es.policy
+        info: dict = {"active": len(es.active),
+                      "queue": int(es.queue.size)}
+        if es.last_burn is not None:
+            hot = (es.last_burn > pol.burn_hot
+                   or es.last_util > pol.util_hot)
+            cool = (es.last_burn <= pol.burn_hot
+                    and es.last_util < pol.util_cool)
+            es.hot = es.hot + 1 if hot else 0
+            es.cool = es.cool + 1 if cool else 0
+        info["hot_streak"] = es.hot
+        info["cool_streak"] = es.cool
+        if not es.scaled and es.hot >= pol.hot_windows:
+            names = pol.next_activation(es.active)
+            if not names:
+                # Pool consumed (drained nodes are decommissioned and
+                # never reused): a later crowd has nothing to activate.
+                # Stamp it — a silent no-op while burn keeps violating
+                # would read as a dead autoscaler.
+                info["pool_exhausted"] = True
+            else:
+                moved = self._elastic_grow(names)
+                es.active = es.active + tuple(names)
+                es.scaled = True
+                es.hot = 0
+                es.cool = 0
+                es.moved_total += int(moved.size)
+                info["added"] = list(names)
+                info["moved"] = int(moved.size)
+                info["active"] = len(es.active)
+                info["queue"] = int(es.queue.size)
+        elif (es.scaled and not es.drains and es.queue.size == 0
+                and es.cool >= pol.cool_windows and es.active):
+            es.drains = [(w + 1 + i * pol.drain_spacing, nm)
+                         for i, nm in enumerate(es.active)]
+            info["drains_scheduled"] = [[int(a), b]
+                                        for a, b in es.drains]
+            es.scaled = False
+            es.cool = 0
+        due: list = []
+        still: list = []
+        for dw, nm in es.drains:
+            if dw <= w:
+                due.append(FaultEvent(w, "decommission", nm))
+            else:
+                still.append((dw, nm))
+        es.drains = still
+        if due:
+            info["drained"] = [e.node for e in due]
+        rec["elastic"] = info
+        return due
+
+    def _elastic_grow(self, names) -> np.ndarray:
+        """Activate standby nodes: pin + grow the cluster state, rebuild
+        the serve plane on the wider topology, and return the
+        addition-pruned epoch-diff moved set (the rebalance queue)."""
+        from ..placement_fn.epoch import addition_moved
+        from ..serve import ReadRouter
+
+        cs = self._cluster_state
+        topo_old = cs.topology
+        topo_new = self._elastic.policy.grown_topology(topo_old, names)
+        local = None
+        if getattr(topo_old, "n_levels", 0) > 0 \
+                and cs.region_local.any():
+            local = cs.region_local
+        moved = addition_moved(topo_old, topo_new, cs.installed_shards,
+                               cs._fn_primary, cs._fn_seed,
+                               local_mask=local)
+        cs.pin_rows(moved)
+        cs.grow(topo_new)
+        es = self._elastic
+        es.queue = (np.concatenate([es.queue, moved])
+                    if es.queue.size else moved)
+        self._serve_topology = topo_new
+        self._router = ReadRouter(len(topo_new.nodes), self.cfg.serve)
+        self._edge_ms = self._edge_latency_ms(topo_new)
+        self._fn_static_primary = None
+        return moved
+
+    def _elastic_rebalance(self, bytes_reserved: int) -> tuple[int, int]:
+        """Drain the rebalance queue within the remaining churn budget:
+        each file retargets to its new computed row (bytes charged = one
+        shard per NEWLY holding node — exactly the hash-twice moved
+        set's traffic, nothing else).  The repair planner's
+        largest-first-op rule applies: when nothing else moved bytes
+        this window, the head of the queue is admitted regardless."""
+        cs = self._cluster_state
+        es = self._elastic
+        q = es.queue
+        max_bytes = self.cfg.max_bytes_per_window
+        used = 0
+        done = 0
+        for i in range(q.size):
+            fid = int(q[i])
+            new_row = cs._fn_base_rows(
+                np.asarray([fid], dtype=np.int64))[0]
+            cur = cs.row(fid)
+            new_only = ({int(x) for x in new_row[new_row >= 0]}
+                        - {int(x) for x in cur[cur >= 0]})
+            charge = int(cs.shard_bytes[fid]) * len(new_only)
+            if max_bytes is not None \
+                    and bytes_reserved + used + charge > max_bytes \
+                    and bytes_reserved + used > 0:
+                break
+            used += cs.retarget_row(fid, new_row)
+            done += 1
+        es.queue = q[done:]
+        return used, done
+
     # -- storage strategies (storage/) -------------------------------------
-    def _file_strategy(self, cat: int, fid: int) -> tuple[int, int, int]:
-        """(min_live, shard_bytes, ec_k) of one file under ``cat``."""
+    def _file_strategy(self, cat: int,
+                       fid: int) -> tuple[int, int, int, bool]:
+        """(min_live, shard_bytes, ec_k, region_local) of one file
+        under ``cat``."""
         sv = self._storage
         if cat < 0:
-            return 1, int(self._sizes[fid]), 0
+            return 1, int(self._sizes[fid]), 0, False
         return (int(sv.min_live[cat]),
                 -(-int(self._sizes[fid]) // int(sv.shard_div[cat])),
-                int(sv.ec_k[cat]))
+                int(sv.ec_k[cat]),
+                bool(sv.region_local[cat]))
 
     def _reconcile_strategies(self) -> tuple[int, np.ndarray]:
         """Retry deferred strategy conversions (apply_strategy_target
@@ -1349,19 +1588,23 @@ class ReplicationController:
         want_min = sv.file_min_live(cat)
         want_shard = sv.file_shard_bytes(cat, self._sizes)
         want_k = sv.file_ec_k(cat)
-        fids = cs.strategy_mismatch(want_min, want_shard, want_k)
+        want_local = sv.file_region_local(cat)
+        fids = cs.strategy_mismatch(want_min, want_shard, want_k,
+                                    region_local=want_local)
         converted = 0
         still = []
         for fid in fids:
             f = int(fid)
             cs.apply_strategy_target(
                 f, int(want_min[f]), int(want_shard[f]),
-                int(want_k[f]), int(self.current_rf[f]))
+                int(want_k[f]), int(self.current_rf[f]),
+                bool(want_local[f]))
             # Success = the strategy now matches (the shard-count DELTA
             # can legitimately be 0, e.g. replicate(3) -> ec(2,1)).
             if (int(cs.min_live[f]) == int(want_min[f])
                     and int(cs.shard_bytes[f]) == int(want_shard[f])
-                    and int(cs.ec_k[f]) == int(want_k[f])):
+                    and int(cs.ec_k[f]) == int(want_k[f])
+                    and bool(cs.region_local[f]) == bool(want_local[f])):
                 converted += 1
                 self._installed_cat[f] = int(cat[f])
             else:
@@ -1387,7 +1630,7 @@ class ReplicationController:
         isafe = np.clip(icat, 0, None)
         if self._cluster_state is not None:
             cs = self._cluster_state
-            counts = (cs.replica_map >= 0).sum(axis=1)
+            counts = cs.assigned_counts()
             shard_b = cs.shard_bytes
             ec_files = int(((cs.ec_k > 0) & planned).sum())
         else:
@@ -1420,7 +1663,8 @@ class ReplicationController:
                                    if per_cat[i]},
         }
 
-    def _serve_penalty_ms(self, slot_ok: np.ndarray) -> np.ndarray:
+    def _serve_penalty_ms(self, slot_ok: np.ndarray,
+                          fids: np.ndarray | None = None) -> np.ndarray:
         """(n_files,) additive read latency from the storage layer: the
         tier penalty (a cold read is ``1/throughput`` x slower than the
         hot-tier service time) plus the degraded-read penalty — a read
@@ -1428,9 +1672,12 @@ class ReplicationController:
         shards from the surviving stripe before it can answer.  Reads
         hit whatever encoding is actually on disk, so the penalty
         follows the INSTALLED category (deferred conversions are still
-        plain hot-tier copies)."""
+        plain hot-tier copies).  ``fids`` restricts to a compacted
+        view's rows (the lowmem serve path) — the result is then
+        per-row, not per-population-file."""
         sv = self._storage
-        cat = self._installed_cat
+        cat = (self._installed_cat if fids is None
+               else self._installed_cat[fids])
         safe = np.clip(cat, 0, None)
         pen = np.where(cat >= 0, sv.read_penalty[safe],
                        sv.default_read_penalty)
@@ -1484,12 +1731,16 @@ class ReplicationController:
             if self._storage is not None:
                 # Shard-aware placement: an EC slot holds size/k bytes,
                 # not the full file (all-replicate shard_bytes == sizes
-                # and this is place_replicas bit-for-bit).
+                # and this is place_replicas bit-for-bit).  Region-local
+                # categories pin to the primary's top-level domain on a
+                # hierarchical topology (no-op otherwise).
                 self._placement = place_stripes(
                     self.manifest, rf.copy(), topology, seed=0,
                     shard_bytes=self._storage.file_shard_bytes(
                         self.current_cat, self._sizes),
-                    method=self._placement_method)
+                    method=self._placement_method,
+                    local_mask=self._storage.file_region_local(
+                        self.current_cat))
             else:
                 self._placement = place_replicas(
                     self.manifest, rf.copy(), topology, seed=0,
@@ -1539,6 +1790,8 @@ class ReplicationController:
             arrays.update(self._hotspot.state_arrays())
         if self._scrub is not None:
             arrays.update(self._scrub.state_arrays())
+        if self._elastic is not None:
+            arrays["elastic_queue"] = self._elastic.queue.copy()
         meta = {
             "window_index": self.window_index,
             "last_window_events": self._last_window_events,
@@ -1560,6 +1813,17 @@ class ReplicationController:
             "scrub": self._scrub is not None,
             "placement": self.cfg.placement_mode,
         }
+        if self._elastic is not None:
+            es = self._elastic
+            meta["elastic"] = {
+                "hot": es.hot, "cool": es.cool,
+                "active": list(es.active),
+                "moved_total": es.moved_total,
+                "drains": [[int(a), str(b)] for a, b in es.drains],
+                "scaled": bool(es.scaled),
+                "last_burn": es.last_burn,
+                "last_util": es.last_util,
+            }
         if self.cfg.backend == "jax":
             meta["pad_events"] = self._state.pad_events
         stats = save_state(path, arrays, meta=meta)
@@ -1666,6 +1930,34 @@ class ReplicationController:
         # load).
         self._accepted_file_cat = None
         self.scheduler.load_state_arrays(arrays)
+        if self._elastic is not None and meta.get("elastic"):
+            # Elastic growth must be REPLAYED before the state arrays
+            # load: a post-scale-out snapshot's arrays are sized for the
+            # grown topology, and the serve plane must route on it too.
+            em = meta["elastic"]
+            es = self._elastic
+            es.hot = int(em["hot"])
+            es.cool = int(em["cool"])
+            es.active = tuple(em["active"])
+            es.moved_total = int(em["moved_total"])
+            es.drains = [(int(a), str(b)) for a, b in em["drains"]]
+            es.scaled = bool(em["scaled"])
+            es.last_burn = em["last_burn"]
+            es.last_util = em["last_util"]
+            es.queue = np.asarray(
+                arrays.get("elastic_queue", np.zeros(0, np.int64)),
+                dtype=np.int64).copy()
+            if es.active:
+                from ..serve import ReadRouter
+
+                topo_new = es.policy.grown_topology(
+                    self._cluster_state.topology, es.active)
+                self._cluster_state.grow(topo_new)
+                self._serve_topology = topo_new
+                self._router = ReadRouter(len(topo_new.nodes),
+                                          self.cfg.serve)
+                self._edge_ms = self._edge_latency_ms(topo_new)
+                self._fn_static_primary = None
         if self._cluster_state is not None:
             self._cluster_state.load_state_arrays(arrays)
             self._repairs.load_state_arrays(arrays)

@@ -257,27 +257,12 @@ class RepairScheduler:
 
     def _charge(self, state, fid: int, target: int) -> int:
         """Budget charge of creating one new shard of ``fid`` on
-        ``target``: the wire bytes (one full copy for a replicate file;
-        ``k x shard_bytes`` reconstruction reads for an EC stripe —
-        ``ClusterState.repair_read_bytes``) divided by the slowest
-        throughput on the route — straggler wire-time inflation,
-        deterministic.  A replicate copy streams from the single BEST
-        reachable source; an EC rebuild must read k shards from k
-        distinct holders, so it is gated by the slowest of the k FASTEST
-        sources."""
-        read_bytes = int(state.repair_read_bytes(fid))
-        node_reach = state.node_reachable()
-        row = state.replica_map[fid]
-        srcs = [float(state.node_throughput[int(x)]) for x in row[row >= 0]
-                if node_reach[int(x)]]
-        k = int(state.ec_k[fid])
-        if k > 1 and srcs:
-            srcs.sort(reverse=True)
-            src_m = srcs[min(k, len(srcs)) - 1]
-        else:
-            src_m = max(srcs, default=1.0)
-        m = min(src_m, float(state.node_throughput[target]))
-        return int(np.ceil(read_bytes / max(m, 1e-9)))
+        ``target`` — ``ClusterState.copy_charge``: wire bytes over the
+        best source's effective rate, where the hierarchy's per-edge
+        byte-cost multipliers both inflate a WAN copy's charge and lose
+        it the source election when an in-region copy exists (flat edge
+        costs: bit-identical to the historical straggler arithmetic)."""
+        return state.copy_charge(fid, target)
 
     def _tail_avail(self, state, fids: np.ndarray,
                     rebalance: np.ndarray, reach: np.ndarray) -> np.ndarray:
@@ -293,7 +278,7 @@ class RepairScheduler:
         if rebalance.any():
             per_dom = np.bincount(state.domain_index[node_reach],
                                   minlength=state.n_domains)
-            rows = state.replica_map[fids[rebalance]]
+            rows = state.rows(fids[rebalance])
             assigned = rows >= 0
             dom = state.domain_index[np.clip(rows, 0, None)]
             occ = np.zeros(rows.shape[0], dtype=np.int64)

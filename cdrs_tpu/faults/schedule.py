@@ -68,6 +68,11 @@ _KIND_ORDER = {k: i for i, k in enumerate(KINDS)}
 #: Kinds whose span form (``@lo-hi``) expands to (start kind, end kind).
 _SPAN_END = {"crash": "recover", "flaky": "unflaky",
              "partition": "heal", "degrade": "restore"}
+#: Kinds accepting a ``level:name`` domain scope (``crash:region:eu@3-7``
+#: downs EVERY node of region ``eu`` — the correlated whole-domain event
+#: a geo hierarchy exists to survive).  Resolution needs the topology, so
+#: scoped schedules expand through ``expand_domains`` before running.
+_SCOPE_KINDS = ("crash", "recover", "decommission", "partition", "heal")
 
 
 @dataclass(frozen=True)
@@ -106,6 +111,11 @@ class FaultEvent:
             raise ValueError(
                 f"node groups ('+') are only valid for partition/heal, "
                 f"not {self.kind!r} ({self.node!r})")
+        if ":" in self.node and self.kind not in _SCOPE_KINDS:
+            raise ValueError(
+                f"domain scopes ('level:name', e.g. 'region:eu') are "
+                f"only valid for {'/'.join(_SCOPE_KINDS)}, not "
+                f"{self.kind!r} ({self.node!r})")
         if self.file >= 0 and self.kind != "corrupt":
             raise ValueError(
                 f"file targeting is only valid for corrupt, not "
@@ -156,11 +166,54 @@ class FaultSchedule:
         return tuple(sorted({n for e in self.events for n in e.node_list}))
 
     def validate_nodes(self, topology_nodes) -> None:
+        scoped = sorted(n for n in self.nodes() if ":" in n)
+        if scoped:
+            raise ValueError(
+                f"fault schedule still carries unexpanded domain scopes "
+                f"{scoped} — resolve them against the topology first "
+                f"(FaultSchedule.expand_domains)")
         unknown = sorted(set(self.nodes()) - set(topology_nodes))
         if unknown:
             raise ValueError(
                 f"fault schedule names nodes outside the topology "
                 f"{tuple(topology_nodes)}: {unknown}")
+
+    def expand_domains(self, topology) -> "FaultSchedule":
+        """Resolve ``level:name`` domain scopes against a topology:
+        ``crash:region:eu@3`` becomes one crash per node of region
+        ``eu``; a scoped partition/heal keeps the resolved nodes as ONE
+        atomic group (the whole region drops/returns together — the WAN
+        partition).  Scope-free schedules return ``self`` unchanged.
+        Unknown levels/domains raise naming the offending token
+        (``ClusterTopology.nodes_in``)."""
+        if not any(":" in n for e in self.events for n in e.node_list):
+            return self
+        events: list[FaultEvent] = []
+        for e in self.events:
+            if not any(":" in n for n in e.node_list):
+                events.append(e)
+                continue
+            resolved: list[str] = []
+            for token in e.node_list:
+                if ":" not in token:
+                    resolved.append(token)
+                    continue
+                level, dom = token.split(":", 1)
+                try:
+                    members = topology.nodes_in(level, dom)
+                except (ValueError, AttributeError) as err:
+                    raise ValueError(
+                        f"fault event {e.spec()!r}: {err}") from None
+                resolved.extend(members)
+            kw = {"fail_prob": e.fail_prob, "factor": e.factor,
+                  "file": e.file}
+            if e.kind in ("partition", "heal"):
+                events.append(FaultEvent(e.window, e.kind,
+                                         "+".join(resolved), **kw))
+            else:
+                events.extend(FaultEvent(e.window, e.kind, n, **kw)
+                              for n in resolved)
+        return FaultSchedule(events)
 
     # -- constructors --------------------------------------------------------
     @classmethod

@@ -128,8 +128,8 @@ class Scrubber:
             """Verify every reachable copy of ``fid``; False = budget died
             before the file finished (partial verifications are re-done
             next window — the cursor does not advance past it)."""
-            row = state.replica_map[fid]
-            corr = state.slot_corrupt[fid]
+            row = state.row(fid)
+            corr = state.corrupt_row(fid)
             checked = 0
             for s in np.flatnonzero(row >= 0):
                 node = int(row[s])
@@ -177,7 +177,7 @@ class Scrubber:
         if not halted:
             n = self.n_files
             order = (self.cursor + np.arange(n)) % n     # lap order
-            rm = state.replica_map[order]                # (n, R)
+            rm = state.rows(order)                       # (n, R)
             ok = (rm >= 0) & reach[np.clip(rm, 0, None)]
             rows, slots = np.nonzero(ok)                 # copy-level
             charge = np.ceil(
@@ -191,7 +191,7 @@ class Scrubber:
                 rep.bytes_used = int(csum[kpre - 1])
                 rep.copies_verified += kpre
                 fids = order[rows[:kpre]]
-                corr = state.slot_corrupt[fids, slots[:kpre]]
+                corr = state.corrupt_at(fids, slots[:kpre])
                 nodes = rm[rows[:kpre], slots[:kpre]]
                 for f, nd in zip(fids[corr].tolist(),
                                  nodes[corr].tolist()):

@@ -82,6 +82,14 @@ class ClusterState:
         self._node_idx = {nm: i for i, nm in enumerate(self.nodes)}
         self.domain_index = self.topology.domain_index()
         self.n_domains = self.topology.n_domains
+        #: Top-hierarchy-level (region) ids per node, None for one-level
+        #: topologies — gates every hierarchy-aware code path to zero
+        #: cost on pre-hierarchy clusters.
+        self._top_index = (self.topology.top_domain_index()
+                           if getattr(self.topology, "n_levels", 0) > 0
+                           else None)
+        self._n_top = (self.topology.n_domains_at(self.topology.n_levels)
+                       if self._top_index is not None else 0)
         self.sizes = np.asarray(size_bytes, dtype=np.int64)
         if self.sizes.shape != (n,):
             raise ValueError(
@@ -100,6 +108,18 @@ class ClusterState:
         self.min_live = np.ones(n, dtype=np.int32)
         self.shard_bytes = self.sizes.copy()
         self.ec_k = np.zeros(n, dtype=np.int32)
+        #: Region-locality flag per file (storage/ ``locality: region``):
+        #: True pins every copy/shard to the file's current top-level
+        #: domain — repair targets stay in-region.  All-False (the
+        #: default, and any non-hierarchical topology) is bit-identical
+        #: to the pre-hierarchy behaviour.
+        self.region_local = np.zeros(n, dtype=bool)
+        #: (n_nodes, n_nodes) per-copy byte-cost multipliers from the
+        #: hierarchy's edge costs; None = flat costs (no matrix, no
+        #: lookups — the historical charge arithmetic).
+        self._byte_cost = (self.topology.byte_cost_matrix()
+                           if getattr(self.topology, "edge_bytes", ())
+                           else None)
         #: Shard-count INTENT of the installed form: what repair should
         #: maintain for each file.  Updated when an rf change or a
         #: strategy re-encode APPLIES — a deferred conversion keeps the
@@ -149,6 +169,12 @@ class ClusterState:
         for d in range(self.n_domains):
             spread += ((slot_dom == d) & reach).any(axis=1)
         self._dom_spread = spread
+        if self._top_index is not None:
+            top = self._top_index[np.clip(self.replica_map, 0, None)]
+            tspread = np.zeros(self.replica_map.shape[0], dtype=np.int32)
+            for d in range(self._n_top):
+                tspread += ((top == d) & reach).any(axis=1)
+            self._top_spread = tspread
 
     def _refresh_files(self, fids: np.ndarray) -> None:
         """Recompute the cached counts for a row subset (the files a
@@ -156,7 +182,7 @@ class ClusterState:
         fids = np.asarray(fids, dtype=np.int64)
         if fids.size == 0:
             return
-        rows = self.replica_map[fids]
+        rows = self.rows(fids)
         safe = np.clip(rows, 0, None)
         assigned = rows >= 0
         self._live_counts[fids] = (assigned
@@ -168,6 +194,12 @@ class ClusterState:
         for d in range(self.n_domains):
             spread += ((dom == d) & rmask).any(axis=1)
         self._dom_spread[fids] = spread
+        if self._top_index is not None:
+            top = self._top_index[safe]
+            tspread = np.zeros(fids.shape[0], dtype=np.int32)
+            for d in range(self._n_top):
+                tspread += ((top == d) & rmask).any(axis=1)
+            self._top_spread[fids] = tspread
 
     def _recompute_node_bytes(self) -> None:
         self.node_bytes = np.zeros(len(self.nodes), dtype=np.int64)
@@ -195,24 +227,26 @@ class ClusterState:
         self.version += 1
 
     def set_file_strategy(self, fid: int, min_live: int, shard_bytes: int,
-                          ec_k: int) -> None:
+                          ec_k: int, region_local: bool = False) -> None:
         """Re-strategize ONE file (a migration moved it to a category
         with a different storage strategy): its assigned slots re-account
         at the new shard size."""
         old = int(self.shard_bytes[fid])
         new = int(shard_bytes)
         if new != old:
-            row = self.replica_map[fid]
+            row = self.row(fid)
             for node in row[row >= 0]:
                 self.node_bytes[int(node)] += new - old
         self.min_live[fid] = int(min_live)
         self.shard_bytes[fid] = new
         self.ec_k[fid] = int(ec_k)
+        self.region_local[fid] = bool(region_local)
         self.version += 1
 
     def apply_strategy_target(self, fid: int, min_live: int,
                               shard_bytes: int, ec_k: int,
-                              target: int) -> int:
+                              target: int,
+                              region_local: bool = False) -> int:
         """Move ``fid`` to a (possibly different) storage strategy and
         bring it toward ``target`` shards — the migration-apply entry
         point when a storage config is active.
@@ -232,7 +266,8 @@ class ClusterState:
         ``pick_repair_target``.  Returns the shard-count delta."""
         same = (int(self.min_live[fid]) == int(min_live)
                 and int(self.shard_bytes[fid]) == int(shard_bytes)
-                and int(self.ec_k[fid]) == int(ec_k))
+                and int(self.ec_k[fid]) == int(ec_k)
+                and bool(self.region_local[fid]) == bool(region_local))
         if same:
             return self.apply_rf_target(fid, target)
         # Per-row reachability from the maintained cache: the full
@@ -242,11 +277,12 @@ class ClusterState:
         if reach < int(self.min_live[fid]) \
                 or self.n_available < int(min_live):
             return 0
-        row = self.replica_map[fid]
+        row = self.row(fid)
         before = int((row >= 0).sum())
         for node in [int(x) for x in row[row >= 0]]:
             self.drop_replica(fid, node)
-        self.set_file_strategy(fid, min_live, shard_bytes, ec_k)
+        self.set_file_strategy(fid, min_live, shard_bytes, ec_k,
+                               region_local)
         self.installed_shards[fid] = int(target)
         placed = 0
         goal = min(int(target), self.n_available)
@@ -260,14 +296,18 @@ class ClusterState:
 
     def strategy_mismatch(self, min_live: np.ndarray,
                           shard_bytes: np.ndarray,
-                          ec_k: np.ndarray) -> np.ndarray:
+                          ec_k: np.ndarray,
+                          region_local: np.ndarray | None = None
+                          ) -> np.ndarray:
         """File ids whose installed strategy differs from the wanted
         arrays — deferred conversions the controller retries per
         window (see ``apply_strategy_target``)."""
-        return np.flatnonzero(
-            (self.min_live != np.asarray(min_live, np.int32))
-            | (self.shard_bytes != np.asarray(shard_bytes, np.int64))
-            | (self.ec_k != np.asarray(ec_k, np.int32)))
+        mism = ((self.min_live != np.asarray(min_live, np.int32))
+                | (self.shard_bytes != np.asarray(shard_bytes, np.int64))
+                | (self.ec_k != np.asarray(ec_k, np.int32)))
+        if region_local is not None:
+            mism |= self.region_local != np.asarray(region_local, bool)
+        return np.flatnonzero(mism)
 
     def repair_read_bytes(self, fid: int) -> int:
         """Bytes read over the wire to create ONE new shard of ``fid``:
@@ -318,7 +358,7 @@ class ClusterState:
         """
         if not self._n_corrupt:
             return 0, 0
-        row = self.replica_map[fid]
+        row = self.row(fid)
         corr = self.slot_corrupt[fid]
         reach = self.node_reachable()
         found = 0
@@ -333,6 +373,15 @@ class ClusterState:
             self.quarantine(fid, node)
             found += 1
         return found, charge
+
+    def corrupt_row(self, fid: int) -> np.ndarray:
+        """(n_nodes,) bool rot mask of one file (scrub's hint loop; the
+        lowmem backend reconstructs it from its sparse bitmask)."""
+        return self.slot_corrupt[fid]
+
+    def corrupt_at(self, fids: np.ndarray, slots: np.ndarray) -> np.ndarray:
+        """Bool per (fid, slot) pair — the scrub lap's gather."""
+        return self.slot_corrupt[np.asarray(fids), np.asarray(slots)]
 
     def corrupt_file_counts(self) -> np.ndarray:
         """(n,) int32: LIVE corrupt copies per file (ground truth).  Rot
@@ -364,6 +413,156 @@ class ClusterState:
             "files_corrupt": int((cf > 0).sum()),
             "true_lost": int(self.true_lost_mask().sum()),
         }
+
+    # -- row access (the seam the lowmem functional backend overrides) -------
+    def row(self, fid: int) -> np.ndarray:
+        """(n_nodes,) int32 slot row of one file.  Dense backends return
+        a live VIEW (in-place writes hit the map); overlay backends
+        return a resolved copy and route writes through the mutation
+        primitives — which is why shared policy code only ever mutates
+        through ``add_replica``/``drop_replica``."""
+        return self.replica_map[fid]
+
+    def rows(self, fids: np.ndarray) -> np.ndarray:
+        """(k, n_nodes) int32 slot rows of a file subset (copy-or-view;
+        read-only by contract)."""
+        return self.replica_map[fids]
+
+    def assigned_counts(self) -> np.ndarray:
+        """(n,) int64 ASSIGNED slots per file (up or down — bytes on
+        disk; the storage record's byte accounting).  Overlay backends
+        compute it chunked instead of materializing the map."""
+        return (self.replica_map >= 0).sum(axis=1).astype(np.int64)
+
+    # -- hierarchy-aware copy pricing ----------------------------------------
+    def copy_charge(self, fid: int, target: int) -> int:
+        """Budget charge of creating one new shard of ``fid`` on
+        ``target``: the wire bytes (one full copy for a replicate file;
+        ``k x shard_bytes`` reconstruction reads for an EC stripe)
+        divided by the best source's effective rate — the slowest of
+        (source, target) throughput, divided by the hierarchy's per-edge
+        byte-cost multiplier, so a WAN copy both costs its multiplier
+        and loses the source election to an in-region copy when one
+        exists.  An EC rebuild reads k shards, so it is gated by the
+        k-th best effective source.  With flat edge costs this is
+        bit-identical to the historical straggler arithmetic (min and
+        the throughput sort commute)."""
+        read_bytes = int(self.repair_read_bytes(fid))
+        node_reach = self.node_reachable()
+        row = self.row(fid)
+        tgt = float(self.node_throughput[target])
+        cost = self._byte_cost
+        rates = []
+        for x in row[row >= 0]:
+            s = int(x)
+            if not node_reach[s]:
+                continue
+            r = min(float(self.node_throughput[s]), tgt)
+            if cost is not None:
+                r /= float(cost[s, target])
+            rates.append(r)
+        k = int(self.ec_k[fid])
+        if k > 1 and rates:
+            rates.sort(reverse=True)
+            rate = rates[min(k, len(rates)) - 1]
+        else:
+            rate = max(rates, default=min(1.0, tgt))
+        return int(np.ceil(read_bytes / max(rate, 1e-9)))
+
+    # -- elastic capacity ----------------------------------------------------
+    def _grow_common(self, topology) -> int:
+        """The representation-independent half of ``grow``: validate the
+        strict-prefix contract, swap the topology + LUTs, extend every
+        per-NODE array.  Returns the number of appended nodes.  Shared
+        by the dense and overlay backends so a future per-node array
+        cannot be extended in one and forgotten in the other."""
+        old_n = len(self.nodes)
+        if tuple(topology.nodes[:old_n]) != self.nodes \
+                or len(topology.nodes) <= old_n:
+            raise ValueError(
+                f"grow needs the current node set as a strict prefix of "
+                f"the new topology (have {self.nodes}, got "
+                f"{tuple(topology.nodes)})")
+        add = len(topology.nodes) - old_n
+        self.topology = topology
+        self.nodes = tuple(topology.nodes)
+        self._node_idx = {nm: i for i, nm in enumerate(self.nodes)}
+        self.domain_index = topology.domain_index()
+        self.n_domains = topology.n_domains
+        self._top_index = (topology.top_domain_index()
+                           if getattr(topology, "n_levels", 0) > 0
+                           else None)
+        self._n_top = (topology.n_domains_at(topology.n_levels)
+                       if self._top_index is not None else 0)
+        self._byte_cost = (topology.byte_cost_matrix()
+                           if getattr(topology, "edge_bytes", ())
+                           else None)
+        self.node_up = np.concatenate([self.node_up, np.ones(add, bool)])
+        self.node_decommissioned = np.concatenate(
+            [self.node_decommissioned, np.zeros(add, bool)])
+        self.node_partitioned = np.concatenate(
+            [self.node_partitioned, np.zeros(add, bool)])
+        self.node_fail_prob = np.concatenate(
+            [self.node_fail_prob, np.zeros(add)])
+        self.node_throughput = np.concatenate(
+            [self.node_throughput, np.ones(add)])
+        self.node_bytes = np.concatenate(
+            [self.node_bytes, np.zeros(add, dtype=np.int64)])
+        self.version += 1
+        return add
+
+    def grow(self, topology) -> None:
+        """Install a GROWN topology (the old one with nodes appended —
+        the elastic scale-out): per-node arrays extend, the map gains
+        empty columns, and every existing file's placement, counts and
+        domain ids are untouched (appended nodes introduce only new
+        domain names, or join existing ones whose ids are stable under
+        first-appearance ordering)."""
+        add = self._grow_common(topology)
+        n = self.replica_map.shape[0]
+        self.replica_map = np.concatenate(
+            [self.replica_map, np.full((n, add), -1, dtype=np.int32)],
+            axis=1)
+        self.slot_corrupt = np.concatenate(
+            [self.slot_corrupt, np.zeros((n, add), dtype=bool)], axis=1)
+
+    def pin_rows(self, fids) -> None:
+        """Snapshot hook before a base-moving change (functional epoch
+        advance): dense backends already hold every row, so this is a
+        no-op; functional backends pin the resolved rows so they stand
+        as exceptions until the rebalance physically moves them."""
+
+    def retarget_row(self, fid: int, new_row: np.ndarray) -> int:
+        """Install a fully specified slot row for one file (the elastic
+        rebalance move): byte accounting follows the node-set delta, rot
+        bits follow their surviving nodes (a dropped node's copy — and
+        its rot — is deleted).  Returns the bytes WRITTEN (one shard per
+        newly holding node)."""
+        new_row = np.asarray(new_row, dtype=np.int32)
+        old_row = self.row(fid).copy()
+        old_nodes = {int(x) for x in old_row[old_row >= 0]}
+        new_nodes = {int(x) for x in new_row[new_row >= 0]}
+        sb = int(self.shard_bytes[fid])
+        for v in old_nodes - new_nodes:
+            self.node_bytes[v] -= sb
+        for v in new_nodes - old_nodes:
+            self.node_bytes[v] += sb
+        corr = self.slot_corrupt[fid]
+        if corr.any():
+            new_corr = np.zeros_like(corr)
+            slot_of = {int(v): int(s) for s, v in enumerate(new_row)
+                       if v >= 0}
+            for s in np.flatnonzero(corr):
+                v = int(old_row[s])
+                if v in slot_of:
+                    new_corr[slot_of[v]] = True
+                else:
+                    self._n_corrupt -= 1
+            self.slot_corrupt[fid] = new_corr
+        self.replica_map[fid] = new_row
+        self._refresh_files(np.asarray([fid]))
+        self.version += 1
+        return sb * len(new_nodes - old_nodes)
 
     # -- node status ---------------------------------------------------------
     def _nid(self, node: str) -> int:
@@ -459,6 +658,34 @@ class ClusterState:
         reach = self.node_reachable()
         return int(np.unique(self.domain_index[reach]).size)
 
+    def domains_reachable_at(self, level: int) -> int:
+        """Hierarchy domains at ``level`` with >= 1 reachable node."""
+        reach = self.node_reachable()
+        idx = self.topology.domain_index_at(level)
+        return int(np.unique(idx[reach]).size)
+
+    def spread_at(self, level: int, chunk: int = 1 << 20) -> np.ndarray:
+        """(n,) int32 distinct hierarchy-level-``level`` domains holding
+        a REACHABLE replica of each file (the base level's cached twin
+        is ``domain_spread``).  Chunked through ``rows`` so overlay
+        backends never materialize the full map."""
+        idx = self.topology.domain_index_at(level)
+        n_dom = self.topology.n_domains_at(level)
+        n = self.min_live.shape[0]
+        node_reach = self.node_reachable()
+        out = np.zeros(n, dtype=np.int32)
+        for lo in range(0, n, int(chunk)):
+            hi = min(lo + int(chunk), n)
+            rows = self.rows(np.arange(lo, hi, dtype=np.int64))
+            safe = np.clip(rows, 0, None)
+            rmask = (rows >= 0) & node_reach[safe]
+            dom = idx[safe]
+            spread = np.zeros(hi - lo, dtype=np.int32)
+            for d in range(n_dom):
+                spread += ((dom == d) & rmask).any(axis=1)
+            out[lo:hi] = spread
+        return out
+
     # -- replica accounting --------------------------------------------------
     def live_mask(self) -> np.ndarray:
         """(n, n_nodes) bool: slot holds a replica on an UP node (the data
@@ -506,14 +733,28 @@ class ClusterState:
         wants >= 2 — one rack/switch failure from unavailability.  An
         overlay, not a tier: a file can be under-replicated AND
         correlated.  ``reach``/``eff`` let per-window callers reuse
-        already-derived arrays instead of re-deriving 10M-row copies."""
-        if self.n_domains < 2 or self.domains_reachable() < 2:
-            return np.zeros(self.replica_map.shape[0], dtype=bool)
+        already-derived arrays instead of re-deriving 10M-row copies.
+
+        On a geo hierarchy the overlay extends UP the tree: a file
+        rack-diverse but region-concentrated (every reachable copy in
+        one top-level domain while a second region is reachable) is one
+        region outage from unavailability and joins the rebalance work
+        list — except region-LOCAL files, whose concentration is their
+        locality contract, not a risk to fight."""
+        n = self.min_live.shape[0]
         if reach is None:
             reach = self._reach_counts
         if eff is None:
             eff = self.effective_target(target_rf)
-        return (reach >= 2) & (self._dom_spread == 1) & (eff >= 2)
+        out = np.zeros(n, dtype=bool)
+        if self.n_domains >= 2 and self.domains_reachable() >= 2:
+            out |= (reach >= 2) & (self._dom_spread == 1) & (eff >= 2)
+        if self._top_index is not None and self._n_top >= 2 \
+                and self.domains_reachable_at(
+                    self.topology.n_levels) >= 2:
+            out |= ((reach >= 2) & (self._top_spread == 1) & (eff >= 2)
+                    & ~self.region_local)
+        return out
 
     def durability(self, target_rf: np.ndarray, cat: np.ndarray,
                    categories) -> dict:
@@ -550,7 +791,7 @@ class ClusterState:
             for ci, c in enumerate(counts):
                 if c:
                     per.setdefault(names[ci], {})[key] = int(c)
-        return {
+        out = {
             "nodes_up": self.n_available,
             "nodes_partitioned": self.n_partitioned,
             "domains_reachable": self.domains_reachable(),
@@ -562,6 +803,30 @@ class ClusterState:
                 target_rf, reach=reach, eff=eff).sum()),
             "per_category": per,
         }
+        n_levels = getattr(self.topology, "n_levels", 0)
+        if n_levels > 0:
+            # Geo-hierarchical view: correlated risk COMPUTED PER LEVEL —
+            # a file rack-diverse but region-concentrated is one region
+            # outage from unavailability, which the base-level overlay
+            # cannot see.  Region-LOCAL files are exempt at levels above
+            # the base: their concentration is the locality contract,
+            # not a risk the rebalancer should fight.  Only stamped for
+            # hierarchical topologies: pre-hierarchy records stay
+            # byte-identical.
+            out["regions_reachable"] = self.domains_reachable_at(n_levels)
+            per_level: dict[str, int] = {}
+            for lvl in range(1, n_levels + 1):
+                name = self.topology.level_names[lvl]
+                if self.domains_reachable_at(lvl) < 2:
+                    per_level[name] = 0
+                    continue
+                spread = (self._top_spread if lvl == n_levels
+                          else self.spread_at(lvl))
+                mask = ((reach >= 2) & (spread == 1) & (eff >= 2)
+                        & ~self.region_local)
+                per_level[name] = int(mask.sum())
+            out["correlated_risk_levels"] = per_level
+        return out
 
     def lost_mask(self) -> np.ndarray:
         """Files below their existence threshold — no live full copy, or
@@ -579,7 +844,7 @@ class ClusterState:
     def _file_domains(self, fid: int) -> set:
         """Domains already holding an ASSIGNED replica of ``fid`` (down
         holders count: their copy returns on recovery)."""
-        row = self.replica_map[fid]
+        row = self.row(fid)
         return {int(self.domain_index[x]) for x in row[row >= 0]}
 
     def pick_repair_target(self, fid: int, rotate: int = 0,
@@ -587,26 +852,55 @@ class ClusterState:
         """Deterministic target for a new replica of ``fid``: a reachable
         node not already assigned a replica (up OR down — a down holder
         still owns the bytes and will return), preferring nodes in failure
-        domains the file does not yet occupy (maximum domain spread),
-        least-loaded within a preference class.  ``rotate`` (the repair
-        attempt count) steps through the candidate ring so a retry after a
-        flaky failure tries a different node.  ``new_domain_only``
-        restricts candidates to unoccupied domains (the correlated-risk
-        rebalance pass — a same-domain copy would not fix anything)."""
-        row = self.replica_map[fid]
+        domains the file does not yet occupy (maximum domain spread; with
+        a geo hierarchy, unoccupied TOP-level domains outrank unoccupied
+        racks — heal the region spread first), least-loaded within a
+        preference class.  ``rotate`` (the repair attempt count) steps
+        through the candidate ring so a retry after a flaky failure tries
+        a different node.  ``new_domain_only`` restricts candidates to
+        unoccupied domains (the correlated-risk rebalance pass — a
+        same-domain copy would not fix anything).  A region-local file
+        (``region_local``) only ever targets nodes in a top-level domain
+        it already occupies — its locality contract survives repair."""
+        row = self.row(fid)
         holding = set(int(x) for x in row[row >= 0])
         have_domains = self._file_domains(fid)
         reach = self.node_reachable()
+        n_levels = getattr(self.topology, "n_levels", 0)
         avail = [i for i in range(len(self.nodes))
                  if reach[i] and i not in holding]
+        if n_levels > 0:
+            top = self.topology.top_domain_index()
+            have_top = {int(top[x]) for x in holding}
+            if self.region_local[fid] and have_top:
+                avail = [i for i in avail if int(top[i]) in have_top]
         if new_domain_only:
             avail = [i for i in avail
                      if int(self.domain_index[i]) not in have_domains]
         if not avail:
             return -1
-        avail.sort(key=lambda i: (
-            int(self.domain_index[i]) in have_domains,   # new domains first
-            int(self.node_bytes[i]), i))
+        if n_levels > 0:
+            # Count-balancing, not boolean preference: the chooser's
+            # (region count, rack count, priority) key carried into the
+            # mutation path, so an EC(k, m) re-encode placing k+m
+            # shards one at a time still lands region counts within one
+            # of each other — the same ceil(shards / regions) worst
+            # case a whole-region loss is survivable under.
+            top_cnt: dict[int, int] = {}
+            base_cnt: dict[int, int] = {}
+            for x in holding:
+                t = int(top[x])
+                b = int(self.domain_index[x])
+                top_cnt[t] = top_cnt.get(t, 0) + 1
+                base_cnt[b] = base_cnt.get(b, 0) + 1
+            avail.sort(key=lambda i: (
+                top_cnt.get(int(top[i]), 0),
+                base_cnt.get(int(self.domain_index[i]), 0),
+                int(self.node_bytes[i]), i))
+        else:
+            avail.sort(key=lambda i: (
+                int(self.domain_index[i]) in have_domains,  # new doms first
+                int(self.node_bytes[i]), i))
         return avail[int(rotate) % len(avail)]
 
     def add_replica(self, fid: int, node: int) -> None:
@@ -639,11 +933,22 @@ class ClusterState:
     def _drop_order(self, fid: int, holders: list[int]) -> list[int]:
         """Holders sorted most-droppable first: crowded domains lose
         replicas before singleton domains (keep the spread the domain-aware
-        placement bought), most-loaded node within a domain class."""
+        placement bought; with a geo hierarchy, crowded REGIONS outrank
+        crowded racks — a rebalance's fresh cross-region copy must never
+        be the drop victim), most-loaded node within a domain class."""
         dom_count: dict[int, int] = {}
         for h in holders:
             d = int(self.domain_index[h])
             dom_count[d] = dom_count.get(d, 0) + 1
+        if self._top_index is not None:
+            top_count: dict[int, int] = {}
+            for h in holders:
+                t = int(self._top_index[h])
+                top_count[t] = top_count.get(t, 0) + 1
+            return sorted(holders, key=lambda i: (
+                -top_count[int(self._top_index[i])],
+                -dom_count[int(self.domain_index[i])],
+                -int(self.node_bytes[i]), i))
         return sorted(holders, key=lambda i: (
             -dom_count[int(self.domain_index[i])],
             -int(self.node_bytes[i]), i))
@@ -652,7 +957,7 @@ class ClusterState:
         """Drop one REACHABLE replica from the file's most-crowded domain
         (the free half of a spread rebalance).  Returns the node dropped,
         or -1 when the file has fewer than 2 reachable replicas."""
-        row = self.replica_map[fid]
+        row = self.row(fid)
         reach = self.node_reachable()
         holders = [int(x) for x in row[row >= 0] if reach[int(x)]]
         if len(holders) < 2:
@@ -693,13 +998,13 @@ class ClusterState:
         if live > target:
             # Release dead-weight slots on DOWN nodes first (partitioned
             # nodes are up — their stranded copies are kept).
-            row = self.replica_map[fid]
+            row = self.row(fid)
             for node in [int(x) for x in row[row >= 0]
                          if not self.node_up[int(x)]]:
                 self.drop_replica(fid, node)
         reach = self.node_reachable()
         while live > target:
-            row = self.replica_map[fid]
+            row = self.row(fid)
             holders = [int(x) for x in row[row >= 0] if reach[int(x)]]
             if not holders:  # pragma: no cover - live>target implies holders
                 break
@@ -756,6 +1061,7 @@ class ClusterState:
             "fault_min_live": self.min_live.copy(),
             "fault_shard_bytes": self.shard_bytes.copy(),
             "fault_ec_k": self.ec_k.copy(),
+            "fault_region_local": self.region_local.copy(),
             "fault_installed_shards": self.installed_shards.copy(),
             # Latent-rot ground truth (integrity layer): a mid-outage
             # resume must keep serving/refusing exactly the same copies.
@@ -796,6 +1102,11 @@ class ClusterState:
         self.ec_k = np.asarray(
             arrays.get("fault_ec_k", np.zeros(n, np.int32)),
             dtype=np.int32).copy()
+        # Pre-hierarchy checkpoints lack the locality flags: no file was
+        # ever pinned to a region.
+        self.region_local = np.asarray(
+            arrays.get("fault_region_local", np.zeros(n, bool)),
+            dtype=bool).copy()
         # Pre-intent checkpoints: fall back to the assigned-slot count
         # (floored at min_live) — the closest observable to the intent.
         self.installed_shards = np.asarray(

@@ -21,17 +21,22 @@ from .compute import (
     node_salts,
     primary_on_topology,
 )
-from .epoch import Epoch, EpochDiff, EpochMap
-from .state import FunctionalClusterState
+from .compute import clip_shards_for_locality, hierarchical_fill
+from .epoch import Epoch, EpochDiff, EpochMap, addition_moved
+from .state import FunctionalClusterState, OverlayClusterState
 
 __all__ = [
     "Epoch",
     "EpochDiff",
     "EpochMap",
     "FunctionalClusterState",
+    "OverlayClusterState",
+    "addition_moved",
+    "clip_shards_for_locality",
     "compute_placement",
     "file_keys",
     "hash_priorities",
+    "hierarchical_fill",
     "node_salts",
     "primary_on_topology",
 ]

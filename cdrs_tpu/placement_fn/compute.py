@@ -58,6 +58,7 @@ import numpy as np
 
 __all__ = ["node_salts", "file_keys", "hash_priorities",
            "compute_placement", "primary_on_topology",
+           "hierarchical_fill", "clip_shards_for_locality",
            "PRIO_MAX", "NODE_MASK", "MAX_NODES"]
 
 _SPLITMIX_GAMMA = np.uint64(0x9E3779B97F4A7C15)
@@ -164,6 +165,103 @@ def primary_on_topology(node_vocab, primary_node_id: np.ndarray,
     return lut[np.asarray(primary_node_id)]
 
 
+_U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def clip_shards_for_locality(n_shards: np.ndarray, primary: np.ndarray,
+                             topology,
+                             local_mask: np.ndarray | None) -> np.ndarray:
+    """Effective shard counts under a region-locality mask: a file pinned
+    to its primary's top-level domain can hold at most that domain's node
+    count (the region-local analogue of the global distinct-node cap).
+    Returns ``n_shards`` untouched when no mask applies — shared by the
+    choosers and by callers that need the cap without placing."""
+    if local_mask is None or topology.n_levels == 0:
+        return n_shards
+    local = np.asarray(local_mask, dtype=bool)
+    if not local.any():
+        return n_shards
+    top = topology.top_domain_index()
+    region_size = np.bincount(top,
+                              minlength=topology.n_domains_at(
+                                  topology.n_levels))
+    cap = region_size[top[np.asarray(primary, dtype=np.int64)]]
+    return np.where(local, np.minimum(n_shards, cap),
+                    n_shards).astype(np.int32)
+
+
+def hierarchical_fill(w: np.ndarray, out: np.ndarray, prim: np.ndarray,
+                      max_rf: int, topology) -> None:
+    """Greedy highest-level-first fill of one chunk's slots 1..max_rf-1.
+
+    ``w`` is the (n_nodes, m) PACKED priority block (mutated: chosen and
+    excluded candidates are masked to PRIO_MAX); slot 0 (the primary)
+    must already be written to ``out`` and masked in ``w``.  Each
+    subsequent slot takes the node minimizing the lexicographic key
+    ``(replicas already in its TOP-level domain, replicas already in its
+    base domain, packed priority)`` — CRUSH's descend-and-spread shape:
+    top-level (region) counts differ by at most one across the row, so a
+    whole-region loss can only take ``ceil(rf / n_regions)`` copies of
+    anything, and within a region copies spread racks first.
+    Region-local files (EC stripes pinned to the primary's region) have
+    their off-region candidates pre-masked by the caller: the same key
+    then spreads racks within the region (the only region with copies).
+    Deterministic and tie-free (the packed node-id bits),
+    nested in rf (slot c depends only on slots < c), and subset-safe
+    (per-file state only) — the same contracts the flat chooser makes.
+
+    Used by BOTH choosers: ``compute_placement`` feeds it hash-packed
+    priorities, the legacy rng chooser feeds it rng-packed ones — one
+    structural policy, two priority sources.
+    """
+    n_nodes, m = w.shape
+    cols = np.arange(m)
+    dom_base = topology.domain_index()
+    dom_top = topology.top_domain_index()
+    n_base = topology.n_domains
+    n_top = topology.n_domains_at(topology.n_levels)
+    base_rows = [np.flatnonzero(dom_base == d) for d in range(n_base)]
+    #: Each base domain's top-level domain (nesting is validated).
+    top_of_base = np.asarray([int(dom_top[rows[0]])
+                              for rows in base_rows], dtype=np.int64)
+    base_cnt = np.zeros((n_base, m), dtype=np.uint16)
+    top_cnt = np.zeros((n_top, m), dtype=np.uint16)
+    base_cnt[dom_base[prim], cols] = 1
+    top_cnt[dom_top[prim], cols] = 1
+    dvb = np.empty((n_base, m), dtype=np.uint32)
+    comp = np.empty((n_base, m), dtype=np.uint64)
+    span = np.uint64(n_nodes + 2)
+    for c in range(1, max_rf):
+        for d, rows in enumerate(base_rows):
+            np.copyto(dvb[d], w[rows[0]])
+            for r in rows[1:]:
+                np.minimum(dvb[d], w[r], out=dvb[d])
+        # Composite key: (top count * span + base count) in the high 32
+        # bits, the packed priority (node id in the low 6) below — the
+        # min over base domains picks the least-covered region, then the
+        # least-covered rack, then the best node, and its identity rides
+        # the minimum.  Exhausted domains are forced to the ceiling.
+        np.multiply(top_cnt[top_of_base].astype(np.uint64), span,
+                    out=comp)
+        comp += base_cnt
+        comp <<= np.uint64(32)
+        comp |= dvb
+        comp[dvb == PRIO_MAX] = _U64_MAX
+        best = comp.min(axis=0)
+        valid = best != _U64_MAX
+        sel = (best.astype(np.uint32) & NODE_MASK).astype(np.int32)
+        # Exhausted rows (rf past the candidate pool — only reachable
+        # when a locality clip or mixed rf leaves the slot unused) must
+        # not index with the sentinel id.
+        np.copyto(sel, np.int32(0), where=~valid)
+        out[:, c] = np.where(valid, sel, -1)
+        w[sel, cols] = np.where(valid, PRIO_MAX, w[sel, cols])
+        np.add.at(base_cnt, (dom_base[sel], cols),
+                  valid.astype(np.uint16))
+        np.add.at(top_cnt, (dom_top[sel], cols),
+                  valid.astype(np.uint16))
+
+
 def compute_placement(
     file_ids: np.ndarray,
     n_shards: np.ndarray,
@@ -174,6 +272,7 @@ def compute_placement(
     salts: np.ndarray | None = None,
     out_width: int | None = None,
     chunk: int = 1 << 17,
+    local_mask: np.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Functional placement of an arbitrary file-id subset.
 
@@ -202,6 +301,9 @@ def compute_placement(
     rf = np.clip(rf, 1, n_nodes)
     if rf.shape == ():  # scalar broadcast
         rf = np.full(m_total, int(rf), dtype=np.int32)
+    hier = getattr(topology, "n_levels", 0) > 0
+    if hier and m_total:
+        rf = clip_shards_for_locality(rf, primary, topology, local_mask)
     max_rf = int(rf.max()) if m_total else 1
     width = max_rf if out_width is None else int(out_width)
     # np.empty, not np.full: every cell in [:, :max_rf] is written below
@@ -220,7 +322,12 @@ def compute_placement(
     # singleton domain" IS the best non-primary node, and a singleton
     # remote domain has no second member — so skip the domain machinery
     # wholesale (bit-identical, property-tested).
-    multi_domain = (1 < n_domains < n_nodes and max_rf >= 2)
+    multi_domain = (not hier and 1 < n_domains < n_nodes
+                    and max_rf >= 2)
+    dom_top = topology.top_domain_index() if hier else None
+    local_all = None
+    if hier and local_mask is not None:
+        local_all = np.asarray(local_mask, dtype=bool)
     uniform_rf = bool((rf == max_rf).all())
     chunk = max(int(chunk), 1)
     buf = min(chunk, m_total)
@@ -258,7 +365,21 @@ def compute_placement(
         out[:, 0] = prim
         w[prim, cols] = PRIO_MAX
 
-        start_col = 1
+        if hier:
+            # Geo-hierarchical policy: region-local files lose every
+            # off-region candidate up front, then the greedy
+            # highest-level-first fill places the remaining slots (one
+            # policy for both choosers — hierarchical_fill).
+            if local_all is not None:
+                lc = local_all[lo:hi]
+                if lc.any():
+                    offr = dom_top[:, None] != dom_top[prim][None, :]
+                    w[offr & lc[None, :]] = PRIO_MAX
+            if max_rf >= 2:
+                hierarchical_fill(w, out, prim, max_rf, topology)
+            start_col = max_rf
+        else:
+            start_col = 1
         if multi_domain:
             # Replica 1: best-priority node OUTSIDE the primary's
             # domain; replica 2: that same remote domain's second-best

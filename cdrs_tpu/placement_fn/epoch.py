@@ -25,7 +25,59 @@ import numpy as np
 
 from .compute import compute_placement, node_salts, primary_on_topology
 
-__all__ = ["Epoch", "EpochDiff", "EpochMap"]
+__all__ = ["Epoch", "EpochDiff", "EpochMap", "addition_moved"]
+
+
+def addition_moved(topo_old, topo_new, n_shards: np.ndarray,
+                   primary: np.ndarray, seed: int = 0, *,
+                   chunk: int = 1 << 20,
+                   local_mask: np.ndarray | None = None) -> np.ndarray:
+    """File ids whose computed placement changes when nodes are APPENDED.
+
+    The additive twin of ``EpochMap.diff``'s removal prune (the elastic
+    scale-out path): when the new topology is the old one plus appended
+    nodes (surviving names, domains, hierarchy levels and ORDER all
+    preserved), a file's placement changes iff its NEW computed slots
+    touch an added node — existing nodes' salts and tie-break order are
+    untouched, so the added nodes' priorities merely splice into each
+    file's otherwise-identical candidate sequence (and an rf re-capped
+    upward by the growth necessarily drafts an added node).  One hash
+    pass over the new topology, candidacy IS the moved set.  ``primary``
+    must already be resolved onto the (shared) node-id space.
+    """
+    old_n = len(topo_old.nodes)
+    prefix_ok = (
+        tuple(topo_new.nodes[:old_n]) == tuple(topo_old.nodes)
+        and len(topo_new.nodes) > old_n
+        and tuple(topo_new.domains[:old_n] if topo_new.domains else ())
+        == tuple(topo_old.domains)
+        and len(topo_old.levels) == len(topo_new.levels)
+        and all(a[0] == b[0] and tuple(b[1][:old_n]) == tuple(a[1])
+                for a, b in zip(topo_old.levels, topo_new.levels)))
+    if not prefix_ok:
+        raise ValueError(
+            "addition_moved needs the new topology to be the old one "
+            "with nodes APPENDED (names, domains, levels and order of "
+            "survivors preserved) — anything else is a general epoch "
+            "diff (EpochMap.diff)")
+    n = int(np.asarray(n_shards).shape[0])
+    shards = np.asarray(n_shards)
+    prim = np.asarray(primary)
+    salts = node_salts(topo_new.nodes, seed)
+    moved_parts: list[np.ndarray] = []
+    for lo in range(0, n, int(chunk)):
+        hi = min(lo + int(chunk), n)
+        fids = np.arange(lo, hi, dtype=np.int64)
+        slots, _ = compute_placement(
+            fids, shards[lo:hi], prim[lo:hi], topo_new, seed,
+            salts=salts,
+            local_mask=None if local_mask is None else local_mask[lo:hi])
+        hit = (slots >= old_n).any(axis=1)
+        if hit.any():
+            moved_parts.append(fids[hit])
+    if not moved_parts:
+        return np.zeros(0, dtype=np.int64)
+    return np.concatenate(moved_parts)
 
 
 @dataclass(frozen=True)
@@ -120,7 +172,8 @@ class EpochMap:
         names_old, names_new = set(topo_old.nodes), set(topo_new.nodes)
         if old_id == new_id or (
                 tuple(topo_old.nodes) == tuple(topo_new.nodes)
-                and tuple(topo_old.domains) == tuple(topo_new.domains)):
+                and tuple(topo_old.domains) == tuple(topo_new.domains)
+                and tuple(topo_old.levels) == tuple(topo_new.levels)):
             w = 0
             empty = np.zeros((0, w), dtype=np.int32)
             return EpochDiff(np.zeros(0, dtype=np.int64), empty, empty,
@@ -152,9 +205,16 @@ class EpochMap:
         # reorder could flip a tie and move a non-holder.
         survivors_in_old_order = [x for x in topo_old.nodes
                                   if x in names_new]
+        lvl_old = {n: tuple(d[i] for _, d in topo_old.levels)
+                   for i, n in enumerate(topo_old.nodes)}
+        lvl_new = {n: tuple(d[i] for _, d in topo_new.levels)
+                   for i, n in enumerate(topo_new.nodes)}
         removal_only = (names_new <= names_old
                         and survivors_in_old_order == list(topo_new.nodes)
                         and all(dom_new[nd] == dom_old[nd]
+                                for nd in topo_new.nodes)
+                        and len(topo_old.levels) == len(topo_new.levels)
+                        and all(lvl_new[nd] == lvl_old[nd]
                                 for nd in topo_new.nodes))
         n_removed = len(names_old - names_new)
         use_prune = bool(prune and removal_only and n_removed)

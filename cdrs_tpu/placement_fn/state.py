@@ -47,7 +47,7 @@ import numpy as np
 from ..faults.state import ClusterState
 from .compute import compute_placement, node_salts
 
-__all__ = ["FunctionalClusterState"]
+__all__ = ["FunctionalClusterState", "OverlayClusterState"]
 
 
 class FunctionalClusterState(ClusterState):
@@ -82,12 +82,19 @@ class FunctionalClusterState(ClusterState):
     # -- base placement ------------------------------------------------------
     def _fn_base_rows(self, fids: np.ndarray) -> np.ndarray:
         """(k, n_nodes) computed-base rows (padded to map width) for a
-        file subset — the pure recompute every consumer shares."""
+        file subset — the pure recompute every consumer shares.  The
+        base is a function of (seed, epoch topology, installed shards,
+        primary, region-locality flag): region-local files compute with
+        their off-region candidates masked."""
         fids = np.asarray(fids, dtype=np.int64)
+        local = None
+        if getattr(self.topology, "n_levels", 0) > 0 \
+                and self.region_local.any():
+            local = self.region_local[fids]
         slots, _ = compute_placement(
             fids, self.installed_shards[fids], self._fn_primary[fids],
             self.topology, self._fn_seed, salts=self._fn_salts,
-            out_width=len(self.nodes))
+            out_width=len(self.nodes), local_mask=local)
         return slots
 
     def exception_fids(self, verify_chunk: int = 1 << 18) -> np.ndarray:
@@ -137,6 +144,25 @@ class FunctionalClusterState(ClusterState):
                         (self.replica_map == i).any(axis=1)))
         super().apply_event(ev)
 
+    def grow(self, topology) -> None:
+        """Elastic scale-out: the appended nodes join the functional
+        base (fresh salts, epoch bump).  The caller must ``pin_rows``
+        the epoch diff's moved set FIRST — every other file's computed
+        row is unchanged (salts are name-keyed)."""
+        super().grow(topology)
+        self._fn_salts = node_salts(self.topology.nodes, self._fn_seed)
+        self._fn_epoch += 1
+
+    def pin_rows(self, fids) -> None:
+        """Dense backend: rows are already materialized; just mark them
+        for exception re-verification against the (about to move)
+        base."""
+        self._fn_touched.update(int(f) for f in np.asarray(fids))
+
+    def retarget_row(self, fid: int, new_row: np.ndarray) -> int:
+        self._fn_touched.add(int(fid))
+        return super().retarget_row(fid, new_row)
+
     # -- base-form retarget --------------------------------------------------
     def apply_rf_target(self, fid: int, rf_new: int,
                         record_intent: bool = True) -> int:
@@ -155,7 +181,7 @@ class FunctionalClusterState(ClusterState):
         cluster: current row in base form, every holder AND every would-be
         computed target reachable (a fault anywhere defers to the legacy
         stateful policy and its partial-placement semantics)."""
-        row = self.replica_map[fid]
+        row = self.row(fid)
         cur = int(self.installed_shards[fid])
         base = self._fn_order(fid, max(cur, int(rf_new)))
         n_cur = int((row >= 0).sum())
@@ -169,10 +195,14 @@ class FunctionalClusterState(ClusterState):
 
     def _fn_order(self, fid: int, shards: int) -> np.ndarray:
         """(min(shards, n_nodes),) computed slot order of one file."""
+        local = None
+        if getattr(self.topology, "n_levels", 0) > 0 \
+                and self.region_local[fid]:
+            local = np.asarray([True])
         slots, _ = compute_placement(
             np.asarray([fid], dtype=np.int64), np.asarray([shards]),
             self._fn_primary[fid:fid + 1], self.topology, self._fn_seed,
-            salts=self._fn_salts)
+            salts=self._fn_salts, local_mask=local)
         row = slots[0]
         return row[row >= 0]
 
@@ -181,7 +211,7 @@ class FunctionalClusterState(ClusterState):
         growth appends computed nodes, shrink drops the computed tail) —
         the add/drop primitives keep bytes, corruption bits and cached
         counts consistent, and the row stays in base form."""
-        cur = int((self.replica_map[fid] >= 0).sum())
+        cur = int((self.row(fid) >= 0).sum())
         self.installed_shards[fid] = int(rf_new)
         target = min(max(int(rf_new), 1), len(self.nodes))
         if target == cur:
@@ -246,11 +276,13 @@ class FunctionalClusterState(ClusterState):
         # replicate-only runs, O(converted files) otherwise.
         dev = np.flatnonzero((self.min_live != 1)
                              | (self.shard_bytes != self.sizes)
-                             | (self.ec_k != 0))
+                             | (self.ec_k != 0)
+                             | self.region_local)
         arrays["fault_fn_strat_fids"] = dev.astype(np.int64)
         arrays["fault_fn_strat_min_live"] = self.min_live[dev].copy()
         arrays["fault_fn_strat_shard_bytes"] = self.shard_bytes[dev].copy()
         arrays["fault_fn_strat_ec_k"] = self.ec_k[dev].copy()
+        arrays["fault_fn_strat_local"] = self.region_local[dev].copy()
         return arrays
 
     def load_state_arrays(self, arrays: dict) -> None:
@@ -293,6 +325,7 @@ class FunctionalClusterState(ClusterState):
         self.min_live = np.ones(n, dtype=np.int32)
         self.shard_bytes = self.sizes.copy()
         self.ec_k = np.zeros(n, dtype=np.int32)
+        self.region_local = np.zeros(n, dtype=bool)
         sf = np.asarray(arrays.get("fault_fn_strat_fids",
                                    np.zeros(0, np.int64)), dtype=np.int64)
         if sf.size:
@@ -302,6 +335,9 @@ class FunctionalClusterState(ClusterState):
                 arrays["fault_fn_strat_shard_bytes"], dtype=np.int64)
             self.ec_k[sf] = np.asarray(
                 arrays["fault_fn_strat_ec_k"], dtype=np.int32)
+            if "fault_fn_strat_local" in arrays:
+                self.region_local[sf] = np.asarray(
+                    arrays["fault_fn_strat_local"], dtype=bool)
         # Recompute the base, then lay the exceptions over it.
         self.replica_map = np.full((n, n_nodes), -1, dtype=np.int32)
         chunk = 1 << 20
@@ -350,3 +386,674 @@ class FunctionalClusterState(ClusterState):
             dev = np.flatnonzero((self.replica_map[lo:hi] != base)
                                  .any(axis=1))
             self._fn_exceptions.update(int(lo + f) for f in dev)
+
+
+class OverlayClusterState(FunctionalClusterState):
+    """Functional ClusterState with NO resident dense map (ROADMAP item
+    3's leftover): the ``(n_files, n_nodes)`` replica map and corruption
+    mask — the two arrays that dominated functional-mode RSS once
+    checkpoints, router and planner stopped needing them (PR 13) — are
+    replaced by the sparse overlay itself.
+
+    * a row is **computed** on demand (``_fn_base_rows``) and overlaid
+      by ``_ov`` — a dict of exactly the rows that deviate from base, so
+      the overlay IS the standing exception set (an entry is written
+      only when the mutated row differs from its recomputed base, and
+      removed the moment a repair reconverges it);
+    * corruption is a ``fid -> slot bitmask`` dict (n_nodes <= 63 — one
+      int per rotten file);
+    * the per-file count caches (live/reachable/domain-spread — O(n)
+      int32, the durability plane) stay maintained exactly as before;
+    * liveness events recompute their blast radius by a chunked base
+      scan — the explicit CRUSH trade: O(population) hashing per
+      node-status event instead of O(population x nodes) resident
+      bytes every second of every run.
+
+    Decision-identical to the dense family by construction: every
+    mutation primitive reproduces the dense semantics on the resolved
+    row (slot positions included), and the class is exercised against
+    the ``materialized_hash`` oracle by the same controller-equivalence
+    tests.  Checkpoints are the sparse snapshot (always —
+    ``sparse_checkpoint=False`` makes no sense without a dense map).
+    ``replica_map``/``slot_corrupt``/masks materialize on access for
+    tests and the evaluate replay; hot paths never touch them.
+    """
+
+    def __init__(self, placement, size_bytes, *, primary: np.ndarray,
+                 seed: int = 0, epoch: int = 0,
+                 sparse_checkpoint: bool = True):
+        if not sparse_checkpoint:
+            raise ValueError(
+                "OverlayClusterState has no dense map to snapshot — use "
+                "FunctionalClusterState for the dense oracle")
+        # Deliberately NOT calling the dense __init__ chain: replicate
+        # the scalar/per-node/per-file (but never per-file-x-node) setup.
+        topology = placement.topology
+        self.topology = topology
+        self.nodes = tuple(topology.nodes)
+        n_nodes = len(self.nodes)
+        rf = np.asarray(placement.rf, dtype=np.int32)
+        n = rf.shape[0]
+        self._node_idx = {nm: i for i, nm in enumerate(self.nodes)}
+        self.domain_index = topology.domain_index()
+        self.n_domains = topology.n_domains
+        self._top_index = (topology.top_domain_index()
+                           if getattr(topology, "n_levels", 0) > 0
+                           else None)
+        self._n_top = (topology.n_domains_at(topology.n_levels)
+                       if self._top_index is not None else 0)
+        self.sizes = np.asarray(size_bytes, dtype=np.int64)
+        if self.sizes.shape != (n,):
+            raise ValueError(
+                f"size_bytes shape {self.sizes.shape} != ({n},)")
+        self.min_live = np.ones(n, dtype=np.int32)
+        self.shard_bytes = self.sizes.copy()
+        self.ec_k = np.zeros(n, dtype=np.int32)
+        self.region_local = np.zeros(n, dtype=bool)
+        self._byte_cost = (topology.byte_cost_matrix()
+                           if getattr(topology, "edge_bytes", ())
+                           else None)
+        self.installed_shards = rf.copy()
+        self._n_corrupt = 0
+        self._corrupt_bits: dict[int, int] = {}
+        self.node_up = np.ones(n_nodes, dtype=bool)
+        self.node_decommissioned = np.zeros(n_nodes, dtype=bool)
+        self.node_partitioned = np.zeros(n_nodes, dtype=bool)
+        self.node_fail_prob = np.zeros(n_nodes, dtype=np.float64)
+        self.node_throughput = np.ones(n_nodes, dtype=np.float64)
+        self._fn_primary = np.asarray(primary, dtype=np.int32)
+        if self._fn_primary.shape[0] != n:
+            raise ValueError(
+                f"primary shape {self._fn_primary.shape} != ({n},)")
+        self._fn_seed = int(seed)
+        self._fn_epoch = int(epoch)
+        self._fn_sparse = True
+        self._fn_salts = node_salts(self.topology.nodes, self._fn_seed)
+        self._fn_touched: set[int] = set()   # compat no-op (see parent)
+        self._fn_exceptions: set[int] = set()
+        self._fn_exc_array = None
+        #: The overlay: fid -> (n_nodes,) int32 row, stored IFF != base.
+        self._ov: dict[int, np.ndarray] = {}
+        rm = placement.replica_map
+        if rm is not None and rm.size:
+            # A hand-built placement may deviate from base: seed the
+            # overlay with exactly the deviating rows (base-form input —
+            # place_replicas(method='hash') — seeds nothing).
+            chunk = 1 << 20
+            for lo in range(0, n, chunk):
+                hi = min(lo + chunk, n)
+                base = self._fn_base_rows(np.arange(lo, hi,
+                                                    dtype=np.int64))
+                width = min(rm.shape[1], n_nodes)
+                given = np.full((hi - lo, n_nodes), -1, dtype=np.int32)
+                given[:, :width] = rm[lo:hi, :width]
+                for f in np.flatnonzero((given != base).any(axis=1)):
+                    self._ov[int(lo + f)] = given[f].copy()
+        self.node_bytes = np.zeros(n_nodes, dtype=np.int64)
+        self._recompute_node_bytes()
+        self.version = 0
+        self._refresh_all()
+
+    @classmethod
+    def from_base(cls, topology, size_bytes, *, n_shards: np.ndarray,
+                  primary: np.ndarray, seed: int = 0,
+                  epoch: int = 0) -> "OverlayClusterState":
+        """Construct directly in base form — no placement materialized
+        anywhere, which is what makes a 100M-file fault-mode controller
+        constructible without the transient (n, rf) map."""
+        import types
+
+        shim = types.SimpleNamespace(
+            topology=topology,
+            # The placement cap (distinct nodes per shard), exactly as
+            # place_replicas would have applied it.
+            rf=np.clip(np.asarray(n_shards), 1,
+                       len(topology.nodes)).astype(np.int32),
+            replica_map=None)
+        return cls(shim, size_bytes, primary=primary, seed=seed,
+                   epoch=epoch)
+
+    # -- row resolution ------------------------------------------------------
+    def row(self, fid: int) -> np.ndarray:
+        """Resolved (n_nodes,) row — overlay entry or computed base.
+        Read-only by contract (mutations go through the primitives)."""
+        r = self._ov.get(int(fid))
+        if r is not None:
+            return r
+        return self._fn_base_rows(np.asarray([fid], dtype=np.int64))[0]
+
+    def rows(self, fids: np.ndarray) -> np.ndarray:
+        fids = np.asarray(fids, dtype=np.int64)
+        out = self._fn_base_rows(fids)
+        if self._ov:
+            ov = self._ov
+            for i, f in enumerate(fids.tolist()):
+                r = ov.get(f)
+                if r is not None:
+                    out[i] = r
+        return out
+
+    def _set_row(self, fid: int, row: np.ndarray) -> None:
+        base = self._fn_base_rows(np.asarray([fid], dtype=np.int64))[0]
+        if np.array_equal(row, base):
+            self._ov.pop(int(fid), None)
+        else:
+            self._ov[int(fid)] = np.asarray(row, dtype=np.int32)
+
+    def assigned_counts(self, chunk: int = 1 << 20) -> np.ndarray:
+        """Chunked through ``rows`` — never materializes the map."""
+        n = self.min_live.shape[0]
+        out = np.empty(n, dtype=np.int64)
+        for lo in range(0, n, int(chunk)):
+            hi = min(lo + int(chunk), n)
+            rows = self.rows(np.arange(lo, hi, dtype=np.int64))
+            out[lo:hi] = (rows >= 0).sum(axis=1)
+        return out
+
+    #: Materialized compat views (tests / evaluate replay only).
+    @property
+    def replica_map(self) -> np.ndarray:
+        n = self.min_live.shape[0]
+        return self.rows(np.arange(n, dtype=np.int64))
+
+    @property
+    def slot_corrupt(self) -> np.ndarray:
+        n = self.min_live.shape[0]
+        out = np.zeros((n, len(self.nodes)), dtype=bool)
+        for f, bits in self._corrupt_bits.items():
+            for s in range(len(self.nodes)):
+                if bits >> s & 1:
+                    out[f, s] = True
+        return out
+
+    def live_mask(self) -> np.ndarray:
+        rm = self.replica_map
+        return (rm >= 0) & self.node_up[np.clip(rm, 0, None)]
+
+    def reachable_mask(self) -> np.ndarray:
+        rm = self.replica_map
+        return (rm >= 0) & self.node_reachable()[np.clip(rm, 0, None)]
+
+    # -- cached counts -------------------------------------------------------
+    def _refresh_all(self, chunk: int = 1 << 20) -> None:
+        """Chunked rebuild through ``rows`` (the per-file refresh is
+        inherited — it already resolves through the overlay)."""
+        n = self.min_live.shape[0]
+        self._live_counts = np.zeros(n, dtype=np.int32)
+        self._reach_counts = np.zeros(n, dtype=np.int32)
+        self._dom_spread = np.zeros(n, dtype=np.int32)
+        if self._top_index is not None:
+            self._top_spread = np.zeros(n, dtype=np.int32)
+        for lo in range(0, n, int(chunk)):
+            hi = min(lo + int(chunk), n)
+            self._refresh_files(np.arange(lo, hi, dtype=np.int64))
+
+    def _recompute_node_bytes(self, chunk: int = 1 << 20) -> None:
+        n = self.min_live.shape[0]
+        self.node_bytes = np.zeros(len(self.nodes), dtype=np.int64)
+        for lo in range(0, n, int(chunk)):
+            hi = min(lo + int(chunk), n)
+            rows = self.rows(np.arange(lo, hi, dtype=np.int64))
+            sel = rows >= 0
+            np.add.at(self.node_bytes, rows[sel],
+                      np.broadcast_to(self.shard_bytes[lo:hi, None],
+                                      rows.shape)[sel])
+
+    # -- holders scan (the per-event recompute trade) ------------------------
+    def _holders(self, node: int, chunk: int = 1 << 20) -> np.ndarray:
+        """Sorted fids whose RESOLVED row assigns ``node`` — chunked
+        base scan patched by the overlay."""
+        n = self.min_live.shape[0]
+        parts: list[np.ndarray] = []
+        for lo in range(0, n, int(chunk)):
+            hi = min(lo + int(chunk), n)
+            fids = np.arange(lo, hi, dtype=np.int64)
+            base = self._fn_base_rows(fids)
+            parts.append(fids[(base == node).any(axis=1)])
+        holders = set(np.concatenate(parts).tolist()) if parts else set()
+        for f, r in self._ov.items():
+            if (r == node).any():
+                holders.add(f)
+            else:
+                holders.discard(f)
+        return np.asarray(sorted(holders), dtype=np.int64)
+
+    # -- mutation primitives -------------------------------------------------
+    def add_replica(self, fid: int, node: int) -> None:
+        row = self.row(fid).copy()
+        free = np.flatnonzero(row < 0)
+        if free.size == 0:  # pragma: no cover - width==n_nodes prevents
+            raise RuntimeError(f"file {fid} has no free replica slot")
+        s = int(free[0])
+        row[s] = node
+        self._clear_corrupt_bit(fid, s)
+        self.node_bytes[node] += self.shard_bytes[fid]
+        self._set_row(fid, row)
+        self._refresh_files(np.asarray([fid]))
+        self.version += 1
+
+    def drop_replica(self, fid: int, node: int) -> None:
+        row = self.row(fid).copy()
+        slots = np.flatnonzero(row == node)
+        if slots.size:
+            s = int(slots[0])
+            row[s] = -1
+            self._clear_corrupt_bit(fid, s)
+            self.node_bytes[node] -= self.shard_bytes[fid]
+            self._set_row(fid, row)
+            self._refresh_files(np.asarray([fid]))
+            self.version += 1
+
+    # -- corruption (sparse bitmasks) ----------------------------------------
+    def _clear_corrupt_bit(self, fid: int, slot: int) -> None:
+        bits = self._corrupt_bits.get(int(fid))
+        if bits is not None and bits >> slot & 1:
+            bits &= ~(1 << slot)
+            self._n_corrupt -= 1
+            if bits:
+                self._corrupt_bits[int(fid)] = bits
+            else:
+                del self._corrupt_bits[int(fid)]
+            self.version += 1
+
+    def corrupt_replica(self, fid: int, node: int) -> bool:
+        row = self.row(fid)
+        slots = np.flatnonzero(row == node)
+        if slots.size == 0:
+            return False
+        s = int(slots[0])
+        bits = self._corrupt_bits.get(int(fid), 0)
+        if bits >> s & 1:
+            return False
+        self._corrupt_bits[int(fid)] = bits | (1 << s)
+        self._n_corrupt += 1
+        self.version += 1
+        return True
+
+    def corrupt_row(self, fid: int) -> np.ndarray:
+        """(n_nodes,) bool rot mask of one file (scrub hint loop)."""
+        out = np.zeros(len(self.nodes), dtype=bool)
+        bits = self._corrupt_bits.get(int(fid), 0)
+        s = 0
+        while bits:
+            if bits & 1:
+                out[s] = True
+            bits >>= 1
+            s += 1
+        return out
+
+    def corrupt_at(self, fids: np.ndarray, slots: np.ndarray) -> np.ndarray:
+        """Bool per (fid, slot) pair — the scrub lap's gather, O(pairs)
+        dict lookups but only when rot exists at all."""
+        if not self._n_corrupt:
+            return np.zeros(np.asarray(fids).shape[0], dtype=bool)
+        cb = self._corrupt_bits
+        return np.fromiter(
+            (bool(cb.get(int(f), 0) >> int(s) & 1)
+             for f, s in zip(np.asarray(fids), np.asarray(slots))),
+            dtype=bool, count=np.asarray(fids).shape[0])
+
+    def verify_sources(self, fid: int) -> tuple[int, int]:
+        if not self._n_corrupt:
+            return 0, 0
+        bits = self._corrupt_bits.get(int(fid), 0)
+        if not bits:
+            return 0, 0
+        row = self.row(fid)
+        reach = self.node_reachable()
+        found = 0
+        charge = 0
+        for s in range(len(self.nodes)):
+            if not (bits >> s & 1) or row[s] < 0:
+                continue
+            node = int(row[s])
+            if not reach[node]:
+                continue
+            charge += int(np.ceil(
+                int(self.shard_bytes[fid])
+                / max(float(self.node_throughput[node]), 1e-9)))
+            self.quarantine(fid, node)
+            found += 1
+        return found, charge
+
+    def corrupt_file_counts(self) -> np.ndarray:
+        n = self.min_live.shape[0]
+        out = np.zeros(n, dtype=np.int32)
+        if not self._n_corrupt:
+            return out
+        for f, bits in self._corrupt_bits.items():
+            row = self.row(f)
+            c = 0
+            for s in range(len(self.nodes)):
+                if bits >> s & 1 and row[s] >= 0 \
+                        and self.node_up[int(row[s])]:
+                    c += 1
+            out[f] = c
+        return out
+
+    def true_lost_mask(self) -> np.ndarray:
+        if not self._n_corrupt:
+            return self.lost_mask()
+        clean = self._live_counts - self.corrupt_file_counts()
+        return clean < self.min_live
+
+    # -- events --------------------------------------------------------------
+    def apply_event(self, ev) -> None:
+        affected: list[np.ndarray] = []
+        for name in ev.node_list:
+            i = self._nid(name)
+            if ev.kind in self._COUNT_KINDS:
+                affected.append(self._holders(i))
+            if ev.kind == "crash":
+                self.node_up[i] = False
+            elif ev.kind == "recover":
+                if not self.node_decommissioned[i]:
+                    self.node_up[i] = True
+            elif ev.kind == "decommission":
+                self.node_up[i] = False
+                self.node_decommissioned[i] = True
+                gone = affected[-1]
+                self.node_bytes[i] = 0
+                for f in gone.tolist():
+                    row = self.row(int(f)).copy()
+                    for s in np.flatnonzero(row == i):
+                        row[int(s)] = -1
+                        self._clear_corrupt_bit(int(f), int(s))
+                    self._set_row(int(f), row)
+            elif ev.kind == "partition":
+                self.node_partitioned[i] = True
+            elif ev.kind == "heal":
+                self.node_partitioned[i] = False
+            elif ev.kind == "flaky":
+                self.node_fail_prob[i] = float(ev.fail_prob)
+            elif ev.kind == "unflaky":
+                self.node_fail_prob[i] = 0.0
+            elif ev.kind == "degrade":
+                self.node_throughput[i] = float(ev.factor)
+            elif ev.kind == "restore":
+                self.node_throughput[i] = 1.0
+            elif ev.kind == "corrupt":
+                if ev.file >= 0:
+                    if ev.file >= self.min_live.shape[0]:
+                        raise ValueError(
+                            f"corrupt event {ev.spec()!r} pins file "
+                            f"{ev.file} but the population has "
+                            f"{self.min_live.shape[0]} files")
+                    self.corrupt_replica(int(ev.file), i)
+                else:
+                    from ..faults.state import _corrupt_roll
+
+                    holds = self._holders(i)
+                    roll = _corrupt_roll(ev.window, i, holds)
+                    for f in holds[roll < float(ev.fail_prob)]:
+                        self.corrupt_replica(int(f), i)
+            else:  # pragma: no cover - FaultEvent validates kinds
+                raise ValueError(f"unknown fault kind {ev.kind!r}")
+        if affected:
+            self._refresh_files(np.unique(np.concatenate(affected)))
+        self.version += 1
+
+    # -- intent changes pin the row (the implicit-base contract) -------------
+    # A row absent from the overlay IS its computed base — which moves
+    # the moment installed_shards (or the strategy/locality vectors, or
+    # the epoch) changes.  Every intent-changing path therefore pins the
+    # currently-resolved row first; the mutation primitives' _set_row
+    # drops the pin again the moment the row physically reaches the new
+    # base (so steady-state retargets still serialize to zero
+    # exceptions, exactly like the dense twin).
+    def apply_rf_target(self, fid: int, rf_new: int,
+                        record_intent: bool = True) -> int:
+        if record_intent:
+            if self._fn_can_retarget(fid, rf_new):
+                return self._fn_retarget(fid, rf_new)
+            pinned = self.row(fid).copy()
+            self.installed_shards[fid] = int(rf_new)
+            self._ov[int(fid)] = pinned
+            delta = ClusterState.apply_rf_target(self, fid, rf_new,
+                                                 record_intent=False)
+            self._set_row(fid, self.row(fid).copy())
+            return delta
+        return ClusterState.apply_rf_target(self, fid, rf_new,
+                                            record_intent=False)
+
+    def _fn_retarget(self, fid: int, rf_new: int) -> int:
+        cur_row = self.row(fid).copy()
+        cur = int((cur_row >= 0).sum())
+        self.installed_shards[fid] = int(rf_new)
+        self._ov[int(fid)] = cur_row          # pin under the new base
+        target = min(max(int(rf_new), 1), len(self.nodes))
+        if target == cur:
+            self._set_row(fid, self.row(fid).copy())
+            return 0
+        order = self._fn_order(fid, max(cur, target))
+        delta = 0
+        for node in order[cur:target]:
+            self.add_replica(fid, int(node))
+            delta += 1
+        for node in order[target:cur][::-1]:
+            self.drop_replica(fid, int(node))
+            delta -= 1
+        return delta
+
+    def apply_strategy_target(self, fid: int, min_live: int,
+                              shard_bytes: int, ec_k: int,
+                              target: int,
+                              region_local: bool = False) -> int:
+        same = (int(self.min_live[fid]) == int(min_live)
+                and int(self.shard_bytes[fid]) == int(shard_bytes)
+                and int(self.ec_k[fid]) == int(ec_k)
+                and bool(self.region_local[fid]) == bool(region_local))
+        if not same:
+            # The re-encode changes the base (strategy vectors feed it):
+            # pin the resolved row so the drops/adds below mutate real
+            # state, not a phantom recompute.
+            self._ov.setdefault(int(fid), self.row(fid).copy())
+        delta = ClusterState.apply_strategy_target(
+            self, fid, min_live, shard_bytes, ec_k, target, region_local)
+        if not same:
+            self._set_row(fid, self.row(fid).copy())
+        return delta
+
+    def pin_rows(self, fids) -> None:
+        """Pin resolved rows before a base-moving change (epoch
+        advance): afterwards they stand as exceptions until the
+        rebalance physically reconverges them."""
+        fids = np.asarray(fids, dtype=np.int64)
+        if fids.size == 0:
+            return
+        rows = self.rows(fids)
+        for i, f in enumerate(fids.tolist()):
+            self._ov[int(f)] = rows[i].copy()
+
+    def retarget_row(self, fid: int, new_row: np.ndarray) -> int:
+        new_row = np.asarray(new_row, dtype=np.int32)
+        old_row = self.row(fid).copy()
+        old_nodes = {int(x) for x in old_row[old_row >= 0]}
+        new_nodes = {int(x) for x in new_row[new_row >= 0]}
+        sb = int(self.shard_bytes[fid])
+        for v in old_nodes - new_nodes:
+            self.node_bytes[v] -= sb
+        for v in new_nodes - old_nodes:
+            self.node_bytes[v] += sb
+        bits = self._corrupt_bits.get(int(fid), 0)
+        if bits:
+            slot_of = {int(v): int(s) for s, v in enumerate(new_row)
+                       if v >= 0}
+            new_bits = 0
+            for s in range(len(self.nodes)):
+                if bits >> s & 1:
+                    v = int(old_row[s])
+                    if v in slot_of:
+                        new_bits |= 1 << slot_of[v]
+                    else:
+                        self._n_corrupt -= 1
+            if new_bits:
+                self._corrupt_bits[int(fid)] = new_bits
+            else:
+                del self._corrupt_bits[int(fid)]
+        self._set_row(fid, new_row)
+        self._refresh_files(np.asarray([fid]))
+        self.version += 1
+        return sb * len(new_nodes - old_nodes)
+
+    def grow(self, topology) -> None:
+        """Scale-out without a dense map: per-node arrays extend
+        (``_grow_common`` — shared with the dense backend), every
+        PINNED overlay row widens, salts refresh, epoch bumps."""
+        add = self._grow_common(topology)
+        pad = np.full(add, -1, dtype=np.int32)
+        for f in list(self._ov):
+            self._ov[f] = np.concatenate([self._ov[f], pad])
+        self._fn_salts = node_salts(self.topology.nodes, self._fn_seed)
+        self._fn_epoch += 1
+
+    # -- serve resolution ----------------------------------------------------
+    def read_rows(self, uniq: np.ndarray):
+        """(rows, slot_ok, slot_corrupt|None) for a unique-pid subset —
+        the serve layer's O(unique pids) view (serve/view.read_view)."""
+        rows = self.rows(uniq)
+        ok = (rows >= 0) & self.node_reachable()[np.clip(rows, 0, None)]
+        corrupt = None
+        if self._n_corrupt:
+            corrupt = np.zeros(rows.shape, dtype=bool)
+            cb = self._corrupt_bits
+            for i, f in enumerate(np.asarray(uniq).tolist()):
+                bits = cb.get(int(f), 0)
+                s = 0
+                while bits:
+                    if bits & 1:
+                        corrupt[i, s] = True
+                    bits >>= 1
+                    s += 1
+        return rows, ok, corrupt
+
+    # -- exceptions / checkpoint ---------------------------------------------
+    def exception_fids(self, verify_chunk: int = 1 << 18) -> np.ndarray:
+        """The overlay keys — maintained exactly (rows are stored iff
+        they deviate from base), so no re-verification pass exists."""
+        return np.asarray(sorted(self._ov), dtype=np.int64)
+
+    def state_arrays(self, rf_hint: np.ndarray | None = None
+                     ) -> dict[str, np.ndarray]:
+        exc = self.exception_fids()
+        arrays: dict[str, np.ndarray] = {
+            "fault_fn_sparse": np.asarray([1], dtype=np.int8),
+            "fault_fn_seed": np.asarray([self._fn_seed], dtype=np.int64),
+            "fault_fn_epoch": np.asarray([self._fn_epoch],
+                                         dtype=np.int64),
+            "fault_fn_exc_fids": exc,
+            "fault_fn_exc_rows": (
+                np.stack([self._ov[int(f)] for f in exc])
+                if exc.size else np.zeros((0, len(self.nodes)),
+                                          dtype=np.int32)),
+            "fault_node_up": self.node_up.copy(),
+            "fault_node_decommissioned": self.node_decommissioned.copy(),
+            "fault_node_partitioned": self.node_partitioned.copy(),
+            "fault_node_fail_prob": self.node_fail_prob.copy(),
+            "fault_node_throughput": self.node_throughput.copy(),
+        }
+        if self._n_corrupt:
+            cf, cs = [], []
+            for f in sorted(self._corrupt_bits):
+                bits = self._corrupt_bits[f]
+                for s in range(len(self.nodes)):
+                    if bits >> s & 1:
+                        cf.append(f)
+                        cs.append(s)
+            arrays["fault_fn_corrupt_fid"] = np.asarray(cf,
+                                                        dtype=np.int64)
+            arrays["fault_fn_corrupt_slot"] = np.asarray(cs,
+                                                         dtype=np.int32)
+        if rf_hint is not None:
+            default = np.clip(np.asarray(rf_hint, dtype=np.int64),
+                              1, None).astype(np.int32)
+            dev = np.flatnonzero(self.installed_shards != default)
+            arrays["fault_fn_intent_fids"] = dev.astype(np.int64)
+            arrays["fault_fn_intent_vals"] = \
+                self.installed_shards[dev].copy()
+        else:
+            arrays["fault_fn_intent_dense"] = self.installed_shards.copy()
+        dev = np.flatnonzero((self.min_live != 1)
+                             | (self.shard_bytes != self.sizes)
+                             | (self.ec_k != 0)
+                             | self.region_local)
+        arrays["fault_fn_strat_fids"] = dev.astype(np.int64)
+        arrays["fault_fn_strat_min_live"] = self.min_live[dev].copy()
+        arrays["fault_fn_strat_shard_bytes"] = \
+            self.shard_bytes[dev].copy()
+        arrays["fault_fn_strat_ec_k"] = self.ec_k[dev].copy()
+        arrays["fault_fn_strat_local"] = self.region_local[dev].copy()
+        return arrays
+
+    def load_state_arrays(self, arrays: dict) -> None:
+        if "fault_fn_sparse" not in arrays:
+            raise ValueError(
+                "OverlayClusterState resumes from sparse functional "
+                "snapshots only (this one is dense — a materialized-"
+                "mode checkpoint; stale checkpoint? delete it to start "
+                "over)")
+        n = self.min_live.shape[0]
+        if int(arrays["fault_fn_seed"][0]) != self._fn_seed:
+            raise ValueError(
+                f"checkpoint placement seed "
+                f"{int(arrays['fault_fn_seed'][0])} != {self._fn_seed} "
+                f"— stale checkpoint? delete it to start over")
+        self._fn_epoch = int(arrays["fault_fn_epoch"][0])
+        if "fault_fn_intent_dense" in arrays:
+            self.installed_shards = np.asarray(
+                arrays["fault_fn_intent_dense"], dtype=np.int32).copy()
+        else:
+            if "current_rf" not in arrays:
+                raise ValueError(
+                    "sparse functional checkpoint needs the "
+                    "controller's current_rf for intent reconstruction")
+            self.installed_shards = np.clip(
+                np.asarray(arrays["current_rf"], dtype=np.int64), 1,
+                None).astype(np.int32)
+            fids = np.asarray(arrays["fault_fn_intent_fids"],
+                              dtype=np.int64)
+            self.installed_shards[fids] = np.asarray(
+                arrays["fault_fn_intent_vals"], dtype=np.int32)
+        self.min_live = np.ones(n, dtype=np.int32)
+        self.shard_bytes = self.sizes.copy()
+        self.ec_k = np.zeros(n, dtype=np.int32)
+        self.region_local = np.zeros(n, dtype=bool)
+        sf = np.asarray(arrays.get("fault_fn_strat_fids",
+                                   np.zeros(0, np.int64)),
+                        dtype=np.int64)
+        if sf.size:
+            self.min_live[sf] = np.asarray(
+                arrays["fault_fn_strat_min_live"], dtype=np.int32)
+            self.shard_bytes[sf] = np.asarray(
+                arrays["fault_fn_strat_shard_bytes"], dtype=np.int64)
+            self.ec_k[sf] = np.asarray(
+                arrays["fault_fn_strat_ec_k"], dtype=np.int32)
+            if "fault_fn_strat_local" in arrays:
+                self.region_local[sf] = np.asarray(
+                    arrays["fault_fn_strat_local"], dtype=bool)
+        exc = np.asarray(arrays["fault_fn_exc_fids"], dtype=np.int64)
+        rows = np.asarray(arrays["fault_fn_exc_rows"], dtype=np.int32)
+        self._ov = {int(f): rows[i].copy()
+                    for i, f in enumerate(exc.tolist())}
+        self._corrupt_bits = {}
+        self._n_corrupt = 0
+        if "fault_fn_corrupt_fid" in arrays:
+            for f, s in zip(
+                    np.asarray(arrays["fault_fn_corrupt_fid"]).tolist(),
+                    np.asarray(arrays["fault_fn_corrupt_slot"]).tolist()):
+                self._corrupt_bits[int(f)] = \
+                    self._corrupt_bits.get(int(f), 0) | (1 << int(s))
+                self._n_corrupt += 1
+        self.node_up = np.asarray(arrays["fault_node_up"],
+                                  dtype=bool).copy()
+        self.node_decommissioned = np.asarray(
+            arrays["fault_node_decommissioned"], dtype=bool).copy()
+        self.node_partitioned = np.asarray(
+            arrays["fault_node_partitioned"], dtype=bool).copy()
+        self.node_fail_prob = np.asarray(
+            arrays["fault_node_fail_prob"], dtype=np.float64).copy()
+        self.node_throughput = np.asarray(
+            arrays["fault_node_throughput"], dtype=np.float64).copy()
+        self._recompute_node_bytes()
+        self._refresh_all()
+        self.version += 1

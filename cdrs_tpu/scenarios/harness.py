@@ -179,16 +179,30 @@ def _controller(spec: ScenarioSpec, manifest: Manifest,
                 schedule: FaultSchedule | None) -> ReplicationController:
     scoring = _scoring(spec)
     topology = None
-    if spec.racks:
+    if spec.topology is not None:
+        from ..cluster import ClusterTopology
+
+        topology = ClusterTopology.from_hierarchy(spec.topology)
+    elif spec.racks:
         from ..cluster import ClusterTopology
 
         topology = ClusterTopology.from_rack_spec(manifest.nodes,
                                                   spec.racks)
+    elastic = None
+    if spec.elastic is not None:
+        from ..control.elastic import ElasticPolicy
+
+        elastic = ElasticPolicy.from_dict(spec.elastic)
     storage = None
     if spec.storage:
-        from ..storage import resolve_storage_config
+        if isinstance(spec.storage, dict):
+            from ..storage import storage_config_from_dict
 
-        storage = resolve_storage_config(spec.storage, scoring)
+            storage = storage_config_from_dict(spec.storage)
+        else:
+            from ..storage import resolve_storage_config
+
+            storage = resolve_storage_config(spec.storage, scoring)
     serve = None
     if spec.serve is not None:
         from ..serve import ServeConfig, SloSpec
@@ -230,6 +244,7 @@ def _controller(spec: ScenarioSpec, manifest: Manifest,
         storage=storage,
         serve=serve,
         scrub=scrub,
+        elastic=elastic,
     )
     return ReplicationController(manifest, cfg)
 
@@ -254,11 +269,63 @@ def _served_windows(records: list[dict]) -> list[dict]:
 def _check_invariants(spec: ScenarioSpec, records: list[dict],
                       max_bytes: int | None, budget_slack: int,
                       multi_domain: bool, has_corrupt: bool,
-                      has_ec: bool) -> dict:
+                      has_ec: bool, schedule=None) -> dict:
     inv: dict[str, bool] = {}
     dur = [r for r in records if r.get("durability")]
     if dur:
         inv["zero_lost_final"] = dur[-1]["durability"]["lost"] == 0
+    # -- geo-hierarchical cells (topology axis) ----------------------------
+    scoped = schedule is not None and any(
+        ":" in n for ev in schedule for n in ev.node_list)
+    has_partition = schedule is not None and any(
+        ev.kind == "partition" for ev in schedule)
+    if spec.topology is not None and dur:
+        n_regions = len({str(d) for d in
+                         (spec.topology.get(
+                             spec.topology["levels"][-1]) or {})})
+        if scoped:
+            # A region-scale event must actually BITE: some window saw
+            # fewer reachable regions than the topology defines.
+            inv["region_engaged"] = any(
+                0 < r["durability"].get("regions_reachable", n_regions)
+                < n_regions for r in dur)
+        if has_partition:
+            # Stranded != lost: a partition strands data behind the WAN
+            # split, it never destroys it — and repairs STALL on the
+            # doomed files (deferred_partition) instead of burning
+            # budget on copies that cannot land.
+            stranded = [r for r in dur
+                        if r["durability"].get("unreachable", 0) > 0]
+            inv["stranded_not_lost"] = bool(
+                stranded and all(r["durability"]["lost"] == 0
+                                 for r in stranded))
+            inv["partition_stall_engaged"] = any(
+                r.get("repair_deferred_partition", 0) > 0
+                for r in records)
+        # Heal convergence: whatever the schedule did, the final window
+        # is whole again — nothing stranded, nothing under target, and
+        # every hierarchy level's correlated risk back to zero (the
+        # cross-region spread was actually restored, not just counted).
+        last = dur[-1]["durability"]
+        inv["heal_converged"] = (
+            last.get("unreachable", 0) == 0
+            and last["under_replicated"] == 0
+            and all(v == 0 for v in last.get(
+                "correlated_risk_levels", {}).values()))
+    # -- elastic cells -----------------------------------------------------
+    if spec.elastic is not None:
+        el = [r.get("elastic") or {} for r in records]
+        moved = sum(e.get("moved", 0) for e in el)
+        rebal = sum(e.get("rebalanced", 0) for e in el)
+        drained = [n for e in el for n in e.get("drained", ())]
+        inv["elastic_engaged"] = bool(
+            any("added" in e for e in el)       # scale-out fired
+            and moved == rebal                  # traffic == epoch diff
+            and (el[-1].get("queue", 0) == 0))  # queue fully drained
+        inv["elastic_drained"] = bool(
+            drained
+            and dur and dur[-1]["durability"]["nodes_up"]
+            == len(spec.nodes))                 # capacity back to baseline
     # Positive engagement: a cell whose axis silently failed to inject
     # must not pass vacuously — the invariants below only bite when the
     # machinery they guard actually fired (the replaced CI steps
@@ -332,7 +399,9 @@ def _check_invariants(spec: ScenarioSpec, records: list[dict],
         slack = budget_slack if integ else 0
         inv["budget_conserved"] = all(
             r.get("repair_bytes", 0) + r["bytes_migrated"]
-            + (r.get("scrub") or {}).get("bytes", 0) <= max_bytes + slack
+            + (r.get("scrub") or {}).get("bytes", 0)
+            + (r.get("elastic") or {}).get("rebalance_bytes", 0)
+            <= max_bytes + slack
             for r in records)
     if multi_domain and dur:
         inv["domain_diversity"] = \
@@ -391,7 +460,7 @@ def run_cell(spec: ScenarioSpec, *, suite: str | None = None,
     records = res.records
 
     multi_domain = False
-    if spec.racks:
+    if spec.racks or spec.topology is not None:
         multi_domain = len(set(
             ctl.cfg.topology.domains)) > 1 if ctl.cfg.topology else False
     has_corrupt = schedule is not None and any(
@@ -410,7 +479,8 @@ def run_cell(spec: ScenarioSpec, *, suite: str | None = None,
             len(spec.nodes)
             * int(np.max(np.asarray(manifest.size_bytes))) / min_factor)
     inv = _check_invariants(spec, records, max_bytes, budget_slack,
-                            multi_domain, has_corrupt, has_ec)
+                            multi_domain, has_corrupt, has_ec,
+                            schedule=schedule)
 
     if spec.resume_window is not None:
         import os

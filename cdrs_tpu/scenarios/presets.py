@@ -39,6 +39,24 @@ _NODES6 = ("dn1", "dn2", "dn3", "dn4", "dn5", "dn6")
 _NODES12 = tuple(f"dn{i}" for i in range(1, 13))
 _RACKS12 = ("r0=dn1,dn2,dn3;r1=dn4,dn5,dn6;"
             "r2=dn7,dn8,dn9;r3=dn10,dn11,dn12")
+#: Geo hierarchy: 3 regions x 2 racks x 2 nodes, WAN edges priced
+#: (cross-region copies cost 4x budget bytes, reads +8x service time).
+_GEO_TOPOLOGY = {
+    "nodes": list(_NODES12),
+    "levels": ["rack", "region"],
+    "rack": {f"r{j}": [f"dn{2 * j + 1}", f"dn{2 * j + 2}"]
+             for j in range(6)},
+    "region": {"eu": ["r0", "r1"], "us": ["r2", "r3"],
+               "ap": ["r4", "r5"]},
+    "edge_bytes": {"rack": 1.0, "region": 4.0},
+    "edge_latency": {"rack": 1.5, "region": 8.0},
+}
+#: Region-local Archival stripes: ec(2,1) pinned to the primary's
+#: region (zero WAN bytes for cold data; a WAN partition STRANDS these
+#: — the stranded != lost scenario), everything else replicates spread.
+_GEO_LOCAL_STORAGE = {
+    "strategies": {"Archival": {"k": 2, "m": 1, "tier": "cold",
+                                "locality": "region"}}}
 
 
 def _presets() -> dict[str, ScenarioSpec]:
@@ -140,6 +158,55 @@ def _presets() -> dict[str, ScenarioSpec]:
         faults={"specs": ["crash:dn3@6-9"]},
         serve={"policy": "p2c"}, resume_window=8)
 
+    # -- geo hierarchy: region loss / WAN partition / elasticity -----------
+    # Kill a whole REGION (4 of 12 nodes, correlated): hierarchy-aware
+    # placement spreads every file's copies across regions — replicate
+    # rf>=2 and the spread EC(6,3) stripes (shards (3,3,3) per region;
+    # 6 = k survive) both ride it out with ZERO loss, where the same
+    # workload on a racks-only topology measurably loses files (the
+    # contrast is pinned by tests/test_geo.py and benchmarks/geo_bench).
+    # Functional placement + mid-cell kill/resume: the sparse overlay
+    # snapshot must restore the region outage bit-identically.
+    p["region-loss"] = ScenarioSpec(
+        name="region-loss", n_files=400, seed=21, duration=1800.0,
+        n_windows=15, k=12, nodes=_NODES12, topology=_GEO_TOPOLOGY,
+        placement="functional", storage="ec_archival",
+        faults={"specs": ["crash:region:eu@5-9"]},
+        serve={"policy": "p2c"}, resume_window=7)
+    # Partition region eu off the WAN: its region-LOCAL Archival
+    # stripes strand (unreachable > 0) but are never lost, repairs
+    # STALL on them (partition backoff) instead of burning budget on
+    # doomed WAN copies, and the heal converges every level's
+    # correlated risk back to zero.
+    p["wan-partition"] = ScenarioSpec(
+        name="wan-partition", n_files=400, seed=22, duration=1800.0,
+        n_windows=15, k=12, nodes=_NODES12, topology=_GEO_TOPOLOGY,
+        placement="functional", storage=_GEO_LOCAL_STORAGE,
+        faults={"specs": ["partition:region:eu@4-7"]},
+        serve={"policy": "p2c"})
+    # Black Friday: a flash crowd on the hot cohort saturates the
+    # 3-node baseline; sustained SLO burn activates the standby pool
+    # (capacity doubles), the addition-pruned epoch diff rebalances
+    # inside the shared churn budget, p99 recovers within the SloSpec
+    # bound by the final window, and the cool-down drains capacity back
+    # to baseline via rolling decommission.  Kill/resume crosses the
+    # scale-out boundary (grown-topology checkpoint restore).
+    p["black-friday"] = ScenarioSpec(
+        name="black-friday", n_files=300, seed=23, duration=1800.0,
+        n_windows=15, k=12, placement="functional",
+        workload={"kind": "flash_crowd", "start_frac": 0.25,
+                  "duration_frac": 0.3, "boost": 25.0, "cohort": "hot"},
+        serve={"policy": "p2c", "service_ms": 6.0, "slo_ms": 60.0,
+               "p99_max_ms": 60.0},
+        # burn_hot sits WELL inside the crowd/off-crowd separation
+        # (burn ~0 quiet, >= 0.6 under the crowd on every suite seed):
+        # the trigger must be decisive, not a coin flip at the
+        # threshold.
+        elastic={"pool": ["sb1", "sb2", "sb3"], "burn_hot": 0.4,
+                 "util_hot": 0.9, "hot_windows": 2, "util_cool": 0.5,
+                 "cool_windows": 2, "drain_spacing": 1},
+        resume_window=8)
+
     # -- workload curves / drift patterns ----------------------------------
     p["diurnal"] = ScenarioSpec(
         name="diurnal", n_files=300, seed=10, duration=1800.0,
@@ -231,7 +298,8 @@ SUITES: dict[str, tuple[tuple[str, ...], int]] = {
                   "rolling-decommission", "storage-ec", "serve-chaos",
                   "flash-crowd", "integrity-scrub", "integrity-read",
                   "diurnal", "adversarial-drift", "gradual-drift",
-                  "scale-mesh", "scale-placement"), 2),
+                  "scale-mesh", "scale-placement",
+                  "region-loss", "wan-partition", "black-friday"), 2),
     # Everything, including the slow legacy-reproduction preset.
     "full": (tuple(PRESETS), 4),
 }

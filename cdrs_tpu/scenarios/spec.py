@@ -85,9 +85,22 @@ class ScenarioSpec:
     drift: dict | None = None
     faults: dict | None = None
     racks: str | None = None
-    storage: str | None = None
+    #: Geo-hierarchical topology spec (the ``ClusterTopology.
+    #: from_hierarchy`` dict: levels, per-level group maps, optional
+    #: edge_bytes/edge_latency multipliers).  Mutually exclusive with
+    #: ``racks``; fault specs may then use domain scopes
+    #: (``crash:region:eu@5-9``).
+    topology: dict | None = None
+    #: ``replicate`` / ``ec_archival`` / JSON path, or an inline
+    #: StorageConfig dict (storage_config_from_dict — the form that can
+    #: carry per-category ``locality`` rules).
+    storage: str | dict | None = None
     serve: dict | None = None
     scrub: int | None = None
+    #: Elastic capacity (control/elastic.ElasticPolicy dict: standby
+    #: pool + hot/cool thresholds).  Requires ``serve`` (the telemetry
+    #: source) and a hash ``placement`` mode (the epoch-diff rebalance).
+    elastic: dict | None = None
     # -- controller knobs --------------------------------------------------
     #: Per-window churn budget as a fraction of the population's total
     #: bytes (None = unbounded) — repair + migration + scrub share it.
@@ -146,6 +159,40 @@ class ScenarioSpec:
                 f"cell {self.name!r}: unknown placement "
                 f"{self.placement!r} (want 'materialized', 'functional' "
                 f"or 'materialized_hash')")
+        if self.topology is not None:
+            if self.racks is not None:
+                raise ValueError(
+                    f"cell {self.name!r}: topology and racks are "
+                    f"mutually exclusive (the hierarchy spec subsumes "
+                    f"the rack map)")
+            if not isinstance(self.topology, dict):
+                raise ValueError(
+                    f"cell {self.name!r}: topology must be a hierarchy "
+                    f"spec dict (ClusterTopology.from_hierarchy)")
+            from ..cluster.placement import ClusterTopology
+
+            try:
+                topo = ClusterTopology.from_hierarchy(self.topology)
+            except ValueError as e:
+                raise ValueError(
+                    f"cell {self.name!r}: bad topology spec: {e}"
+                ) from None
+            if set(topo.nodes) != set(self.nodes):
+                raise ValueError(
+                    f"cell {self.name!r}: topology nodes "
+                    f"{sorted(topo.nodes)} != cell nodes "
+                    f"{sorted(self.nodes)}")
+        if self.elastic is not None:
+            if self.serve is None:
+                raise ValueError(
+                    f"cell {self.name!r}: elastic requires a serve axis "
+                    f"(the SLO-burn/utilization telemetry that drives "
+                    f"the scale decisions)")
+            if self.placement == "materialized":
+                raise ValueError(
+                    f"cell {self.name!r}: elastic requires a hash "
+                    f"placement mode ('functional'/'materialized_hash')"
+                    f" — scale-out rebalances by epoch diff")
         if self.mesh is not None:
             # Kept jax-import-free (specs parse anywhere): the full axis
             # validation re-runs in ControllerConfig/validate_mesh_shape.

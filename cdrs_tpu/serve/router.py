@@ -348,6 +348,7 @@ class ReadRouter:
               window_seconds: float | None = None,
               rng: np.random.Generator | None = None,
               extra_ms: np.ndarray | None = None,
+              edge_ms: np.ndarray | None = None,
               slot_corrupt: np.ndarray | None = None) -> WindowServeResult:
         """Route one time-ordered batch of reads.
 
@@ -368,6 +369,15 @@ class ReadRouter:
         on the CLIENT side of the queue, so it does not occupy the
         chosen server — queue waits are unchanged, the latency sample
         (and therefore the percentiles and SLO burn) carries it.
+
+        ``edge_ms``: optional (n_nodes, n_nodes) added latency for a
+        read served ACROSS the topology hierarchy — indexed
+        ``[client, server]`` (the geo topology's
+        ``latency_matrix``-derived propagation delay; WAN ≫ rack).
+        Reads from clients outside the topology (``client == -1``) add
+        nothing.  Propagation is wire time on the client side of the
+        queue: server busy-time and queue waits are unchanged, the
+        latency sample (percentiles, SLO burn) carries it.
 
         ``slot_corrupt``: optional (n_files, R) bool — slots whose copy
         has silently rotted (``ClusterState.slot_corrupt``).  With
@@ -458,6 +468,12 @@ class ReadRouter:
                                                  dtype=np.float64)[routed]
         if retry_ms is not None:
             latency_ms = latency_ms + retry_ms[routed]
+        if edge_ms is not None:
+            cl = np.where(client >= 0, client, 0)
+            hop = np.asarray(edge_ms, dtype=np.float64)[
+                cl, np.clip(server, 0, None)]
+            hop = np.where(client >= 0, hop, 0.0)
+            latency_ms = latency_ms + hop[routed]
 
         counts = np.bincount(server[routed], minlength=self.n_nodes
                              ).astype(np.int64)

@@ -40,6 +40,10 @@ class ReadView:
     node_throughput: np.ndarray      # (n_nodes,) float64
     slot_corrupt: np.ndarray | None  # same shape as replica_map, or None
     pid: np.ndarray                  # read file ids, remapped if compacted
+    #: Population file id behind each ROW of a compacted view; None =
+    #: rows are population-indexed (callers overlaying per-file masks
+    #: index with this when present).
+    file_ids: np.ndarray | None = None
 
 
 def read_view(pid: np.ndarray, *, state=None, placement=None,
@@ -53,6 +57,17 @@ def read_view(pid: np.ndarray, *, state=None, placement=None,
     output, plus any exception overlay the caller maintains.
     """
     if state is not None:
+        if getattr(state, "read_rows", None) is not None:
+            # Lowmem functional backend: resolve ONLY this window's
+            # unique files (rows + reachability + sparse rot) — the
+            # fault path's O(unique pids) counterpart of the static
+            # resolver below.  Routing is bit-identical: the router
+            # only ever indexes replica_map[pid].
+            uniq, inv = np.unique(pid, return_inverse=True)
+            rows, ok, corrupt = state.read_rows(uniq)
+            return ReadView(rows, ok, state.node_throughput, corrupt,
+                            inv.astype(pid.dtype if pid.dtype.kind == "i"
+                                       else np.int64), file_ids=uniq)
         corrupt = state.slot_corrupt if state.has_corruption else None
         return ReadView(state.replica_map, state.reachable_mask(),
                         state.node_throughput, corrupt, pid)
@@ -64,7 +79,7 @@ def read_view(pid: np.ndarray, *, state=None, placement=None,
         rows = np.asarray(resolver(uniq), dtype=np.int32)
         return ReadView(rows, rows >= 0, np.ones(n_nodes), None,
                         inv.astype(pid.dtype if pid.dtype.kind == "i"
-                                   else np.int64))
+                                   else np.int64), file_ids=uniq)
     if placement is None:
         raise ValueError("read_view needs one of state=, resolver=, "
                          "placement=")

@@ -95,7 +95,7 @@ DEFAULT_TIERS: dict[str, StorageTier] = _default_tiers()
 _SPEC_RE = re.compile(
     r"^\s*(?:(?:replicate|rf)\((?P<rf>-?\d+)\)"
     r"|ec\((?P<k>-?\d+)\s*,\s*(?P<m>-?\d+)\))"
-    r"\s*(?::(?P<tier>\w+))?\s*$")
+    r"\s*(?::(?P<tier>\w+))?(?::(?P<loc>region|spread))?\s*$")
 
 
 @dataclass(frozen=True)
@@ -107,8 +107,20 @@ class Strategy:
     k: int = 1                # ec: data shards
     m: int = 0                # ec: parity shards
     tier: str = "hot"
+    #: Placement locality on a geo-hierarchical topology: ``"spread"``
+    #: (default) lets copies/shards cross top-level domains — the
+    #: region-loss survival posture; ``"region"`` pins the whole file to
+    #: its primary's top-level domain — zero WAN bytes for data whose
+    #: durability target is satisfied in-region (stripes stay local; a
+    #: WAN partition strands them rather than losing them).  Ignored by
+    #: non-hierarchical topologies.
+    locality: str = "spread"
 
     def __post_init__(self):
+        if self.locality not in ("spread", "region"):
+            raise ValueError(
+                f"unknown strategy locality {self.locality!r} "
+                f"(want 'spread' or 'region')")
         if self.kind not in ("replicate", "ec"):
             raise ValueError(
                 f"unknown strategy kind {self.kind!r} (want 'replicate' "
@@ -153,7 +165,10 @@ class Strategy:
     def spec(self) -> str:
         body = (f"replicate({self.rf})" if self.kind == "replicate"
                 else f"ec({self.k},{self.m})")
-        return f"{body}:{self.tier}"
+        out = f"{body}:{self.tier}"
+        if self.locality != "spread":
+            out += f":{self.locality}"
+        return out
 
     @classmethod
     def from_spec(cls, spec, tier: str | None = None) -> "Strategy":
@@ -166,7 +181,7 @@ class Strategy:
         if isinstance(spec, dict):
             d = dict(spec)
             kind = d.pop("kind", None)
-            allowed = {"rf", "k", "m", "tier"}
+            allowed = {"rf", "k", "m", "tier", "locality"}
             unknown = set(d) - allowed
             if unknown:
                 raise ValueError(
@@ -190,18 +205,29 @@ class Strategy:
                     f"ec strategy dict {spec!r} must not carry 'rf'")
             if tier is not None:
                 d.setdefault("tier", tier)
-            return cls(kind=kind, **{k: (str(v) if k == "tier" else int(v))
-                                     for k, v in d.items()})
+            return cls(kind=kind,
+                       **{k: (str(v) if k in ("tier", "locality")
+                              else int(v))
+                          for k, v in d.items()})
         m = _SPEC_RE.match(str(spec))
         if not m:
             raise ValueError(
                 f"bad strategy spec {spec!r} (want 'replicate(3)', "
                 f"'ec(6,3)', optionally ':tier' e.g. 'ec(6,3):cold')")
-        t = m.group("tier") or tier or "hot"
+        t = m.group("tier")
+        loc = m.group("loc")
+        if loc is None and t in ("region", "spread"):
+            # 'ec(2,1):region' omits the tier: the greedy tier group
+            # must not swallow the locality keyword (those two words
+            # are reserved — no tier may use them).
+            t, loc = None, t
+        t = t or tier or "hot"
+        loc = loc or "spread"
         if m.group("rf") is not None:
-            return cls(kind="replicate", rf=int(m.group("rf")), tier=t)
+            return cls(kind="replicate", rf=int(m.group("rf")), tier=t,
+                       locality=loc)
         return cls(kind="ec", k=int(m.group("k")), m=int(m.group("m")),
-                   tier=t)
+                   tier=t, locality=loc)
 
 
 @dataclass
@@ -220,6 +246,9 @@ class StrategyVectors:
     tier_names: tuple[str, ...]
     byte_cost: np.ndarray     # (n_cat,) float64 per stored byte
     read_penalty: np.ndarray  # (n_cat,) float64 = 1/tier.throughput
+    #: (n_cat,) bool — category pins its files to the primary's
+    #: top-level hierarchy domain (``locality: region``).
+    region_local: np.ndarray = None
     #: Defaults for files with ``cat == -1`` (not yet planned): the
     #: config's default tier.
     default_tier_idx: int = 0
@@ -239,6 +268,12 @@ class StrategyVectors:
         c = np.asarray(cat)
         div = np.where(c >= 0, self.shard_div[np.clip(c, 0, None)], 1)
         return -(-np.asarray(sizes, dtype=np.int64) // div)
+
+    def file_region_local(self, cat: np.ndarray) -> np.ndarray:
+        """(n,) bool region-locality per file (-1-cat files: spread)."""
+        c = np.asarray(cat)
+        return np.where(c >= 0,
+                        self.region_local[np.clip(c, 0, None)], False)
 
     def file_ec_k(self, cat: np.ndarray) -> np.ndarray:
         """(n,) int32 EC data-shard count per file (0 = replicate)."""
@@ -344,6 +379,8 @@ class StorageConfig:
             tier_idx=np.asarray([tidx[s.tier] for s in resolved],
                                 np.int32),
             tier_names=tier_names,
+            region_local=np.asarray(
+                [s.locality == "region" for s in resolved], bool),
             byte_cost=np.asarray([self.tiers[s.tier].byte_cost
                                   for s in resolved], np.float64),
             read_penalty=np.asarray(
@@ -378,6 +415,7 @@ class StorageConfig:
                 "tier_throughput": t.throughput,
                 "cost_per_raw_byte": round(s.overhead * t.byte_cost, 4),
                 "repair_read_shards": s.repair_read_shards,
+                "locality": s.locality,
             })
         return rows
 

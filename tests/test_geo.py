@@ -1,0 +1,507 @@
+"""Geo-hierarchical failure domains, region-loss survival, elasticity.
+
+``CDRS_CHAOS_SEED`` varies the workloads (CI sweeps 0/1/2) so the
+acceptance claims — one-level degeneration bit-for-bit in BOTH
+choosers, region-loss zero-lost vs measurable flat loss for replicate
+AND EC in materialized AND functional modes, functional decision
+identity vs the ``materialized_hash`` oracle, and mid-cell kill/resume
+bit-identity — are checked against three genuinely different
+populations, not one lucky seed.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.cluster import ClusterTopology, place_replicas
+from cdrs_tpu.config import (
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from cdrs_tpu.control import (
+    ControllerConfig,
+    ElasticPolicy,
+    ReplicationController,
+)
+from cdrs_tpu.faults import ClusterState, FaultSchedule
+from cdrs_tpu.placement_fn import addition_moved, compute_placement
+from cdrs_tpu.scenarios import ScenarioSpec
+from cdrs_tpu.sim.access import simulate_access
+from cdrs_tpu.sim.generator import generate_population
+from cdrs_tpu.storage import StorageConfig, resolve_storage_config
+
+SEED = int(os.environ.get("CDRS_CHAOS_SEED", "0"))
+
+_NODES12 = tuple(f"dn{i}" for i in range(1, 13))
+_GEO = {
+    "nodes": list(_NODES12),
+    "levels": ["rack", "region"],
+    "rack": {f"r{j}": [f"dn{2 * j + 1}", f"dn{2 * j + 2}"]
+             for j in range(6)},
+    "region": {"eu": ["r0", "r1"], "us": ["r2", "r3"],
+               "ap": ["r4", "r5"]},
+    "edge_bytes": {"rack": 1.0, "region": 4.0},
+    "edge_latency": {"rack": 1.5, "region": 8.0},
+}
+#: Same nodes, racks only — the flat contrast (no region level).
+_FLAT = {"nodes": list(_NODES12), "levels": ["rack"],
+         "rack": _GEO["rack"]}
+#: The region 'eu' node set (r0 + r1).
+_EU = ("dn1", "dn2", "dn3", "dn4")
+
+
+def _geo():
+    return ClusterTopology.from_hierarchy(_GEO)
+
+
+def _rand_inputs(n=2000, rf_hi=6):
+    rng = np.random.default_rng(300 + SEED)
+    return (np.arange(n, dtype=np.int64),
+            rng.integers(1, rf_hi, n).astype(np.int32),
+            rng.integers(0, 12, n).astype(np.int32))
+
+
+# -- topology spec -----------------------------------------------------------
+
+def test_hierarchy_roundtrip_and_validation_names_offender():
+    topo = _geo()
+    assert topo.n_levels == 1
+    assert topo.level_names == ("rack", "region")
+    assert ClusterTopology.from_hierarchy(topo.to_hierarchy_dict()) == topo
+    with pytest.raises(ValueError, match="unknown rack 'r9'"):
+        ClusterTopology.from_hierarchy(
+            {**_GEO, "region": {"eu": ["r0", "r9"], "us": ["r1", "r2"],
+                                "ap": ["r3", "r4", "r5"]}})
+    with pytest.raises(ValueError, match="'dn3'.*not assigned"):
+        bad_racks = {k: [n for n in v if n != "dn3"]
+                     for k, v in _GEO["rack"].items()}
+        ClusterTopology.from_hierarchy({**_GEO, "rack": bad_racks})
+    with pytest.raises(ValueError, match="rack 'r0' spans"):
+        ClusterTopology(_NODES12,
+                        tuple(_GEO["rack"].keys())[:1] * 12,
+                        levels=(("region",
+                                 ("eu",) * 6 + ("us",) * 6),))
+
+
+def test_one_level_hierarchy_degenerates_bitforbit_both_choosers():
+    """A one-level from_hierarchy spec IS the rack topology: both the
+    rng and the hash chooser must reproduce the historical rack-aware
+    placement bit-for-bit."""
+    flat = ClusterTopology.from_hierarchy(_FLAT)
+    assert flat.levels == () and flat.n_levels == 0
+    racks = ClusterTopology.from_racks(
+        _NODES12, {n: d for n, d in zip(flat.nodes, flat.domains)})
+    man = generate_population(GeneratorConfig(
+        n_files=500, seed=30 + SEED, nodes=_NODES12))
+    rng = np.random.default_rng(SEED)
+    rf = rng.integers(1, 5, 500).astype(np.int32)
+    for method in ("rng", "hash"):
+        a = place_replicas(man, rf, flat, seed=SEED, method=method)
+        b = place_replicas(man, rf, racks, seed=SEED, method=method)
+        assert np.array_equal(a.replica_map, b.replica_map), method
+        assert np.array_equal(a.rf, b.rf), method
+
+
+def test_hierarchical_chooser_properties():
+    """Subset == full, nested-in-rf, chunk invariance, distinct nodes,
+    and top-level max-spread (region counts differ by <= 1) under the
+    hierarchy — the flat chooser's contracts carried up the tree."""
+    fids, rf, prim = _rand_inputs()
+    topo = _geo()
+    full, rfc = compute_placement(fids, rf, prim, topo, SEED)
+    dom_top = topo.top_domain_index()
+    for i in range(len(fids)):
+        row = full[i][full[i] >= 0]
+        assert len(row) == rfc[i]
+        assert len(set(row.tolist())) == len(row)
+        assert row[0] == prim[i]
+        counts = np.bincount(dom_top[row], minlength=3)
+        assert counts.max() - counts.min() <= 1
+    rng = np.random.default_rng(SEED)
+    sub = rng.choice(len(fids), 137, replace=False)
+    rows, _ = compute_placement(fids[sub], rf[sub], prim[sub], topo,
+                                SEED, out_width=full.shape[1])
+    assert np.array_equal(rows, full[sub])
+    lo, lo_rf = compute_placement(fids, np.maximum(rf - 1, 1), prim,
+                                  topo, SEED)
+    for i in range(len(fids)):
+        k = int(lo_rf[i])
+        assert np.array_equal(lo[i][:k], full[i][:k])
+    b, _ = compute_placement(fids, rf, prim, topo, SEED, chunk=173)
+    assert np.array_equal(b, full)
+
+
+def test_region_local_mask_pins_and_caps():
+    fids, rf, prim = _rand_inputs()
+    topo = _geo()
+    rng = np.random.default_rng(SEED + 1)
+    local = rng.random(len(fids)) < 0.5
+    slots, rfc = compute_placement(fids, rf, prim, topo, SEED,
+                                   local_mask=local)
+    dom_top = topo.top_domain_index()
+    for i in np.flatnonzero(local):
+        row = slots[i][slots[i] >= 0]
+        assert (dom_top[row] == dom_top[prim[i]]).all()
+        assert rfc[i] == min(rf[i], 4)    # 4 nodes per region
+    free, _ = compute_placement(fids, rf, prim, topo, SEED)
+    assert np.array_equal(slots[~local], free[~local])
+
+
+def test_addition_moved_is_exact():
+    topo_old = _geo()
+    spec2 = {
+        "nodes": list(_NODES12) + ["sb1", "sb2"],
+        "levels": ["rack", "region"],
+        "rack": {**_GEO["rack"], "rs0": ["sb1"], "rs1": ["sb2"]},
+        "region": {"eu": ["r0", "r1", "rs0"],
+                   "us": ["r2", "r3", "rs1"], "ap": ["r4", "r5"]},
+        "edge_bytes": _GEO["edge_bytes"],
+        "edge_latency": _GEO["edge_latency"],
+    }
+    topo_new = ClusterTopology.from_hierarchy(spec2)
+    fids, rf, prim = _rand_inputs(n=3000)
+    moved = addition_moved(topo_old, topo_new, rf, prim, SEED)
+    old_s, _ = compute_placement(fids, rf, prim, topo_old, SEED)
+    new_s, _ = compute_placement(fids, rf, prim, topo_new, SEED)
+    brute = [i for i in range(len(fids))
+             if {topo_old.nodes[x] for x in old_s[i] if x >= 0}
+             != {topo_new.nodes[x] for x in new_s[i] if x >= 0}]
+    assert np.array_equal(moved, np.asarray(brute, dtype=np.int64))
+
+
+# -- faults: region scopes + WAN pricing -------------------------------------
+
+def test_region_scoped_schedule_expansion_and_errors():
+    topo = _geo()
+    sch = FaultSchedule.from_specs(
+        ["crash:region:eu@3-6", "partition:region:us@2-4"])
+    ex = sch.expand_domains(topo)
+    specs = [e.spec() for e in ex]
+    assert "partition:dn5+dn6+dn7+dn8@2" in specs
+    assert {f"crash:dn{i}@3" for i in range(1, 5)} <= set(specs)
+    ex.validate_nodes(topo.nodes)
+    with pytest.raises(ValueError, match="no domain 'mars'"):
+        FaultSchedule.from_specs(
+            ["crash:region:mars@1"]).expand_domains(topo)
+    with pytest.raises(ValueError, match="unknown hierarchy level"):
+        FaultSchedule.from_specs(
+            ["crash:zone:eu@1"]).expand_domains(topo)
+    with pytest.raises(ValueError, match="unexpanded domain scopes"):
+        sch.validate_nodes(topo.nodes)
+
+
+def test_wan_copy_charge_and_in_region_preference():
+    topo = _geo()
+    man = generate_population(GeneratorConfig(
+        n_files=50, seed=40 + SEED, nodes=_NODES12))
+    p = place_replicas(man, np.full(50, 3, np.int32), topo, seed=SEED,
+                       method="hash")
+    st = ClusterState(p, np.asarray(man.size_bytes, np.int64))
+    dom_top = topo.top_domain_index()
+    f = 0
+    row = st.row(f)
+    holders = row[row >= 0]
+    src_regions = set(dom_top[holders].tolist())
+    in_t = next(i for i in range(12)
+                if dom_top[i] in src_regions
+                and i not in set(holders.tolist()))
+    # rf=3 spreads one copy per region, so every region holds a source:
+    # the in-region source wins the election and no multiplier applies.
+    assert st.copy_charge(f, in_t) == int(st.shard_bytes[f])
+    # Strand the file to ONE region: a cross-region target must charge
+    # the 4x WAN multiplier.
+    only = int(dom_top[holders[0]])
+    for x in [int(v) for v in holders]:
+        if int(dom_top[x]) != only:
+            st.drop_replica(f, x)
+    out_t = next(i for i in range(12) if int(dom_top[i]) != only)
+    assert st.copy_charge(f, out_t) == int(np.ceil(
+        int(st.shard_bytes[f]) * 4.0))
+
+
+def test_per_level_correlated_risk_and_rebalance():
+    """A rack-diverse but region-concentrated file is flagged at the
+    region level and the repair pass rebalances it cross-region."""
+    from cdrs_tpu.faults import RepairScheduler
+
+    topo = _geo()
+    man = generate_population(GeneratorConfig(
+        n_files=60, seed=50 + SEED, nodes=_NODES12))
+    rf = np.full(60, 2, np.int32)
+    p = place_replicas(man, rf, topo, seed=SEED, method="hash")
+    st = ClusterState(p, np.asarray(man.size_bytes, np.int64))
+    # Force file 0 into two racks of ONE region (eu: nodes 0..3).
+    row = st.row(0)
+    for x in [int(v) for v in row[row >= 0]]:
+        st.drop_replica(0, x)
+    st.add_replica(0, 0)
+    st.add_replica(0, 2)
+    rf64 = rf.astype(np.int64)
+    d = st.durability(rf64, np.full(60, -1, np.int64), ("Hot",))
+    assert d["correlated_risk_levels"]["region"] == 1
+    assert bool(st.correlated_mask(rf64)[0])
+    sched = RepairScheduler(seed=SEED)
+    sched.sync(st, rf64)
+    rep = sched.schedule(1, st, rf64, np.full(60, -1, np.int64))
+    assert rep.rebalanced >= 1
+    d2 = st.durability(rf64, np.full(60, -1, np.int64), ("Hot",))
+    assert d2["correlated_risk_levels"]["region"] == 0
+
+
+# -- the acceptance contrast: region loss ------------------------------------
+
+def _region_loss_controller(topo_spec, mode, storage, man, events,
+                            ck=None, maxw=None):
+    # The flat contrast has no region LEVEL to scope by — it kills the
+    # same node set explicitly (identical physical event, the only
+    # difference is whether placement knew the correlation existed).
+    if "region" in topo_spec.get("levels", ()):
+        specs = ["crash:region:eu@5-9"]
+    else:
+        specs = [f"crash:{n}@5-9" for n in _EU]
+    schedule = FaultSchedule.from_specs(specs)
+    scoring = validated_scoring_config()
+    import dataclasses
+
+    rfs = dict(scoring.replication_factors)
+    rfs["Moderate"] = max(2, rfs["Moderate"])
+    scoring = dataclasses.replace(scoring, replication_factors=rfs)
+    cfg = ControllerConfig(
+        window_seconds=120.0, default_rf=2, drift_threshold=0.02,
+        max_bytes_per_window=int(
+            np.asarray(man.size_bytes, np.int64).sum() * 0.25),
+        kmeans=KMeansConfig(k=10, seed=42), scoring=scoring,
+        topology=ClusterTopology.from_hierarchy(topo_spec),
+        fault_schedule=FaultSchedule(schedule.events),
+        placement_mode=mode,
+        storage=(resolve_storage_config("ec_archival", scoring)
+                 if storage else None))
+    return ReplicationController(man, cfg).run(
+        events, checkpoint_path=ck, max_windows=maxw)
+
+
+@pytest.fixture(scope="module")
+def geo_world():
+    man = generate_population(GeneratorConfig(
+        n_files=400, seed=60 + SEED, nodes=_NODES12))
+    events = simulate_access(
+        man, SimulatorConfig(duration_seconds=1800.0, seed=61 + SEED))
+    return man, events
+
+
+@pytest.mark.parametrize("storage", [False, True],
+                         ids=["replicate", "ec"])
+@pytest.mark.parametrize("mode", ["materialized", "functional"])
+def test_region_loss_zero_lost_hier_vs_measurable_flat(
+        geo_world, mode, storage):
+    """The acceptance criterion: killing a whole region loses NOTHING
+    under hierarchy-aware placement and measurably loses files on the
+    racks-only topology — for replicate and EC strategies, in both
+    placement modes, on the same seed.  Flat uses the same node kill
+    (the region's node set) so only the topology's awareness differs."""
+    man, events = geo_world
+    hier = _region_loss_controller(_GEO, mode, storage, man, events)
+    flat = _region_loss_controller(_FLAT, mode, storage, man, events)
+    lost_hier = max(r["durability"]["lost"] for r in hier.records
+                    if r.get("durability"))
+    lost_flat = max(r["durability"]["lost"] for r in flat.records
+                    if r.get("durability"))
+    assert lost_hier == 0, (mode, storage)
+    assert lost_flat > 0, (mode, storage)
+
+
+def test_region_loss_functional_matches_oracle_and_resume(geo_world):
+    man, events = geo_world
+    fn = _region_loss_controller(_GEO, "functional", True, man, events)
+    orc = _region_loss_controller(_GEO, "materialized_hash", True, man,
+                                  events)
+    strip = lambda rs, drop: [{k: v for k, v in r.items()  # noqa: E731
+                               if k not in drop} for r in rs]
+    assert strip(fn.records, ("seconds", "placement")) \
+        == strip(orc.records, ("seconds", "placement"))
+    assert np.array_equal(fn.rf, orc.rf)
+    assert all(r["placement"]["mode"] == "functional"
+               for r in fn.records)
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "c.npz")
+        a = _region_loss_controller(_GEO, "functional", True, man,
+                                    events, ck=ck, maxw=7)
+        b = _region_loss_controller(_GEO, "functional", True, man,
+                                    events, ck=ck)
+        assert strip(a.records, ("seconds",)) \
+            + strip(b.records, ("seconds",)) \
+            == strip(fn.records, ("seconds",))
+        assert np.array_equal(b.rf, fn.rf)
+
+
+# -- region-local storage locality -------------------------------------------
+
+def test_region_local_strategy_spec_roundtrip():
+    from cdrs_tpu.storage import Strategy
+
+    s = Strategy.from_spec("ec(2,1):cold:region")
+    assert s.locality == "region" and s.k == 2 and s.tier == "cold"
+    assert Strategy.from_spec(s.spec()) == s
+    cfg = StorageConfig(strategies={"Archival": {
+        "k": 2, "m": 1, "tier": "cold", "locality": "region"}})
+    sv = cfg.vectors(("Hot", "Archival"), {"Hot": 3, "Archival": 4})
+    assert list(sv.region_local) == [False, True]
+    assert list(sv.file_region_local(np.asarray([-1, 0, 1]))) \
+        == [False, False, True]
+
+
+# -- elasticity --------------------------------------------------------------
+
+def test_elastic_policy_validation_and_growth():
+    pol = ElasticPolicy(pool=({"name": "sb1",
+                               "domains": ("rs0", "eu")},))
+    topo = _geo()
+    pol.validate_against(topo)
+    grown = pol.grown_topology(topo, ("sb1",))
+    assert grown.nodes == topo.nodes + ("sb1",)
+    assert grown.domains[-1] == "rs0"
+    assert grown.levels[0][1][-1] == "eu"
+    assert grown.edge_bytes == topo.edge_bytes
+    with pytest.raises(ValueError, match="declares 0 domains"):
+        ElasticPolicy(pool=("sb9",)).validate_against(topo)
+    with pytest.raises(ValueError, match="already exists"):
+        ElasticPolicy(pool=({"name": "dn1",
+                             "domains": ("r0", "eu")},)
+                      ).validate_against(topo)
+    with pytest.raises(ValueError, match="non-empty pool"):
+        ElasticPolicy(pool=())
+    with pytest.raises(ValueError, match="hash placement"):
+        ScenarioSpec(name="x", serve={"policy": "p2c"},
+                     elastic={"pool": ["sb1"]})
+
+
+def test_elastic_scale_out_drain_and_resume():
+    """Scale-out from SLO burn, rebalance == the epoch-diff moved set
+    inside the shared budget, drain back to baseline, and kill/resume
+    across the grown-topology boundary — decision-identical to the
+    materialized_hash oracle throughout."""
+    from cdrs_tpu.serve import ServeConfig, SloSpec
+    from cdrs_tpu.sim.access import simulate_flash_crowd
+
+    man = generate_population(GeneratorConfig(n_files=300,
+                                              seed=70 + SEED))
+    cohort = np.asarray([c == "hot" for c in man.category])
+    events, _ = simulate_flash_crowd(
+        man, SimulatorConfig(duration_seconds=1800.0, seed=71 + SEED),
+        cohort=cohort, start=450.0, duration=540.0, boost=25.0)
+    pol = ElasticPolicy(pool=("sb1", "sb2", "sb3"), burn_hot=1.0,
+                        util_hot=0.9, hot_windows=2, util_cool=0.5,
+                        cool_windows=2, drain_spacing=1)
+
+    def run(mode, ck=None, maxw=None):
+        cfg = ControllerConfig(
+            window_seconds=120.0, default_rf=2, drift_threshold=0.02,
+            max_bytes_per_window=int(
+                np.asarray(man.size_bytes, np.int64).sum() * 0.25),
+            kmeans=KMeansConfig(k=8, seed=42),
+            scoring=validated_scoring_config(),
+            placement_mode=mode, elastic=pol,
+            serve=ServeConfig(policy="p2c", service_ms=6.0,
+                              slo=SloSpec(target_ms=60.0)))
+        return ReplicationController(man, cfg).run(
+            events, checkpoint_path=ck, max_windows=maxw)
+
+    fn = run("functional")
+    el = [r.get("elastic") or {} for r in fn.records]
+    assert any("added" in e for e in el)
+    moved = sum(e.get("moved", 0) for e in el)
+    rebal = sum(e.get("rebalanced", 0) for e in el)
+    assert moved == rebal and moved > 0
+    assert el[-1].get("queue", 0) == 0
+    drained = [n for e in el for n in e.get("drained", ())]
+    assert drained == ["sb1", "sb2", "sb3"]
+    assert fn.records[-1]["durability"]["nodes_up"] == 3
+    mb = int(np.asarray(man.size_bytes, np.int64).sum() * 0.25)
+    assert all(r.get("repair_bytes", 0) + r["bytes_migrated"]
+               + (r.get("elastic") or {}).get("rebalance_bytes", 0)
+               <= mb for r in fn.records)
+    orc = run("materialized_hash")
+    strip = lambda rs, drop=("seconds", "placement"): [  # noqa: E731
+        {k: v for k, v in r.items() if k not in drop} for r in rs]
+    assert strip(fn.records) == strip(orc.records)
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "c.npz")
+        a = run("functional", ck=ck, maxw=8)
+        b = run("functional", ck=ck)
+        assert strip(a.records, ("seconds",)) \
+            + strip(b.records, ("seconds",)) \
+            == strip(fn.records, ("seconds",))
+
+
+# -- spec round trip ---------------------------------------------------------
+
+def test_scenario_spec_roundtrip_geo_axes():
+    """The repro contract for the new axes: topology (hierarchy dict),
+    elastic (policy dict) and an inline storage dict survive
+    to_dict/from_dict exactly."""
+    spec = ScenarioSpec(
+        name="geo-rt", n_files=120, seed=SEED, nodes=_NODES12,
+        topology=_GEO, placement="functional",
+        storage={"strategies": {"Archival": {
+            "k": 2, "m": 1, "tier": "cold", "locality": "region"}}},
+        faults={"specs": ["partition:region:eu@4-7"]},
+        serve={"policy": "p2c"},
+        elastic={"pool": [{"name": "sb1",
+                           "domains": ["rs0", "eu"]}]})
+    d = spec.to_dict()
+    import json
+
+    back = ScenarioSpec.from_dict(json.loads(json.dumps(d)))
+    assert back == spec
+    assert back.topology == _GEO
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ScenarioSpec(name="x", nodes=_NODES12, topology=_GEO,
+                     racks="r0=dn1,dn2")
+    with pytest.raises(ValueError, match="bad topology spec"):
+        ScenarioSpec(name="x", nodes=_NODES12,
+                     topology={"nodes": list(_NODES12),
+                               "levels": ["rack"],
+                               "rack": {"r0": ["dn1", "nope"]}})
+
+
+# -- lowmem overlay ----------------------------------------------------------
+
+def test_overlay_state_has_no_resident_dense_map(geo_world):
+    """The ROADMAP item 3 leftover: functional mode's resident placement
+    state is the overlay itself — exceptions only — and serve resolution
+    goes through the O(unique pids) read_rows path."""
+    from cdrs_tpu.placement_fn import OverlayClusterState, \
+        primary_on_topology
+
+    man, _ = geo_world
+    topo = _geo()
+    rf = np.full(len(man), 3, np.int32)
+    st = OverlayClusterState.from_base(
+        topo, np.asarray(man.size_bytes, np.int64), n_shards=rf,
+        primary=primary_on_topology(man.nodes, man.primary_node_id,
+                                    topo),
+        seed=SEED)
+    assert "replica_map" not in st.__dict__          # property, not array
+    assert st.exception_fids().size == 0
+    st.apply_rf_target(5, 4)
+    assert st.exception_fids().size == 0             # base-form retarget
+    from cdrs_tpu.faults import FaultEvent
+
+    st.apply_event(FaultEvent(0, "decommission", "dn1"))
+    exc = st.exception_fids()
+    assert exc.size > 0
+    # Every stored exception genuinely deviates from base; every
+    # non-exception row IS its base (spot check).
+    rows = st.rows(exc)
+    base = st._fn_base_rows(exc)
+    assert (rows != base).any(axis=1).all()
+    uniq = np.arange(0, 50, dtype=np.int64)
+    rr, ok, corrupt = st.read_rows(uniq)
+    assert rr.shape == (50, 12) and corrupt is None
+    assert np.array_equal(rr, st.rows(uniq))
