@@ -54,6 +54,12 @@ def _add_metrics_arg(p: argparse.ArgumentParser) -> None:
                    help="emit telemetry here (spans, counters, kmeans "
                         "convergence traces); inspect with "
                         "'cdrs metrics summarize'")
+    p.add_argument("--metrics_max_bytes", type=int, default=None,
+                   metavar="BYTES",
+                   help="with --metrics: rotate the stream past this "
+                        "size (.1/.2 suffixes, larger = older); readers "
+                        "see the rotated set as one stream — bounds a "
+                        "long soak's telemetry file")
     p.add_argument("--device_memory", action="store_true",
                    help="with --metrics: sample per-device memory_stats "
                         "gauges at every span exit (TPU backends)")
@@ -70,7 +76,9 @@ def _open_telemetry(args, stack, root_span: str):
         return None
     from .obs import JsonlSink, Telemetry
 
-    tel = Telemetry(JsonlSink(path),
+    tel = Telemetry(JsonlSink(path,
+                              max_bytes=getattr(args, "metrics_max_bytes",
+                                                None)),
                     device_memory=getattr(args, "device_memory", False))
     stack.enter_context(tel)
     stack.enter_context(tel.span(root_span,
@@ -1019,6 +1027,16 @@ def _cmd_metrics(args) -> int:
     return metrics_main(args.rest)
 
 
+def _cmd_explain(args) -> int:
+    """Decision provenance (obs/explain.py): reconstruct why a file
+    lives where it does, why a category scored what it did, or what a
+    window's signals/traffic/alerts were — offline, from the metrics
+    JSONL + checkpoint."""
+    from .obs.explain import main as explain_main
+
+    return explain_main(args.rest)
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="cdrs", description="Clustering-driven replication strategy (TPU-native)")
@@ -1484,13 +1502,27 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser("metrics", help="inspect a telemetry JSONL stream: "
                        "summarize | tail | export | report | watch | "
-                       "regress")
+                       "alerts | regress")
     p.add_argument("rest", nargs=argparse.REMAINDER,
                    help="summarize FILE | tail FILE [-n N] | "
                         "export FILE --format prometheus [--out FILE] | "
                         "report FILE [-o HTML] | watch FILE | "
+                        "alerts FILE [--follow] [--rules JSON] | "
                         "regress RUN.json [--report-only]")
     p.set_defaults(fn=_cmd_metrics)
+
+    p = sub.add_parser("explain", help="decision provenance: why a file "
+                       "lives where it does (slot-by-slot chooser "
+                       "narration + cause-tagged move history), why a "
+                       "category scored what it did (per-feature "
+                       "Table-2 decomposition), what a window's "
+                       "signals/traffic/alerts were")
+    p.add_argument("rest", nargs=argparse.REMAINDER,
+                   help="file ID --manifest CSV [--metrics JSONL] "
+                        "[--checkpoint NPZ] [--topology JSON|--racks "
+                        "SPEC] | category NAME --checkpoint NPZ | "
+                        "window W --metrics JSONL")
+    p.set_defaults(fn=_cmd_explain)
 
     args = parser.parse_args(argv)
     return args.fn(args)

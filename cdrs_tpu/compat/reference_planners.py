@@ -224,6 +224,8 @@ class ReferenceRepairScheduler:
                 if rebalance:
                     state.drop_crowded(f)
                     rep.rebalanced += 1
+                    rep.rebalanced_fids.append(f)
+                    rep.rebalanced_bytes += charge
                     spread_fixed = True
                     break
                 reach[f] += 1

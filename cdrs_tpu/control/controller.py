@@ -56,7 +56,20 @@ from .drift import detect_drift, detect_drift_jax
 from .migrate import MigrationScheduler, plan_diff
 from .windows import iter_windows
 
-__all__ = ["ControllerConfig", "ControllerResult", "ReplicationController"]
+__all__ = ["ControllerConfig", "ControllerResult", "ReplicationController",
+           "MOVE_CAUSES", "LINEAGE_ID_CAP"]
+
+#: Decision-provenance cause vocabulary: why an admitted move happened.
+#: Codes 1..3 ride the per-file ``_move_cause`` vector (migration moves
+#: keep their cause across backlog windows and checkpoints); the rest
+#: are tagged at their emission site (repair pass, elastic machinery).
+#: Code 0 = unknown (a backlog resumed from a pre-provenance snapshot).
+MOVE_CAUSES = {0: "unknown", 1: "drift", 2: "hotspot", 3: "conversion"}
+
+#: Per-lineage-event file-id cap: counts/bytes stay EXACT past it, only
+#: the id listing truncates (stamped ``truncated``) — a 100M-file epoch
+#: change must not write a 100M-integer JSON line.
+LINEAGE_ID_CAP = 4096
 
 
 @dataclass
@@ -422,6 +435,14 @@ class ReplicationController:
 
         self.current_rf = np.full(n, int(cfg.default_rf), dtype=np.int32)
         self.current_cat = np.full(n, -1, dtype=np.int32)
+        #: Decision provenance: cause code (MOVE_CAUSES) of each file's
+        #: pending submitted move — written at plan submission, read at
+        #: admission, checkpointed so a resumed backlog keeps its story.
+        self._move_cause = np.zeros(n, dtype=np.int8)
+        #: One window's lineage batches [(cause, file_ids, bytes)] —
+        #: built in phase B, emitted by ``_instrument_window`` as
+        #: ``lineage`` events and digested into the record's ``causes``.
+        self._lineage: list[tuple[str, np.ndarray, int]] = []
         #: Category whose strategy is actually INSTALLED per file.  A
         #: deferred conversion (apply_strategy_target refused while the
         #: file was unreadable) keeps the OLD encoding on disk, so byte
@@ -815,6 +836,7 @@ class ReplicationController:
                                           ctx["read_client"])
         t_b = time.perf_counter()
         plan_seconds = 0.0
+        self._lineage = []
 
         if ctx["decision"] is not None:
             t0 = time.perf_counter()
@@ -826,7 +848,8 @@ class ReplicationController:
                 # window's phase A already materialized it, so the audit
                 # sees the same model either way.
                 self._ensure_accepted()
-            self._accept_plan(ctx["decision"])
+            self._accept_plan(ctx["decision"],
+                              trigger=rec.get("recluster_trigger"))
             rec["plan_moves_pending"] = len(self.scheduler.backlog)
             dt = time.perf_counter() - t0
             seconds["recluster"] += dt
@@ -903,6 +926,25 @@ class ReplicationController:
                 rec["repair_corrupt_sources"] = rr.corrupt_sources
             bytes_reserved = rr.bytes_used
             files_reserved = rr.files_touched
+            # Provenance: repair copies vs correlated-risk spread
+            # rebalances are two different answers to "why did this
+            # file move" — split the pass's lineage accordingly (failed
+            # copies' traffic stays attributed to repair: it was spent
+            # healing).
+            if rr.applied or rr.failed:
+                rb = set(rr.rebalanced_fids)
+                rep_fids = np.asarray(
+                    sorted({f for f, _, _ in rr.applied} - rb),
+                    dtype=np.int64)
+                if rep_fids.size or rr.failed:
+                    self._lineage.append(
+                        ("repair", rep_fids,
+                         int(rr.bytes_used - rr.rebalanced_bytes)))
+                if rb:
+                    self._lineage.append(
+                        ("correlated_rebalance",
+                         np.asarray(sorted(rb), dtype=np.int64),
+                         int(rr.rebalanced_bytes)))
 
         # Elastic rebalance drains the epoch-diff moved set on what
         # remains of the shared churn budget after repairs (repairs
@@ -985,6 +1027,18 @@ class ReplicationController:
                         self._installed_cat[m.file_index] = m.cat_new
         seconds["schedule"] = time.perf_counter() - t0
         plan_seconds += seconds["schedule"]
+        if len(applied):
+            # Provenance: admitted migrations carry the cause their plan
+            # was submitted under (hysteresis can admit a move windows
+            # after its re-cluster — the tag rides the backlog and the
+            # checkpoint, so the story survives both).
+            cc = self._move_cause[applied.file_index]
+            for code, name in sorted(MOVE_CAUSES.items()):
+                m = cc == code
+                if m.any():
+                    self._lineage.append(
+                        (name, applied.file_index[m].copy(),
+                         int(applied.bytes_moved[m].sum())))
         rec["moves_applied"] = len(applied)
         rec["bytes_migrated"] = applied.total_bytes
         rec["backlog_files"] = len(self.scheduler.backlog)
@@ -1160,6 +1214,18 @@ class ReplicationController:
                         self._cluster_state.exception_fids().size)
             rec["placement"] = pl
 
+        if self._lineage:
+            # The per-window provenance digest: what traffic each cause
+            # consumed of the shared churn budget (`cdrs explain window`
+            # ranks these; the id-level batches flow out as ``lineage``
+            # telemetry events in _instrument_window).
+            causes: dict[str, dict] = {}
+            for name, fids, b in self._lineage:
+                c = causes.setdefault(name, {"files": 0, "bytes": 0})
+                c["files"] += int(fids.size)
+                c["bytes"] += int(b)
+            rec["causes"] = causes
+
         rec["plan_hash"] = _plan_hash(self.current_rf, self.current_cat)
         # ``plan`` = the host-side planning slice (plan diff/submit +
         # repair pass + budgeted admission) — the control-plane cost the
@@ -1310,6 +1376,22 @@ class ReplicationController:
             # cannot drift apart.
             emit_window_telemetry(tel, rec, self._last_latency_ms)
         self._last_latency_ms = None
+        for name, fids, b in self._lineage:
+            # Decision provenance: one ``lineage`` event per admitted
+            # batch — cause, exact file/byte totals, and the id list
+            # (capped: a 100M-row epoch diff must not become a 100M-int
+            # JSON line; counts stay exact either way).
+            ev = {"kind": "lineage", "window": rec["window"],
+                  "cause": name, "files": int(fids.size),
+                  "bytes": int(b),
+                  "file_ids": [int(x) for x in fids[:LINEAGE_ID_CAP]]}
+            if fids.size > LINEAGE_ID_CAP:
+                ev["truncated"] = True
+            tel._emit(ev)
+            if fids.size:
+                tel.counter_inc(f"lineage.{name}.files", int(fids.size))
+            if b:
+                tel.counter_inc(f"lineage.{name}.bytes", int(b))
         for stage, secs in seconds.items():
             tel.histogram(f"controller.{stage}.seconds", secs)
 
@@ -1364,10 +1446,14 @@ class ReplicationController:
             np.float64)
         self._accepted_fractions = frac / max(len(labels), 1)
 
-    def _accept_plan(self, decision) -> None:
+    def _accept_plan(self, decision, trigger: str | None = None) -> None:
         """Adopt an accepted decision's PLAN: diff against the APPLIED
         plan, rebuild the scheduler backlog (newest plan supersedes
-        pending moves)."""
+        pending moves).  ``trigger`` (the window's re-cluster trigger)
+        cause-tags the submitted moves: hotspot-triggered plans tag
+        ``hotspot``, everything else ``drift`` (a cold start is the
+        first drift decision), and storage-strategy re-encodes override
+        to ``conversion`` per file."""
         cfg = self.cfg
         labels = np.asarray(decision.labels)
         # The model was materialized from THIS decision before planning
@@ -1403,6 +1489,7 @@ class ReplicationController:
         priority = new_score - old_score
 
         move_bytes = None
+        convert = None
         if self._storage is not None:
             # A strategy re-encode (shape change: replicate <-> EC, or a
             # different k) drops every old copy and writes rf_new NEW
@@ -1427,6 +1514,13 @@ class ReplicationController:
         moves = plan_diff(self.current_rf, new_rf, self.current_cat, new_cat,
                           self._sizes, priority=priority,
                           move_bytes=move_bytes)
+        if len(moves):
+            codes = np.full(len(moves),
+                            2 if trigger == "hotspot" else 1,
+                            dtype=np.int8)
+            if convert is not None:
+                codes[convert[moves.file_index]] = 3
+            self._move_cause[moves.file_index] = codes
         self.scheduler.submit(moves)
 
     def _edge_latency_ms(self, topology) -> np.ndarray | None:
@@ -1524,6 +1618,11 @@ class ReplicationController:
         es = self._elastic
         es.queue = (np.concatenate([es.queue, moved])
                     if es.queue.size else moved)
+        # Provenance: the moved set IS the addition-pruned epoch diff —
+        # tagged now (bytes 0: traffic bills when the queue drains as
+        # elastic_rebalance).
+        if moved.size:
+            self._lineage.append(("epoch_diff", moved.copy(), 0))
         self._serve_topology = topo_new
         self._router = ReadRouter(len(topo_new.nodes), self.cfg.serve)
         self._edge_ms = self._edge_latency_ms(topo_new)
@@ -1558,6 +1657,9 @@ class ReplicationController:
             used += cs.retarget_row(fid, new_row)
             done += 1
         es.queue = q[done:]
+        if done:
+            self._lineage.append(
+                ("elastic_rebalance", q[:done].copy(), int(used)))
         return used, done
 
     # -- storage strategies (storage/) -------------------------------------
@@ -1771,6 +1873,14 @@ class ReplicationController:
         arrays["current_rf"] = self.current_rf
         arrays["current_cat"] = self.current_cat
         arrays["installed_cat"] = self._installed_cat
+        # Provenance causes, SPARSE over the scheduler backlog: admitted
+        # moves are the only reader of the cause vector and they always
+        # come from the backlog, so O(pending moves) rows restore the
+        # full story — an O(n_files) dense dump would break the
+        # functional mode's O(exceptions) checkpoint claim.
+        bl_fids = self.scheduler.backlog.file_index
+        arrays["move_cause_fids"] = bl_fids.copy()
+        arrays["move_cause_vals"] = self._move_cause[bl_fids]
         if self._accepted_centroids is not None:
             arrays["accepted_centroids"] = self._accepted_centroids
             arrays["accepted_category_idx"] = self._accepted_category_idx
@@ -1921,6 +2031,12 @@ class ReplicationController:
         self._installed_cat = (arrays["installed_cat"].astype(np.int32)
                                if "installed_cat" in arrays
                                else self.current_cat.copy())
+        # Pre-provenance checkpoints carry no cause rows: the resumed
+        # backlog's moves report cause "unknown" (MOVE_CAUSES code 0).
+        self._move_cause = np.zeros(len(self.manifest), dtype=np.int8)
+        if "move_cause_fids" in arrays:
+            self._move_cause[arrays["move_cause_fids"]] = \
+                arrays["move_cause_vals"].astype(np.int8)
         if "accepted_centroids" in arrays:
             self._accepted_centroids = arrays["accepted_centroids"]
             self._accepted_category_idx = arrays["accepted_category_idx"]
@@ -2021,6 +2137,7 @@ class ReplicationController:
 
     # -- the loop ----------------------------------------------------------
     def run(self, source, *, metrics_path: str | None = None,
+            metrics_max_bytes: int | None = None,
             checkpoint_path: str | None = None, checkpoint_every: int = 1,
             max_windows: int | None = None,
             batch_size: int = 1_000_000) -> ControllerResult:
@@ -2063,10 +2180,26 @@ class ReplicationController:
             self._load_checkpoint_with_fallback(checkpoint_path)
         records: list[dict] = []
         sink = None
+        own_sink = False
         if metrics_path:
             from ..obs import JsonlSink
+            from ..obs import current as _obs_current
 
-            sink = JsonlSink(metrics_path)
+            # One stream, ONE writer: when an active Telemetry already
+            # owns a sink on this very path (the `cdrs control --metrics`
+            # wiring), share it — two independent JsonlSink instances on
+            # one file would each track their own size and, under
+            # max_bytes rotation, rotate the file out from under each
+            # other.  The shared sink's lifetime belongs to the
+            # Telemetry context; a private sink is closed here.
+            tel = _obs_current()
+            if (tel is not None and tel.sink is not None
+                    and getattr(tel.sink, "path", None) == metrics_path):
+                sink = tel.sink
+            else:
+                sink = JsonlSink(metrics_path,
+                                 max_bytes=metrics_max_bytes)
+                own_sink = True
         processed = 0
         since_ckpt = 0
         t0_box: dict = {}
@@ -2138,7 +2271,7 @@ class ReplicationController:
                 finish(pending)
                 pending = None
         finally:
-            if sink:
+            if sink and own_sink:
                 sink.close()
         # Snapshot only on CLEAN exit: an exception can land mid-window
         # (events folded, window_index not yet advanced) and a snapshot of

@@ -136,6 +136,13 @@ class RepairReport:
     failed: int = 0
     #: Correlated-risk files rebalanced into a fresh failure domain.
     rebalanced: int = 0
+    #: The rebalanced files and their byte charge, split out of
+    #: ``applied``/``bytes_used`` so the controller's decision
+    #: provenance (lineage events, per-window ``causes``) can tag
+    #: spread-rebalance traffic ``correlated_rebalance`` instead of
+    #: ``repair`` — two different answers to "why did this file move".
+    rebalanced_fids: list[int] = field(default_factory=list)
+    rebalanced_bytes: int = 0
     deferred_budget: int = 0
     deferred_backoff: int = 0
     deferred_no_source: int = 0
@@ -516,6 +523,8 @@ class RepairScheduler:
                         # unchanged.
                         state.drop_crowded(f)
                         rep.rebalanced += 1
+                        rep.rebalanced_fids.append(f)
+                        rep.rebalanced_bytes += charge
                         spread_fixed = True
                         break
                     reach[f] += 1

@@ -23,8 +23,16 @@ Pieces:
   wrapped kernel; counter increments on a first-seen signature) and
   optional ``jax.local_devices()`` memory-stats gauges.
 * ``metrics_cli`` — the ``cdrs metrics`` subcommand: ``summarize`` (span
-  wall-clock tree, p50/p95 histograms, convergence traces), ``tail``, and
-  ``export --format prometheus``.
+  wall-clock tree, p50/p95 histograms, convergence traces), ``tail``,
+  ``export --format prometheus``, ``watch``, and ``alerts``.
+* ``alerts`` — declarative streaming AlertRules (thresholds, SRE
+  burn-rate pairs over the SloSpec error budget, staleness) evaluated
+  incrementally over the event stream; shared by the CLI, watch, the
+  HTML report, the Prometheus export and the scenario harness.
+* ``explain`` — decision provenance: the ``cdrs explain`` offline
+  reconstruction of placement choices (slot-by-slot chooser narration),
+  category scores (per-feature Table-2 decomposition) and window
+  stories (signals crossed, traffic by cause, alert transitions).
 
 The core imports neither jax nor pandas: a base install can produce and
 read telemetry.

@@ -1,0 +1,390 @@
+"""Streaming alert evaluation over the telemetry event stream.
+
+Yuan et al. (OSDI 2014, PAPERS.md) measured that most catastrophic
+distributed-system failures announce themselves in logs long before the
+data is gone; in this repo those announcements — durability tiers
+degrading, the SLO error budget burning, the scrubber starving, repair
+backlogs pinned — already ride the JSONL stream, but until now nobody
+watched them until a bench failed.  This module turns the stream into
+verdicts: declarative :class:`AlertRule` s evaluated **incrementally**
+over window records (one ``observe`` per event — the same shape
+``obs.sink.iter_events`` yields, so batch files, live tails and
+in-memory controller records all evaluate identically).
+
+Three rule kinds:
+
+* ``threshold`` — a dotted ``field`` path into the window record (or a
+  list of paths, summed) compared against ``value``; fires after
+  ``for_windows`` CONSECUTIVE windows satisfy the predicate and resolves
+  on the first window that does not.  The streak requirement is the
+  standard anti-flap guard: one noisy window must not page.
+* ``burn_rate`` — the SRE multi-window burn-rate pair over the serve
+  layer's :class:`~cdrs_tpu.serve.SloSpec` accounting: ``slo_burn`` is
+  already "fraction of the error budget this window consumed", so the
+  rule fires when BOTH the short (``short_windows``) and long
+  (``long_windows``) trailing means are at/above ``factor``, and
+  resolves when the short mean drops below it — the fast window gives
+  detection latency, the long window keeps a single spike from paging
+  (Google SRE workbook ch. 5, transplanted from wall-clock windows to
+  controller windows).  Windows without serving data are skipped, not
+  counted as zero.
+* ``absence`` — staleness: in a follow session the rule fires when no
+  window record arrives for ``stale_seconds`` of wall clock; in batch
+  evaluation it fires only when the stream contains NO window records at
+  all (a dead producer), so offline verdicts stay deterministic.
+
+Rules round-trip through JSON (``cdrs metrics alerts --rules FILE``);
+:func:`default_rules` is the built-in set every surface shares —
+``cdrs metrics alerts`` (batch + ``--follow``), the ``watch`` dashboard,
+the HTML report's alert section, the Prometheus ``ALERTS`` export, and
+the scenario harness's positive-engagement alert invariants (a
+designed-bad cell must fire its expected alert; a healthy cell must
+stay silent).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import asdict, dataclass, field
+
+__all__ = ["AlertRule", "AlertEngine", "default_rules", "rules_from_json",
+           "evaluate_records", "DEFAULT_RULE_NAMES", "SEVERE_ALERTS"]
+
+_KINDS = ("threshold", "burn_rate", "absence")
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+}
+
+#: Alerts whose firing means data is (or silently went) missing — the
+#: default "must stay silent" set the scenario harness gates healthy
+#: cells on.
+SEVERE_ALERTS = frozenset({"files_lost", "true_lost"})
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    """One declarative rule (see module docstring for kind semantics)."""
+
+    name: str
+    kind: str = "threshold"
+    #: Dotted path into the window record (``"durability.lost"``), or a
+    #: tuple of paths summed (missing components count 0; a record where
+    #: EVERY component is missing does not match the rule at all).
+    field: str | tuple[str, ...] | None = None
+    op: str = ">"
+    value: float = 0.0
+    #: Consecutive matching windows before a threshold rule fires.
+    for_windows: int = 1
+    #: Burn-rate pair (window counts, not wall-clock).
+    short_windows: int = 1
+    long_windows: int = 1
+    factor: float = 1.0
+    #: Absence rule: wall-clock staleness bound of a follow session.
+    stale_seconds: float = 600.0
+    #: ``page`` (wake a human) or ``ticket`` (look during business hours).
+    severity: str = "ticket"
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"alert {self.name!r}: unknown kind {self.kind!r} "
+                f"(want one of {_KINDS})")
+        if self.kind == "threshold":
+            if self.field is None:
+                raise ValueError(
+                    f"alert {self.name!r}: threshold rules need a field")
+            if self.op not in _OPS:
+                raise ValueError(
+                    f"alert {self.name!r}: unknown op {self.op!r} "
+                    f"(want one of {sorted(_OPS)})")
+            if self.for_windows < 1:
+                raise ValueError(
+                    f"alert {self.name!r}: for_windows must be >= 1")
+        if self.kind == "burn_rate":
+            if not 1 <= self.short_windows <= self.long_windows:
+                raise ValueError(
+                    f"alert {self.name!r}: need 1 <= short_windows <= "
+                    f"long_windows, got {self.short_windows}/"
+                    f"{self.long_windows}")
+            if self.factor <= 0:
+                raise ValueError(
+                    f"alert {self.name!r}: factor must be > 0")
+        if self.kind == "absence" and self.stale_seconds <= 0:
+            raise ValueError(
+                f"alert {self.name!r}: stale_seconds must be > 0")
+        if self.severity not in ("page", "ticket"):
+            raise ValueError(
+                f"alert {self.name!r}: severity must be 'page' or "
+                f"'ticket', got {self.severity!r}")
+        if isinstance(self.field, list):
+            # JSON delivers lists; the dataclass is hashable/frozen with
+            # tuples.
+            object.__setattr__(self, "field", tuple(self.field))
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        if isinstance(d["field"], tuple):
+            d["field"] = list(d["field"])
+        return d
+
+
+def default_rules() -> tuple[AlertRule, ...]:
+    """The built-in ruleset every surface shares.
+
+    Thresholds follow the audit flags' semantics (obs/audit.py) where one
+    exists — the alert is the *streaming* form of the same verdict; the
+    burn-rate pair follows the SRE fast/slow convention scaled to
+    controller windows."""
+    R = AlertRule
+    return (
+        # Data is gone (blind tier) / silently gone (ground truth).
+        R("files_lost", field="durability.lost", severity="page"),
+        R("true_lost", field="integrity.true_lost", severity="page"),
+        # Redundancy below target anywhere: the Yuan-et-al. announcement
+        # that precedes loss.
+        R("durability_degraded",
+          field=("durability.lost", "durability.at_risk",
+                 "durability.under_replicated")),
+        R("unreachable_stranded", field="durability.unreachable"),
+        R("correlated_risk", field="durability.correlated_risk",
+          for_windows=2),
+        R("repair_backlog", field="repair_backlog", for_windows=3),
+        R("budget_saturated", field="deferred_budget", for_windows=3),
+        R("scrub_starved", field="scrub.starved", for_windows=2),
+        R("corruption_detected",
+          field=("integrity.detected_scrub", "integrity.detected_read",
+                 "integrity.detected_repair"), severity="page"),
+        R("reads_unavailable", field="reads_unavailable",
+          severity="page"),
+        R("slo_burn_fast", kind="burn_rate", field="slo_burn",
+          short_windows=1, long_windows=3, factor=2.0, severity="page"),
+        R("slo_burn_slow", kind="burn_rate", field="slo_burn",
+          short_windows=2, long_windows=6, factor=1.0),
+        R("no_data", kind="absence", stale_seconds=600.0),
+    )
+
+
+DEFAULT_RULE_NAMES: frozenset = frozenset(r.name for r in default_rules())
+
+
+def rules_from_json(obj) -> tuple[AlertRule, ...]:
+    """Rules from a JSON list (the ``--rules FILE`` format: a list of
+    :meth:`AlertRule.to_dict` objects; unknown keys error by name)."""
+    if isinstance(obj, str):
+        obj = json.loads(obj)
+    if not isinstance(obj, list):
+        raise ValueError("alert rules JSON must be a list of rule objects")
+    allowed = {f.name for f in AlertRule.__dataclass_fields__.values()}
+    rules = []
+    for d in obj:
+        unknown = set(d) - allowed
+        if unknown:
+            raise ValueError(
+                f"alert rule {d.get('name', '?')!r}: unknown keys "
+                f"{sorted(unknown)}")
+        rules.append(AlertRule(**d))
+    names = [r.name for r in rules]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate alert rule names in {names}")
+    return tuple(rules)
+
+
+def _resolve(rec: dict, path) -> float | None:
+    """Value of a dotted path (or summed tuple of paths) in a window
+    record.  None = the record does not carry the field(s) at all — the
+    rule is not applicable to this window (a serve rule on a serve-less
+    stream must neither fire nor resolve)."""
+    if isinstance(path, tuple):
+        vals = [_resolve(rec, p) for p in path]
+        live = [v for v in vals if v is not None]
+        return sum(live) if live else None
+    cur = rec
+    for part in str(path).split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if cur is None:
+        return None
+    if isinstance(cur, bool):
+        return 1.0 if cur else 0.0
+    try:
+        return float(cur)
+    except (TypeError, ValueError):
+        return None
+
+
+class _RuleState:
+    __slots__ = ("streak", "window_values", "firing", "fired", "since",
+                 "transitions")
+
+    def __init__(self):
+        self.streak = 0
+        self.window_values: list[float] = []
+        self.firing = False
+        self.fired = False
+        self.since: int | None = None
+        self.transitions: list[dict] = []
+
+
+class AlertEngine:
+    """Incremental evaluator: feed it events (``observe``), read verdicts
+    (``results``).  One instance per stream; state is O(rules)."""
+
+    def __init__(self, rules=None):
+        self.rules: tuple[AlertRule, ...] = tuple(rules) \
+            if rules is not None else default_rules()
+        self._st: dict[str, _RuleState] = {r.name: _RuleState()
+                                           for r in self.rules}
+        self.windows_seen = 0
+        self._last_window_wall: float | None = None
+
+    # -- transitions -------------------------------------------------------
+    def _fire(self, rule: AlertRule, st: _RuleState, window,
+              value) -> dict:
+        st.firing = True
+        st.fired = True
+        st.since = window
+        t = {"alert": rule.name, "state": "firing", "window": window,
+             "severity": rule.severity}
+        if value is not None:
+            t["value"] = round(float(value), 6)
+        st.transitions.append(t)
+        return t
+
+    def _resolve_alert(self, rule: AlertRule, st: _RuleState,
+                       window) -> dict:
+        st.firing = False
+        t = {"alert": rule.name, "state": "resolved", "window": window,
+             "severity": rule.severity}
+        st.transitions.append(t)
+        return t
+
+    # -- evaluation --------------------------------------------------------
+    def observe(self, event: dict) -> list[dict]:
+        """Evaluate one stream event; returns the state transitions it
+        caused (empty for non-window events)."""
+        if event.get("kind") != "window":
+            return []
+        self.windows_seen += 1
+        self._last_window_wall = time.monotonic()
+        w = event.get("window")
+        out: list[dict] = []
+        for rule in self.rules:
+            st = self._st[rule.name]
+            if rule.kind == "threshold":
+                v = _resolve(event, rule.field)
+                hit = v is not None and _OPS[rule.op](v, rule.value)
+                st.streak = st.streak + 1 if hit else 0
+                if not st.firing and st.streak >= rule.for_windows:
+                    out.append(self._fire(rule, st, w, v))
+                elif st.firing and not hit:
+                    out.append(self._resolve_alert(rule, st, w))
+            elif rule.kind == "burn_rate":
+                v = _resolve(event, rule.field or "slo_burn")
+                if v is None:
+                    continue  # not a serving window: no burn observation
+                st.window_values.append(v)
+                del st.window_values[:-rule.long_windows]
+                vals = st.window_values
+                if len(vals) < rule.long_windows:
+                    # Until the long window has real history its mean
+                    # would collapse onto the short one and the
+                    # anti-spike guard would be vacuous — a stream's
+                    # very first hot window must not page.
+                    continue
+                short = sum(vals[-rule.short_windows:]) / rule.short_windows
+                long_ = sum(vals) / len(vals)
+                if not st.firing and short >= rule.factor \
+                        and long_ >= rule.factor:
+                    out.append(self._fire(rule, st, w, short))
+                elif st.firing and short < rule.factor:
+                    out.append(self._resolve_alert(rule, st, w))
+            # absence rules react to the CLOCK, not to window content
+            # (arriving data resolves them).
+            elif st.firing:
+                out.append(self._resolve_alert(rule, st, w))
+        return out
+
+    def check_staleness(self, now: float | None = None) -> list[dict]:
+        """Follow-mode staleness poll: fire absence rules whose
+        ``stale_seconds`` elapsed since the last window record (or since
+        this engine started watching, when none arrived yet)."""
+        now = time.monotonic() if now is None else now
+        if self._last_window_wall is None:
+            self._last_window_wall = now
+            return []
+        out = []
+        for rule in self.rules:
+            if rule.kind != "absence":
+                continue
+            st = self._st[rule.name]
+            stale = now - self._last_window_wall >= rule.stale_seconds
+            if stale and not st.firing:
+                out.append(self._fire(rule, st, None,
+                                      now - self._last_window_wall))
+        return out
+
+    def finish(self) -> list[dict]:
+        """End-of-stream (batch mode): absence rules fire iff the stream
+        carried no window records at all — a dead or misdirected
+        producer, the one staleness verdict batch evaluation can make
+        deterministically."""
+        out = []
+        if self.windows_seen == 0:
+            for rule in self.rules:
+                st = self._st[rule.name]
+                if rule.kind == "absence" and not st.firing:
+                    out.append(self._fire(rule, st, None, None))
+        return out
+
+    def results(self) -> list[dict]:
+        """Per-rule verdicts, rule order: ``{name, severity, kind,
+        firing, fired, since, transitions}``."""
+        out = []
+        for rule in self.rules:
+            st = self._st[rule.name]
+            out.append({
+                "name": rule.name,
+                "severity": rule.severity,
+                "kind": rule.kind,
+                "firing": st.firing,
+                "fired": st.fired,
+                "since": st.since,
+                "transitions": list(st.transitions),
+            })
+        return out
+
+
+def firing_spans(transitions: list[dict]) -> list[tuple]:
+    """Pair each firing transition with its resolution: ``[(start_window,
+    end_window | None), ...]`` — ``None`` end = still firing.  The ONE
+    fold behind every span rendering (CLI digest, HTML report)."""
+    spans: list[tuple] = []
+    start = None
+    for t in transitions:
+        if t["state"] == "firing":
+            start = t["window"]
+        else:
+            spans.append((start, t["window"]))
+            start = None
+    if start is not None:
+        spans.append((start, None))
+    return spans
+
+
+def evaluate_records(records: list[dict], rules=None) -> list[dict]:
+    """Batch verdicts over window records (controller ``res.records`` or
+    a dedup'd stream): the ONE evaluation the scenario harness, the CLI
+    batch mode, ``watch``, the HTML report and the Prometheus export all
+    share."""
+    eng = AlertEngine(rules)
+    for r in records:
+        eng.observe(r if r.get("kind") == "window"
+                    else {"kind": "window", **r})
+    eng.finish()
+    return eng.results()

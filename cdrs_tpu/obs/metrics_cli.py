@@ -5,22 +5,27 @@ Subcommands:
 * ``summarize FILE`` — per-span wall-clock tree (aggregated over repeated
   spans), counters, gauges, histogram p50/p95, kmeans convergence traces,
   XLA cost/roofline lines (obs/xprof.py captures), the decision-quality
-  audit digest, and a controller-window digest.
+  audit digest, an alert digest, and a controller-window digest.
 * ``tail FILE [-n N]`` — the last N events, one compact line each.
 * ``export FILE --format prometheus [--out FILE]`` — Prometheus textfile
   exposition (node_exporter textfile-collector compatible): counters,
-  gauges, and histogram summaries.
+  gauges, histogram summaries, and ``ALERTS`` gauges for firing alerts.
 * ``report FILE [-o HTML]`` — self-contained static HTML report
-  (obs/report.py): span tree, gauge sparklines, audit timeline, roofline
-  table.
+  (obs/report.py): span tree, gauge sparklines, audit timeline, alert
+  timeline, roofline table.
 * ``watch FILE`` — live terminal view tailing a running producer's stream
-  (obs/sink.iter_events).
+  (obs/sink.iter_events), firing/resolved alerts included.
+* ``alerts FILE [--follow]`` — evaluate the declarative AlertRules
+  (obs/alerts.py: thresholds, SRE burn-rate pairs over the SloSpec error
+  budget, staleness) over the stream: batch verdicts with a transition
+  timeline, or a live follow session printing transitions as they land.
 * ``regress RUN.json`` — compare a fresh bench run against the recorded
   trajectory bands (benchmarks/regress.py); nonzero exit on regression.
 
 The readers are resilient by construction: unknown ``kind``s are ignored
 (forward compatibility) and a torn final line from a killed writer is
-skipped (sink contract, obs/sink.py).
+skipped (sink contract, obs/sink.py); a missing/empty/unparseable stream
+is a clean one-line error naming the path, never a traceback.
 """
 
 from __future__ import annotations
@@ -254,6 +259,28 @@ def _render_cells(cells: list[dict], out) -> None:
               f"{', '.join(d['failed_invariants'])}", file=out)
 
 
+def _render_alerts(windows: list[dict], out) -> None:
+    """Alert digest: the default rules (obs/alerts.py) evaluated over
+    the stream's window records — fired alerts with their transition
+    spans, and whatever is still firing at end of stream."""
+    from .alerts import evaluate_records, firing_spans
+
+    if not windows:
+        return
+    res = [r for r in evaluate_records(windows) if r["fired"]]
+    if not res:
+        return
+    firing = [r for r in res if r["firing"]]
+    print(f"\nAlerts: {len(res)} fired "
+          f"({len(firing)} still firing at end of stream)", file=out)
+    for r in res:
+        spans = [f"w{a}->w{b}" if b is not None
+                 else f"w{a}->(still firing)"
+                 for a, b in firing_spans(r["transitions"])]
+        print(f"  {r['name']:<24} [{r['severity']}] "
+              f"{', '.join(spans)}", file=out)
+
+
 def _render_audit(audits: list[dict], out) -> None:
     if not audits:
         return
@@ -336,6 +363,7 @@ def summarize_events(events: list[dict], out=None, peak_flops=None,
                   f"{inertia}, final shift {last['shift']:.3g}", file=out)
 
     _render_audit(digest["audits"], out)
+    _render_alerts(digest["windows"], out)
     _render_cells(digest.get("cells") or [], out)
     _render_checkpoint(digest, out)
     _render_serving(digest["windows"], out)
@@ -433,6 +461,21 @@ def prometheus_lines(events: list[dict]) -> list[str]:
             f"{m}_sum {agg['sum']:g}",
             f"{m}_count {agg['count']}",
         ]
+    # Prometheus-convention ALERTS gauges (what Alertmanager-side rules
+    # export): one series per alert still firing at end of stream.
+    from .aggregate import dedup_windows as _dw
+    from .alerts import evaluate_records as _ev
+
+    windows = _dw(events)
+    if windows:
+        firing = [r for r in _ev(windows) if r["firing"]]
+        if firing:
+            lines.append("# TYPE ALERTS gauge")
+            for r in firing:
+                lines.append(
+                    f'ALERTS{{alertname="{r["name"]}",'
+                    f'alertstate="firing",'
+                    f'severity="{r["severity"]}"}} 1')
     return lines
 
 
@@ -458,6 +501,9 @@ def _tail_line(e: dict) -> str:
         return (f"window {e.get('window')} events={e.get('n_events')} "
                 f"recluster={e.get('recluster')} "
                 f"moves={e.get('moves_applied')}")
+    if kind == "lineage":
+        return (f"lineage window={e.get('window')} cause={e.get('cause')} "
+                f"files={e.get('files')} bytes={e.get('bytes')}")
     if kind == "audit":
         sil = e.get("silhouette")
         sil = "" if sil is None else f" silhouette={sil:.3f}"
@@ -527,6 +573,22 @@ def watch(path: str, *, interval: float = 1.0, max_seconds: float | None =
         if flagged:
             lines.append(f"flags:   {len(flagged)} windows flagged "
                          f"(last: {', '.join(flagged[-1]['flags'])})")
+        if windows:
+            # Streaming alert verdicts over the (deduplicated) trailing
+            # windows — FIRING lines appear while a rule is hot and
+            # clear to a resolved note once the stream heals.
+            from .alerts import evaluate_records
+
+            res = [r for r in evaluate_records(windows) if r["fired"]]
+            for r in res:
+                if r["firing"]:
+                    lines.append(f"ALERT FIRING: {r['name']} "
+                                 f"[{r['severity']}] since window "
+                                 f"{r['since']}")
+            resolved = [r for r in res if not r["firing"]]
+            if resolved:
+                lines.append("alerts resolved: " + ", ".join(
+                    r["name"] for r in resolved))
         if interactive:
             print("\x1b[2J\x1b[H" + "\n".join(lines), file=out, flush=True)
         else:
@@ -553,6 +615,96 @@ def watch(path: str, *, interval: float = 1.0, max_seconds: float | None =
         return 1
     render()
     return 0
+
+
+# -- alerts ------------------------------------------------------------------
+
+
+def _load_rules(spec: str | None):
+    """Rules from --rules (inline JSON list or a file path); None = the
+    built-in default set."""
+    from .alerts import default_rules, rules_from_json
+
+    if not spec:
+        return default_rules()
+    text = spec
+    if not text.lstrip().startswith("["):
+        with open(text, encoding="utf-8") as f:
+            text = f.read()
+    return rules_from_json(text)
+
+
+def _print_transition(t: dict, out) -> None:
+    w = t.get("window")
+    where = f"window {w}" if w is not None else "stream"
+    if t["state"] == "firing":
+        v = f" value={t['value']:g}" if "value" in t else ""
+        print(f"{where}: FIRING {t['alert']} [{t['severity']}]{v}",
+              file=out)
+    else:
+        print(f"{where}: resolved {t['alert']}", file=out)
+
+
+def alerts_cmd(path: str, *, rules=None, follow: bool = False,
+               interval: float = 1.0, max_seconds: float | None = None,
+               fail_firing: bool = False, out=None) -> int:
+    """Evaluate alert rules over a stream: batch (transition timeline +
+    final verdicts) or live follow (transitions print as they land,
+    staleness checked per poll).  ``--fail_firing`` turns a
+    still-firing end state into a nonzero exit — the CI/script gate."""
+    import time as _time
+
+    from .alerts import AlertEngine
+    from .sink import iter_events, read_events
+
+    out = out or sys.stdout
+    eng = AlertEngine(rules)
+    if follow:
+        t0 = _time.monotonic()
+
+        def stop() -> bool:
+            for t in eng.check_staleness():
+                _print_transition(t, out)
+            return max_seconds is not None \
+                and _time.monotonic() - t0 >= max_seconds
+
+        try:
+            for e in iter_events(path, follow=True, poll=interval,
+                                 stop=stop):
+                for t in eng.observe(e):
+                    _print_transition(t, out)
+        except KeyboardInterrupt:
+            pass
+    else:
+        try:
+            events = read_events(path)
+        except OSError as e:
+            print(f"error: cannot read {path}: {e}", file=sys.stderr)
+            return 1
+        if not events:
+            print(f"error: {path}: no telemetry events (missing, "
+                  f"empty, or corrupt stream)", file=sys.stderr)
+            return 1
+        # Last-wins window dedup BEFORE evaluation: a crash/resume tail
+        # repeats windows (sink contract), and the verdicts must match
+        # what summarize/report/watch evaluate over the same file —
+        # stale pre-crash records must not fire, and repeats must not
+        # double-count streaks or burn-rate means.
+        from .aggregate import dedup_windows
+
+        for e in dedup_windows(events):
+            for t in eng.observe(e):
+                _print_transition(t, out)
+        for t in eng.finish():
+            _print_transition(t, out)
+    res = eng.results()
+    fired = [r for r in res if r["fired"]]
+    firing = [r for r in fired if r["firing"]]
+    print(f"alerts: {len(fired)} fired over {eng.windows_seen} windows, "
+          f"{len(firing)} firing at end"
+          + (f" ({', '.join(r['name'] for r in firing)})" if firing
+             else ""), file=out)
+    return 1 if fail_firing and firing else 0
 
 
 # -- entry -------------------------------------------------------------------
@@ -600,6 +752,24 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--once", action="store_true",
                    help="render the current state once and exit")
 
+    p = sub.add_parser("alerts", help="evaluate AlertRules over the "
+                                      "stream: thresholds, SRE burn-"
+                                      "rate pairs, staleness — batch "
+                                      "timeline or live --follow")
+    p.add_argument("file")
+    p.add_argument("--rules", default=None, metavar="JSON|FILE",
+                   help="declarative rule list (obs/alerts.py schema); "
+                        "default: the built-in ruleset")
+    p.add_argument("--follow", action="store_true",
+                   help="tail the stream live, printing transitions as "
+                        "they land (staleness rules active)")
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--max_seconds", type=float, default=None,
+                   help="bound a follow session (tests, CI)")
+    p.add_argument("--fail_firing", action="store_true",
+                   help="exit nonzero when any alert is still firing "
+                        "at the end")
+
     sub.add_parser("regress", add_help=False,
                    help="compare a bench run against the recorded "
                         "trajectory bands; nonzero exit on regression")
@@ -617,18 +787,32 @@ def main(argv: list[str] | None = None) -> int:
     if args.action == "watch":
         return watch(args.file, interval=args.interval,
                      max_seconds=args.max_seconds, once=args.once)
+    if args.action == "alerts":
+        try:
+            rules = _load_rules(args.rules)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: bad --rules: {e}", file=sys.stderr)
+            return 2
+        return alerts_cmd(args.file, rules=rules, follow=args.follow,
+                          interval=args.interval,
+                          max_seconds=args.max_seconds,
+                          fail_firing=args.fail_firing)
 
     try:
         events = read_events(args.file)
     except OSError as e:
         print(f"error: cannot read {args.file}: {e}", file=sys.stderr)
         return 1
+    if not events and args.action in ("summarize", "tail", "report"):
+        # One clean line naming the path — a missing stream, an empty
+        # file and an all-torn (corrupt) file all land here; none of
+        # them should traceback or silently render nothing.
+        print(f"error: {args.file}: no telemetry events (missing, "
+              f"empty, or corrupt stream)", file=sys.stderr)
+        return 1
 
     try:
         if args.action == "summarize":
-            if not events:
-                print(f"{args.file}: no events", file=sys.stderr)
-                return 1
             summarize_events(events, peak_flops=args.peak_flops,
                              peak_gbps=args.peak_gbps)
             return 0

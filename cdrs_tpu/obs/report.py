@@ -319,6 +319,36 @@ def _audit_section(digest: dict) -> str:
             + "".join(rows) + "</table>")
 
 
+def _alerts_section(digest: dict) -> str:
+    """Streaming-alert timeline: the default AlertRules (obs/alerts.py)
+    evaluated over the stream's window records — fired alerts with their
+    firing/resolved spans.  Absent when nothing fired, so quiet streams
+    render unchanged."""
+    from .alerts import evaluate_records, firing_spans
+
+    windows = digest["windows"]
+    if not windows:
+        return ""
+    res = [r for r in evaluate_records(windows) if r["fired"]]
+    if not res:
+        return ""
+    rows = []
+    for r in res:
+        spans = [f"w{a} → w{b}" if b is not None
+                 else f"w{a} → still firing"
+                 for a, b in firing_spans(r["transitions"])]
+        state = ('<span class="flag critical">⚠ firing</span>'
+                 if r["firing"] else '<span class="ok">✓ resolved</span>')
+        rows.append(
+            f"<tr><td>{_esc(r['name'])}</td>"
+            f"<td>{_esc(r['severity'])}</td>"
+            f"<td>{state}</td>"
+            f"<td>{_esc('; '.join(spans))}</td></tr>")
+    return ("<h2>Alerts</h2>"
+            "<table><tr><th>alert</th><th>severity</th><th>state</th>"
+            "<th>spans</th></tr>" + "".join(rows) + "</table>")
+
+
 def _window_section(digest: dict) -> str:
     windows = digest["windows"]
     if not windows:
@@ -549,6 +579,7 @@ def render_html(events: list[dict], title: str = "cdrs telemetry report"
         + _span_section(digest)
         + _xla_section(digest)
         + _audit_section(digest)
+        + _alerts_section(digest)
         + _serve_section(digest)
         + _storage_section(digest)
         + _durability_section(digest)

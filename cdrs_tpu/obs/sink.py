@@ -7,6 +7,19 @@ immediately, and a crashed writer leaves at worst a repeated tail —
 consumers take the last record per logical key (e.g. window index).  A
 torn final line (the process died mid-``write``) is skipped by
 ``read_events`` rather than poisoning the stream.
+
+**Rotation (opt-in).**  ``JsonlSink(path, max_bytes=N)`` caps the live
+file: when the next line would push it past ``max_bytes`` the file
+rotates shift-style (``path`` -> ``path.1``, ``path.1`` -> ``path.2``,
+...; larger suffix = older), so a 100M-file controller soak cannot grow
+one unbounded file.  A line is never split across files, and a single
+line larger than ``max_bytes`` still lands whole.  ``read_events`` and
+``iter_events`` read the rotated set oldest-first, so consumers see ONE
+logically contiguous stream; ``iter_events(follow=True)`` additionally
+drains the just-rotated ``path.1`` tail when a rotation lands between
+polls (best-effort: more than one rotation inside a single poll interval
+can skip the middle file — size the cap so a poll interval spans far
+less than one file's worth of events).
 """
 
 from __future__ import annotations
@@ -15,14 +28,17 @@ import json
 import os
 import threading
 
-__all__ = ["JsonlSink", "read_events", "iter_events"]
+__all__ = ["JsonlSink", "read_events", "iter_events", "rotated_paths"]
 
 
 class JsonlSink:
     """Append one JSON object per line; safe to share across threads."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: int | None = None):
         self.path = path
+        if max_bytes is not None and max_bytes <= 0:
+            raise ValueError(f"max_bytes must be > 0, got {max_bytes}")
+        self.max_bytes = max_bytes
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
@@ -30,6 +46,21 @@ class JsonlSink:
         # Explicit encoding: telemetry must round-trip identically across
         # platform default encodings (read_events/iter_events match).
         self._f = open(path, "a", encoding="utf-8")
+        self._size = self._f.tell()
+
+    def _rotate(self) -> None:
+        """Shift-rotate under the held lock: close, bump every existing
+        suffix up by one (highest first), move the live file to ``.1``,
+        reopen fresh."""
+        self._f.close()
+        n = 1
+        while os.path.exists(f"{self.path}.{n}"):
+            n += 1
+        for i in range(n - 1, 0, -1):
+            os.replace(f"{self.path}.{i}", f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._size = 0
 
     def emit(self, event: dict) -> None:
         # One write() + flush per event: the line lands atomically from the
@@ -41,8 +72,13 @@ class JsonlSink:
         with self._lock:
             if self._f is None:
                 return  # emitted after close (e.g. a late worker thread)
+            if (self.max_bytes is not None and self._size > 0
+                    and self._size + len(line.encode("utf-8"))
+                    > self.max_bytes):
+                self._rotate()
             self._f.write(line)
             self._f.flush()
+            self._size += len(line.encode("utf-8"))
 
     def close(self) -> None:
         with self._lock:
@@ -68,8 +104,21 @@ def _coerce(obj):
     return str(obj)
 
 
-def read_events(path: str) -> list[dict]:
-    """Parse a telemetry JSONL stream; a torn final line is skipped."""
+def rotated_paths(path: str) -> list[str]:
+    """The rotated predecessors of ``path``, oldest first (``path.N`` ..
+    ``path.1``) — exactly the order that makes ``rotated + [path]`` one
+    logically contiguous stream.  Empty when no rotation ever happened,
+    so non-rotating streams read exactly as before."""
+    out = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        out.append(f"{path}.{n}")
+        n += 1
+    out.reverse()
+    return out
+
+
+def _read_one(path: str) -> list[dict]:
     events: list[dict] = []
     # errors="replace": a writer killed mid-write can tear a multi-byte
     # UTF-8 character; the mangled line then fails JSON parsing and is
@@ -86,6 +135,16 @@ def read_events(path: str) -> list[dict]:
                 # Torn tail from a killed writer — by the sink's contract
                 # only the final line can be affected.
                 continue
+    return events
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse a telemetry JSONL stream (rotated predecessors included,
+    oldest first); a torn final line is skipped."""
+    events: list[dict] = []
+    for p in rotated_paths(path):
+        events.extend(_read_one(p))
+    events.extend(_read_one(path))
     return events
 
 
@@ -108,20 +167,43 @@ def iter_events(path: str, *, follow: bool = False, poll: float = 0.5,
     loop cleanly (tests, bounded watch sessions).  A missing file under
     ``follow`` is waited for, not raised: the watcher may start before the
     controller.
+
+    Rotated predecessors (``JsonlSink(max_bytes=...)``) are yielded first,
+    oldest to newest; when a rotation lands BETWEEN polls of a follow
+    session (the live file shrank and a ``.1`` now holds the old bytes),
+    the old file's unread tail is drained from ``.1`` before the fresh
+    file — best-effort single-step recovery (see module docstring).
     """
     import time as _time
 
+    for p in rotated_paths(path):
+        yield from _read_one(p)
     buf = b""
     pos = 0
     while True:
         try:
             with open(path, "rb") as f:
                 if os.fstat(f.fileno()).st_size < pos:
-                    # Truncated or recreated (rm + fresh producer): the
-                    # old offset points past EOF and would read b""
-                    # forever — restart from the top of the new stream.
+                    # Shrunk: either truncated/recreated (rm + fresh
+                    # producer) or rotated under a max_bytes sink.  If a
+                    # rotation moved our bytes to ``.1``, drain its
+                    # unread tail first; then restart at the top of the
+                    # new live file.
+                    prev = path + ".1"
+                    drained = False
+                    try:
+                        if os.path.getsize(prev) >= pos:
+                            with open(prev, "rb") as pf:
+                                pf.seek(pos)
+                                buf += pf.read()
+                            drained = True
+                    except OSError:
+                        pass
+                    if not drained:
+                        # Plain truncation: the old bytes are gone, and
+                        # any buffered partial line died with them.
+                        buf = b""
                     pos = 0
-                    buf = b""
                 f.seek(pos)
                 chunk = f.read()
                 pos = f.tell()

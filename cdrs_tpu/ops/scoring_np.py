@@ -33,6 +33,7 @@ __all__ = [
     "compute_cluster_medians",
     "compute_cluster_medians_hist",
     "score_table",
+    "score_table_terms",
     "classify_medians",
     "classify",
     "HIST_MEDIAN_THRESHOLD",
@@ -126,15 +127,19 @@ def compute_cluster_medians_hist(
     return out
 
 
-def score_table(
+def score_table_terms(
     cluster_medians: np.ndarray,
     cfg: ScoringConfig,
     global_medians: np.ndarray | None = None,
 ) -> np.ndarray:
-    """(k, n_categories) score matrix.
+    """(k, n_categories, n_features) GATED per-feature score terms.
 
-    Vectorizes reference src/scoring.py:57-84 over all clusters and categories
-    at once.  NaN medians (empty clusters) contribute 0.
+    The decomposition behind ``cdrs explain category`` (obs/explain.py):
+    ``score_table`` is exactly the feature-axis sum of this array, so a
+    per-feature contribution listing reconciles with the decision to the
+    last bit — one math, two views.  A zero entry means the gate closed
+    (direction mismatch, or |delta| outside the Moderate band) or the
+    cluster median was NaN (empty cluster).
     """
     W = np.asarray(cfg.weight_matrix(), dtype=np.float64)        # (C, d)
     D = np.asarray(cfg.direction_matrix(), dtype=np.float64)     # (C, d)
@@ -164,7 +169,21 @@ def score_table(
 
     gate = np.where(is_moderate[None, :, None], gate_mod, gate_dir) & valid_b
     term = np.where(is_moderate[None, :, None], term_mod, term_dir)
-    return np.where(gate, term, 0.0).sum(axis=2)  # (k, C)
+    return np.where(gate, term, 0.0)  # (k, C, d)
+
+
+def score_table(
+    cluster_medians: np.ndarray,
+    cfg: ScoringConfig,
+    global_medians: np.ndarray | None = None,
+) -> np.ndarray:
+    """(k, n_categories) score matrix.
+
+    Vectorizes reference src/scoring.py:57-84 over all clusters and categories
+    at once.  NaN medians (empty clusters) contribute 0.
+    """
+    return score_table_terms(cluster_medians, cfg,
+                             global_medians).sum(axis=2)  # (k, C)
 
 
 def classify_medians(

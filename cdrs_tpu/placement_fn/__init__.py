@@ -16,6 +16,7 @@ equivalence fine print.
 
 from .compute import (
     compute_placement,
+    explain_placement,
     file_keys,
     hash_priorities,
     node_salts,
@@ -34,6 +35,7 @@ __all__ = [
     "addition_moved",
     "clip_shards_for_locality",
     "compute_placement",
+    "explain_placement",
     "file_keys",
     "hash_priorities",
     "hierarchical_fill",

@@ -57,7 +57,7 @@ import hashlib
 import numpy as np
 
 __all__ = ["node_salts", "file_keys", "hash_priorities",
-           "compute_placement", "primary_on_topology",
+           "compute_placement", "explain_placement", "primary_on_topology",
            "hierarchical_fill", "clip_shards_for_locality",
            "PRIO_MAX", "NODE_MASK", "MAX_NODES"]
 
@@ -440,3 +440,187 @@ def compute_placement(
             out[:, max_rf:] = -1
 
     return slots, rf
+
+
+def explain_placement(file_id: int, n_shards: int, primary: int,
+                      topology, seed: int = 0, *,
+                      local: bool = False) -> dict:
+    """Per-slot decision trace of ONE file's computed placement.
+
+    The provenance hook behind ``cdrs explain file``: re-derives the
+    chooser's slot sequence scalar-by-scalar — every candidate's packed
+    hash priority, the domain-count keys the hierarchical greedy
+    compares, which rule picked each slot — and then ASSERTS the
+    narrated slots equal the matching :func:`compute_placement` row, so
+    the narration can never drift from the decision (decision-faithful
+    by construction; a mismatch raises instead of explaining fiction).
+
+    Returns ``{"file", "seed", "rf", "local", "slots": [...]}`` where
+    each slot entry carries ``slot``/``node``/``node_name``/``rule`` and
+    a ``candidates`` list of per-node dicts (``priority`` is the 26-bit
+    hash channel; hierarchical slots add the ``(top_count, base_count)``
+    key components; masked candidates say why).  ``primary`` must be
+    resolved onto ``topology`` (:func:`primary_on_topology`), exactly as
+    the vector path requires.
+    """
+    fid = int(file_id)
+    n_nodes = len(topology)
+    prim = int(primary)
+    salts = node_salts(topology.nodes, seed)
+    w = hash_priorities(file_keys(np.asarray([fid]), seed),
+                        salts).reshape(n_nodes).copy()
+    hier = topology.n_levels > 0
+    rf_arr = np.clip(np.asarray([int(n_shards)], dtype=np.int32),
+                     1, n_nodes)
+    local_mask = np.asarray([bool(local)]) if hier else None
+    if hier:
+        rf_arr = clip_shards_for_locality(
+            rf_arr, np.asarray([prim], dtype=np.int64), topology,
+            local_mask)
+    rf = int(rf_arr[0])
+    dom = topology.domain_index()
+    dom_top = topology.top_domain_index() if hier else None
+    names = list(topology.nodes)
+    base_names = list(topology.domains) if topology.domains else names
+    masked_why = {}  # node -> reason it cannot be a candidate anymore
+
+    def cand_rows(extra=None):
+        rows = []
+        for j in range(n_nodes):
+            row = {"node": j, "name": str(names[j]),
+                   "domain": str(base_names[j])}
+            if w[j] == PRIO_MAX:
+                row["masked"] = masked_why.get(j, "taken")
+            else:
+                row["priority"] = int(w[j] >> np.uint32(6))
+            if extra is not None:
+                row.update(extra(j))
+            rows.append(row)
+        return rows
+
+    slots: list[dict] = [{
+        "slot": 0, "node": prim, "node_name": str(names[prim]),
+        "rule": "primary",
+    }]
+    w[prim] = PRIO_MAX
+    masked_why[prim] = "primary (slot 0)"
+
+    if hier:
+        # Geo-hierarchical policy — the scalar mirror of
+        # ``hierarchical_fill``: per slot, the candidate minimizing
+        # (copies in its TOP-level domain, copies in its base domain,
+        # packed priority); region-local files lose every off-region
+        # candidate up front.
+        if local:
+            for j in range(n_nodes):
+                if dom_top[j] != dom_top[prim] and w[j] != PRIO_MAX:
+                    w[j] = PRIO_MAX
+                    masked_why[j] = "off-region (locality pin)"
+        base_cnt = np.zeros(topology.n_domains, dtype=np.int64)
+        top_cnt = np.zeros(topology.n_domains_at(topology.n_levels),
+                           dtype=np.int64)
+        base_cnt[dom[prim]] += 1
+        top_cnt[dom_top[prim]] += 1
+        for c in range(1, rf):
+            def key_of(j):
+                return {"top_count": int(top_cnt[dom_top[j]]),
+                        "base_count": int(base_cnt[dom[j]])}
+            cands = cand_rows(key_of)
+            live = [j for j in range(n_nodes) if w[j] != PRIO_MAX]
+            if not live:
+                slots.append({"slot": c, "node": -1, "node_name": None,
+                              "rule": "exhausted", "candidates": cands})
+                continue
+            # Tie-free: the packed node-id bits make priorities distinct.
+            sel = min(live, key=lambda j: (int(top_cnt[dom_top[j]]),
+                                           int(base_cnt[dom[j]]),
+                                           int(w[j])))
+            slots.append({"slot": c, "node": int(sel),
+                          "node_name": str(names[sel]),
+                          "rule": "hierarchical_fill "
+                                  "(least-covered region, then rack, "
+                                  "then best priority)",
+                          "key": {"top_count": int(top_cnt[dom_top[sel]]),
+                                  "base_count": int(base_cnt[dom[sel]]),
+                                  "priority": int(w[sel]
+                                                  >> np.uint32(6))},
+                          "candidates": cands})
+            w[sel] = PRIO_MAX
+            masked_why[sel] = f"taken (slot {c})"
+            base_cnt[dom[sel]] += 1
+            top_cnt[dom_top[sel]] += 1
+    else:
+        n_domains = topology.n_domains
+        multi_domain = 1 < n_domains < n_nodes and rf >= 2
+        start_col = 1
+        if multi_domain:
+            # HDFS rack-aware: replica 1 = best-priority node OUTSIDE
+            # the primary's domain (fallback: best anywhere), replica 2
+            # = that remote domain's second-best (fallback likewise).
+            dp = int(dom[prim])
+            cands = cand_rows()
+            remote = [j for j in range(n_nodes)
+                      if w[j] != PRIO_MAX and dom[j] != dp]
+            if remote:
+                sel1 = min(remote, key=lambda j: int(w[j]))
+                rule1 = "best node of a remote domain (off-rack)"
+                has1 = True
+            else:
+                live = [j for j in range(n_nodes) if w[j] != PRIO_MAX]
+                sel1 = min(live, key=lambda j: int(w[j]))
+                rule1 = "best remaining node (no remote domain)"
+                has1 = False
+            slots.append({"slot": 1, "node": int(sel1),
+                          "node_name": str(names[sel1]), "rule": rule1,
+                          "candidates": cands})
+            w[sel1] = PRIO_MAX
+            masked_why[sel1] = "taken (slot 1)"
+            start_col = 2
+            if rf >= 3:
+                cands = cand_rows()
+                second = [j for j in range(n_nodes)
+                          if w[j] != PRIO_MAX and has1
+                          and dom[j] == dom[sel1]]
+                if second:
+                    sel2 = min(second, key=lambda j: int(w[j]))
+                    rule2 = "second-best node of the remote domain"
+                else:
+                    live = [j for j in range(n_nodes)
+                            if w[j] != PRIO_MAX]
+                    sel2 = min(live, key=lambda j: int(w[j]))
+                    rule2 = ("best remaining node (remote domain has "
+                             "no second member)")
+                slots.append({"slot": 2, "node": int(sel2),
+                              "node_name": str(names[sel2]),
+                              "rule": rule2, "candidates": cands})
+                w[sel2] = PRIO_MAX
+                masked_why[sel2] = "taken (slot 2)"
+                start_col = 3
+        for c in range(start_col, rf):
+            cands = cand_rows()
+            live = [j for j in range(n_nodes) if w[j] != PRIO_MAX]
+            sel = min(live, key=lambda j: int(w[j]))
+            slots.append({"slot": c, "node": int(sel),
+                          "node_name": str(names[sel]),
+                          "rule": "ascending hash priority",
+                          "candidates": cands})
+            w[sel] = PRIO_MAX
+            masked_why[sel] = f"taken (slot {c})"
+
+    # The faithfulness guard: the narration above must reproduce the
+    # vector chooser exactly or the explanation is fiction.
+    truth, truth_rf = compute_placement(
+        np.asarray([fid], dtype=np.int64),
+        np.asarray([int(n_shards)], dtype=np.int32),
+        np.asarray([prim], dtype=np.int64), topology, seed,
+        local_mask=local_mask)
+    told = [s["node"] for s in slots]
+    want = [int(x) for x in truth[0, :int(truth_rf[0])]]
+    if told != want or rf != int(truth_rf[0]):
+        raise RuntimeError(
+            f"explain_placement narration diverged from "
+            f"compute_placement for file {fid}: narrated {told}, "
+            f"computed {want} — report this; the trace above is not "
+            f"trustworthy")
+    return {"file": fid, "seed": int(seed), "rf": rf,
+            "local": bool(local), "slots": slots}

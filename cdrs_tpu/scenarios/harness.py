@@ -27,6 +27,12 @@ just metrics:
   applied, corruption rotted/detected, EC stripes stored, reads routed,
   drift re-clustered): a cell whose injection silently became a no-op
   fails instead of passing every negative check vacuously.
+* **alerting** — cells carrying an ``alerts`` axis gate the streaming
+  alert rules (obs/alerts.py) the same way: a designed-bad cell's
+  expected alerts must FIRE (``alerts_expected``) and its forbidden
+  ones must stay silent (``alerts_silent``; ``"forbid": "others"`` =
+  anything outside the expected set) — the sweep doubles as an
+  alerting regression suite.
 
 A failing cell's result carries a one-line seeded repro command
 (``repro_line``) so the sweep output alone is enough to rerun exactly
@@ -269,7 +275,8 @@ def _served_windows(records: list[dict]) -> list[dict]:
 def _check_invariants(spec: ScenarioSpec, records: list[dict],
                       max_bytes: int | None, budget_slack: int,
                       multi_domain: bool, has_corrupt: bool,
-                      has_ec: bool, schedule=None) -> dict:
+                      has_ec: bool, schedule=None,
+                      alerts_fired: set | None = None) -> dict:
     inv: dict[str, bool] = {}
     dur = [r for r in records if r.get("durability")]
     if dur:
@@ -406,6 +413,21 @@ def _check_invariants(spec: ScenarioSpec, records: list[dict],
     if multi_domain and dur:
         inv["domain_diversity"] = \
             dur[-1]["durability"].get("correlated_risk", 0) == 0
+    # -- alerting (obs/alerts.py): the positive-engagement invariant of
+    # the observability axis — a designed-bad cell must FIRE its
+    # expected alerts (a sweep where the durability alert sleeps through
+    # a region kill is an alerting regression, not a green run) and a
+    # cell's forbidden alerts must stay silent (a healthy cell that
+    # pages is the same bug from the other side).
+    if spec.alerts is not None:
+        fired = alerts_fired if alerts_fired is not None else set()
+        expect = set(spec.alerts.get("expect") or ())
+        inv["alerts_expected"] = expect <= fired
+        forbid = spec.alerts.get("forbid")
+        if forbid == "others":
+            inv["alerts_silent"] = not (fired - expect)
+        elif forbid:
+            inv["alerts_silent"] = not (fired & set(forbid))
     if spec.serve is not None:
         served = _served_windows(records)
         inv["serve_engaged"] = sum(int(r.get("reads_routed", 0))
@@ -478,9 +500,13 @@ def run_cell(spec: ScenarioSpec, *, suite: str | None = None,
         budget_slack = int(
             len(spec.nodes)
             * int(np.max(np.asarray(manifest.size_bytes))) / min_factor)
+    from ..obs.alerts import evaluate_records
+
+    alerts_fired = {r["name"] for r in evaluate_records(records)
+                    if r["fired"]}
     inv = _check_invariants(spec, records, max_bytes, budget_slack,
                             multi_domain, has_corrupt, has_ec,
-                            schedule=schedule)
+                            schedule=schedule, alerts_fired=alerts_fired)
 
     if spec.resume_window is not None:
         import os
@@ -518,6 +544,7 @@ def run_cell(spec: ScenarioSpec, *, suite: str | None = None,
             "lost_final": d["lost_final"],
             "unavailable_reads": d["unavailable_reads"],
         })
+    metrics["alerts_fired"] = sorted(alerts_fired)
     served = _served_windows(records)
     if served:
         metrics["latency_p99_ms_final"] = served[-1].get("latency_p99_ms")

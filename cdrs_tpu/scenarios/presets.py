@@ -59,6 +59,15 @@ _GEO_LOCAL_STORAGE = {
                                 "locality": "region"}}}
 
 
+
+def _alerts(expect, forbid=("files_lost", "true_lost")) -> dict:
+    """Alert expectations of a designed-bad cell: ``expect`` must fire,
+    ``forbid`` must stay silent ("others" = everything outside
+    ``expect``).  Defaults forbid the loss alerts — a preset whose
+    faults are designed to heal must never actually lose data."""
+    return {"expect": list(expect),
+            "forbid": forbid if forbid == "others" else list(forbid)}
+
 def _presets() -> dict[str, ScenarioSpec]:
     p: dict[str, ScenarioSpec] = {}
 
@@ -72,64 +81,91 @@ def _presets() -> dict[str, ScenarioSpec]:
     p["chaos-kill"] = ScenarioSpec(
         name="chaos-kill", n_files=400, seed=11, duration=1800.0,
         n_windows=15, k=12,
-        faults={"specs": ["crash:dn2@6"]}, resume_window=8)
+        faults={"specs": ["crash:dn2@6"]}, resume_window=8,
+        alerts=_alerts(["durability_degraded"]))
 
     # -- failure domains / partitions (chaos_rack_bench lineage) -----------
     p["rack-kill"] = ScenarioSpec(
         name="rack-kill", n_files=400, seed=13, duration=1800.0,
         n_windows=15, k=12, nodes=_NODES6, racks=_RACKS6,
-        faults={"specs": ["crash:dn3@5", "crash:dn4@5"]})
+        faults={"specs": ["crash:dn3@5", "crash:dn4@5"]},
+        alerts=_alerts(["durability_degraded", "repair_backlog"]))
     p["rack-partition"] = ScenarioSpec(
         name="rack-partition", n_files=400, seed=13, duration=1800.0,
         n_windows=15, k=12, nodes=_NODES6, racks=_RACKS6,
         faults={"specs": ["partition:dn3+dn4@4-6",
                           "degrade:dn5@4-6:0.25"]},
-        resume_window=6)
+        resume_window=6,
+        alerts=_alerts(["durability_degraded", "repair_backlog"]))
 
     # -- fault templates ---------------------------------------------------
     p["cascade"] = ScenarioSpec(
         name="cascade", n_files=300, seed=3, duration=1800.0,
         n_windows=15, k=12,
         faults={"template": "cascade", "nodes": ["dn2", "dn3"],
-                "start": 4, "spacing": 2, "recover_after": 3})
+                "start": 4, "spacing": 2, "recover_after": 3},
+        alerts=_alerts(["durability_degraded"]))
     p["rolling-decommission"] = ScenarioSpec(
         name="rolling-decommission", n_files=300, seed=4,
         duration=1800.0, n_windows=15, k=12, nodes=_NODES6,
         faults={"template": "rolling_decommission",
-                "nodes": ["dn2", "dn3"], "start": 4, "spacing": 4})
+                "nodes": ["dn2", "dn3"], "start": 4, "spacing": 4},
+        alerts=_alerts(["durability_degraded"]))
 
     # -- storage strategies (storage_bench lineage) ------------------------
     p["storage-ec"] = ScenarioSpec(
         name="storage-ec", n_files=400, seed=13, duration=1800.0,
         n_windows=15, k=12, nodes=_NODES12, racks=_RACKS12,
         storage="ec_archival",
-        faults={"specs": ["crash:dn4@5", "crash:dn5@5", "crash:dn6@5"]})
+        faults={"specs": ["crash:dn4@5", "crash:dn5@5", "crash:dn6@5"]},
+        alerts=_alerts(["durability_degraded"]))
 
     # -- serving / SLO -----------------------------------------------------
     p["serve-chaos"] = ScenarioSpec(
         name="serve-chaos", n_files=300, seed=5, duration=1800.0,
         n_windows=15, k=12,
         serve={"policy": "p2c", "p99_max_ms": 50.0, "burn_max": 1.0},
-        faults={"specs": ["partition:dn2@4-7", "degrade:dn3@4-7:0.25"]})
+        faults={"specs": ["partition:dn2@4-7", "degrade:dn3@4-7:0.25"]},
+        alerts=_alerts(["durability_degraded", "repair_backlog", "budget_saturated"]))
     p["flash-crowd"] = ScenarioSpec(
         name="flash-crowd", n_files=300, seed=6, duration=1800.0,
         n_windows=15, k=12,
         workload={"kind": "flash_crowd", "start_frac": 0.5,
                   "duration_frac": 0.1, "boost": 40.0,
                   "cohort": "archival"},
-        serve={"policy": "p2c", "p99_max_ms": 50.0})
+        serve={"policy": "p2c", "p99_max_ms": 50.0},
+        alerts=_alerts([], "others"))
+    # A sustained crowd on the HOT cohort against an undersized service
+    # budget and no elastic rescue: the SLO burn-rate pair (fast AND
+    # slow) must fire while the crowd holds and resolve when it lifts —
+    # the alerting regression suite's designed-bad SLO cell (flash-crowd
+    # above stays the hotspot-feedback cell and must stay SILENT: its
+    # archival burst re-clusters without ever touching the error
+    # budget).
+    p["slo-burn"] = ScenarioSpec(
+        name="slo-burn", n_files=300, seed=24, duration=1800.0,
+        n_windows=15, k=12,
+        workload={"kind": "flash_crowd", "start_frac": 0.25,
+                  "duration_frac": 0.3, "boost": 40.0, "cohort": "hot"},
+        serve={"policy": "p2c", "service_ms": 6.0, "slo_ms": 60.0,
+               "p99_max_ms": 60.0},
+        alerts=_alerts(["slo_burn_fast", "slo_burn_slow"]))
 
     # -- data integrity (integrity_bench lineage) --------------------------
     p["integrity-scrub"] = ScenarioSpec(
         name="integrity-scrub", n_files=300, seed=9, duration=1800.0,
         n_windows=15, k=12,
         faults={"specs": ["corrupt:dn2@3:0.5"]},
-        scrub=200_000_000, resume_window=7)
+        scrub=200_000_000, resume_window=7,
+        alerts=_alerts(["corruption_detected", "scrub_starved",
+                        "durability_degraded", "budget_saturated"],
+                       ["true_lost"]))
     p["integrity-read"] = ScenarioSpec(
         name="integrity-read", n_files=300, seed=9, duration=1800.0,
         n_windows=15, k=12,
         faults={"specs": ["corrupt:dn2@3:0.5"]},
-        serve={"policy": "p2c", "verify_reads": True})
+        serve={"policy": "p2c", "verify_reads": True},
+        alerts=_alerts(["corruption_detected"], ["true_lost"]))
 
     # -- scale: mesh-sharded control loop ----------------------------------
     # The whole per-window device computation (cluster step, scoring
@@ -142,7 +178,8 @@ def _presets() -> dict[str, ScenarioSpec]:
         name="scale-mesh", n_files=300, seed=8, duration=1800.0,
         n_windows=12, k=12, backend="jax", mesh={"data": 8},
         drift={"kind": "flip", "at_frac": 0.5}, drift_threshold=0.02,
-        resume_window=7)
+        resume_window=7,
+        alerts=_alerts([], "others"))
 
     # -- scale: functional placement ---------------------------------------
     # A drift flip under --placement functional: the CRUSH-style hash
@@ -156,7 +193,8 @@ def _presets() -> dict[str, ScenarioSpec]:
         placement="functional",
         drift={"kind": "flip", "at_frac": 0.5}, drift_threshold=0.02,
         faults={"specs": ["crash:dn3@6-9"]},
-        serve={"policy": "p2c"}, resume_window=8)
+        serve={"policy": "p2c"}, resume_window=8,
+        alerts=_alerts(["durability_degraded"]))
 
     # -- geo hierarchy: region loss / WAN partition / elasticity -----------
     # Kill a whole REGION (4 of 12 nodes, correlated): hierarchy-aware
@@ -172,7 +210,8 @@ def _presets() -> dict[str, ScenarioSpec]:
         n_windows=15, k=12, nodes=_NODES12, topology=_GEO_TOPOLOGY,
         placement="functional", storage="ec_archival",
         faults={"specs": ["crash:region:eu@5-9"]},
-        serve={"policy": "p2c"}, resume_window=7)
+        serve={"policy": "p2c"}, resume_window=7,
+        alerts=_alerts(["durability_degraded", "repair_backlog", "budget_saturated"]))
     # Partition region eu off the WAN: its region-LOCAL Archival
     # stripes strand (unreachable > 0) but are never lost, repairs
     # STALL on them (partition backoff) instead of burning budget on
@@ -183,7 +222,10 @@ def _presets() -> dict[str, ScenarioSpec]:
         n_windows=15, k=12, nodes=_NODES12, topology=_GEO_TOPOLOGY,
         placement="functional", storage=_GEO_LOCAL_STORAGE,
         faults={"specs": ["partition:region:eu@4-7"]},
-        serve={"policy": "p2c"})
+        serve={"policy": "p2c"},
+        alerts=_alerts(["durability_degraded", "reads_unavailable",
+                        "repair_backlog", "unreachable_stranded",
+                        "slo_burn_fast", "slo_burn_slow"]))
     # Black Friday: a flash crowd on the hot cohort saturates the
     # 3-node baseline; sustained SLO burn activates the standby pool
     # (capacity doubles), the addition-pruned epoch diff rebalances
@@ -205,7 +247,8 @@ def _presets() -> dict[str, ScenarioSpec]:
         elastic={"pool": ["sb1", "sb2", "sb3"], "burn_hot": 0.4,
                  "util_hot": 0.9, "hot_windows": 2, "util_cool": 0.5,
                  "cool_windows": 2, "drain_spacing": 1},
-        resume_window=8)
+        resume_window=8,
+        alerts=_alerts(["durability_degraded"]))
 
     # -- workload curves / drift patterns ----------------------------------
     p["diurnal"] = ScenarioSpec(
@@ -213,17 +256,20 @@ def _presets() -> dict[str, ScenarioSpec]:
         n_windows=15, k=12,
         workload={"kind": "diurnal", "amplitude": 0.8},
         serve={"policy": "p2c", "p99_max_ms": 50.0},
-        faults={"specs": ["crash:dn2@5-8"]})
+        faults={"specs": ["crash:dn2@5-8"]},
+        alerts=_alerts(["durability_degraded"]))
     p["adversarial-drift"] = ScenarioSpec(
         name="adversarial-drift", n_files=300, seed=11, duration=2400.0,
         n_windows=20, k=12, decay=0.7, drift_threshold=0.02,
         drift={"kind": "adversarial", "cycles": 3,
-               "start_frac": 0.3, "end_frac": 0.8})
+               "start_frac": 0.3, "end_frac": 0.8},
+        alerts=_alerts([], "others"))
     p["gradual-drift"] = ScenarioSpec(
         name="gradual-drift", n_files=300, seed=12, duration=2400.0,
         n_windows=20, k=12, decay=0.7, drift_threshold=0.02,
         drift={"kind": "gradual", "steps": 3,
-               "start_frac": 0.3, "end_frac": 0.7})
+               "start_frac": 0.3, "end_frac": 0.7},
+        alerts=_alerts([], "others"))
 
     for name, spec in p.items():
         spec._preset = name
@@ -296,9 +342,9 @@ SUITES: dict[str, tuple[tuple[str, ...], int]] = {
     # compositions.  >= 12 cells.
     "ci-smoke": (("chaos-kill", "rack-kill", "rack-partition", "cascade",
                   "rolling-decommission", "storage-ec", "serve-chaos",
-                  "flash-crowd", "integrity-scrub", "integrity-read",
-                  "diurnal", "adversarial-drift", "gradual-drift",
-                  "scale-mesh", "scale-placement",
+                  "flash-crowd", "slo-burn", "integrity-scrub",
+                  "integrity-read", "diurnal", "adversarial-drift",
+                  "gradual-drift", "scale-mesh", "scale-placement",
                   "region-loss", "wan-partition", "black-friday"), 2),
     # Everything, including the slow legacy-reproduction preset.
     "full": (tuple(PRESETS), 4),

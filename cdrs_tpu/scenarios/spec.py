@@ -39,6 +39,10 @@ Axes
 * ``serve`` — read-router config dict (policy/slo_ms/...); None = no
   serving.
 * ``scrub`` — background-scrubber bytes/window; None = off.
+* ``alerts`` — alerting expectations (obs/alerts.py default rules):
+  ``{"expect": [...], "forbid": [...] | "others"}`` — expected alerts
+  must fire, forbidden ones must stay silent (the alerting-regression
+  axis); None = no alert gating.
 * scale — ``n_files`` / ``duration`` / ``n_windows`` / ``k`` / ``mesh``
   (``{"data": N}`` runs the whole per-window device computation —
   cluster step, scoring medians, feature fold, drift one-Lloyd-step —
@@ -97,6 +101,15 @@ class ScenarioSpec:
     storage: str | dict | None = None
     serve: dict | None = None
     scrub: int | None = None
+    #: Alerting expectations (obs/alerts.py, default ruleset):
+    #: ``{"expect": [names...], "forbid": [names...] | "others"}`` — the
+    #: named alerts must FIRE during the cell (``alerts_expected``
+    #: invariant) and the forbidden ones must stay silent
+    #: (``alerts_silent``); ``"forbid": "others"`` means any alert
+    #: outside ``expect`` failing silent fails the cell.  None = no
+    #: alert gating (e.g. random cells, whose transient fault storms
+    #: legitimately trip loss alerts that heal by the end).
+    alerts: dict | None = None
     #: Elastic capacity (control/elastic.ElasticPolicy dict: standby
     #: pool + hot/cool thresholds).  Requires ``serve`` (the telemetry
     #: source) and a hash ``placement`` mode (the epoch-diff rebalance).
@@ -193,6 +206,23 @@ class ScenarioSpec:
                     f"cell {self.name!r}: elastic requires a hash "
                     f"placement mode ('functional'/'materialized_hash')"
                     f" — scale-out rebalances by epoch diff")
+        if self.alerts is not None:
+            from ..obs.alerts import DEFAULT_RULE_NAMES
+
+            unknown_keys = set(self.alerts) - {"expect", "forbid"}
+            if unknown_keys:
+                raise ValueError(
+                    f"cell {self.name!r}: unknown alerts keys "
+                    f"{sorted(unknown_keys)} (want 'expect'/'forbid')")
+            names = list(self.alerts.get("expect") or [])
+            forbid = self.alerts.get("forbid")
+            if forbid != "others":
+                names += list(forbid or [])
+            bad = sorted(set(names) - DEFAULT_RULE_NAMES)
+            if bad:
+                raise ValueError(
+                    f"cell {self.name!r}: unknown alert names {bad} "
+                    f"(known: {sorted(DEFAULT_RULE_NAMES)})")
         if self.mesh is not None:
             # Kept jax-import-free (specs parse anywhere): the full axis
             # validation re-runs in ControllerConfig/validate_mesh_shape.

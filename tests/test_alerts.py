@@ -1,0 +1,454 @@
+"""Streaming alerting (obs/alerts.py) + its surfaces.
+
+Covers: rule validation and JSON round trip, threshold streak
+fire/resolve semantics, the SRE burn-rate pair, absence staleness (batch
+and follow), the ``cdrs metrics alerts`` CLI (batch timeline, exit
+codes, --follow), the watch dashboard's firing/resolved lines across
+incremental reads, the Prometheus ``ALERTS`` export, the summarize and
+HTML-report alert sections, JSONL sink rotation, and the metrics CLI's
+clean-error contract on missing/empty/corrupt streams.
+"""
+
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from cdrs_tpu.obs.alerts import (
+    AlertEngine,
+    AlertRule,
+    DEFAULT_RULE_NAMES,
+    default_rules,
+    evaluate_records,
+    rules_from_json,
+)
+from cdrs_tpu.obs.metrics_cli import (
+    main as metrics_main,
+    prometheus_lines,
+    summarize_events,
+    watch,
+)
+from cdrs_tpu.obs.sink import JsonlSink, iter_events, read_events
+
+
+def _win(w, **kw):
+    return {"kind": "window", "window": w, "n_events": 10, **kw}
+
+
+# -- rule validation ---------------------------------------------------------
+
+def test_rule_validation_errors():
+    with pytest.raises(ValueError, match="unknown kind"):
+        AlertRule("x", kind="nope")
+    with pytest.raises(ValueError, match="need a field"):
+        AlertRule("x", kind="threshold")
+    with pytest.raises(ValueError, match="unknown op"):
+        AlertRule("x", field="a", op="!!")
+    with pytest.raises(ValueError, match="short_windows"):
+        AlertRule("x", kind="burn_rate", short_windows=4, long_windows=2)
+    with pytest.raises(ValueError, match="severity"):
+        AlertRule("x", field="a", severity="meh")
+
+
+def test_rules_from_json_roundtrip_and_errors():
+    rules = default_rules()
+    back = rules_from_json(json.dumps([r.to_dict() for r in rules]))
+    assert back == rules
+    with pytest.raises(ValueError, match="unknown keys"):
+        rules_from_json('[{"name": "x", "field": "a", "bogus": 1}]')
+    with pytest.raises(ValueError, match="duplicate"):
+        rules_from_json('[{"name": "x", "field": "a"},'
+                        ' {"name": "x", "field": "b"}]')
+    with pytest.raises(ValueError, match="must be a list"):
+        rules_from_json('{"name": "x"}')
+
+
+# -- threshold semantics -----------------------------------------------------
+
+def test_threshold_fire_and_resolve_with_streak():
+    rule = AlertRule("deg", field="durability.lost", for_windows=2)
+    eng = AlertEngine([rule])
+    assert eng.observe(_win(0, durability={"lost": 1})) == []  # streak 1
+    t = eng.observe(_win(1, durability={"lost": 2}))
+    assert [x["state"] for x in t] == ["firing"]
+    assert t[0]["value"] == 2
+    assert eng.observe(_win(2, durability={"lost": 3})) == []  # stays firing
+    t = eng.observe(_win(3, durability={"lost": 0}))
+    assert [x["state"] for x in t] == ["resolved"]
+    res = eng.results()[0]
+    assert res["fired"] and not res["firing"]
+    assert [x["window"] for x in res["transitions"]] == [1, 3]
+
+
+def test_threshold_missing_field_is_not_a_match():
+    rule = AlertRule("deg", field="durability.lost")
+    eng = AlertEngine([rule])
+    # no durability key at all: never fires, never errors
+    for w in range(3):
+        assert eng.observe(_win(w)) == []
+    assert not eng.results()[0]["fired"]
+
+
+def test_threshold_summed_fields_and_bool():
+    rule = AlertRule("any", field=("a.x", "a.y"))
+    eng = AlertEngine([rule])
+    assert eng.observe(_win(0, a={"x": 0, "y": 0})) == []
+    assert [t["state"] for t in eng.observe(_win(1, a={"x": 0, "y": 2}))] \
+        == ["firing"]
+    scrub = AlertRule("sc", field="scrub.starved")
+    e2 = AlertEngine([scrub])
+    assert [t["state"] for t in e2.observe(
+        _win(0, scrub={"starved": True}))] == ["firing"]
+
+
+# -- burn rate ---------------------------------------------------------------
+
+def test_burn_rate_pair_fires_and_resolves():
+    rule = AlertRule("burn", kind="burn_rate", field="slo_burn",
+                     short_windows=1, long_windows=3, factor=2.0)
+    eng = AlertEngine([rule])
+    # a spike BEFORE the long window has history must not page: the
+    # anti-spike guard needs real history to mean anything
+    assert eng.observe(_win(0, slo_burn=9.0)) == []
+    assert eng.observe(_win(1, slo_burn=0.1)) == []
+    # history full: short (last 1) >= 2 and long mean (9+0.1+9)/3 >= 2
+    t = eng.observe(_win(2, slo_burn=9.0))
+    assert [x["state"] for x in t] == ["firing"]
+    # short window drops under the factor -> resolves
+    t = eng.observe(_win(3, slo_burn=0.5))
+    assert [x["state"] for x in t] == ["resolved"]
+
+
+def test_burn_rate_long_window_guards_single_spike():
+    # long=3 mean must ALSO clear the factor: one spike after a long
+    # quiet stretch must not page.
+    rule = AlertRule("burn", kind="burn_rate", field="slo_burn",
+                     short_windows=1, long_windows=3, factor=2.0)
+    eng = AlertEngine([rule])
+    for w in range(3):
+        assert eng.observe(_win(w, slo_burn=0.0)) == []
+    assert eng.observe(_win(3, slo_burn=4.0)) == []  # long mean 4/3 < 2
+    assert not eng.results()[0]["fired"]
+
+
+def test_burn_rate_skips_serve_less_windows():
+    rule = AlertRule("burn", kind="burn_rate", field="slo_burn",
+                     short_windows=1, long_windows=2, factor=1.0)
+    eng = AlertEngine([rule])
+    eng.observe(_win(0))               # no slo_burn: not an observation
+    assert eng.observe(_win(1, slo_burn=3.0)) == []  # long not yet full
+    eng.observe(_win(2))               # still not an observation
+    t = eng.observe(_win(3, slo_burn=3.0))
+    assert [x["state"] for x in t] == ["firing"]
+
+
+# -- absence -----------------------------------------------------------------
+
+def test_absence_batch_fires_only_on_empty_stream():
+    eng = AlertEngine([AlertRule("nd", kind="absence", stale_seconds=1)])
+    assert eng.finish() and eng.results()[0]["fired"]
+    eng2 = AlertEngine([AlertRule("nd", kind="absence", stale_seconds=1)])
+    eng2.observe(_win(0))
+    assert eng2.finish() == []
+    assert not eng2.results()[0]["fired"]
+
+
+def test_absence_staleness_fires_and_data_resolves():
+    eng = AlertEngine([AlertRule("nd", kind="absence",
+                                 stale_seconds=0.01)])
+    eng.observe(_win(0))
+    time.sleep(0.03)
+    t = eng.check_staleness()
+    assert [x["state"] for x in t] == ["firing"]
+    t = eng.observe(_win(1))
+    assert [x["state"] for x in t] == ["resolved"]
+
+
+# -- evaluate_records / defaults --------------------------------------------
+
+def test_evaluate_records_accepts_bare_controller_records():
+    recs = [{"window": 0, "durability": {"lost": 0}},
+            {"window": 1, "durability": {"lost": 5}}]
+    res = {r["name"]: r for r in evaluate_records(recs)}
+    assert res["files_lost"]["fired"] and res["files_lost"]["firing"]
+    assert res["durability_degraded"]["fired"]
+    assert not res["true_lost"]["fired"]
+    assert DEFAULT_RULE_NAMES == {r["name"] for r in evaluate_records([])}
+
+
+# -- CLI: alerts -------------------------------------------------------------
+
+def _write_stream(path, events):
+    with open(path, "w", encoding="utf-8") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_alerts_cli_batch_timeline_and_exit(tmp_path, capsys):
+    p = tmp_path / "s.jsonl"
+    _write_stream(p, [_win(0, durability={"lost": 0}),
+                      _win(1, durability={"lost": 3}),
+                      _win(2, durability={"lost": 0})])
+    assert metrics_main(["alerts", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "FIRING files_lost [page]" in out
+    assert "resolved files_lost" in out
+    assert "fired over 3 windows, 0 firing at end" in out
+    # still-firing + --fail_firing -> nonzero
+    _write_stream(p, [_win(0, durability={"lost": 3})])
+    assert metrics_main(["alerts", str(p), "--fail_firing"]) == 1
+    assert metrics_main(["alerts", str(p)]) == 0
+
+
+def test_alerts_cli_batch_dedups_crash_repeated_windows(tmp_path, capsys):
+    """A crash/resume tail repeats windows (sink contract) — batch
+    verdicts must evaluate the LAST record per window, agreeing with
+    summarize/report/watch on the same file."""
+    p = tmp_path / "s.jsonl"
+    _write_stream(p, [
+        _win(0, durability={"lost": 0}),
+        _win(1, durability={"lost": 5}),   # stale pre-crash record
+        _win(1, durability={"lost": 0}),   # resumed run's last-wins rec
+    ])
+    assert metrics_main(["alerts", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "FIRING files_lost" not in out
+    assert "fired over 2 windows" in out
+
+
+def test_alerts_cli_custom_rules_and_errors(tmp_path, capsys):
+    p = tmp_path / "s.jsonl"
+    _write_stream(p, [_win(0, foo=9)])
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps(
+        [{"name": "foo_high", "field": "foo", "value": 5}]),
+        encoding="utf-8")
+    assert metrics_main(["alerts", str(p), "--rules", str(rules)]) == 0
+    assert "FIRING foo_high" in capsys.readouterr().out
+    assert metrics_main(["alerts", str(p), "--rules",
+                         '[{"name": "x", "bad_key": 1}]']) == 2
+    assert "bad --rules" in capsys.readouterr().err
+    missing = tmp_path / "nope.jsonl"
+    assert metrics_main(["alerts", str(missing)]) == 1
+    err = capsys.readouterr().err
+    assert "cannot read" in err and "nope.jsonl" in err
+
+
+def test_alerts_cli_follow_prints_transitions_live(tmp_path, capsys):
+    p = tmp_path / "s.jsonl"
+    _write_stream(p, [_win(0, durability={"lost": 2})])
+
+    def append_later():
+        time.sleep(0.1)
+        with open(p, "a", encoding="utf-8") as f:
+            f.write(json.dumps(_win(1, durability={"lost": 0})) + "\n")
+
+    t = threading.Thread(target=append_later)
+    t.start()
+    rc = metrics_main(["alerts", str(p), "--follow", "--interval", "0.02",
+                       "--max_seconds", "2"])
+    t.join()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "FIRING files_lost" in out and "resolved files_lost" in out
+
+
+# -- watch: firing/resolved lines across incremental reads -------------------
+
+def test_watch_renders_firing_then_resolved_across_appends(tmp_path):
+    """The watch dashboard must show an ALERT FIRING line while the
+    stream is hot and clear it to a resolved note when later windows
+    heal — across the same incremental (appending producer) reads the
+    truncation-recovery machinery serves."""
+    p = tmp_path / "w.jsonl"
+    _write_stream(p, [_win(0, durability={"lost": 4})])
+    buf = io.StringIO()
+    assert watch(str(p), once=True, out=buf) == 0
+    first = buf.getvalue()
+    assert "ALERT FIRING: files_lost [page] since window 0" in first
+    assert "alerts resolved" not in first
+    # the producer appends a healed window; a fresh render must clear it
+    with open(p, "a", encoding="utf-8") as f:
+        f.write(json.dumps(_win(1, durability={"lost": 0})) + "\n")
+    buf2 = io.StringIO()
+    assert watch(str(p), once=True, out=buf2) == 0
+    second = buf2.getvalue()
+    assert "ALERT FIRING" not in second
+    assert "alerts resolved: files_lost" in second
+
+
+def test_watch_alert_lines_survive_truncation_recovery(tmp_path):
+    """Extend the truncation-recovery contract to alert rendering: after
+    rm + fresh producer, the dashboard reflects the NEW stream's alert
+    state, not the stale pre-truncation one."""
+    p = tmp_path / "w.jsonl"
+    _write_stream(p, [_win(0, durability={"lost": 4})])
+    buf = io.StringIO()
+    assert watch(str(p), once=True, out=buf) == 0
+    assert "ALERT FIRING: files_lost" in buf.getvalue()
+    os.remove(p)
+    _write_stream(p, [_win(0, durability={"lost": 0})])
+    buf2 = io.StringIO()
+    assert watch(str(p), once=True, out=buf2) == 0
+    text = buf2.getvalue()
+    assert "ALERT FIRING" not in text and "alerts resolved" not in text
+
+
+# -- prometheus / summarize / report ----------------------------------------
+
+def test_prometheus_alerts_gauges_for_firing_only():
+    events = [_win(0, durability={"lost": 2})]
+    lines = prometheus_lines(events)
+    assert "# TYPE ALERTS gauge" in lines
+    assert ('ALERTS{alertname="files_lost",alertstate="firing",'
+            'severity="page"} 1') in lines
+    healed = events + [_win(1, durability={"lost": 0})]
+    lines = prometheus_lines(healed)
+    assert not any(line.startswith("ALERTS{") for line in lines)
+
+
+def test_summarize_alert_digest(tmp_path):
+    def dur(lost):
+        return {"lost": lost, "at_risk": 0, "under_replicated": 0,
+                "nodes_up": 5}
+
+    out = io.StringIO()
+    summarize_events([_win(0, durability=dur(1)),
+                      _win(1, durability=dur(0))], out=out)
+    text = out.getvalue()
+    assert "Alerts: 2 fired (0 still firing at end of stream)" in text
+    assert "files_lost" in text and "w0->w1" in text
+
+
+def test_report_alert_section():
+    from cdrs_tpu.obs.report import render_html
+
+    html = render_html([_win(0, durability={"lost": 1})])
+    assert "<h2>Alerts</h2>" in html
+    assert "files_lost" in html and "firing" in html
+    quiet = render_html([_win(0, durability={"lost": 0})])
+    assert "<h2>Alerts</h2>" not in quiet
+
+
+# -- sink rotation -----------------------------------------------------------
+
+def test_sink_rotation_and_ordered_read(tmp_path):
+    p = str(tmp_path / "r.jsonl")
+    with JsonlSink(p, max_bytes=60) as sink:
+        for i in range(12):
+            sink.emit({"kind": "counter", "i": i})
+    assert os.path.exists(p + ".1") and os.path.exists(p + ".2")
+    # every line lands whole in exactly one file of the rotated set
+    events = read_events(p)
+    assert [e["i"] for e in events] == list(range(12))
+    # the live file respects the cap (single oversized lines excepted)
+    assert os.path.getsize(p) <= 60
+    # iter_events (batch) sees the same contiguous order
+    got = [e["i"] for e in iter_events(p)]
+    assert got == list(range(12))
+
+
+def test_sink_rotation_oversized_line_still_lands(tmp_path):
+    p = str(tmp_path / "r.jsonl")
+    with JsonlSink(p, max_bytes=40) as sink:
+        sink.emit({"kind": "x", "blob": "y" * 200})
+        sink.emit({"kind": "x", "i": 1})
+    events = read_events(p)
+    assert len(events) == 2 and events[0]["blob"] == "y" * 200
+
+
+def test_sink_rotation_rejects_bad_cap(tmp_path):
+    with pytest.raises(ValueError, match="max_bytes"):
+        JsonlSink(str(tmp_path / "x.jsonl"), max_bytes=0)
+
+
+def test_iter_events_follow_drains_rotated_tail(tmp_path):
+    """A rotation landing between polls: the unread tail of the old file
+    (now ``.1``) must be drained before the fresh file's lines."""
+    p = str(tmp_path / "r.jsonl")
+    sink = JsonlSink(p, max_bytes=120)
+    sink.emit({"i": 0})
+    got = []
+
+    def consume():
+        for e in iter_events(p, follow=True, poll=0.02,
+                             stop=lambda: len(got) >= 6):
+            got.append(e["i"])
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.1)   # the follower has read i=0 from the live file
+    for i in range(1, 6):
+        sink.emit({"i": i})   # forces at least one rotation
+    sink.close()
+    t.join(timeout=5)
+    assert got == list(range(6))
+
+
+def test_controller_shares_rotating_sink_with_telemetry(tmp_path):
+    """`cdrs control --metrics X --metrics_max_bytes N` wiring: the
+    controller reuses the active Telemetry's sink on the same path (ONE
+    writer — two independently rotating sinks would rename the file out
+    from under each other), rotation happens, and the rotated set reads
+    back as one stream with every window record present."""
+    from cdrs_tpu.config import (
+        GeneratorConfig,
+        KMeansConfig,
+        SimulatorConfig,
+        validated_scoring_config,
+    )
+    from cdrs_tpu.control import ControllerConfig, ReplicationController
+    from cdrs_tpu.obs import Telemetry
+    from cdrs_tpu.sim.access import simulate_access
+    from cdrs_tpu.sim.generator import generate_population
+
+    manifest = generate_population(GeneratorConfig(n_files=120, seed=41))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=600.0, seed=42))
+    cfg = ControllerConfig(window_seconds=100.0,
+                           kmeans=KMeansConfig(k=6, seed=42),
+                           scoring=validated_scoring_config())
+    mp = str(tmp_path / "m.jsonl")
+    sink = JsonlSink(mp, max_bytes=20_000)
+    with Telemetry(sink, meta=False):
+        ctl = ReplicationController(manifest, cfg)
+        res = ctl.run(events, metrics_path=mp)
+        assert sink._f is not None  # run() must NOT close the shared sink
+    assert os.path.exists(mp + ".1"), "the stream must have rotated"
+    stream = read_events(mp)
+    windows = [e for e in stream if e.get("kind") == "window"]
+    assert [w["window"] for w in windows] == \
+        [r["window"] for r in res.records]
+
+
+# -- metrics CLI clean errors (summarize | tail | report) --------------------
+
+@pytest.mark.parametrize("action", ["summarize", "tail", "report"])
+def test_metrics_cli_missing_file_clean_error(action, tmp_path, capsys):
+    rc = metrics_main([action, str(tmp_path / "nope.jsonl")])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "nope.jsonl" in err and "\n" == err[-1]
+    assert "Traceback" not in err
+
+
+@pytest.mark.parametrize("action", ["summarize", "tail", "report"])
+def test_metrics_cli_empty_file_clean_error(action, tmp_path, capsys):
+    p = tmp_path / "empty.jsonl"
+    p.write_text("", encoding="utf-8")
+    rc = metrics_main([action, str(p)])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "no telemetry events" in err and str(p) in err
+
+
+@pytest.mark.parametrize("action", ["summarize", "tail", "report"])
+def test_metrics_cli_corrupt_file_clean_error(action, tmp_path, capsys):
+    p = tmp_path / "corrupt.jsonl"
+    p.write_bytes(b'{"kind": "window", "window\x00\xff garbage\nmore{{{\n')
+    rc = metrics_main([action, str(p)])
+    assert rc == 1
+    assert "no telemetry events" in capsys.readouterr().err

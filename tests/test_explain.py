@@ -1,0 +1,373 @@
+"""Decision provenance: explain hooks, cause tagging, lineage, CLI.
+
+The acceptance contract (ISSUE 15): ``cdrs explain file`` output is
+decision-faithful — the narrated slot choices reproduce
+``compute_placement`` exactly (property-tested on seeds 0/1/2, flat +
+hierarchical topologies, and against BOTH hash placement surfaces:
+functional recompute and the materialized_hash placement rows) — and
+every explained move's cause tag matches the controller record that
+produced it.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.cluster import ClusterTopology, place_replicas
+from cdrs_tpu.config import (
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from cdrs_tpu.control import ReplicationController
+from cdrs_tpu.control.controller import ControllerConfig, MOVE_CAUSES
+from cdrs_tpu.obs import JsonlSink, Telemetry, read_events
+from cdrs_tpu.obs.explain import (
+    explain_category,
+    explain_window,
+    file_history,
+    main as explain_main,
+)
+from cdrs_tpu.placement_fn import (
+    compute_placement,
+    explain_placement,
+    primary_on_topology,
+)
+from cdrs_tpu.sim.access import simulate_access_with_shift
+from cdrs_tpu.sim.generator import generate_population
+
+SEED = int(os.environ.get("CDRS_CHAOS_SEED", "0"))
+
+_GEO = {
+    "nodes": [f"dn{i}" for i in range(1, 13)],
+    "levels": ["rack", "region"],
+    "rack": {f"r{j}": [f"dn{2 * j + 1}", f"dn{2 * j + 2}"]
+             for j in range(6)},
+    "region": {"eu": ["r0", "r1"], "us": ["r2", "r3"],
+               "ap": ["r4", "r5"]},
+}
+
+
+def _topologies():
+    return [
+        ("flat", ClusterTopology(nodes=("dn1", "dn2", "dn3", "dn4",
+                                        "dn5"))),
+        ("racked", ClusterTopology.from_rack_spec(
+            ("dn1", "dn2", "dn3", "dn4", "dn5", "dn6"),
+            "r0=dn1,dn2;r1=dn3,dn4;r2=dn5,dn6")),
+        ("geo", ClusterTopology.from_hierarchy(_GEO)),
+    ]
+
+
+# -- explain_placement: decision-faithful by property ------------------------
+
+@pytest.mark.parametrize("name,topology", _topologies())
+def test_explain_placement_matches_compute_placement(name, topology):
+    """The narration's slots equal the vector chooser's row for every
+    (file, rf, primary) tried — explain_placement raises on divergence,
+    so surviving the sweep IS the property."""
+    n = len(topology)
+    for seed in (SEED, SEED + 1, SEED + 2):
+        for fid in range(60):
+            for rf in (1, 2, 3, min(5, n), n):
+                d = explain_placement(fid, rf, fid % n, topology, seed)
+                want, want_rf = compute_placement(
+                    np.asarray([fid]), np.asarray([rf], np.int32),
+                    np.asarray([fid % n]), topology, seed)
+                assert [s["node"] for s in d["slots"]] == \
+                    [int(x) for x in want[0, :int(want_rf[0])]]
+
+
+def test_explain_placement_matches_materialized_hash_rows():
+    """The same chooser materialized (place_replicas(method='hash') —
+    the materialized_hash mode's placement) agrees with the narration
+    row for row."""
+    for name, topology in _topologies():
+        nodes = topology.nodes
+        manifest = generate_population(GeneratorConfig(
+            n_files=80, seed=SEED + 3, nodes=tuple(nodes)))
+        rf = np.full(80, 3, dtype=np.int32)
+        placement = place_replicas(manifest, rf, topology, seed=0,
+                                   method="hash")
+        primary = primary_on_topology(manifest.nodes,
+                                      manifest.primary_node_id, topology)
+        for fid in range(0, 80, 7):
+            d = explain_placement(fid, 3, int(primary[fid]), topology, 0)
+            row = placement.replica_map[fid]
+            assert [s["node"] for s in d["slots"]] == \
+                [int(x) for x in row[:int(placement.rf[fid])]]
+
+
+def test_explain_placement_region_local_masks_off_region():
+    topo = ClusterTopology.from_hierarchy(_GEO)
+    d = explain_placement(5, 3, 0, topo, SEED, local=True)
+    masked = [c for s in d["slots"] for c in s.get("candidates", ())
+              if c.get("masked") == "off-region (locality pin)"]
+    assert masked, "off-region candidates must be visibly masked"
+    # and the chosen nodes all sit in the primary's region
+    top = topo.top_domain_index()
+    assert all(top[s["node"]] == top[0] for s in d["slots"])
+
+
+def test_explain_placement_slot_rules_flat_vs_racked():
+    flat = _topologies()[0][1]
+    d = explain_placement(3, 3, 1, flat, 0)
+    assert d["slots"][0]["rule"] == "primary"
+    assert all("ascending hash priority" == s["rule"]
+               for s in d["slots"][1:])
+    racked = _topologies()[1][1]
+    d = explain_placement(3, 3, 1, racked, 0)
+    assert "remote domain" in d["slots"][1]["rule"]
+
+
+# -- score decomposition (Table-2 math) --------------------------------------
+
+def test_score_terms_sum_to_score_table_exactly():
+    from cdrs_tpu.config import ScoringConfig
+    from cdrs_tpu.ops.scoring_np import score_table, score_table_terms
+
+    rng = np.random.default_rng(SEED)
+    for cfg in (ScoringConfig(), validated_scoring_config()):
+        medians = rng.uniform(0, 1, size=(8, len(cfg.features)))
+        medians[2, 1] = np.nan  # empty-cluster row
+        terms = score_table_terms(medians, cfg)
+        assert np.array_equal(terms.sum(axis=2),
+                              score_table(medians, cfg))
+
+
+def test_explain_category_contributions_reconcile():
+    cfg = validated_scoring_config()
+    rng = np.random.default_rng(SEED + 1)
+    cent = rng.uniform(0, 1, size=(6, len(cfg.features)))
+    from cdrs_tpu.ops.scoring_np import classify_medians
+
+    cat_idx, scores = classify_medians(cent, cfg)
+    from cdrs_tpu.config import CATEGORIES
+
+    for ci, name in enumerate(CATEGORIES):
+        d = explain_category(name, cent, cat_idx, cfg)
+        for c in d["clusters"]:
+            total = round(sum(f["contribution"] for f in c["features"]), 4)
+            assert total == round(c["score"], 4)
+            assert c["scores_all"][name] == c["score"]
+            # the decomposition's argmax agrees with the decision here
+            # (same representative in = same scores out)
+            assert c["margin"] >= 0
+
+
+# -- controller cause tagging + lineage --------------------------------------
+
+@pytest.fixture(scope="module")
+def chaos_stream(tmp_path_factory):
+    """One fault-mode controller run with telemetry: records + stream."""
+    from cdrs_tpu.faults import FaultSchedule
+
+    td = tmp_path_factory.mktemp("explain")
+    manifest = generate_population(GeneratorConfig(n_files=250,
+                                                   seed=SEED + 11))
+    events, _ = simulate_access_with_shift(
+        manifest, SimulatorConfig(duration_seconds=1500.0, seed=SEED + 12),
+        750.0, {"hot": "archival", "archival": "hot"})
+    cfg = ControllerConfig(
+        window_seconds=100.0, kmeans=KMeansConfig(k=8, seed=42),
+        scoring=validated_scoring_config(), default_rf=2,
+        drift_threshold=0.02, placement_mode="materialized_hash",
+        fault_schedule=FaultSchedule.from_specs(["crash:dn2@5-9"]))
+    mp = str(td / "m.jsonl")
+    ck = str(td / "c.npz")
+    with Telemetry(JsonlSink(mp), meta=False):
+        res = ReplicationController(manifest, cfg).run(
+            events, metrics_path=mp, checkpoint_path=ck)
+    return {"manifest": manifest, "events": events, "cfg": cfg,
+            "records": res.records, "stream": read_events(mp),
+            "metrics_path": mp, "checkpoint_path": ck, "dir": td}
+
+
+def test_lineage_events_match_window_cause_records(chaos_stream):
+    """Acceptance: every lineage batch's cause/files/bytes reconciles
+    with the ``causes`` digest of the window record that produced it."""
+    stream = chaos_stream["stream"]
+    lineage = [e for e in stream if e.get("kind") == "lineage"]
+    assert lineage, "a drifting fault run must emit lineage"
+    assert {e["cause"] for e in lineage} >= {"drift", "repair"}
+    by_window: dict = {}
+    for e in lineage:
+        agg = by_window.setdefault(e["window"], {})
+        c = agg.setdefault(e["cause"], {"files": 0, "bytes": 0})
+        c["files"] += e["files"]
+        c["bytes"] += e["bytes"]
+        assert len(e["file_ids"]) == e["files"]  # under the id cap here
+    for rec in chaos_stream["records"]:
+        assert by_window.get(rec["window"], {}) == \
+            (rec.get("causes") or {})
+
+
+def test_lineage_totals_match_record_traffic(chaos_stream):
+    for rec in chaos_stream["records"]:
+        causes = rec.get("causes") or {}
+        mig = sum(v["bytes"] for k, v in causes.items()
+                  if k in MOVE_CAUSES.values())
+        assert mig == rec["bytes_migrated"]
+        rep = (causes.get("repair", {}).get("bytes", 0)
+               + causes.get("correlated_rebalance", {}).get("bytes", 0))
+        assert rep == rec.get("repair_bytes", 0)
+
+
+def test_file_history_matches_records(chaos_stream):
+    stream = chaos_stream["stream"]
+    lineage = [e for e in stream if e.get("kind") == "lineage"]
+    fid = lineage[0]["file_ids"][0]
+    hist = file_history(stream, fid)
+    assert hist
+    recs = {r["window"]: r for r in chaos_stream["records"]}
+    for h in hist:
+        rec = recs[h["window"]]
+        assert h["cause"] in (rec.get("causes") or {})
+        assert h["plan_hash"] == rec["plan_hash"]
+
+
+def test_cause_tags_survive_kill_resume(chaos_stream):
+    """A resumed controller must report the same causes as the
+    uninterrupted run — the cause vector rides the checkpoint."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "c.npz")
+        cfg = chaos_stream["cfg"]
+        manifest = chaos_stream["manifest"]
+        events = chaos_stream["events"]
+        a = ReplicationController(manifest, cfg).run(
+            events, checkpoint_path=ck, max_windows=6)
+        b = ReplicationController(manifest, cfg).run(
+            events, checkpoint_path=ck)
+        strip = [{k: v for k, v in r.items() if k != "seconds"}
+                 for r in a.records + b.records]
+        want = [{k: v for k, v in r.items() if k != "seconds"}
+                for r in chaos_stream["records"]]
+        assert strip == want
+
+
+def test_explain_window_ranks_crossed_signals(chaos_stream):
+    stream = chaos_stream["stream"]
+    crash = next(r["window"] for r in chaos_stream["records"]
+                 if r.get("fault_events"))
+    d = explain_window(stream, crash)
+    crossed = [s["signal"] for s in d["signals"] if s["crossed"]]
+    assert any(s.startswith("durability.") for s in crossed)
+    assert d["signals"][0]["crossed"]  # crossed ranked first
+    assert "repair" in d["traffic"]
+    assert d["traffic_bytes_total"] >= d["repair_bytes"]
+    with pytest.raises(ValueError, match="no window 999"):
+        explain_window(stream, 999)
+
+
+# -- the CLI: golden-stable, decision-faithful -------------------------------
+
+def _manifest_csv(chaos_stream):
+    p = str(chaos_stream["dir"] / "manifest.csv")
+    if not os.path.exists(p):
+        chaos_stream["manifest"].write_csv(p)
+    return p
+
+
+def test_explain_file_cli_stable_and_faithful(chaos_stream, capsys):
+    mpath = _manifest_csv(chaos_stream)
+    argv = ["file", "3", "--manifest", mpath,
+            "--metrics", chaos_stream["metrics_path"],
+            "--checkpoint", chaos_stream["checkpoint_path"]]
+    assert explain_main(argv) == 0
+    first = capsys.readouterr().out
+    assert explain_main(argv) == 0
+    assert capsys.readouterr().out == first  # golden-stable
+    assert "computed placement" in first and "slot 0" in first
+    assert "move history" in first
+
+
+def test_explain_category_cli(chaos_stream, capsys):
+    assert explain_main(["category", "Hot", "--checkpoint",
+                         chaos_stream["checkpoint_path"],
+                         "--scoring_config", "validated"]) == 0
+    out = capsys.readouterr().out
+    assert "category Hot" in out
+    assert explain_main(["category", "Bogus", "--checkpoint",
+                         chaos_stream["checkpoint_path"]]) == 2
+    assert "unknown category" in capsys.readouterr().err
+
+
+def test_explain_window_cli(chaos_stream, capsys):
+    assert explain_main(["window", "5", "--metrics",
+                         chaos_stream["metrics_path"]]) == 0
+    out = capsys.readouterr().out
+    assert "signals (crossed first):" in out
+    assert explain_main(["window", "999", "--metrics",
+                         chaos_stream["metrics_path"]]) == 2
+
+
+def test_explain_file_cli_rejects_materialized_checkpoint(tmp_path,
+                                                          capsys):
+    manifest = generate_population(GeneratorConfig(n_files=60,
+                                                   seed=SEED + 20))
+    events, _ = simulate_access_with_shift(
+        manifest, SimulatorConfig(duration_seconds=300.0, seed=SEED + 21),
+        150.0, {"hot": "archival"})
+    cfg = ControllerConfig(window_seconds=100.0,
+                           kmeans=KMeansConfig(k=6, seed=42),
+                           scoring=validated_scoring_config())
+    ck = str(tmp_path / "c.npz")
+    ReplicationController(manifest, cfg).run(events, checkpoint_path=ck)
+    mpath = str(tmp_path / "m.csv")
+    manifest.write_csv(mpath)
+    rc = explain_main(["file", "0", "--manifest", mpath,
+                       "--checkpoint", ck])
+    assert rc == 2
+    assert "materialized" in capsys.readouterr().err
+
+
+def test_explain_file_out_of_range_clean_error(chaos_stream, capsys):
+    """Out-of-range ids error cleanly even with a checkpoint (the range
+    check must run before any checkpoint array is indexed)."""
+    mpath = _manifest_csv(chaos_stream)
+    rc = explain_main(["file", "99999", "--manifest", mpath,
+                       "--checkpoint", chaos_stream["checkpoint_path"]])
+    assert rc == 2
+    assert "out of range" in capsys.readouterr().err
+
+
+def test_explain_cli_clean_errors(tmp_path, capsys):
+    rc = explain_main(["window", "1", "--metrics",
+                       str(tmp_path / "nope.jsonl")])
+    assert rc == 1
+    assert "cannot read" in capsys.readouterr().err
+
+
+def test_lineage_id_cap_truncates_ids_not_counts(monkeypatch):
+    import cdrs_tpu.control.controller as ctl_mod
+
+    monkeypatch.setattr(ctl_mod, "LINEAGE_ID_CAP", 5)
+    manifest = generate_population(GeneratorConfig(n_files=120,
+                                                   seed=SEED + 30))
+    events, _ = simulate_access_with_shift(
+        manifest, SimulatorConfig(duration_seconds=400.0, seed=SEED + 31),
+        200.0, {"hot": "archival", "archival": "hot"})
+    cfg = ControllerConfig(window_seconds=100.0,
+                           kmeans=KMeansConfig(k=6, seed=42),
+                           scoring=validated_scoring_config(),
+                           drift_threshold=0.02)
+    captured: list = []
+
+    class _Cap:
+        def emit(self, e):
+            captured.append(e)
+
+        def close(self):
+            pass
+
+    with Telemetry(_Cap(), meta=False):
+        ReplicationController(manifest, cfg).run(events)
+    lin = [e for e in captured if e.get("kind") == "lineage"]
+    big = [e for e in lin if e["files"] > 5]
+    assert big, "the cold-start plan moves >5 files"
+    for e in big:
+        assert e["truncated"] and len(e["file_ids"]) == 5
