@@ -1,0 +1,293 @@
+"""Streaming-daemon baselines: decision latency, serving under re-plan.
+
+The always-on controller daemon's acceptance artifact
+(``data/daemon_bench.json``), three scenario families:
+
+**Event-to-decision latency** (``run_decision_latency``): the daemon
+tails a binary event log and every closed window must become an
+admitted plan + published epoch in sub-second time — p99 over the
+per-window carve -> decide -> publish wall-clock (the daemon's
+``decision_seconds`` samples).  Acceptance: p99 < 1 s.
+
+**Serving under re-clustering** (``run_serve_under_recluster``): the
+epoch-pinned read path must sustain >= 1M routed reads/s WHILE the
+daemon re-clusters and republishes placement epochs underneath.  The
+daemon ingests the whole log in a background thread; the foreground
+pins ``publisher.pin()`` once per read batch and routes through the
+epoch's functional resolver (``PlacementEpoch.read_view`` -> the full
+router).  The run must observe at least two distinct epochs across its
+batches — serving genuinely crossed a republication, it did not just
+race past a finished daemon.
+
+**Decayed-fold identity** (``run_decay_identity``): with decay = 1.0
+the daemon's per-window decayed sufficient-statistics fold must be
+DECISION-identical to the windowed batch controller — same per-window
+plan hashes, same final category populations, same per-file durability
+tiers (rf) — on three seeds.  The daemon's controller gets the decayed
+accumulator force-enabled (it is normally elided at decay = 1.0) so the
+claim is about the decayed code path, not about it being skipped.
+
+``python -m cdrs_tpu.benchmarks.daemon_bench`` writes the artifact and
+appends round-16 rows to ``data/bench_history.jsonl``
+(regress.append_history, deduped); ``--quick`` shrinks scales for the
+CI smoke step and never appends.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from ..config import (
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from ..control import ControllerConfig, ReplicationController
+from ..daemon import DaemonConfig, StreamDaemon
+from ..serve import ReadRouter, ServeConfig
+from ..sim.access import simulate_access
+from ..sim.generator import generate_population
+
+__all__ = ["run_decision_latency", "run_serve_under_recluster",
+           "run_decay_identity"]
+
+_NODES = ("dn1", "dn2", "dn3", "dn4", "dn5")
+
+
+def _controller(manifest, window_seconds: float, k: int,
+                decay: float = 1.0) -> ReplicationController:
+    cfg = ControllerConfig(
+        window_seconds=window_seconds, default_rf=2, decay=decay,
+        kmeans=KMeansConfig(k=k, seed=42),
+        scoring=validated_scoring_config())
+    return ReplicationController(manifest, cfg)
+
+
+def _population(n_files: int, duration: float, seed: int):
+    manifest = generate_population(GeneratorConfig(
+        n_files=n_files, seed=seed, nodes=_NODES))
+    events = simulate_access(manifest, SimulatorConfig(
+        duration_seconds=duration, seed=seed + 1))
+    return manifest, events
+
+
+def run_decision_latency(n_files: int = 20_000, n_windows: int = 20,
+                         window_seconds: float = 60.0, k: int = 12,
+                         seed: int = 41) -> dict:
+    """p99 window-close-to-admitted-decision latency through the full
+    daemon path (binary-log tail -> carve -> fold -> decide -> epoch
+    publish), at the control-overhead scale."""
+    manifest, events = _population(n_files, n_windows * window_seconds,
+                                   seed)
+    with tempfile.TemporaryDirectory() as td:
+        log = os.path.join(td, "events.cdrsb")
+        events.write_binary(log, manifest)
+        daemon = StreamDaemon(_controller(manifest, window_seconds, k))
+        dig = daemon.run(log)
+    lat = np.asarray(daemon.decision_seconds, dtype=np.float64)
+    return {
+        "n_files": n_files,
+        "n_windows": int(dig["windows_processed"]),
+        "events": int(dig["events_ingested"]),
+        "epochs_published": int(dig["epochs_published"]),
+        "decision_p50_seconds": round(float(np.quantile(lat, 0.5)), 6),
+        "decision_p99_seconds": float(dig["decision_p99_seconds"]),
+        "decision_max_seconds": round(float(lat.max()), 6),
+        "sub_second_p99": bool(dig["decision_p99_seconds"] < 1.0),
+    }
+
+
+def run_serve_under_recluster(n_files: int = 1 << 15,
+                              n_windows: int = 24,
+                              window_seconds: float = 60.0,
+                              k: int = 16,
+                              reads_per_batch: int = 1_000_000,
+                              min_batches: int = 4,
+                              max_batches: int = 64,
+                              seed: int = 43) -> dict:
+    """Routed reads/s through the pinned epoch while the daemon
+    re-clusters and republishes underneath (module docstring)."""
+    manifest, events = _population(n_files, n_windows * window_seconds,
+                                   seed)
+    rng = np.random.default_rng(seed + 7)
+    n_nodes = len(_NODES)
+    router = ReadRouter(n_nodes, ServeConfig(policy="p2c", seed=seed))
+
+    batches: list[dict] = []
+    with tempfile.TemporaryDirectory() as td:
+        log = os.path.join(td, "events.cdrsb")
+        events.write_binary(log, manifest)
+        daemon = StreamDaemon(_controller(manifest, window_seconds, k))
+        t = threading.Thread(target=daemon.run, args=(log,), daemon=True)
+        t.start()
+        while daemon.publisher.pin() is None and t.is_alive():
+            time.sleep(0.002)
+        # Route batches pinned one-epoch-each until the daemon finishes
+        # (and at least ``min_batches`` either way): skewed pids, the
+        # zipf-ish head the load-aware policy exists to absorb.
+        while (t.is_alive() or len(batches) < min_batches) \
+                and len(batches) < max_batches:
+            ep = daemon.publisher.pin()
+            ts = np.sort(rng.random(reads_per_batch) * window_seconds)
+            pid = (n_files
+                   * rng.random(reads_per_batch) ** 3.0).astype(np.int32)
+            client = rng.integers(0, n_nodes,
+                                  reads_per_batch).astype(np.int32)
+            t0 = time.perf_counter()
+            rv = ep.read_view(pid)
+            res = router.route(rv.replica_map, rv.slot_ok,
+                               rv.node_throughput, ts=ts, pid=rv.pid,
+                               client=client,
+                               window_seconds=window_seconds)
+            dt = time.perf_counter() - t0
+            batches.append({"epoch": int(ep.epoch_id),
+                            "seconds": round(dt, 4),
+                            "p99_ms": round(res.p99_ms, 4)})
+        t.join()
+    total_reads = reads_per_batch * len(batches)
+    total_seconds = sum(b["seconds"] for b in batches)
+    epochs_seen = sorted({b["epoch"] for b in batches})
+    return {
+        "n_files": n_files,
+        "reads_per_batch": reads_per_batch,
+        "batches": len(batches),
+        "reads_per_sec": round(total_reads / total_seconds, 1),
+        "epochs_published": int(daemon.publisher.published_total),
+        "epochs_seen_while_routing": epochs_seen,
+        "per_batch": batches,
+        "sustained_1m_reads_per_sec":
+            total_reads / total_seconds >= 1_000_000,
+        "reclustered_underneath": len(epochs_seen) >= 2,
+    }
+
+
+def run_decay_identity(n_files: int = 2_000, n_windows: int = 12,
+                       window_seconds: float = 120.0, k: int = 10,
+                       seeds: tuple[int, ...] = (0, 1, 2)) -> dict:
+    """Decay=1.0 decayed live fold vs windowed batch controller:
+    decision identity per seed (plan hashes, category populations,
+    durability tiers)."""
+    per_seed = []
+    for seed in seeds:
+        manifest, events = _population(
+            n_files, n_windows * window_seconds, 100 + seed)
+        batch = _controller(manifest, window_seconds, k)
+        res = batch.run(events)
+        live = _controller(manifest, window_seconds, k)
+        # Force the decayed accumulator on (normally elided at
+        # decay=1.0) so the identity claim exercises the decayed path.
+        live._dec = {key: np.zeros(len(manifest))
+                     for key in ("access_freq", "writes", "local_acc",
+                                 "conc_max")}
+        live._dec_obs_end = None
+        with tempfile.TemporaryDirectory() as td:
+            log = os.path.join(td, "events.cdrsb")
+            events.write_binary(log, manifest)
+            daemon = StreamDaemon(live)
+            daemon.run(log)
+        hashes_batch = [r["plan_hash"] for r in res.records]
+        hashes_live = [r["plan_hash"] for r in daemon.records]
+        pops_batch = np.bincount(batch.current_cat, minlength=k)
+        pops_live = np.bincount(live.current_cat, minlength=k)
+        per_seed.append({
+            "seed": seed,
+            "windows": len(daemon.records),
+            "plan_hashes_identical": hashes_batch == hashes_live,
+            "category_populations_identical":
+                bool(np.array_equal(pops_batch, pops_live)),
+            "durability_tiers_identical":
+                bool(np.array_equal(batch.current_rf, live.current_rf)),
+        })
+    return {
+        "n_files": n_files, "n_windows": n_windows, "seeds": list(seeds),
+        "per_seed": per_seed,
+        "decay_one_identical": all(
+            s["plan_hashes_identical"]
+            and s["category_populations_identical"]
+            and s["durability_tiers_identical"] for s in per_seed),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--out", default="data/daemon_bench.json")
+    p.add_argument("--round", type=int, default=16, dest="round_no",
+                   help="PR-round stamp for the regress history")
+    p.add_argument("--quick", action="store_true",
+                   help="small sizes for smoke runs (CI); never appends "
+                        "to the history")
+    from .regress import add_history_argument
+
+    add_history_argument(p)
+    args = p.parse_args(argv)
+
+    if args.quick:
+        latency = run_decision_latency(n_files=2_000, n_windows=8)
+        serve = run_serve_under_recluster(
+            n_files=1 << 13, n_windows=12, reads_per_batch=200_000,
+            min_batches=3)
+        decay = run_decay_identity(n_files=500, n_windows=8,
+                                   seeds=(0, 1, 2))
+    else:
+        latency = run_decision_latency()
+        serve = run_serve_under_recluster()
+        decay = run_decay_identity()
+
+    out: dict = {
+        "round": args.round_no,
+        "decision_latency": latency,
+        "serve_under_recluster": serve,
+        "decay_identity": decay,
+    }
+    out["criteria"] = {
+        "decision_p99_sub_second": latency["sub_second_p99"],
+        "routed_1m_reads_per_sec_during_recluster":
+            serve["sustained_1m_reads_per_sec"]
+            and serve["reclustered_underneath"],
+        "decay_one_decision_identical": decay["decay_one_identical"],
+    }
+    out["bench_records"] = [
+        {"metric": "daemon_decision_p99_seconds",
+         "value": latency["decision_p99_seconds"], "unit": "s",
+         "direction": "lower", "backend": "numpy"},
+        {"metric": "daemon_routed_reads_per_sec",
+         "value": serve["reads_per_sec"], "unit": "reads/s",
+         "backend": "numpy"},
+    ]
+
+    parent = os.path.dirname(args.out)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    appended = 0
+    if not args.quick:
+        from .regress import append_history, extract_records, \
+            resolve_history_path
+
+        history = resolve_history_path(args)
+        if history:
+            appended = append_history(
+                history, extract_records(out,
+                                         os.path.basename(args.out)))
+    print(json.dumps({"out": args.out, **out["criteria"],
+                      "decision_p99_seconds":
+                          latency["decision_p99_seconds"],
+                      "reads_per_sec": serve["reads_per_sec"],
+                      "history_appended": appended}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -561,6 +561,53 @@ def _cmd_control(args) -> int:
     return _run_controller(args, _controller_cfg(args), "control_cmd")
 
 
+def _cmd_daemon(args) -> int:
+    """Always-on streaming controller (daemon/): tail the growing binary
+    event log (or read it once), carve windows on the controller's grid,
+    publish every admitted plan as a pinned placement epoch, evaluate
+    the live alert rules, and land cursor-carrying checkpoints so
+    SIGTERM -> restart resumes bit-identically over O(new data)."""
+    import contextlib
+
+    from .control import ReplicationController
+    from .daemon import DaemonConfig, StreamDaemon
+    from .io.events import Manifest
+
+    manifest = Manifest.read_csv(args.manifest)
+    controller = ReplicationController(manifest, _controller_cfg(args))
+    daemon = StreamDaemon(controller, DaemonConfig(
+        follow=args.follow, poll=args.poll,
+        checkpoint_every=args.checkpoint_every,
+        max_windows=args.max_windows, max_seconds=args.max_seconds,
+        recluster=args.recluster, minibatch_rows=args.minibatch_rows))
+    daemon.install_signal_handlers()
+    with contextlib.ExitStack() as stack:
+        _open_telemetry(args, stack, "daemon_cmd")
+        with StageTimer("daemon_cmd") as t:
+            digest = daemon.run(
+                args.access_log, metrics_path=args.metrics,
+                checkpoint_path=args.checkpoint,
+                batch_size=args.batch_size)
+    if args.plan_out:
+        from .cluster.plan import write_plan_csv
+        from .control.controller import ControllerResult
+
+        result = ControllerResult(records=daemon.records,
+                                  rf=controller.current_rf,
+                                  category_idx=controller.current_cat,
+                                  manifest=manifest)
+        write_plan_csv(args.plan_out, result.plan_entries())
+        print(f"plan: {len(manifest)} files -> {args.plan_out}",
+              file=sys.stderr)
+    digest["seconds"] = round(t.elapsed, 3)
+    if args.digest_out:
+        with open(args.digest_out, "w", encoding="utf-8") as f:
+            json.dump(digest, f, indent=2)
+            f.write("\n")
+    print(json.dumps(digest, indent=2))
+    return 0
+
+
 def _cmd_chaos(args) -> int:
     """Fault-injected controller run: the control loop plus a seeded
     FaultSchedule (node crash/recover/decommission/flaky, network
@@ -1263,6 +1310,32 @@ def main(argv: list[str] | None = None) -> int:
                        "-> bounded-churn migration")
     _add_control_args(p)
     p.set_defaults(fn=_cmd_control)
+
+    p = sub.add_parser("daemon", help="always-on streaming controller: "
+                       "tail the growing binary event log, decide per "
+                       "window, publish epoch-pinned placements, "
+                       "checkpoint with an ingest cursor for bit-identical "
+                       "resume")
+    _add_control_args(p)
+    p.add_argument("--follow", action="store_true",
+                   help="keep tailing the log for appended blocks "
+                        "(default: process to EOF once and exit)")
+    p.add_argument("--poll", type=float, default=0.5, metavar="SECONDS",
+                   help="follow-mode poll cadence")
+    p.add_argument("--max_seconds", type=float, default=None,
+                   help="stop (checkpoint + digest) after this much wall "
+                        "clock")
+    p.add_argument("--recluster", choices=["controller", "minibatch"],
+                   default="controller",
+                   help="'minibatch' additionally advances a warm-started "
+                        "mini-batch Lloyd step per window (live "
+                        "centroid/inertia telemetry; jax backend; "
+                        "decisions unchanged)")
+    p.add_argument("--minibatch_rows", type=int, default=2048,
+                   metavar="ROWS")
+    p.add_argument("--digest_out", default=None, metavar="JSON",
+                   help="additionally write the final digest here")
+    p.set_defaults(fn=_cmd_daemon)
 
     p = sub.add_parser("chaos", help="fault-injected controller run: node "
                        "crash/recover/decommission/flaky events, durability "

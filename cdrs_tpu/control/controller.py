@@ -1858,8 +1858,16 @@ class ReplicationController:
         return float(m.read_locality), float(m.load_balance)
 
     # -- checkpoint --------------------------------------------------------
-    def save_checkpoint(self, path: str) -> None:
-        """Atomic npz snapshot of the full controller state."""
+    def save_checkpoint(self, path: str,
+                        extra_meta: dict | None = None) -> None:
+        """Atomic npz snapshot of the full controller state.
+
+        ``extra_meta`` rides along in the JSON meta blob under the
+        caller's own keys (the streaming daemon stores its ingest
+        cursor there, so ONE atomic file carries both the controller
+        state and the resume position — no torn two-file checkpoint);
+        ``load_checkpoint`` hands the full meta back via
+        ``last_checkpoint_meta``."""
         from ..utils.checkpoint import save_state
 
         # A lazily accepted decision must land in host arrays before it
@@ -1936,6 +1944,8 @@ class ReplicationController:
             }
         if self.cfg.backend == "jax":
             meta["pad_events"] = self._state.pad_events
+        if extra_meta:
+            meta.update(extra_meta)
         stats = save_state(path, arrays, meta=meta)
         # Per-save record (window-stamped): the checkpoint-size artifact
         # the functional placement mode is measured by.
@@ -2085,6 +2095,10 @@ class ReplicationController:
         self._last_window_events = int(meta.get("last_window_events", 0))
         self._t0 = meta.get("t0")
         self._events_total = int(meta.get("events_total", 0))
+        #: Full meta blob of the snapshot just loaded — callers that
+        #: stored ``extra_meta`` via ``save_checkpoint`` (the streaming
+        #: daemon's ingest cursor) read it back from here.
+        self.last_checkpoint_meta = meta
 
     def _load_checkpoint_with_fallback(self, path: str) -> None:
         """Resume from ``path``; a corrupt/truncated snapshot (power cut

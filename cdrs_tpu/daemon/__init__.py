@@ -1,0 +1,29 @@
+"""Always-on streaming controller daemon (ROADMAP open item 3).
+
+Everything else in the repo is one batch ``ReplicationController.run()``
+over a pre-materialized log; this package is the process that never
+stops.  Three pieces:
+
+* ``tailer`` — follow-mode batch reader over the growing binary event
+  log (``io/events`` ``.cdrsb``), mirroring ``obs/sink.iter_events``
+  semantics: wait for a missing file, buffer the torn tail, drain a
+  rotated predecessor, honor a stop predicate per poll round.
+* ``epochs`` — immutable :class:`PlacementEpoch` snapshots published
+  through an atomic single-reference :class:`EpochPublisher`; readers
+  pin ONE epoch per request batch, so a routed read never observes a
+  torn placement while the controller recomputes underneath (the CRUSH
+  cluster-map posture, PAPERS.md).
+* ``core`` — :class:`StreamDaemon`, the loop: ingest -> carve windows
+  on the controller's grid -> ``process_window`` (decision-identical to
+  the batch loop by construction) -> publish an epoch -> evaluate the
+  live alert rules -> checkpoint.  SIGTERM lands a cursor-carrying
+  checkpoint and a resumed daemon continues bit-identically, reading
+  O(new data) instead of re-reading history.
+"""
+
+from .core import DaemonConfig, StreamDaemon
+from .epochs import EpochPublisher, PlacementEpoch
+from .tailer import TailBatch, tail_binary_log
+
+__all__ = ["DaemonConfig", "StreamDaemon", "EpochPublisher",
+           "PlacementEpoch", "TailBatch", "tail_binary_log"]

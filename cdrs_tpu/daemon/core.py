@@ -1,0 +1,451 @@
+"""The always-on streaming controller loop.
+
+:class:`StreamDaemon` wraps a :class:`~cdrs_tpu.control.ReplicationController`
+and drives it window by window over a LIVE event stream — a growing
+binary log (tailer) or an in-process batch feed — instead of a finished
+file.  The windows themselves come from the batch loop's own carver
+(``control.windows.iter_windows`` consumes any batch iterable lazily),
+and each window runs through the controller's public
+``process_window``: the daemon therefore makes *exactly* the decisions
+the batch ``run()`` loop would make on the same stream — the
+equivalence oracle ``benchmarks/daemon_bench.py`` gates on.
+
+What the daemon adds around that loop:
+
+* **Epoch publication** — every processed window's admitted plan
+  freezes into a :class:`~cdrs_tpu.daemon.epochs.PlacementEpoch` backed
+  by a new ``placement_fn.EpochMap`` revision and lands via one atomic
+  reference swap; readers pin per request batch (see ``epochs``).
+* **Live alerting** — the window record feeds ``obs/alerts.AlertEngine``
+  as it is produced; a firing page-severity alert (``files_lost`` /
+  ``true_lost``) triggers an immediate protective checkpoint — the
+  alert engine is the daemon's control surface, not a post-hoc report.
+* **Cursor checkpoints** — the controller snapshot carries the ingest
+  cursor ``(byte offset of the block holding the first unprocessed
+  event, events to skip within it)`` in its meta blob, making resume
+  O(new data): the batch loop's documented O(history) re-read from byte
+  0 is exactly the follow-up this daemon implements.
+* **Graceful shutdown** — SIGTERM sets a flag; the loop finishes the
+  window in flight, checkpoints, and returns.  Buffered events of the
+  next (incomplete) window are NOT folded — the cursor re-reads them on
+  resume, so an interrupted-and-resumed daemon produces bit-identical
+  records and plans to an uninterrupted one (Yuan et al.'s warning:
+  the shutdown path is tested, not assumed).
+* **Incremental re-cluster tracking** (``recluster="minibatch"``) — a
+  warm-started ``ops/kmeans_stream.MiniBatchKMeans`` advances one
+  mini-batch Lloyd step per window on the decayed feature snapshot,
+  maintaining live centroids/inertia between the controller's admitted
+  full plans.  Observability only: plan decisions stay the
+  controller's, so the equivalence oracle holds with it on or off.
+
+Backpressure is pull-based by construction: the tailer is only read
+when the loop is ready for the next window, so a fast writer fills the
+log (bounded by disk), never the daemon's memory — in-flight residency
+is one window plus one block.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..control.windows import _slice, iter_windows
+from ..io.events import EventLog, is_binary_log
+from ..obs.alerts import SEVERE_ALERTS, AlertEngine, default_rules
+from .epochs import EpochPublisher, PlacementEpoch
+from .tailer import tail_binary_log
+
+__all__ = ["DaemonConfig", "StreamDaemon"]
+
+_RECLUSTER_MODES = ("controller", "minibatch")
+
+
+@dataclass
+class DaemonConfig:
+    """Daemon-side knobs (everything decision-relevant lives in the
+    wrapped controller's ``ControllerConfig``)."""
+
+    #: Tail the log for appended blocks (False = process to EOF, once).
+    follow: bool = False
+    #: Poll cadence of the follow-mode tailer, seconds.
+    poll: float = 0.5
+    #: Snapshot every N processed windows (plus once at exit/SIGTERM).
+    checkpoint_every: int = 1
+    #: Stop after this many windows processed THIS run (None = no cap).
+    max_windows: int | None = None
+    #: Stop after this much wall clock, seconds (None = no cap).
+    max_seconds: float | None = None
+    #: "controller" = plans re-cluster exactly as the batch loop does;
+    #: "minibatch" additionally advances a warm-started mini-batch
+    #: Lloyd step per window (live centroids/inertia telemetry).
+    recluster: str = "controller"
+    #: Rows sampled from the feature snapshot per mini-batch step.
+    minibatch_rows: int = 2048
+    #: Seed of the daemon's EpochMap hash placement.
+    placement_seed: int = 0
+
+    def __post_init__(self):
+        if self.recluster not in _RECLUSTER_MODES:
+            raise ValueError(
+                f"unknown recluster mode {self.recluster!r} "
+                f"(want one of {_RECLUSTER_MODES})")
+        if self.poll <= 0:
+            raise ValueError(f"poll must be > 0, got {self.poll}")
+
+
+@dataclass
+class _Inflight:
+    """Cursor bookkeeping for one ingested batch still overlapping an
+    unprocessed window: where its first event lives in the log."""
+
+    offset: int        # block-boundary byte offset (0 for feeds)
+    base: int          # events to skip at ``offset`` before this batch
+    ts: np.ndarray     # the batch's timestamps (window membership)
+
+
+class StreamDaemon:
+    """Drive a ReplicationController continuously over a live stream.
+
+    ``source`` accepted by :meth:`run`: a ``.cdrsb`` binary-log path
+    (tailed; CSV logs are rejected — the live fast path is columnar),
+    an in-memory ``EventLog``, or any iterable of ``EventLog`` batches
+    (the in-process generator feed).  For feeds the resume cursor is an
+    event COUNT — the feed must be replayable from its start (the
+    scenario harness replays the seeded simulator).
+    """
+
+    def __init__(self, controller, cfg: DaemonConfig | None = None, *,
+                 rules=None):
+        self.controller = controller
+        self.cfg = cfg or DaemonConfig()
+        self.publisher = EpochPublisher()
+        self.engine = AlertEngine(rules if rules is not None
+                                  else default_rules())
+        self.records: list[dict] = []
+        self.alert_log: list[dict] = []
+        self.decision_seconds: list[float] = []
+        self.minibatch: dict | None = None
+        self.alert_checkpoints = 0
+        self.checkpoint_count = 0
+        self.windows_processed = 0
+        self.events_ingested = 0
+        self._stop = threading.Event()
+        self._stop_reason: str | None = None
+        self._cursor = {"offset": 0, "skip": 0}
+        self._inflight: list[_Inflight] = []
+        self._tail = (0, 0)       # cursor when nothing is in flight
+        self._emap = None
+        self._flat_topo = None
+        self._mbk = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def request_stop(self, reason: str = "requested") -> None:
+        """Ask the loop to stop after the window in flight (thread- and
+        signal-safe)."""
+        if self._stop_reason is None:
+            self._stop_reason = reason
+        self._stop.set()
+
+    def install_signal_handlers(self,
+                                signals=(signal.SIGTERM,
+                                         signal.SIGINT)) -> None:
+        """Graceful shutdown: SIGTERM/SIGINT -> finish the current
+        window, checkpoint, return (main thread only)."""
+        for s in signals:
+            signal.signal(
+                s, lambda signum, frame: self.request_stop(
+                    signal.Signals(signum).name))
+
+    # -- ingest ------------------------------------------------------------
+    def _batches(self, source, batch_size: int):
+        """Normalize any source into EventLog batches WITH cursor
+        bookkeeping: every yielded batch is registered in
+        ``_inflight`` so a checkpoint can name the byte/count position
+        of the first unprocessed event."""
+        skip = int(self._cursor["skip"])
+        if isinstance(source, (str, bytes, os.PathLike)):
+            if os.path.exists(source) and not is_binary_log(source):
+                raise ValueError(
+                    f"daemon ingest needs the binary event log "
+                    f"(.cdrsb), got a CSV/unknown file: {source!r} — "
+                    f"produce one with `cdrs simulate --format binary` "
+                    f"or EventLog.write_binary")
+            stream = tail_binary_log(
+                str(source), self.controller.manifest,
+                follow=self.cfg.follow, poll=self.cfg.poll,
+                stop=self._stop.is_set,
+                start_offset=int(self._cursor["offset"]))
+            for ev, off, nxt in stream:
+                base = 0
+                if skip:
+                    take = min(skip, len(ev))
+                    skip -= take
+                    if take == len(ev):
+                        self._tail = (nxt, 0)
+                        continue
+                    ev = _slice(ev, take, len(ev))
+                    base = take
+                self._inflight.append(_Inflight(off, base, ev.ts))
+                self._tail = (nxt, 0)
+                self.events_ingested += len(ev)
+                yield ev
+            return
+        if int(self._cursor["offset"]):
+            raise ValueError(
+                "resume cursor carries a byte offset but the source is "
+                "an in-process feed — the checkpoint belongs to a "
+                "binary-log daemon")
+        feed = iter([source]) if isinstance(source, EventLog) \
+            else iter(source)
+        gidx = 0
+        for ev in feed:
+            if self._stop.is_set():
+                return
+            n = len(ev)
+            if skip:
+                take = min(skip, n)
+                skip -= take
+                gidx += take
+                if take == n:
+                    self._tail = (0, gidx)
+                    continue
+                ev = _slice(ev, take, n)
+            self._inflight.append(_Inflight(0, gidx, ev.ts))
+            gidx += len(ev)
+            self._tail = (0, gidx)
+            self.events_ingested += len(ev)
+            yield ev
+
+    def _advance_cursor(self, w: int) -> None:
+        """After window ``w`` closed: the cursor is the position of the
+        first event belonging to window ``w+1`` (block boundary + skip
+        count), or the ingest tail when nothing is buffered."""
+        w_end = self.controller._t0 \
+            + (w + 1) * float(self.controller.cfg.window_seconds)
+        keep: list[_Inflight] = []
+        cursor = None
+        for fl in self._inflight:
+            cut = int(np.searchsorted(fl.ts, w_end, side="left"))
+            if cut < len(fl.ts):
+                if cursor is None:
+                    cursor = (fl.offset, fl.base + cut)
+                keep.append(fl)
+        self._inflight = keep
+        off, sk = cursor if cursor is not None else self._tail
+        self._cursor = {"offset": int(off), "skip": int(sk)}
+
+    # -- per-window actions ------------------------------------------------
+    def _publish(self, w: int, rec: dict) -> PlacementEpoch:
+        ctl = self.controller
+        topo = None
+        if getattr(ctl, "_cluster_state", None) is not None:
+            topo = ctl._cluster_state.topology
+        elif getattr(ctl.cfg, "topology", None) is not None:
+            topo = ctl.cfg.topology
+        if topo is None:
+            if self._flat_topo is None:
+                from ..cluster import ClusterTopology
+
+                self._flat_topo = ClusterTopology(
+                    nodes=tuple(ctl.manifest.nodes))
+            topo = self._flat_topo
+        if self._emap is None:
+            from ..placement_fn import EpochMap
+
+            self._emap = EpochMap(ctl.manifest.nodes, topo,
+                                  seed=self.cfg.placement_seed)
+        # Every admitted plan IS a new cluster-map revision (an
+        # unchanged topology diffs to zero moves by construction).
+        map_ep = self._emap.advance(topo)
+        rf = ctl.current_rf.copy()
+        cat = ctl.current_cat.copy()
+        emap, prim = self._emap, ctl.manifest.primary_node_id
+
+        def resolver(uniq, _eid=map_ep.epoch_id, _rf=rf):
+            slots, _ = emap.placement(_eid, np.asarray(uniq),
+                                      _rf[uniq], prim[uniq])
+            return slots
+
+        epoch = PlacementEpoch(
+            epoch_id=self.publisher.published_total + 1,
+            window=int(w), plan_hash=str(rec.get("plan_hash", "")),
+            rf=rf, category_idx=cat, n_nodes=len(topo.nodes),
+            map_epoch_id=map_ep.epoch_id, resolver=resolver)
+        return self.publisher.publish(epoch)
+
+    def _observe_alerts(self, rec: dict, sink,
+                        checkpoint_path: str | None) -> None:
+        for t in self.engine.observe({"kind": "window", **rec}):
+            self.alert_log.append(t)
+            if sink is not None:
+                sink.emit({"kind": "alert", **t})
+            if (t.get("state") == "firing"
+                    and t.get("alert") in SEVERE_ALERTS
+                    and checkpoint_path):
+                # A page-severity alert is the control surface acting:
+                # land a protective snapshot immediately so the state
+                # that first saw the loss is durable for post-mortem
+                # and restart.
+                self._save(checkpoint_path)
+                self.alert_checkpoints += 1
+
+    def _minibatch_step(self) -> None:
+        from ..ops.kmeans_stream import MiniBatchKMeans  # needs jax
+
+        ctl = self.controller
+        X = np.asarray(ctl._feature_snapshot(), dtype=np.float32)
+        k = int(ctl.cfg.kmeans.k)
+        if self._mbk is None:
+            self._mbk = MiniBatchKMeans(k=k, seed=ctl.cfg.kmeans.seed)
+        n_b = self._mbk.state.n_batches if self._mbk.state else 0
+        rng = np.random.default_rng(
+            (int(ctl.cfg.kmeans.seed or 0) << 16) ^ n_b)
+        rows = min(max(int(self.cfg.minibatch_rows), k), len(X))
+        idx = np.sort(rng.choice(len(X), size=rows, replace=False))
+        sample = X[idx]
+        self._mbk.partial_fit(sample)
+        d = sample[:, None, :] - self._mbk.centroids[None, :, :]
+        inertia = float(np.mean(np.min((d * d).sum(-1), axis=1)))
+        self.minibatch = {
+            "n_batches": int(self._mbk.state.n_batches),
+            "inertia": inertia,
+        }
+
+    def _save(self, path: str) -> None:
+        self.controller.save_checkpoint(path, extra_meta={"daemon": {
+            "offset": int(self._cursor["offset"]),
+            "skip": int(self._cursor["skip"]),
+            "epochs_published": int(self.publisher.published_total),
+        }})
+        self.checkpoint_count += 1
+
+    # -- the loop ----------------------------------------------------------
+    def run(self, source, *, metrics_path: str | None = None,
+            metrics_max_bytes: int | None = None,
+            checkpoint_path: str | None = None,
+            batch_size: int = 1_000_000) -> dict:
+        """Ingest -> carve -> decide -> publish, until the stream ends
+        (non-follow), a cap is hit, or a stop/SIGTERM arrives.  Returns
+        the digest (:meth:`digest`)."""
+        ctl = self.controller
+        cfg = self.cfg
+        if checkpoint_path:
+            ctl._load_checkpoint_with_fallback(checkpoint_path)
+            dmeta = (getattr(ctl, "last_checkpoint_meta", None)
+                     or {}).get("daemon") or {}
+            self._cursor = {"offset": int(dmeta.get("offset", 0)),
+                            "skip": int(dmeta.get("skip", 0))}
+            self._tail = (self._cursor["offset"], self._cursor["skip"])
+            self.publisher.published_total = int(
+                dmeta.get("epochs_published", 0))
+        sink = None
+        own_sink = False
+        if metrics_path:
+            from ..obs import JsonlSink
+            from ..obs import current as _obs_current
+
+            # One stream, ONE writer (controller.run's sharing rule).
+            tel = _obs_current()
+            if (tel is not None and tel.sink is not None
+                    and getattr(tel.sink, "path", None) == metrics_path):
+                sink = tel.sink
+            else:
+                sink = JsonlSink(metrics_path,
+                                 max_bytes=metrics_max_bytes)
+                own_sink = True
+
+        deadline = (time.monotonic() + float(cfg.max_seconds)
+                    if cfg.max_seconds is not None else None)
+        every = max(1, int(cfg.checkpoint_every))
+        since_ckpt = 0
+        t0_box: dict = {}
+        try:
+            for w, events in iter_windows(
+                    self._batches(source, batch_size), ctl.manifest,
+                    ctl.cfg.window_seconds, batch_size=batch_size,
+                    t0=ctl._t0, t0_out=t0_box):
+                if self._stop.is_set():
+                    # Includes the carver's trailing partial-window
+                    # flush after a stop-interrupted tail: those events
+                    # stay unprocessed, the cursor re-reads them.
+                    break
+                if ctl._t0 is None:
+                    ctl._t0 = t0_box.get("t0")
+                if w < ctl.window_index:
+                    # Already processed before the checkpoint.  Any
+                    # events here re-read past the cursor are a late
+                    # tail appended after the snapshot, inside an
+                    # already-planned window's span: fold them so no
+                    # event is lost (batch resume's contract).
+                    if len(events):
+                        ctl._fold_window(events, new_window=False)
+                        ctl._last_window_events += len(events)
+                        self._advance_cursor(w)
+                        since_ckpt += 1
+                    continue
+                t_dec = time.perf_counter()
+                rec = ctl.process_window(w, events)
+                ctl.window_index = w + 1
+                ctl._last_window_events = len(events)
+                self.records.append(rec)
+                if sink is not None:
+                    sink.emit({"kind": "window", **rec})
+                self._observe_alerts(rec, sink, checkpoint_path)
+                self._publish(w, rec)
+                if cfg.recluster == "minibatch":
+                    self._minibatch_step()
+                self.decision_seconds.append(
+                    time.perf_counter() - t_dec)
+                self.windows_processed += 1
+                since_ckpt += 1
+                self._advance_cursor(w)
+                if checkpoint_path and since_ckpt >= every:
+                    self._save(checkpoint_path)
+                    since_ckpt = 0
+                if (cfg.max_windows is not None
+                        and self.windows_processed
+                        >= int(cfg.max_windows)):
+                    self.request_stop("max_windows")
+                if deadline is not None and time.monotonic() > deadline:
+                    self.request_stop("max_seconds")
+            else:
+                if self._stop_reason is None:
+                    self._stop_reason = "end_of_stream"
+        finally:
+            if sink is not None and own_sink:
+                sink.close()
+        if checkpoint_path and since_ckpt:
+            self._save(checkpoint_path)
+        return self.digest()
+
+    # -- reporting ---------------------------------------------------------
+    def digest(self) -> dict:
+        """One JSON-able summary of the daemon's run (the CLI prints
+        it; CI asserts on it)."""
+        lat = np.asarray(self.decision_seconds, dtype=np.float64)
+        cur = self.publisher.pin()
+        out = {
+            "windows_processed": int(self.windows_processed),
+            "window_index": int(self.controller.window_index),
+            "events_ingested": int(self.events_ingested),
+            "epochs_published": int(self.publisher.published_total),
+            "current_epoch": None if cur is None else int(cur.epoch_id),
+            "plan_hash": None if cur is None else cur.plan_hash,
+            "alerts_fired": sorted({t["alert"] for t in self.alert_log
+                                    if t.get("state") == "firing"}),
+            "alert_checkpoints": int(self.alert_checkpoints),
+            "checkpoints": int(self.checkpoint_count),
+            "decision_p99_seconds": (
+                None if lat.size == 0
+                else round(float(np.quantile(lat, 0.99)), 6)),
+            "stop_reason": self._stop_reason,
+            "cursor": dict(self._cursor),
+        }
+        if self.minibatch is not None:
+            out["minibatch"] = dict(self.minibatch)
+        return out

@@ -1,0 +1,98 @@
+"""Atomically published placement epochs — the daemon's serve contract.
+
+The CRUSH posture (Weil et al., PAPERS.md): serving resolves against a
+*published cluster-map epoch*, never a mutable table.  Each admitted
+plan freezes into a :class:`PlacementEpoch` — immutable rf/category
+vectors, the plan hash, and a functional resolver over the backing
+``placement_fn.EpochMap`` revision — and lands via one atomic reference
+swap in :class:`EpochPublisher`.  Readers ``pin()`` ONCE per request
+batch and route every read of that batch against the pinned epoch; a
+concurrent ``publish()`` is invisible to them until their next pin, so
+no batch ever observes a mix of epoch N and N+1 (property-tested in
+tests/test_daemon.py under concurrent publication).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["PlacementEpoch", "EpochPublisher"]
+
+
+@dataclass(frozen=True)
+class PlacementEpoch:
+    """One immutable admitted plan, as served.
+
+    ``epoch_id`` is the daemon-lifetime publication sequence (continuous
+    across checkpoint/resume); ``map_epoch_id`` names the backing
+    ``placement_fn.EpochMap`` revision INSIDE the current process (the
+    map is rebuilt on resume, so its ids restart while ``epoch_id`` does
+    not).  ``resolver(unique_file_ids) -> (k, R) int32 slot rows`` is
+    the ``serve.read_view(resolver=...)`` plug — the O(unique pids)
+    functional resolution, frozen over this epoch's rf vector and map
+    revision.
+    """
+
+    epoch_id: int
+    window: int                  # window index whose plan this is
+    plan_hash: str
+    rf: np.ndarray               # (n,) int32, read-only
+    category_idx: np.ndarray     # (n,) int32, read-only
+    n_nodes: int
+    map_epoch_id: int = 0
+    resolver: object | None = field(default=None, repr=False)
+
+    def __post_init__(self):
+        # An epoch is a snapshot, not a view: freeze the arrays so a
+        # later controller window can never mutate a pinned plan.
+        self.rf.setflags(write=False)
+        self.category_idx.setflags(write=False)
+
+    def read_view(self, pid: np.ndarray):
+        """The router inputs for one request batch, pinned to THIS
+        epoch (``serve.read_view`` resolver path)."""
+        from ..serve import read_view
+
+        if self.resolver is None:
+            raise ValueError(
+                f"epoch {self.epoch_id} carries no resolver (published "
+                f"without a topology)")
+        return read_view(pid, resolver=self.resolver,
+                         n_nodes=self.n_nodes)
+
+
+class EpochPublisher:
+    """Single-slot atomic epoch publication.
+
+    ``publish`` swaps one reference under a lock (writers are the
+    daemon's window loop — rare); ``pin`` is ONE unlocked attribute
+    read, atomic by construction, so readers never block the publisher
+    and vice versa.  Epoch ids must grow monotonically — a republish of
+    an older epoch is a torn-history bug and raises.
+    """
+
+    def __init__(self, published_total: int = 0):
+        self._lock = threading.Lock()
+        self._current: PlacementEpoch | None = None
+        #: Epochs ever published across the daemon's LIFETIME, including
+        #: before a checkpoint/resume (restored from daemon meta).
+        self.published_total = int(published_total)
+
+    def publish(self, epoch: PlacementEpoch) -> PlacementEpoch:
+        with self._lock:
+            cur = self._current
+            if cur is not None and epoch.epoch_id <= cur.epoch_id:
+                raise ValueError(
+                    f"epoch ids must grow: {epoch.epoch_id} after "
+                    f"{cur.epoch_id}")
+            self._current = epoch
+            self.published_total += 1
+        return epoch
+
+    def pin(self) -> PlacementEpoch | None:
+        """The current epoch, pinned: callers hold the returned object
+        for their WHOLE request batch and never re-read mid-batch."""
+        return self._current

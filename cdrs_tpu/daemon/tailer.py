@@ -1,0 +1,177 @@
+"""Follow-mode tailer over the growing binary event log (.cdrsb).
+
+``EventLog.read_binary_batches`` reads a COMPLETE log: a block whose
+bytes run past EOF is corruption and raises.  A live log being appended
+to looks exactly like that corruption from a reader's point of view —
+the writer's last block is mid-flight — so the tailer re-interprets the
+torn tail as "wait for more bytes" and only ever surfaces whole blocks.
+Semantics mirror ``obs/sink.iter_events`` (the jsonl follow reader):
+
+* a missing file is waited for under ``follow`` (the daemon may start
+  before the simulator), and a clean one-line error otherwise;
+* the torn tail (incomplete final block, or a header still being
+  written) is buffered by NOT consuming it until the bytes land;
+* rotation (``path`` -> ``path + ".1"``) is detected by the file
+  shrinking below the read offset; the rotated predecessor is drained
+  from that offset before the new file is followed from its header;
+* an optional ``stop`` predicate is checked once per poll round, so a
+  shutdown request interrupts the sleep cadence, not just the yields.
+
+Yields :class:`TailBatch` — the block's events plus the block-boundary
+byte offsets the daemon's resume cursor is built from.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import NamedTuple
+
+import numpy as np
+
+from ..io.events import EventLog, Manifest
+
+__all__ = ["TailBatch", "tail_binary_log"]
+
+
+class TailBatch(NamedTuple):
+    """One whole block from the log: events + its byte extent."""
+
+    events: EventLog
+    offset: int        # byte offset of the block's first byte
+    next_offset: int   # byte just past the block — a valid later start
+
+
+def _wait(poll: float, stop) -> bool:
+    """One poll round; True = the stop predicate asked us to return."""
+    if stop is not None and stop():
+        return True
+    time.sleep(poll)
+    return False
+
+
+def tail_binary_log(path: str, manifest: Manifest, *,
+                    follow: bool = False, poll: float = 0.5,
+                    stop=None, start_offset: int = 0):
+    """Yield :class:`TailBatch` per complete block of a ``.cdrsb`` log.
+
+    ``follow=False`` reads to the current end of file and returns,
+    raising the reader's canonical one-line errors on a torn tail (a
+    static file ending mid-block IS corruption).  ``follow=True`` keeps
+    polling every ``poll`` seconds for appended blocks, waiting out
+    missing files and torn tails, until ``stop()`` returns truthy.
+    ``start_offset`` resumes from a block boundary previously reported
+    via ``TailBatch.next_offset`` (0 = from the first block).
+    """
+    header = None
+    while header is None:
+        try:
+            header = EventLog._try_read_binary_header(path)
+        except FileNotFoundError:
+            if not follow:
+                raise
+            header = None
+        if header is None:
+            if not follow:
+                raise ValueError(
+                    f"truncated/corrupt header of {path!r}: file ends "
+                    f"inside the header/vocabulary tables")
+            if _wait(poll, stop):
+                return
+    file_clients, file_paths, first_block = header
+    plut, clut, clients = EventLog._binary_luts(file_clients, file_paths,
+                                                manifest)
+    pos = int(start_offset) if start_offset else first_block
+    if pos < first_block:
+        raise ValueError(
+            f"start_offset {pos} outside the block region of {path!r} "
+            f"(first block at byte {first_block})")
+
+    while True:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            # Deleted/rotated away mid-follow: wait for it to reappear.
+            if not follow:
+                raise FileNotFoundError(
+                    f"missing event log {path!r}: no such file") from None
+            if _wait(poll, stop):
+                return
+            continue
+        if size < pos:
+            # The log rotated under us (sink.iter_events semantics):
+            # drain the predecessor from our offset, then restart on the
+            # new file from ITS header.  Offsets yielded for the drained
+            # blocks refer to the rotated file — a resume cursor taken
+            # across a rotation is only valid against ``path + ".1"``.
+            prev = path + ".1"
+            if os.path.exists(prev) and os.path.getsize(prev) >= pos:
+                psize = os.path.getsize(prev)
+                with open(prev, "rb") as f:
+                    f.seek(pos)
+                    while pos < psize:
+                        blk = pos
+                        ts, pid, op, cid, pos = EventLog._read_block(
+                            f, pos, psize, prev, len(file_paths),
+                            len(file_clients))
+                        if ts is None:
+                            continue
+                        yield TailBatch(_remap(ts, pid, op, cid, plut,
+                                               clut, clients), blk, pos)
+            header = EventLog._try_read_binary_header(path)
+            if header is None:
+                if _wait(poll, stop):
+                    return
+                continue
+            file_clients, file_paths, first_block = header
+            plut, clut, clients = EventLog._binary_luts(
+                file_clients, file_paths, manifest)
+            pos = first_block
+            continue
+
+        progressed = False
+        with open(path, "rb") as f:
+            f.seek(pos)
+            while pos < size:
+                # Complete-block probe BEFORE parsing: a count field or
+                # column run past ``size`` is the writer's in-flight
+                # tail, not corruption — leave it unconsumed.
+                head = f.read(8)
+                if len(head) < 8:
+                    break
+                bn = int(np.frombuffer(head, dtype=np.int64)[0])
+                if bn < 0:
+                    raise ValueError(
+                        f"truncated/corrupt block at byte {pos} of "
+                        f"{path!r}")
+                need = 8 + bn * (8 + 4 + 1 + 4)
+                if pos + need > size:
+                    break  # torn tail — wait for the rest
+                f.seek(pos)
+                blk = pos
+                ts, pid, op, cid, pos = EventLog._read_block(
+                    f, pos, size, path, len(file_paths),
+                    len(file_clients))
+                progressed = True
+                if ts is None:
+                    continue  # legal empty block
+                yield TailBatch(_remap(ts, pid, op, cid, plut, clut,
+                                       clients), blk, pos)
+        if not follow:
+            if pos < size:
+                # Static file ending mid-block: the canonical error.
+                raise ValueError(
+                    f"truncated/corrupt block at byte {pos} of {path!r}")
+            return
+        if not progressed and _wait(poll, stop):
+            return
+        if progressed and stop is not None and stop():
+            return
+
+
+def _remap(ts, pid, op, cid, plut, clut, clients) -> EventLog:
+    """Raw block columns -> caller-manifest EventLog (reader contract)."""
+    if plut is not None:
+        pid = plut[pid]
+    return EventLog(ts=ts, path_id=pid, op=op, client_id=clut[cid],
+                    clients=list(clients))

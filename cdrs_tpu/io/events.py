@@ -94,16 +94,43 @@ class Manifest:
     def __len__(self) -> int:
         return len(self.paths)
 
+    #: Columns a manifest CSV cannot be read without.
+    _REQUIRED_COLUMNS = ("path", "creation_ts", "primary_node")
+
     @classmethod
     def read_csv(cls, path: str) -> "Manifest":
+        """Read metadata.csv; IO/shape failures raise ONE clean one-line
+        error naming the path (the `cdrs metrics` error contract): a
+        missing file stays FileNotFoundError, a header- or row-level
+        defect (no header, missing required columns, unparseable
+        timestamp/size) raises ValueError."""
         paths, creation, nodes_col, sizes, cats = [], [], [], [], []
-        with open(path, newline="") as f:
-            for row in csv.DictReader(f):
-                paths.append(row["path"])
-                creation.append(parse_iso_ts(row["creation_ts"]))
-                nodes_col.append(row["primary_node"])
-                sizes.append(int(row.get("size_bytes", 0) or 0))
-                cats.append(row.get("category", "moderate"))
+        try:
+            f = open(path, newline="")
+        except FileNotFoundError:
+            raise FileNotFoundError(
+                f"missing manifest {path!r}: no such file") from None
+        with f:
+            reader = csv.DictReader(f)
+            missing = [c for c in cls._REQUIRED_COLUMNS
+                       if c not in (reader.fieldnames or ())]
+            if missing:
+                raise ValueError(
+                    f"truncated/corrupt manifest {path!r}: "
+                    + ("no header row" if not reader.fieldnames
+                       else f"missing columns {missing}"))
+            try:
+                for row in reader:
+                    paths.append(row["path"])
+                    creation.append(parse_iso_ts(row["creation_ts"]))
+                    nodes_col.append(row["primary_node"])
+                    sizes.append(int(row.get("size_bytes", 0) or 0))
+                    cats.append(row.get("category") or "moderate")
+            except (KeyError, TypeError, ValueError, AttributeError) as e:
+                raise ValueError(
+                    f"truncated/corrupt manifest {path!r}: row "
+                    f"{reader.line_num} unreadable "
+                    f"({type(e).__name__}: {e})") from None
         node_vocab: dict[str, int] = {}
         node_ids = np.empty(len(nodes_col), dtype=np.int32)
         for i, nm in enumerate(nodes_col):
@@ -233,6 +260,9 @@ class EventLog:
         all); every contract above holds, with offsets at block
         boundaries.
         """
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"missing event log {path!r}: no such file")
         if is_binary_log(path):
             # Binary columnar log: same yield contract, no parsing at all
             # (``native`` is irrelevant — the columns are read directly).
@@ -434,6 +464,133 @@ class EventLog:
         return n
 
     @classmethod
+    def _binary_luts(cls, file_clients, file_paths, manifest: Manifest):
+        """Remap tables from a binary log's embedded vocabularies onto the
+        CALLER's manifest: ``(plut|None, clut, clients)``.  ``plut`` is
+        None when the file's path table IS the manifest's (identity — the
+        common same-population case); unknown clients extend the
+        vocabulary past ``manifest.nodes`` in file order."""
+        if file_paths == manifest.paths:
+            plut = None
+        else:
+            plut = np.asarray(
+                [manifest.path_to_id.get(p, -1) for p in file_paths],
+                dtype=np.int32)
+        clients = list(manifest.nodes)
+        cvocab = {nm: i for i, nm in enumerate(clients)}
+        clut = np.empty(len(file_clients), dtype=np.int32)
+        for i, nm in enumerate(file_clients):
+            if nm not in cvocab:
+                cvocab[nm] = len(clients)
+                clients.append(nm)
+            clut[i] = cvocab[nm]
+        return plut, clut, clients
+
+    @staticmethod
+    def _read_block(f, pos: int, size: int, path: str,
+                    n_paths: int, n_clients: int):
+        """Parse ONE block at byte ``pos`` (file cursor already there).
+
+        Returns ``(ts, pid, op, cid, next_pos)`` with RAW (pre-LUT) id
+        columns — ``ts`` is None for a legal empty block.  Raises the
+        canonical truncated/corrupt ValueError when the block's bytes
+        run past ``size`` or its ids fall outside the embedded tables.
+        Shared by ``read_binary_batches`` and the daemon tailer (which
+        treats the truncation case as "wait for more bytes" instead)."""
+        head = np.fromfile(f, dtype=np.int64, count=1)
+        bn = int(head[0]) if head.size == 1 else -1
+        need = 8 + bn * (8 + 4 + 1 + 4)
+        if bn < 0 or pos + need > size:
+            raise ValueError(
+                f"truncated/corrupt block at byte {pos} of {path!r}")
+        if bn == 0:
+            return None, None, None, None, pos + need
+        ts = np.fromfile(f, dtype=np.float64, count=bn)
+        pid = np.fromfile(f, dtype=np.int32, count=bn)
+        op = np.fromfile(f, dtype=np.int8, count=bn)
+        cid = np.fromfile(f, dtype=np.int32, count=bn)
+        # Range-check BEFORE the LUT remap: out-of-range ids would wrap
+        # via numpy negative indexing into silently wrong rows.
+        if pid.size and (int(pid.min()) < 0 or int(pid.max()) >= n_paths):
+            raise ValueError(
+                f"truncated/corrupt block at byte {pos} of {path!r}: "
+                f"path id outside [0, {n_paths})")
+        if cid.size and (int(cid.min()) < 0
+                         or int(cid.max()) >= n_clients):
+            raise ValueError(
+                f"truncated/corrupt block at byte {pos} of {path!r}: "
+                f"client id outside [0, {n_clients})")
+        return ts, pid, op, cid, pos + need
+
+    @classmethod
+    def _try_read_binary_header(cls, path: str):
+        """Defensive header probe: ``(clients, paths, first_block_offset)``
+        when the header + vocab tables are fully on disk, ``None`` when the
+        file is a valid PREFIX still being written (the daemon tailer's
+        wait-for-more signal), and a one-line ValueError naming the path
+        when the bytes present cannot be a binary event log header.
+
+        ``_read_binary_header`` trusts the file; this probe trusts nothing
+        — every length is checked before parsing, so a torn header never
+        surfaces as a numpy short-read artifact."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            raise FileNotFoundError(
+                f"missing event log {path!r}: no such file") from None
+        with open(path, "rb") as f:
+            head = f.read(len(BINARY_MAGIC) + 24)
+            if len(head) < len(BINARY_MAGIC):
+                if not BINARY_MAGIC.startswith(head):
+                    raise ValueError(
+                        f"truncated/corrupt header of {path!r}: bad magic")
+                return None
+            if head[:len(BINARY_MAGIC)] != BINARY_MAGIC:
+                raise ValueError(
+                    f"truncated/corrupt header of {path!r}: bad magic")
+            if len(head) < len(BINARY_MAGIC) + 24:
+                return None
+            n_clients, n_paths = (int(x) for x in np.frombuffer(
+                head[len(BINARY_MAGIC):len(BINARY_MAGIC) + 16],
+                dtype=np.int64))
+            if n_clients < 0 or n_paths < 0:
+                raise ValueError(
+                    f"truncated/corrupt header of {path!r}: negative "
+                    f"vocabulary size")
+
+            def table(n):
+                off_b = f.read(8 * (n + 1))
+                if len(off_b) < 8 * (n + 1):
+                    return None
+                off = np.frombuffer(off_b, dtype=np.int64)
+                if int(off[0]) != 0 or (np.diff(off) < 0).any():
+                    raise ValueError(
+                        f"truncated/corrupt header of {path!r}: "
+                        f"non-monotonic string-table offsets")
+                want = int(off[-1]) if n else 0
+                blob = f.read(want)
+                if len(blob) < want:
+                    return None
+                try:
+                    return [blob[off[i]:off[i + 1]].decode("utf-8")
+                            for i in range(n)]
+                except UnicodeDecodeError:
+                    raise ValueError(
+                        f"truncated/corrupt header of {path!r}: "
+                        f"undecodable string table") from None
+
+            clients = table(n_clients)
+            if clients is None:
+                return None
+            paths = table(n_paths)
+            if paths is None:
+                return None
+            first_block = f.tell()
+        if first_block > size:  # pragma: no cover - file shrank mid-probe
+            return None
+        return clients, paths, first_block
+
+    @classmethod
     def _read_binary_header(cls, f):
         """Parse header + vocab tables; returns (clients, paths,
         first_block_offset)."""
@@ -474,27 +631,17 @@ class EventLog:
         block into ONE EventLog (the ``read_csv_batches`` whole-file
         contract), yielded with offset None.
         """
+        probe = cls._try_read_binary_header(path)
+        if probe is None:
+            raise ValueError(
+                f"truncated/corrupt header of {path!r}: file ends inside "
+                f"the header/vocabulary tables")
+        file_clients, file_paths, first_block = probe
+        plut, clut, clients = cls._binary_luts(file_clients, file_paths,
+                                               manifest)
         size = os.path.getsize(path)
         with open(path, "rb") as f:
-            file_clients, file_paths, first_block = cls._read_binary_header(f)
-
-            # Path remap: identity when the file's table IS the manifest's
-            # (the common same-population case); else a dict-lookup lut.
-            if file_paths == manifest.paths:
-                plut = None
-            else:
-                plut = np.asarray(
-                    [manifest.path_to_id.get(p, -1) for p in file_paths],
-                    dtype=np.int32)
-            clients = list(manifest.nodes)
-            cvocab = {nm: i for i, nm in enumerate(clients)}
-            clut = np.empty(len(file_clients), dtype=np.int32)
-            for i, nm in enumerate(file_clients):
-                if nm not in cvocab:
-                    cvocab[nm] = len(clients)
-                    clients.append(nm)
-                clut[i] = cvocab[nm]
-
+            f.seek(first_block)
             pos = int(start_offset) if start_offset else first_block
             if pos < first_block or pos > size:
                 raise ValueError(
@@ -503,32 +650,11 @@ class EventLog:
             f.seek(pos)
             whole: list[EventLog] = []  # batch_size=None: accumulate blocks
             while pos < size:
-                blk = pos
-                head = np.fromfile(f, dtype=np.int64, count=1)
-                bn = int(head[0]) if head.size == 1 else -1
-                need = 8 + bn * (8 + 4 + 1 + 4)
-                if bn < 0 or pos + need > size:
-                    raise ValueError(
-                        f"truncated/corrupt block at byte {pos} of {path!r}")
-                pos += need
-                if bn == 0:
+                ts, pid, op, cid, pos = cls._read_block(
+                    f, pos, size, path, len(file_paths), len(file_clients))
+                if ts is None:
                     continue  # legal empty block (e.g. an empty final flush)
-                ts = np.fromfile(f, dtype=np.float64, count=bn)
-                pid = np.fromfile(f, dtype=np.int32, count=bn)
-                op = np.fromfile(f, dtype=np.int8, count=bn)
-                cid = np.fromfile(f, dtype=np.int32, count=bn)
-                # Range-check BEFORE the LUT remap: out-of-range ids would
-                # wrap via numpy negative indexing into silently wrong rows.
-                if pid.size and (int(pid.min()) < 0
-                                 or int(pid.max()) >= len(file_paths)):
-                    raise ValueError(
-                        f"truncated/corrupt block at byte {blk} of {path!r}: "
-                        f"path id outside [0, {len(file_paths)})")
-                if cid.size and (int(cid.min()) < 0
-                                 or int(cid.max()) >= len(file_clients)):
-                    raise ValueError(
-                        f"truncated/corrupt block at byte {blk} of {path!r}: "
-                        f"client id outside [0, {len(file_clients)})")
+                bn = len(ts)
                 if plut is not None:
                     pid = plut[pid]
                 cid = clut[cid]

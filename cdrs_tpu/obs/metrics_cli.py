@@ -522,16 +522,21 @@ def _tail_line(e: dict) -> str:
 # -- watch -------------------------------------------------------------------
 
 
-def watch(path: str, *, interval: float = 1.0, max_seconds: float | None =
-          None, once: bool = False, out=None) -> int:
+def watch(path: str, *, interval: float = 1.0, poll: float | None = None,
+          max_seconds: float | None = None, once: bool = False,
+          out=None) -> int:
     """Live terminal view of a growing stream.
 
     Tails ``path`` through ``obs.sink.iter_events`` and redraws a compact
     dashboard — windows processed, re-clusters, migrated bytes, last audit
     verdict, top counters, event rate — every ``interval`` seconds while
-    the producer (e.g. ``cdrs control --metrics``) appends.  ``once``
-    renders the current state a single time (no follow); ``max_seconds``
-    bounds a follow session (tests, CI).  Ctrl-C exits cleanly.
+    the producer (e.g. ``cdrs control --metrics``) appends.  ``poll``
+    sets the file-poll cadence separately from the redraw ``interval``
+    (default: same) — a sub-second poll against a live daemon keeps
+    tail latency low without redrawing the terminal at that rate.
+    ``once`` renders the current state a single time (no follow);
+    ``max_seconds`` bounds a follow session (tests, CI).  Ctrl-C exits
+    cleanly.
     """
     import time as _time
 
@@ -549,6 +554,8 @@ def watch(path: str, *, interval: float = 1.0, max_seconds: float | None =
     #: dashboard shows except all-time totals, which fall back to
     #: trailing-window totals.
     cap = 200_000
+    if poll is None:
+        poll = interval
     interactive = (not once) and getattr(out, "isatty", lambda: False)()
 
     def render():
@@ -594,16 +601,23 @@ def watch(path: str, *, interval: float = 1.0, max_seconds: float | None =
         else:
             print("\n".join(lines) + "\n", file=out, flush=True)
 
+    last_draw = -float("inf")
+
     def stop() -> bool:
-        nonlocal rendered_at
-        if len(events) != rendered_at:  # redraw only on new data
+        nonlocal rendered_at, last_draw
+        now = _time.monotonic()
+        # Redraw only on new data, at most once per ``interval`` — the
+        # file may be polled much faster (--poll) than the terminal
+        # should repaint.
+        if len(events) != rendered_at and now - last_draw >= interval:
             render()
             rendered_at = len(events)
+            last_draw = now
         return max_seconds is not None \
             and _time.monotonic() - t0 >= max_seconds
 
     try:
-        for e in iter_events(path, follow=not once, poll=interval,
+        for e in iter_events(path, follow=not once, poll=poll,
                              stop=stop):
             events.append(e)
             if len(events) > cap:
@@ -646,12 +660,16 @@ def _print_transition(t: dict, out) -> None:
 
 
 def alerts_cmd(path: str, *, rules=None, follow: bool = False,
-               interval: float = 1.0, max_seconds: float | None = None,
+               interval: float = 1.0, poll: float | None = None,
+               max_seconds: float | None = None,
                fail_firing: bool = False, out=None) -> int:
     """Evaluate alert rules over a stream: batch (transition timeline +
     final verdicts) or live follow (transitions print as they land,
-    staleness checked per poll).  ``--fail_firing`` turns a
-    still-firing end state into a nonzero exit — the CI/script gate."""
+    staleness checked per poll).  ``poll`` overrides the file-poll
+    cadence separately from ``interval`` (default: same) — paging on a
+    live daemon wants sub-second detection latency.  ``--fail_firing``
+    turns a still-firing end state into a nonzero exit — the CI/script
+    gate."""
     import time as _time
 
     from .alerts import AlertEngine
@@ -661,6 +679,8 @@ def alerts_cmd(path: str, *, rules=None, follow: bool = False,
     eng = AlertEngine(rules)
     if follow:
         t0 = _time.monotonic()
+        if poll is None:
+            poll = interval
 
         def stop() -> bool:
             for t in eng.check_staleness():
@@ -669,7 +689,7 @@ def alerts_cmd(path: str, *, rules=None, follow: bool = False,
                 and _time.monotonic() - t0 >= max_seconds
 
         try:
-            for e in iter_events(path, follow=True, poll=interval,
+            for e in iter_events(path, follow=True, poll=poll,
                                  stop=stop):
                 for t in eng.observe(e):
                     _print_transition(t, out)
@@ -747,6 +767,11 @@ def main(argv: list[str] | None = None) -> int:
                                      "producer's stream")
     p.add_argument("file")
     p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--poll", type=float, default=None, metavar="SECONDS",
+                   help="file-poll cadence, decoupled from the redraw "
+                        "--interval (default: same) — sub-second polls "
+                        "track a live daemon without repainting at "
+                        "that rate")
     p.add_argument("--max_seconds", type=float, default=None,
                    help="stop after this long (default: until Ctrl-C)")
     p.add_argument("--once", action="store_true",
@@ -764,6 +789,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="tail the stream live, printing transitions as "
                         "they land (staleness rules active)")
     p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--poll", type=float, default=None, metavar="SECONDS",
+                   help="file-poll cadence override (default: "
+                        "--interval) — sub-second detection latency "
+                        "against a live daemon")
     p.add_argument("--max_seconds", type=float, default=None,
                    help="bound a follow session (tests, CI)")
     p.add_argument("--fail_firing", action="store_true",
@@ -785,7 +814,7 @@ def main(argv: list[str] | None = None) -> int:
 
     args = parser.parse_args(argv)
     if args.action == "watch":
-        return watch(args.file, interval=args.interval,
+        return watch(args.file, interval=args.interval, poll=args.poll,
                      max_seconds=args.max_seconds, once=args.once)
     if args.action == "alerts":
         try:
@@ -794,7 +823,7 @@ def main(argv: list[str] | None = None) -> int:
             print(f"error: bad --rules: {e}", file=sys.stderr)
             return 2
         return alerts_cmd(args.file, rules=rules, follow=args.follow,
-                          interval=args.interval,
+                          interval=args.interval, poll=args.poll,
                           max_seconds=args.max_seconds,
                           fail_firing=args.fail_firing)
 

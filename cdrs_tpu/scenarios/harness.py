@@ -451,6 +451,76 @@ def _check_invariants(spec: ScenarioSpec, records: list[dict],
     return inv
 
 
+def _daemon_invariants(spec: ScenarioSpec, manifest: Manifest,
+                       schedule, events: EventLog,
+                       batch_records: list[dict]) -> dict:
+    """The streaming-daemon axis: the cell's whole event stream goes
+    through the always-on daemon (binary-log tail -> same window grid ->
+    same admission path), gated on
+
+    * ``daemon_engaged`` — at least two placement epochs actually
+      published (cold start + at least one live re-plan; a daemon that
+      never re-publishes slept through the cell's drift/fault axes),
+    * ``daemon_decisions_identical`` — the daemon's window records are
+      bit-identical to the windowed batch controller's (same plan
+      hashes, same budget charges, same durability tallies),
+    * ``daemon_epoch_pinned`` — the pinned epoch is frozen (arrays
+      non-writable), equal to the admitted plan, and resolves reads,
+    * ``daemon_resume_bit_identical`` — stop mid-run via the SIGTERM
+      flag path (``request_stop`` is exactly what the signal handler
+      raises), checkpoint, resume: the stitched record stream and the
+      final epoch must equal the uninterrupted daemon run's.
+    """
+    import os
+    import tempfile
+
+    from ..daemon import DaemonConfig, StreamDaemon
+
+    inv: dict[str, bool] = {}
+    with tempfile.TemporaryDirectory() as td:
+        log = os.path.join(td, "events.cdrsb")
+        events.write_binary(log, manifest)
+
+        full = StreamDaemon(_controller(spec, manifest, schedule))
+        dig = full.run(log)
+        inv["daemon_engaged"] = dig["epochs_published"] >= 2
+        inv["daemon_decisions_identical"] = \
+            _strip(full.records) == _strip(batch_records)
+
+        ep = full.publisher.pin()
+        ctl = full.controller
+        pinned = (ep is not None
+                  and not ep.rf.flags.writeable
+                  and not ep.category_idx.flags.writeable
+                  and np.array_equal(ep.rf, ctl.current_rf)
+                  and np.array_equal(ep.category_idx, ctl.current_cat))
+        if pinned:
+            pids = np.arange(min(64, len(manifest)))
+            rv = ep.read_view(pids)
+            pinned = (rv.replica_map.shape[0] == len(pids)
+                      and rv.replica_map.shape[1] >= 1)
+        inv["daemon_epoch_pinned"] = bool(pinned)
+
+        # Kill/resume across the daemon's one-file checkpoint: stop via
+        # the same flag the SIGTERM handler sets, after roughly half the
+        # windows, then resume and require the stitch to be exact.
+        ck = os.path.join(td, "daemon.npz")
+        stop_at = max(2, int(spec.n_windows) // 2)
+        a = StreamDaemon(_controller(spec, manifest, schedule),
+                         DaemonConfig(max_windows=stop_at))
+        a.run(log, checkpoint_path=ck)
+        b = StreamDaemon(_controller(spec, manifest, schedule))
+        b.run(log, checkpoint_path=ck)
+        bep = b.publisher.pin()
+        inv["daemon_resume_bit_identical"] = bool(
+            _strip(a.records) + _strip(b.records) == _strip(full.records)
+            and ep is not None and bep is not None
+            and bep.epoch_id == ep.epoch_id
+            and np.array_equal(bep.rf, ep.rf)
+            and np.array_equal(bep.category_idx, ep.category_idx))
+    return inv
+
+
 def repro_line(spec: ScenarioSpec, suite: str | None = None,
                suite_seed: int = 0) -> str:
     """One line that reruns exactly this cell.  The suite form carries
@@ -507,6 +577,10 @@ def run_cell(spec: ScenarioSpec, *, suite: str | None = None,
     inv = _check_invariants(spec, records, max_bytes, budget_slack,
                             multi_domain, has_corrupt, has_ec,
                             schedule=schedule, alerts_fired=alerts_fired)
+
+    if spec.daemon:
+        inv.update(_daemon_invariants(spec, manifest, schedule, events,
+                                      records))
 
     if spec.resume_window is not None:
         import os

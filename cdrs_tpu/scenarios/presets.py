@@ -250,6 +250,23 @@ def _presets() -> dict[str, ScenarioSpec]:
         resume_window=8,
         alerts=_alerts(["durability_degraded"]))
 
+    # -- streaming daemon --------------------------------------------------
+    # The always-on controller daemon (cdrs_tpu/daemon) over a seeded
+    # live feed: the cell's events land in a binary event log the daemon
+    # tails, with a mid-stream category flip (the drift axis) and one
+    # node killed under it (the fault axis).  Gated on the daemon
+    # invariants — >= 2 epochs published (daemon_engaged), decisions
+    # bit-identical to the windowed batch run, the pinned epoch frozen
+    # and read-resolving, and SIGTERM-flag stop/checkpoint/resume
+    # stitching bit-identical — on top of the usual zero-loss and
+    # budget-conservation gates.
+    p["daemon-stream"] = ScenarioSpec(
+        name="daemon-stream", n_files=300, seed=17, duration=1800.0,
+        n_windows=15, k=12, daemon=True,
+        drift={"kind": "flip", "at_frac": 0.5}, drift_threshold=0.02,
+        faults={"specs": ["crash:dn2@8"]},
+        alerts=_alerts(["durability_degraded"]))
+
     # -- workload curves / drift patterns ----------------------------------
     p["diurnal"] = ScenarioSpec(
         name="diurnal", n_files=300, seed=10, duration=1800.0,
@@ -345,7 +362,8 @@ SUITES: dict[str, tuple[tuple[str, ...], int]] = {
                   "flash-crowd", "slo-burn", "integrity-scrub",
                   "integrity-read", "diurnal", "adversarial-drift",
                   "gradual-drift", "scale-mesh", "scale-placement",
-                  "region-loss", "wan-partition", "black-friday"), 2),
+                  "region-loss", "wan-partition", "black-friday",
+                  "daemon-stream"), 2),
     # Everything, including the slow legacy-reproduction preset.
     "full": (tuple(PRESETS), 4),
 }

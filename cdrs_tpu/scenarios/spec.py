@@ -135,6 +135,14 @@ class ScenarioSpec:
     #: resume from the checkpoint, asserting the stitched record stream
     #: equals the uninterrupted run's.  None = not sampled for this cell.
     resume_window: int | None = None
+    #: Streaming-daemon axis: run the cell's event stream through the
+    #: always-on controller daemon (daemon/core.StreamDaemon) tailing a
+    #: binary event log, and gate the daemon invariants — decisions
+    #: bit-identical to the windowed batch run, >= 2 placement epochs
+    #: published (``daemon_engaged``), the pinned epoch frozen and equal
+    #: to the admitted plan, and SIGTERM-path stop/checkpoint/resume
+    #: stitching bit-identical to the uninterrupted daemon run.
+    daemon: bool = False
 
     def __post_init__(self):
         kind = (self.workload or {}).get("kind", "poisson")

@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
-from cdrs_tpu.io.events import EventLog, is_binary_log
+from cdrs_tpu.io.events import EventLog, Manifest, is_binary_log
 from cdrs_tpu.sim.access import simulate_access
 from cdrs_tpu.sim.generator import generate_population
 
@@ -300,3 +300,65 @@ def test_cli_simulate_binary_format(tmp_path, capsys):
     assert is_binary_log(str(out))  # --format auto picked binary by suffix
     ev = EventLog.read_csv(str(out), manifest)
     assert len(ev) > 0
+
+
+# -- clean one-line reader errors (daemon round: operator-facing shapes) ----
+
+def test_manifest_missing_truncated_corrupt_one_line_errors(tmp_path,
+                                                            workload):
+    """Each broken-manifest shape raises ONE clean error naming the path:
+    missing file, truncated (no header), corrupt (unreadable row)."""
+    manifest, _ = workload
+    missing = str(tmp_path / "ghost.csv")
+    with pytest.raises(FileNotFoundError, match="missing manifest") as ei:
+        Manifest.read_csv(missing)
+    assert "ghost.csv" in str(ei.value)
+
+    empty = tmp_path / "empty.csv"
+    empty.write_text("")
+    with pytest.raises(ValueError, match="no header row") as ei:
+        Manifest.read_csv(str(empty))
+    assert "empty.csv" in str(ei.value)
+
+    nocol = tmp_path / "nocol.csv"
+    nocol.write_text("path,creation_ts\n/a,1.0\n")
+    with pytest.raises(ValueError, match="missing columns"):
+        Manifest.read_csv(str(nocol))
+
+    good = tmp_path / "good.csv"
+    manifest.write_csv(str(good))
+    lines = good.read_text().splitlines()
+    lines[2] = lines[2].replace(lines[2].split(",")[1], "not-a-stamp", 1)
+    bad = tmp_path / "bad.csv"
+    bad.write_text("\n".join(lines) + "\n")
+    with pytest.raises(ValueError, match="truncated/corrupt manifest") as ei:
+        Manifest.read_csv(str(bad))
+    assert "row 3" in str(ei.value) and "bad.csv" in str(ei.value)
+
+
+def test_binary_header_shapes_one_line_errors(tmp_path, workload):
+    """Every torn/corrupt header shape names the path in one line: bad
+    magic, a cut inside the vocabulary tables, a missing file."""
+    manifest, events = workload
+    p = str(tmp_path / "h.cdrsb")
+    events.write_binary(p, manifest)
+    with open(p, "rb") as f:
+        blob = f.read()
+        f.seek(0)
+        _, _, first_block = EventLog._read_binary_header(f)
+
+    with pytest.raises(FileNotFoundError, match="missing event log"):
+        EventLog.read_csv(str(tmp_path / "none.cdrsb"), manifest)
+
+    wrong = tmp_path / "magic.cdrsb"
+    wrong.write_bytes(b"NOTMAGIC" + blob[8:])
+    with pytest.raises(ValueError, match="bad magic"):
+        list(EventLog.read_binary_batches(str(wrong), manifest))
+
+    for cut in (4, 20, first_block - 3):  # mid-magic, mid-head, mid-table
+        torn = tmp_path / "torn.cdrsb"
+        torn.write_bytes(blob[:cut])
+        with pytest.raises(ValueError,
+                           match="truncated/corrupt header") as ei:
+            list(EventLog.read_binary_batches(str(torn), manifest))
+        assert "torn.cdrsb" in str(ei.value)

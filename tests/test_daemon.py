@@ -1,0 +1,405 @@
+"""Streaming controller daemon (daemon/): tailer semantics, epoch-pinned
+serving, batch-loop decision identity, SIGTERM/checkpoint/resume
+bit-equality, and the decayed-fold/mini-batch property contracts."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.config import (
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from cdrs_tpu.control import ControllerConfig, ReplicationController
+from cdrs_tpu.daemon import (
+    DaemonConfig,
+    EpochPublisher,
+    PlacementEpoch,
+    StreamDaemon,
+    tail_binary_log,
+)
+from cdrs_tpu.io.events import EventLog, Manifest
+from cdrs_tpu.sim.access import simulate_access
+from cdrs_tpu.sim.generator import generate_population
+
+
+@pytest.fixture(scope="module")
+def workload():
+    manifest = generate_population(GeneratorConfig(n_files=150, seed=31))
+    events = simulate_access(manifest,
+                             SimulatorConfig(duration_seconds=600.0, seed=32))
+    return manifest, events
+
+
+def _cfg(**kw):
+    base = dict(window_seconds=120.0, backend="numpy",
+                kmeans=KMeansConfig(k=8, seed=42),
+                scoring=validated_scoring_config())
+    base.update(kw)
+    return ControllerConfig(**base)
+
+
+def _strip(records):
+    return [{k: v for k, v in r.items() if k != "seconds"} for r in records]
+
+
+def _slice_log(events, lo, hi):
+    return EventLog(ts=events.ts[lo:hi], path_id=events.path_id[lo:hi],
+                    op=events.op[lo:hi], client_id=events.client_id[lo:hi],
+                    clients=events.clients)
+
+
+# -- tailer -----------------------------------------------------------------
+
+def test_tailer_static_file_matches_batch_reader(tmp_path, workload):
+    manifest, events = workload
+    p = str(tmp_path / "t.cdrsb")
+    events.write_binary(p, manifest, block_rows=999)
+    got = list(tail_binary_log(p, manifest))
+    back = EventLog.concat([b.events for b in got])
+    np.testing.assert_array_equal(back.ts, events.ts)
+    np.testing.assert_array_equal(back.path_id, events.path_id)
+    np.testing.assert_array_equal(back.client_id, events.client_id)
+    # Offsets are strictly increasing block boundaries, each a valid
+    # resume point reproducing the exact remainder.
+    offs = [b.offset for b in got]
+    assert offs == sorted(set(offs))
+    mid = got[len(got) // 2]
+    resumed = list(tail_binary_log(p, manifest, start_offset=mid.offset))
+    tail = EventLog.concat([b.events for b in resumed])
+    done = sum(len(b.events) for b in got[:len(got) // 2])
+    np.testing.assert_array_equal(tail.ts, events.ts[done:])
+
+
+def test_tailer_missing_and_torn_errors(tmp_path, workload):
+    manifest, events = workload
+    missing = str(tmp_path / "nope.cdrsb")
+    with pytest.raises(FileNotFoundError, match="missing event log"):
+        list(tail_binary_log(missing, manifest))
+    # Non-follow over a file ending mid-block: the reader's canonical
+    # one-line error (a static torn tail IS corruption).
+    p = str(tmp_path / "torn.cdrsb")
+    events.write_binary(p, manifest, block_rows=997)
+    with open(p, "rb") as f:
+        blob = f.read()
+    with open(p, "wb") as f:
+        f.write(blob[:-37])
+    with pytest.raises(ValueError, match="truncated/corrupt block"):
+        list(tail_binary_log(p, manifest))
+    # A file ending inside the header is the header-shape error.
+    h = str(tmp_path / "head.cdrsb")
+    with open(h, "wb") as f:
+        f.write(blob[:40])
+    with pytest.raises(ValueError, match="truncated/corrupt header"):
+        list(tail_binary_log(h, manifest))
+
+
+def test_tailer_follow_waits_out_live_appends(tmp_path, workload):
+    """A writer appending whole blocks mid-follow: the tailer surfaces
+    each block once, never a torn prefix, and honors the stop predicate."""
+    manifest, events = workload
+    p = str(tmp_path / "live.cdrsb")
+    n = len(events)
+    cuts = [0, n // 3, 2 * n // 3, n]
+    _slice_log(events, cuts[0], cuts[1]).write_binary(p, manifest)
+
+    def writer():
+        for lo, hi in zip(cuts[1:-1], cuts[2:]):
+            time.sleep(0.15)
+            _slice_log(events, lo, hi).write_binary(p, manifest,
+                                                    append=True)
+
+    seen = 0
+    done = threading.Event()
+    t = threading.Thread(target=writer)
+    t.start()
+    got = []
+    for b in tail_binary_log(p, manifest, follow=True, poll=0.05,
+                             stop=done.is_set):
+        got.append(b.events)
+        seen += len(b.events)
+        if seen >= n:
+            done.set()
+    t.join()
+    back = EventLog.concat(got)
+    np.testing.assert_array_equal(back.ts, events.ts)
+
+
+# -- epochs -----------------------------------------------------------------
+
+def _epoch(i, n=16, resolver=None):
+    return PlacementEpoch(epoch_id=i, window=i - 1, plan_hash=f"h{i}",
+                          rf=np.full(n, i, dtype=np.int32),
+                          category_idx=np.full(n, i % 4, dtype=np.int32),
+                          n_nodes=3, resolver=resolver)
+
+
+def test_publisher_monotonic_and_frozen():
+    pub = EpochPublisher()
+    pub.publish(_epoch(1))
+    pub.publish(_epoch(2))
+    with pytest.raises(ValueError, match="epoch ids must grow"):
+        pub.publish(_epoch(2))
+    ep = pub.pin()
+    assert ep.epoch_id == 2 and pub.published_total == 2
+    with pytest.raises(ValueError):
+        ep.rf[0] = 99  # pinned plans are immutable snapshots
+
+
+def test_epoch_pinning_no_torn_reads_under_publication():
+    """Property: a reader pins ONCE per request batch; every value it
+    reads through that pin belongs to one epoch — never a mix — while a
+    publisher swaps epochs concurrently.  Each epoch is self-consistent
+    by construction (rf == epoch_id everywhere), so any mixed read
+    would show two different values inside one batch."""
+    pub = EpochPublisher()
+    pub.publish(_epoch(1))
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        rng = np.random.default_rng(0)
+        while not stop.is_set():
+            ep = pub.pin()  # pin once ...
+            vals = set()
+            for _ in range(8):  # ... hold for the whole request batch
+                idx = rng.integers(0, len(ep.rf), size=4)
+                vals.update(int(v) for v in ep.rf[idx])
+                vals.add(int(ep.epoch_id))
+                vals.add(int(ep.category_idx[int(idx[0])]) * 0
+                         + int(ep.rf[int(idx[1])]))
+            if len(vals) != 1:
+                torn.append(vals)
+                return
+
+    readers = [threading.Thread(target=reader) for _ in range(4)]
+    for r in readers:
+        r.start()
+    for i in range(2, 250):
+        pub.publish(_epoch(i))
+    stop.set()
+    for r in readers:
+        r.join()
+    assert not torn, f"mixed-epoch read observed: {torn[:3]}"
+    assert pub.pin().epoch_id == 249
+
+
+# -- daemon vs batch controller ---------------------------------------------
+
+def test_daemon_decisions_identical_to_batch_run(tmp_path, workload):
+    manifest, events = workload
+    batch = ReplicationController(manifest, _cfg()).run(events)
+    # In-memory feed.
+    mem = StreamDaemon(ReplicationController(manifest, _cfg()))
+    dig = mem.run(events)
+    assert _strip(mem.records) == _strip(batch.records)
+    assert dig["epochs_published"] == len(batch.records) >= 2
+    # Binary log through the tailer.
+    p = str(tmp_path / "ev.cdrsb")
+    events.write_binary(p, manifest, block_rows=1013)
+    d2 = StreamDaemon(ReplicationController(manifest, _cfg()))
+    d2.run(p)
+    assert _strip(d2.records) == _strip(batch.records)
+    # The served epoch is the final applied plan.
+    ep = mem.publisher.pin()
+    assert ep.plan_hash == mem.records[-1]["plan_hash"]
+    rv = ep.read_view(np.array([0, 5, 5, 1], dtype=np.int32))
+    assert rv.replica_map.shape[1] >= 1
+
+
+def test_daemon_epoch_rf_tracks_applied_plan(workload):
+    manifest, events = workload
+    d = StreamDaemon(ReplicationController(manifest, _cfg()))
+    d.run(events)
+    ep = d.publisher.pin()
+    np.testing.assert_array_equal(ep.rf, d.controller.current_rf)
+    np.testing.assert_array_equal(ep.category_idx, d.controller.current_cat)
+
+
+def test_daemon_rejects_csv_source(tmp_path, workload):
+    manifest, events = workload
+    p = str(tmp_path / "a.log")
+    events.write_csv(p, manifest)
+    d = StreamDaemon(ReplicationController(manifest, _cfg()))
+    with pytest.raises(ValueError, match="binary event log"):
+        d.run(p)
+
+
+# -- checkpoint / SIGTERM / resume ------------------------------------------
+
+def test_daemon_resume_bit_identical_mid_epoch(tmp_path, workload):
+    """Stop after 2 windows (mid-epoch-stream), resume: the two runs'
+    records concatenate to exactly the uninterrupted run's, epoch ids
+    stay continuous, and the resume reads only the unprocessed tail."""
+    manifest, events = workload
+    full = StreamDaemon(ReplicationController(manifest, _cfg()))
+    full.run(events)
+    p = str(tmp_path / "ev.cdrsb")
+    ck = str(tmp_path / "d.ckpt")
+    events.write_binary(p, manifest, block_rows=2048)
+
+    d1 = StreamDaemon(ReplicationController(manifest, _cfg()),
+                      DaemonConfig(max_windows=2))
+    dig1 = d1.run(p, checkpoint_path=ck)
+    assert dig1["stop_reason"] == "max_windows"
+    d2 = StreamDaemon(ReplicationController(manifest, _cfg()))
+    dig2 = d2.run(p, checkpoint_path=ck)
+    assert _strip(d1.records) + _strip(d2.records) == _strip(full.records)
+    assert dig2["epochs_published"] == len(full.records)
+    assert d2.events_ingested < len(events)  # O(new data), not O(history)
+    np.testing.assert_array_equal(d2.controller.current_rf,
+                                  full.controller.current_rf)
+    np.testing.assert_array_equal(d2.controller.current_cat,
+                                  full.controller.current_cat)
+
+
+def test_daemon_stop_mid_backlog_resumes_bit_identical(tmp_path, workload):
+    """A stop landing between windows (follow mode, unprocessed events
+    buffered past the cursor) must not fold the in-flight partial
+    window: resume re-reads it and the joined records stay exact."""
+    manifest, events = workload
+    full = StreamDaemon(ReplicationController(manifest, _cfg()))
+    full.run(events)
+    p = str(tmp_path / "ev.cdrsb")
+    ck = str(tmp_path / "d.ckpt")
+    events.write_binary(p, manifest, block_rows=512)
+
+    d1 = StreamDaemon(ReplicationController(manifest, _cfg()),
+                      DaemonConfig(follow=True, poll=0.05))
+    timer = threading.Timer(0.6, d1.request_stop, args=("SIGTERM",))
+    timer.start()
+    dig1 = d1.run(p, checkpoint_path=ck)
+    timer.cancel()
+    assert dig1["stop_reason"] == "SIGTERM"
+    d2 = StreamDaemon(ReplicationController(manifest, _cfg()))
+    d2.run(p, checkpoint_path=ck)
+    assert _strip(d1.records) + _strip(d2.records) == _strip(full.records)
+
+
+def test_daemon_checkpoint_carries_cursor_meta(tmp_path, workload):
+    manifest, events = workload
+    p = str(tmp_path / "ev.cdrsb")
+    ck = str(tmp_path / "d.ckpt")
+    events.write_binary(p, manifest)
+    d = StreamDaemon(ReplicationController(manifest, _cfg()),
+                     DaemonConfig(max_windows=1))
+    dig = d.run(p, checkpoint_path=ck)
+    ctl = ReplicationController(manifest, _cfg())
+    ctl.load_checkpoint(ck)
+    meta = ctl.last_checkpoint_meta["daemon"]
+    assert meta["offset"] == dig["cursor"]["offset"]
+    assert meta["skip"] == dig["cursor"]["skip"]
+    assert meta["epochs_published"] == dig["epochs_published"] == 1
+
+
+# -- satellite properties ---------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_decayed_fold_decay_one_bit_identical_to_batch(seed):
+    """The decayed live-statistics path at decay=1.0 is the batch fold,
+    bit for bit: same feature snapshots, same records, same plans.
+    (Window edges land on integer seconds, so no (file, second)
+    concurrency bucket ever straddles a window boundary.)"""
+    manifest = generate_population(GeneratorConfig(n_files=120,
+                                                   seed=100 + seed))
+    events = simulate_access(manifest,
+                             SimulatorConfig(duration_seconds=480.0,
+                                             seed=200 + seed))
+    a = ReplicationController(manifest, _cfg())
+    b = ReplicationController(manifest, _cfg())
+    # Force the decayed-accumulator path on b at g=1.0 (cfg.decay=1.0
+    # normally short-circuits to the cumulative fold).
+    b._dec = {k: np.zeros(len(manifest)) for k in
+              ("access_freq", "writes", "local_acc", "conc_max")}
+    b._dec_obs_end = None
+    ra = a.run(events)
+    rb = b.run(events)
+    assert _strip(ra.records) == _strip(rb.records)
+    np.testing.assert_array_equal(
+        a._feature_snapshot(), b._feature_snapshot())
+    np.testing.assert_array_equal(a.current_rf, b.current_rf)
+    np.testing.assert_array_equal(a.current_cat, b.current_cat)
+
+
+def test_minibatch_warm_start_inertia_within_band_of_full_lloyd():
+    """Warm-started mini-batch Lloyd (what daemon --recluster minibatch
+    advances per window) converges to an inertia within a pinned band of
+    the full-refit Lloyd optimum on the same data."""
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from cdrs_tpu.ops.kmeans_np import kmeans
+    from cdrs_tpu.ops.kmeans_stream import MiniBatchKMeans
+
+    rng = np.random.default_rng(7)
+    centers = rng.normal(size=(6, 5)) * 8.0
+    X = np.concatenate([rng.normal(loc=c, scale=0.4, size=(300, 5))
+                        for c in centers]).astype(np.float32)
+
+    def inertia(C):
+        d = X[:, None, :] - C[None, :, :]
+        return float(np.mean(np.min((d * d).sum(-1), axis=1)))
+
+    C_full, _ = kmeans(X.astype(np.float64), 6, random_state=0)
+    full = inertia(C_full.astype(np.float32))
+
+    mb = MiniBatchKMeans(k=6, seed=0)
+    perm = np.random.default_rng(8).permutation(len(X))
+    for _ in range(3):  # a few warm passes, daemon-style
+        for lo in range(0, len(X), 256):
+            mb.partial_fit(X[perm[lo:lo + 256]])
+    warm = inertia(mb.centroids)
+    # Pinned band: warm mini-batch within 1.5x of the full refit (and
+    # both must actually separate the blobs, not merely not-crash).
+    assert warm <= full * 1.5 + 1e-6, (warm, full)
+    assert warm < float(np.var(X, axis=0).sum())
+
+
+# -- live feed with drift + alert surface -----------------------------------
+
+def test_daemon_follow_live_appends_with_alert_surface(tmp_path):
+    """End-to-end live run: a writer appends the log while the daemon
+    follows; >= 2 epochs publish, no events are lost, and the digest is
+    the same as a batch daemon over the final log."""
+    manifest = generate_population(GeneratorConfig(n_files=100, seed=41))
+    events = simulate_access(manifest,
+                             SimulatorConfig(duration_seconds=480.0,
+                                             seed=42))
+    p = str(tmp_path / "live.cdrsb")
+    n = len(events)
+    cuts = [0, n // 4, n // 2, 3 * n // 4, n]
+
+    def _part(i):
+        return EventLog(ts=events.ts[cuts[i]:cuts[i + 1]],
+                        path_id=events.path_id[cuts[i]:cuts[i + 1]],
+                        op=events.op[cuts[i]:cuts[i + 1]],
+                        client_id=events.client_id[cuts[i]:cuts[i + 1]],
+                        clients=events.clients)
+
+    _part(0).write_binary(p, manifest)
+    d = StreamDaemon(ReplicationController(manifest, _cfg()),
+                     DaemonConfig(follow=True, poll=0.05))
+
+    def writer():
+        for i in range(1, 4):
+            time.sleep(0.2)
+            _part(i).write_binary(p, manifest, append=True)
+        # Writer done: let the daemon drain, then stop it.
+        time.sleep(0.5)
+        d.request_stop("writer_done")
+
+    t = threading.Thread(target=writer)
+    t.start()
+    dig = d.run(p)
+    t.join()
+    ref = StreamDaemon(ReplicationController(manifest, _cfg()))
+    ref.run(events)
+    # The stop lands between windows; everything processed must match
+    # the batch prefix exactly, with >= 2 epochs live-published.
+    k = len(d.records)
+    assert k >= 2 and dig["epochs_published"] == k
+    assert _strip(d.records) == _strip(ref.records)[:k]
