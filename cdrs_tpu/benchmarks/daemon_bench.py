@@ -27,8 +27,13 @@ tiers (rf) — on three seeds.  The daemon's controller gets the decayed
 accumulator force-enabled (it is normally elided at decay = 1.0) so the
 claim is about the decayed code path, not about it being skipped.
 
+Decision latency runs WITH tracing on (obs/trace.py): the artifact's
+``stage_attribution`` columns are the critical-path shares and the
+``trace_reconciled`` criterion asserts the exact integer-ns segment
+telescoping on every traced decision.
+
 ``python -m cdrs_tpu.benchmarks.daemon_bench`` writes the artifact and
-appends round-16 rows to ``data/bench_history.jsonl``
+appends round-17 rows to ``data/bench_history.jsonl``
 (regress.append_history, deduped); ``--quick`` shrinks scales for the
 CI smoke step and never appends.
 """
@@ -84,15 +89,25 @@ def run_decision_latency(n_files: int = 20_000, n_windows: int = 20,
                          seed: int = 41) -> dict:
     """p99 window-close-to-admitted-decision latency through the full
     daemon path (binary-log tail -> carve -> fold -> decide -> epoch
-    publish), at the control-overhead scale."""
+    publish), at the control-overhead scale — WITH decision tracing on
+    (obs/trace.py rides the metrics sink), so the reported numbers carry
+    the tracing cost they claim to and each decision's critical path is
+    attributed per stage."""
     manifest, events = _population(n_files, n_windows * window_seconds,
                                    seed)
     with tempfile.TemporaryDirectory() as td:
         log = os.path.join(td, "events.cdrsb")
+        metrics = os.path.join(td, "metrics.jsonl")
         events.write_binary(log, manifest)
         daemon = StreamDaemon(_controller(manifest, window_seconds, k))
-        dig = daemon.run(log)
+        dig = daemon.run(log, metrics_path=metrics)
+        with open(metrics, encoding="utf-8") as f:
+            evs = [json.loads(line) for line in f]
     lat = np.asarray(daemon.decision_seconds, dtype=np.float64)
+    from ..obs.aggregate import collect, critical_path_digest
+
+    agg = collect(evs)
+    cp = critical_path_digest(agg["decisions"], agg["windows"]) or {}
     return {
         "n_files": n_files,
         "n_windows": int(dig["windows_processed"]),
@@ -102,6 +117,13 @@ def run_decision_latency(n_files: int = 20_000, n_windows: int = 20,
         "decision_p99_seconds": float(dig["decision_p99_seconds"]),
         "decision_max_seconds": round(float(lat.max()), 6),
         "sub_second_p99": bool(dig["decision_p99_seconds"] < 1.0),
+        "traced_decisions": int(dig["traced_decisions"]),
+        "trace_reconciled": bool(cp.get("reconciled", False)),
+        "stage_attribution": {
+            name: round(share, 4)
+            for name, share in (cp.get("stage_shares") or {}).items()},
+        "event_to_decision_p99_seconds": round(
+            float(cp.get("total_p99_seconds", 0.0)), 6),
     }
 
 
@@ -219,7 +241,7 @@ def run_decay_identity(n_files: int = 2_000, n_windows: int = 12,
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--out", default="data/daemon_bench.json")
-    p.add_argument("--round", type=int, default=16, dest="round_no",
+    p.add_argument("--round", type=int, default=17, dest="round_no",
                    help="PR-round stamp for the regress history")
     p.add_argument("--quick", action="store_true",
                    help="small sizes for smoke runs (CI); never appends "
@@ -249,6 +271,7 @@ def main(argv=None) -> int:
     }
     out["criteria"] = {
         "decision_p99_sub_second": latency["sub_second_p99"],
+        "trace_reconciled": latency["trace_reconciled"],
         "routed_1m_reads_per_sec_during_recluster":
             serve["sustained_1m_reads_per_sec"]
             and serve["reclustered_underneath"],

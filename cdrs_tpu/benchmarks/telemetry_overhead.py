@@ -1,0 +1,143 @@
+"""Telemetry + decision-tracing overhead of the streaming daemon.
+
+The repo's standing acceptance bound (ISSUE-2/3, re-checked every time
+the observe path grows): a fully instrumented run must stay within
+**1.05x** of the same run with telemetry off.  Round 17 adds per-decision
+causal tracing (obs/trace.py) to the daemon's metrics sink — a
+``decision_trace`` event per window, exemplar span trees for the N
+slowest decisions, first-pin recording on the publisher — so this bench
+re-measures the bound with ALL of that active.
+
+Methodology (the repo's standard noisy-host discipline, matching
+``data/telemetry_overhead_r15.json``): interleaved paired rounds — each
+round runs the SAME binary log through a plain daemon (no metrics sink,
+tracing off) and a traced daemon (metrics sink + tracing + audit path),
+alternating, so host noise lands on both sides equally.  Headline is
+the best-window ratio (min traced / min plain: the cleanest window each
+side got); the per-round paired ratios and every raw window are
+disclosed in the artifact.
+
+``python -m cdrs_tpu.benchmarks.telemetry_overhead`` writes
+``data/telemetry_overhead_r17.json``; ``--quick`` shrinks scales for CI
+smoke and writes wherever ``--out`` points.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+from ..config import GeneratorConfig, SimulatorConfig
+from ..sim.access import simulate_access
+from ..sim.generator import generate_population
+
+__all__ = ["run_overhead"]
+
+BUDGET = 1.05
+
+
+def _daemon(manifest, window_seconds: float, k: int):
+    from ..config import KMeansConfig, validated_scoring_config
+    from ..control import ControllerConfig, ReplicationController
+    from ..daemon import StreamDaemon
+
+    cfg = ControllerConfig(
+        window_seconds=window_seconds, default_rf=2,
+        kmeans=KMeansConfig(k=k, seed=42),
+        scoring=validated_scoring_config())
+    return StreamDaemon(ReplicationController(manifest, cfg))
+
+
+def run_overhead(n_files: int = 20_000, n_windows: int = 8,
+                 window_seconds: float = 60.0, k: int = 12,
+                 rounds: int = 9, seed: int = 51) -> dict:
+    """Paired plain-vs-traced daemon rounds over one shared binary log
+    (module docstring).  Returns the artifact's ``daemon`` block."""
+    manifest = generate_population(GeneratorConfig(
+        n_files=n_files, seed=seed,
+        nodes=("dn1", "dn2", "dn3", "dn4", "dn5")))
+    events = simulate_access(manifest, SimulatorConfig(
+        duration_seconds=n_windows * window_seconds, seed=seed + 1))
+
+    plain: list[float] = []
+    traced: list[float] = []
+    trace_events = 0
+    with tempfile.TemporaryDirectory() as td:
+        log = os.path.join(td, "events.cdrsb")
+        events.write_binary(log, manifest)
+        for r in range(rounds):
+            d = _daemon(manifest, window_seconds, k)
+            t0 = time.perf_counter()
+            d.run(log)
+            plain.append(time.perf_counter() - t0)
+
+            d = _daemon(manifest, window_seconds, k)
+            metrics = os.path.join(td, f"m{r}.jsonl")
+            t0 = time.perf_counter()
+            dig = d.run(log, metrics_path=metrics)
+            traced.append(time.perf_counter() - t0)
+            trace_events = int(dig["traced_decisions"])
+
+    ratios = sorted(t / p for t, p in zip(traced, plain))
+    return {
+        "n_files": n_files,
+        "windows_per_run": n_windows,
+        "plain_seconds": min(plain),
+        "traced_seconds": min(traced),
+        "plain_windows": plain,
+        "traced_windows": traced,
+        "paired_ratios": ratios,
+        "paired_ratio_median": ratios[len(ratios) // 2],
+        "overhead_ratio": min(traced) / min(plain),
+        "trace_events_per_run": trace_events,
+        "budget": BUDGET,
+        "within_budget": min(traced) / min(plain) <= BUDGET,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--out", default="data/telemetry_overhead_r17.json")
+    p.add_argument("--quick", action="store_true",
+                   help="small sizes for smoke runs (CI)")
+    args = p.parse_args(argv)
+
+    if args.quick:
+        block = run_overhead(n_files=2_000, n_windows=6, rounds=3)
+    else:
+        block = run_overhead()
+
+    out = {
+        "artifact": "telemetry_overhead_r17",
+        "note": ("ISSUE-2/3 <=5% acceptance bound re-checked with the "
+                 "round-17 decision-tracing surfaces active on the "
+                 "daemon path: a decision_trace event per processed "
+                 "window (exact integer-ns segment telescoping), "
+                 "tail-sampled exemplar span trees, first-pin recording "
+                 "on the epoch publisher, and the window/lineage/audit "
+                 "stream of round 15.  Trace ANALYSIS (cdrs trace, "
+                 "critical-path digests) is a consumer-side cost and "
+                 "never runs in the loop.  Interleaved paired rounds, "
+                 "best-window ratio (the repo's standard noisy-host "
+                 "methodology); every window disclosed."),
+        "daemon": block,
+    }
+    parent = os.path.dirname(args.out)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"out": args.out,
+                      "overhead_ratio": block["overhead_ratio"],
+                      "within_budget": block["within_budget"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -15,6 +15,7 @@ subcommand of ``python -m cdrs_tpu`` (or the ``cdrs`` console script):
             (new; cdrs_tpu/scenarios)
   bench     benchmark harness                          (new; BASELINE.md configs)
   metrics   inspect telemetry JSONL streams            (new; obs/metrics_cli.py)
+  trace     per-decision causal traces of the daemon   (new; obs/trace.py)
 
 ``--metrics out.jsonl`` on pipeline/cluster/stream/control/bench activates
 the unified telemetry layer (cdrs_tpu/obs): hierarchical stage spans,
@@ -1074,6 +1075,15 @@ def _cmd_metrics(args) -> int:
     return metrics_main(args.rest)
 
 
+def _cmd_trace(args) -> int:
+    """Per-decision causal traces of the streaming daemon
+    (obs/trace.py): list decisions slowest-first, render one decision's
+    span tree, export deterministic Chrome/Perfetto JSON."""
+    from .obs.trace import main as trace_main
+
+    return trace_main(args.rest)
+
+
 def _cmd_explain(args) -> int:
     """Decision provenance (obs/explain.py): reconstruct why a file
     lives where it does, why a category scored what it did, or what a
@@ -1583,6 +1593,14 @@ def main(argv: list[str] | None = None) -> int:
                         "alerts FILE [--follow] [--rules JSON] | "
                         "regress RUN.json [--report-only]")
     p.set_defaults(fn=_cmd_metrics)
+
+    p = sub.add_parser("trace", help="per-decision causal traces of the "
+                       "streaming daemon: list | show | export "
+                       "(Chrome/Perfetto trace_event JSON)")
+    p.add_argument("rest", nargs=argparse.REMAINDER,
+                   help="list FILE [--limit N] | show FILE WINDOW | "
+                        "export FILE [--out JSON] [--canonical]")
+    p.set_defaults(fn=_cmd_trace)
 
     p = sub.add_parser("explain", help="decision provenance: why a file "
                        "lives where it does (slot-by-slot chooser "

@@ -1394,6 +1394,20 @@ class ReplicationController:
                 tel.counter_inc(f"lineage.{name}.bytes", int(b))
         for stage, secs in seconds.items():
             tel.histogram(f"controller.{stage}.seconds", secs)
+        tid = getattr(self, "_trace_id", None)
+        if tid is not None:
+            # Decision tracing (obs/trace.py): the daemon set a trace
+            # context around this window, so each already-measured stage
+            # joins the live span stream as a retrospective child of the
+            # enclosing ``daemon.decision`` span.  Batch runs never set
+            # ``_trace_id`` — their telemetry output is unchanged.
+            parent = tel.current_span_id()
+            for stage, secs in seconds.items():
+                if stage == "total":
+                    continue
+                tel.emit_span(f"controller.{stage}", secs,
+                              parent=parent, trace=tid,
+                              window=rec["window"])
 
     def _degraded_recluster(self, warm: bool, X, init, err: Exception):
         """Degraded mode: the jax kernel path failed (device lost, OOM,

@@ -57,6 +57,8 @@ import numpy as np
 from ..control.windows import _slice, iter_windows
 from ..io.events import EventLog, is_binary_log
 from ..obs.alerts import SEVERE_ALERTS, AlertEngine, default_rules
+from ..obs.telemetry import HIST_RAW_CAP
+from ..obs.trace import build_span_tree, decision_trace_id
 from .epochs import EpochPublisher, PlacementEpoch
 from .tailer import tail_binary_log
 
@@ -88,6 +90,12 @@ class DaemonConfig:
     minibatch_rows: int = 2048
     #: Seed of the daemon's EpochMap hash placement.
     placement_seed: int = 0
+    #: Tail-sampled exemplars: the N slowest decisions seen so far keep
+    #: a FULL span tree embedded in their ``decision_trace`` event; the
+    #: rest keep stage sums only (the 1.05x telemetry-budget contract —
+    #: obs/trace.py).  0 disables exemplar trees; tracing itself rides
+    #: the metrics sink, not this knob.
+    trace_exemplars: int = 8
 
     def __post_init__(self):
         if self.recluster not in _RECLUSTER_MODES:
@@ -96,6 +104,10 @@ class DaemonConfig:
                 f"(want one of {_RECLUSTER_MODES})")
         if self.poll <= 0:
             raise ValueError(f"poll must be > 0, got {self.poll}")
+        if self.trace_exemplars < 0:
+            raise ValueError(
+                f"trace_exemplars must be >= 0, "
+                f"got {self.trace_exemplars}")
 
 
 @dataclass
@@ -142,6 +154,21 @@ class StreamDaemon:
         self._emap = None
         self._flat_topo = None
         self._mbk = None
+        # Bounded decision-latency reservoir (HIST_RAW_CAP decimation):
+        # sample i is kept iff i % stride == 0; the stride doubles each
+        # time the list fills, so ``decision_seconds`` stays a uniform
+        # subsample with stable memory in a true always-on run.
+        self._dec_seen = 0
+        self._dec_stride = 1
+        # Decision tracing (obs/trace.py; active iff a metrics sink is).
+        self.traced_decisions = 0
+        self._ingest_box: dict = {"ns": 0}
+        self._batch_cursor = (0, 0)   # (offset, skip) of the last mint
+        self._prev_end_ns = 0
+        self._exemplar_heap: list[int] = []
+        self._publish_info: dict[int, tuple[int, int, str]] = {}
+        self._pins_seen: set[int] = set()
+        self._last_epoch_id = 0
 
     # -- lifecycle ---------------------------------------------------------
     def request_stop(self, reason: str = "requested") -> None:
@@ -179,7 +206,8 @@ class StreamDaemon:
                 str(source), self.controller.manifest,
                 follow=self.cfg.follow, poll=self.cfg.poll,
                 stop=self._stop.is_set,
-                start_offset=int(self._cursor["offset"]))
+                start_offset=int(self._cursor["offset"]),
+                ingest_box=self._ingest_box)
             for ev, off, nxt in stream:
                 base = 0
                 if skip:
@@ -191,6 +219,9 @@ class StreamDaemon:
                     ev = _slice(ev, take, len(ev))
                     base = take
                 self._inflight.append(_Inflight(off, base, ev.ts))
+                # The per-batch trace mint: the tailer already stamped
+                # the ingest instant into ``_ingest_box`` at the read.
+                self._batch_cursor = (off, base)
                 self._tail = (nxt, 0)
                 self.events_ingested += len(ev)
                 yield ev
@@ -216,6 +247,10 @@ class StreamDaemon:
                     continue
                 ev = _slice(ev, take, n)
             self._inflight.append(_Inflight(0, gidx, ev.ts))
+            # Feed-path mint: no tailer to stamp the read, so the batch
+            # arrival IS the yield instant.
+            self._ingest_box["ns"] = time.perf_counter_ns()
+            self._batch_cursor = (0, gidx)
             gidx += len(ev)
             self._tail = (0, gidx)
             self.events_ingested += len(ev)
@@ -240,7 +275,8 @@ class StreamDaemon:
         self._cursor = {"offset": int(off), "skip": int(sk)}
 
     # -- per-window actions ------------------------------------------------
-    def _publish(self, w: int, rec: dict) -> PlacementEpoch:
+    def _publish(self, w: int, rec: dict,
+                 trace_id: str | None = None) -> PlacementEpoch:
         ctl = self.controller
         topo = None
         if getattr(ctl, "_cluster_state", None) is not None:
@@ -275,8 +311,21 @@ class StreamDaemon:
             epoch_id=self.publisher.published_total + 1,
             window=int(w), plan_hash=str(rec.get("plan_hash", "")),
             rf=rf, category_idx=cat, n_nodes=len(topo.nodes),
-            map_epoch_id=map_ep.epoch_id, resolver=resolver)
-        return self.publisher.publish(epoch)
+            map_epoch_id=map_ep.epoch_id, resolver=resolver,
+            trace_id=trace_id)
+        epoch = self.publisher.publish(epoch)
+        self._last_epoch_id = int(epoch.epoch_id)
+        if trace_id is not None:
+            # Publish instant + provenance, kept until the epoch's first
+            # serve-path pin closes the loop (``_drain_pins``).  Bounded:
+            # an epoch nobody ever pins is dropped once it falls 256
+            # publications behind.
+            self._publish_info[int(epoch.epoch_id)] = (
+                time.perf_counter_ns(), int(w), trace_id)
+            stale = epoch.epoch_id - 256
+            for eid in [e for e in self._publish_info if e < stale]:
+                del self._publish_info[eid]
+        return epoch
 
     def _observe_alerts(self, rec: dict, sink,
                         checkpoint_path: str | None) -> None:
@@ -316,6 +365,79 @@ class StreamDaemon:
             "inertia": inertia,
         }
 
+    def _record_decision(self, seconds: float) -> None:
+        """Bounded decision-latency reservoir: uniform 2:1 decimation
+        past ``HIST_RAW_CAP`` (the ``obs.telemetry.histogram``
+        contract), so a true always-on run keeps stable memory and
+        ``digest()``'s p50/p99 stay those of a uniform subsample."""
+        if self._dec_seen % self._dec_stride == 0:
+            lst = self.decision_seconds
+            lst.append(float(seconds))
+            if len(lst) >= HIST_RAW_CAP:
+                del lst[1::2]
+                self._dec_stride *= 2
+        self._dec_seen += 1
+
+    def _emit_decision_trace(self, sink, w: int, trace_id: str,
+                             rec: dict, epoch: PlacementEpoch,
+                             segments_ns: dict, total_ns: int,
+                             ref_ns: int, n_events: int) -> None:
+        """One compact ``decision_trace`` event per decision — segments
+        are integer-ns deltas of ONE clock, so their sum equals
+        ``total_ns`` bit-for-bit (the reconciliation every consumer
+        asserts).  The ``trace_exemplars`` slowest decisions seen so far
+        additionally embed the full span tree."""
+        ev = {
+            "kind": "decision_trace", "trace": trace_id,
+            "window": int(w), "total_ns": int(total_ns),
+            "segments_ns": {k: int(v) for k, v in segments_ns.items()},
+            "ref_ns": int(ref_ns), "n_events": int(n_events),
+            "epoch_id": int(epoch.epoch_id),
+            "map_epoch_id": int(epoch.map_epoch_id),
+            "plan_hash": epoch.plan_hash,
+            "batch": {"offset": int(self._batch_cursor[0]),
+                      "skip": int(self._batch_cursor[1])},
+        }
+        cap = int(self.cfg.trace_exemplars)
+        exemplar = False
+        if cap > 0:
+            import heapq
+
+            if len(self._exemplar_heap) < cap:
+                heapq.heappush(self._exemplar_heap, int(total_ns))
+                exemplar = True
+            elif int(total_ns) > self._exemplar_heap[0]:
+                heapq.heapreplace(self._exemplar_heap, int(total_ns))
+                exemplar = True
+        ev["exemplar"] = exemplar
+        if exemplar:
+            ev["spans"] = build_span_tree(ev, rec)
+        sink.emit(ev)
+        self.traced_decisions += 1
+
+    def _drain_pins(self, sink) -> None:
+        """Surface first serve-path pins as ``epoch_pin`` events closing
+        the publish->pin causal gap.  Entries for epochs older than the
+        latest publication can never be stamped again (``pin`` only sees
+        the current epoch), so they are pruned once emitted — bounded
+        state in an always-on run."""
+        fp = self.publisher.first_pins
+        for eid in sorted(fp):
+            if eid not in self._pins_seen:
+                self._pins_seen.add(eid)
+                ev = {"kind": "epoch_pin", "epoch_id": int(eid)}
+                info = self._publish_info.get(eid)
+                if info is not None:
+                    pub_ns, win, tid = info
+                    ev["window"] = win
+                    ev["trace"] = tid
+                    ev["publish_to_pin_ns"] = int(fp[eid] - pub_ns)
+                sink.emit(ev)
+            if eid < self._last_epoch_id:
+                fp.pop(eid, None)
+                self._pins_seen.discard(eid)
+                self._publish_info.pop(eid, None)
+
     def _save(self, path: str) -> None:
         self.controller.save_checkpoint(path, extra_meta={"daemon": {
             "offset": int(self._cursor["offset"]),
@@ -345,6 +467,7 @@ class StreamDaemon:
                 dmeta.get("epochs_published", 0))
         sink = None
         own_sink = False
+        tel = None
         if metrics_path:
             from ..obs import JsonlSink
             from ..obs import current as _obs_current
@@ -358,6 +481,14 @@ class StreamDaemon:
                 sink = JsonlSink(metrics_path,
                                  max_bytes=metrics_max_bytes)
                 own_sink = True
+                tel = None   # ambient instrument writes elsewhere
+        # Decision tracing rides the metrics sink: a sink means every
+        # decision gets a trace context and a ``decision_trace`` event;
+        # live ``daemon.decision``/``controller.*`` spans additionally
+        # flow when the ambient telemetry shares that sink.
+        trace_on = sink is not None
+        if trace_on:
+            self.publisher.record_pins = True
 
         deadline = (time.monotonic() + float(cfg.max_seconds)
                     if cfg.max_seconds is not None else None)
@@ -388,19 +519,58 @@ class StreamDaemon:
                         self._advance_cursor(w)
                         since_ckpt += 1
                     continue
-                t_dec = time.perf_counter()
-                rec = ctl.process_window(w, events)
+                # Segment clocks: consecutive ``perf_counter_ns`` reads
+                # of ONE clock, so the per-stage deltas telescope to the
+                # measured total EXACTLY (integer equality — the
+                # reconciliation obs/trace.py asserts).  ``ref`` is the
+                # decision's causal origin: the ingest instant of the
+                # closing batch, or the previous decision's end when the
+                # loop itself is the bottleneck (a backlog replay must
+                # not double-charge earlier decisions' service time to
+                # later windows' tails).
+                t_start = time.perf_counter_ns()
+                ref = max(self._ingest_box["ns"], self._prev_end_ns)
+                if ref == 0 or ref > t_start:
+                    ref = t_start
+                tid = decision_trace_id(w)
+                if tel is not None:
+                    ctl._trace_id = tid
+                    try:
+                        with tel.span("daemon.decision", trace=tid,
+                                      window=int(w)):
+                            rec = ctl.process_window(w, events)
+                    finally:
+                        ctl._trace_id = None
+                else:
+                    rec = ctl.process_window(w, events)
+                t1 = time.perf_counter_ns()
                 ctl.window_index = w + 1
                 ctl._last_window_events = len(events)
                 self.records.append(rec)
                 if sink is not None:
                     sink.emit({"kind": "window", **rec})
                 self._observe_alerts(rec, sink, checkpoint_path)
-                self._publish(w, rec)
+                t2 = time.perf_counter_ns()
+                epoch = self._publish(
+                    w, rec, trace_id=tid if trace_on else None)
+                t3 = time.perf_counter_ns()
+                t4 = t3
                 if cfg.recluster == "minibatch":
                     self._minibatch_step()
-                self.decision_seconds.append(
-                    time.perf_counter() - t_dec)
+                    t4 = time.perf_counter_ns()
+                segments = {"tail": t_start - ref,
+                            "decide": t1 - t_start,
+                            "observe": t2 - t1,
+                            "publish": t3 - t2}
+                if cfg.recluster == "minibatch":
+                    segments["minibatch"] = t4 - t3
+                self._record_decision((t4 - t_start) / 1e9)
+                if trace_on:
+                    self._emit_decision_trace(
+                        sink, w, tid, rec, epoch, segments,
+                        t4 - ref, ref, len(events))
+                    self._drain_pins(sink)
+                self._prev_end_ns = t4
                 self.windows_processed += 1
                 since_ckpt += 1
                 self._advance_cursor(w)
@@ -428,7 +598,9 @@ class StreamDaemon:
         """One JSON-able summary of the daemon's run (the CLI prints
         it; CI asserts on it)."""
         lat = np.asarray(self.decision_seconds, dtype=np.float64)
-        cur = self.publisher.pin()
+        # NOT ``pin()``: a digest is reporting, not serving — it must
+        # never register as an epoch's first serve-path pin.
+        cur = self.publisher._current
         out = {
             "windows_processed": int(self.windows_processed),
             "window_index": int(self.controller.window_index),
@@ -440,9 +612,13 @@ class StreamDaemon:
                                     if t.get("state") == "firing"}),
             "alert_checkpoints": int(self.alert_checkpoints),
             "checkpoints": int(self.checkpoint_count),
+            "decision_p50_seconds": (
+                None if lat.size == 0
+                else round(float(np.quantile(lat, 0.5)), 6)),
             "decision_p99_seconds": (
                 None if lat.size == 0
                 else round(float(np.quantile(lat, 0.99)), 6)),
+            "traced_decisions": int(self.traced_decisions),
             "stop_reason": self._stop_reason,
             "cursor": dict(self._cursor),
         }

@@ -15,6 +15,7 @@ tests/test_daemon.py under concurrent publication).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -44,6 +45,11 @@ class PlacementEpoch:
     n_nodes: int
     map_epoch_id: int = 0
     resolver: object | None = field(default=None, repr=False)
+    #: Trace id of the decision that published this epoch (obs/trace.py)
+    #: — the causal link from an ingested event batch through the
+    #: decision to the plan a reader pins.  None on epochs published
+    #: outside a traced daemon run (tests, ad-hoc publication).
+    trace_id: str | None = None
 
     def __post_init__(self):
         # An epoch is a snapshot, not a view: freeze the arrays so a
@@ -80,6 +86,16 @@ class EpochPublisher:
         #: Epochs ever published across the daemon's LIFETIME, including
         #: before a checkpoint/resume (restored from daemon meta).
         self.published_total = int(published_total)
+        #: Decision tracing: when on, ``pin`` records the FIRST pin of
+        #: each epoch (``perf_counter_ns``) so the publish-to-first-pin
+        #: latency joins the decision's trace.  Off by default — the
+        #: untraced pin path stays one attribute read.
+        self.record_pins = False
+        #: epoch_id -> perf_counter_ns of its first observed pin.  Two
+        #: racing request batches may both stamp "first" within
+        #: nanoseconds of each other; either value is the honest first
+        #: pin at trace resolution, so no lock is taken on the pin path.
+        self.first_pins: dict[int, int] = {}
 
     def publish(self, epoch: PlacementEpoch) -> PlacementEpoch:
         with self._lock:
@@ -94,5 +110,11 @@ class EpochPublisher:
 
     def pin(self) -> PlacementEpoch | None:
         """The current epoch, pinned: callers hold the returned object
-        for their WHOLE request batch and never re-read mid-batch."""
-        return self._current
+        for their WHOLE request batch and never re-read mid-batch.
+        With ``record_pins`` on, the first pin of each epoch is
+        timestamped (one dict probe per batch — never per read)."""
+        ep = self._current
+        if self.record_pins and ep is not None \
+                and ep.epoch_id not in self.first_pins:
+            self.first_pins[ep.epoch_id] = time.perf_counter_ns()
+        return ep

@@ -52,7 +52,8 @@ def _wait(poll: float, stop) -> bool:
 
 def tail_binary_log(path: str, manifest: Manifest, *,
                     follow: bool = False, poll: float = 0.5,
-                    stop=None, start_offset: int = 0):
+                    stop=None, start_offset: int = 0,
+                    ingest_box: dict | None = None):
     """Yield :class:`TailBatch` per complete block of a ``.cdrsb`` log.
 
     ``follow=False`` reads to the current end of file and returns,
@@ -62,6 +63,10 @@ def tail_binary_log(path: str, manifest: Manifest, *,
     missing files and torn tails, until ``stop()`` returns truthy.
     ``start_offset`` resumes from a block boundary previously reported
     via ``TailBatch.next_offset`` (0 = from the first block).
+    ``ingest_box``, when given, is stamped ``{"ns": perf_counter_ns}``
+    as each block is parsed — the decision tracer's ingest origin,
+    taken HERE (at the read, before any downstream slicing) so the
+    trace's ``tail`` segment starts where the data actually arrived.
     """
     header = None
     while header is None:
@@ -116,6 +121,8 @@ def tail_binary_log(path: str, manifest: Manifest, *,
                             len(file_clients))
                         if ts is None:
                             continue
+                        if ingest_box is not None:
+                            ingest_box["ns"] = time.perf_counter_ns()
                         yield TailBatch(_remap(ts, pid, op, cid, plut,
                                                clut, clients), blk, pos)
             header = EventLog._try_read_binary_header(path)
@@ -155,6 +162,8 @@ def tail_binary_log(path: str, manifest: Manifest, *,
                 progressed = True
                 if ts is None:
                     continue  # legal empty block
+                if ingest_box is not None:
+                    ingest_box["ns"] = time.perf_counter_ns()
                 yield TailBatch(_remap(ts, pid, op, cid, plut, clut,
                                        clients), blk, pos)
         if not follow:

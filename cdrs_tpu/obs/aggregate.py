@@ -17,7 +17,7 @@ __all__ = ["collect", "span_forest", "ordered_span_paths", "percentile",
            "bucket_percentile", "merge_hist_buckets", "dedup_windows",
            "final_counters", "roofline_rows", "fmt_bytes", "serve_digest",
            "storage_digest", "pacing_digest", "integrity_digest",
-           "cells_digest"]
+           "cells_digest", "critical_path_digest", "daemon_digest"]
 
 
 def fmt_bytes(b, sep: str = " ") -> str:
@@ -237,6 +237,8 @@ def collect(events: list[dict]) -> dict:
         "traces": traces,
         "windows": dedup_windows(events, "window"),
         "audits": dedup_windows(events, "audit"),
+        "decisions": dedup_windows(events, "decision_trace"),
+        "epoch_pins": _dedup_pins(events),
         "cells": list(cells.values()),
         "xla": [xla[k] for k in sorted(xla, key=lambda t: (str(t[0]),
                                                            str(t[1])))],
@@ -411,6 +413,112 @@ def pacing_digest(windows: list[dict]) -> dict | None:
         out["collective_bytes_per_iter"] = int(
             mesh[-1].get("collective_bytes_per_iter", 0))
     return out
+
+
+def _dedup_pins(events: list[dict]) -> list[dict]:
+    """``epoch_pin`` events, last-wins per epoch id (a crashed run's
+    replayed tail may repeat epoch ids — the window-dedup contract,
+    applied to the pin stream's natural key)."""
+    by_eid: dict = {}
+    for e in events:
+        if e.get("kind") == "epoch_pin":
+            by_eid[e.get("epoch_id")] = e
+    return [by_eid[k] for k in sorted(by_eid, key=lambda x: (x is None,
+                                                             x))]
+
+
+def critical_path_digest(decisions: list[dict],
+                         windows: list[dict] | None = None) -> dict | None:
+    """Critical-path latency attribution over ``decision_trace`` records
+    (obs/trace.py — a traced daemon run).  None when the stream has no
+    decisions, so untraced streams render unchanged everywhere.
+
+    Every decision's integer-ns segments MUST telescope to its measured
+    total (the emitter's one-clock contract); the digest re-checks that
+    here and reports any mismatch instead of silently renormalizing —
+    the same discipline as the PR-15 ``causes`` byte reconciliation.
+    Stage shares are time-weighted across all decisions, with the
+    ``decide`` segment expanded into the controller's per-stage seconds
+    when the window records are available to join."""
+    if not decisions:
+        return None
+    from .trace import SEGMENT_ORDER, STAGE_ORDER
+
+    mismatches = [d for d in decisions
+                  if sum(int(v) for v in
+                         (d.get("segments_ns") or {}).values())
+                  != int(d.get("total_ns", -1))]
+    totals = [int(d.get("total_ns", 0)) / 1e9 for d in decisions]
+    grand_ns = sum(int(d.get("total_ns", 0)) for d in decisions)
+    by_win = {w.get("window"): w for w in (windows or [])}
+    # Time-weighted attribution: coarse daemon segments, with ``decide``
+    # split by the joined window's controller stage seconds (scaled so
+    # the split still sums to the decide segment exactly in expectation;
+    # shares are reporting, the ns reconciliation above is the invariant).
+    acc: dict[str, float] = {}
+    for d in decisions:
+        segs = d.get("segments_ns") or {}
+        for name, ns in segs.items():
+            if name == "decide":
+                w = by_win.get(d.get("window"))
+                secs = (w or {}).get("seconds") \
+                    if isinstance((w or {}).get("seconds"), dict) else None
+                stage_sum = sum(float(secs[k]) for k in secs
+                                if k != "total") if secs else 0.0
+                if secs and stage_sum > 0:
+                    for k, v in secs.items():
+                        if k != "total":
+                            acc[k] = acc.get(k, 0.0) \
+                                + float(v) / stage_sum * int(ns)
+                    continue
+            acc[name] = acc.get(name, 0.0) + int(ns)
+    order = [s for s in SEGMENT_ORDER if s != "decide"] \
+        + list(STAGE_ORDER) + ["decide"]
+    known = [k for k in order if k in acc] \
+        + sorted(k for k in acc if k not in order)
+    shares = {k: acc[k] / grand_ns for k in known} if grand_ns else {}
+    exemplars = sorted(
+        (d for d in decisions if d.get("exemplar")),
+        key=lambda d: -int(d.get("total_ns", 0)))
+    return {
+        "decisions": len(decisions),
+        "reconciled": not mismatches,
+        "reconcile_mismatches": len(mismatches),
+        "total_p50_seconds": percentile(totals, 0.5),
+        "total_p99_seconds": percentile(totals, 0.99),
+        "stage_shares": shares,
+        "exemplars": [{"trace": d.get("trace"),
+                       "window": d.get("window"),
+                       "total_seconds": int(d.get("total_ns", 0)) / 1e9}
+                      for d in exemplars],
+    }
+
+
+def daemon_digest(decisions: list[dict],
+                  epoch_pins: list[dict] | None = None) -> dict | None:
+    """Streaming-daemon digest over the trace stream: publications,
+    serve-path pin coverage, and the event-to-decision latency tail.
+    None when the stream has no decisions (a batch run), so non-daemon
+    streams render unchanged everywhere.  ``epochs_published`` is the
+    max epoch id seen — the daemon-LIFETIME publication sequence, exact
+    across checkpoint/resume where counter sums double-count a crashed
+    tail."""
+    if not decisions:
+        return None
+    pins = epoch_pins or []
+    totals = [int(d.get("total_ns", 0)) / 1e9 for d in decisions]
+    p2p = [int(p["publish_to_pin_ns"]) / 1e9 for p in pins
+           if p.get("publish_to_pin_ns") is not None]
+    return {
+        "decisions": len(decisions),
+        "epochs_published": max(int(d.get("epoch_id", 0))
+                                for d in decisions),
+        "epochs_pinned": len(pins),
+        "event_to_decision_p50_seconds": percentile(totals, 0.5),
+        "event_to_decision_p99_seconds": percentile(totals, 0.99),
+        "publish_to_pin_p50_seconds": (percentile(p2p, 0.5)
+                                       if p2p else None),
+    }
 
 
 def roofline_rows(digest: dict, peak_flops: float | None = None,

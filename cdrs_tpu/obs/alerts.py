@@ -165,6 +165,17 @@ def default_rules() -> tuple[AlertRule, ...]:
           short_windows=1, long_windows=3, factor=2.0, severity="page"),
         R("slo_burn_slow", kind="burn_rate", field="slo_burn",
           short_windows=2, long_windows=6, factor=1.0),
+        # Stage-latency SLOs over the per-window ``seconds`` breakdown
+        # (the decision trace's critical-path stages — obs/trace.py): a
+        # sustained planning or whole-decision stall is a control-plane
+        # regression worth a ticket long before it pages anyone.
+        # Thresholds sit far above any healthy windowed run (ci-smoke
+        # cells decide in milliseconds) so they only engage on real
+        # stalls; the streak is the standard anti-flap guard.
+        R("stage_plan_latency", field="seconds.plan", value=2.0,
+          for_windows=3),
+        R("decision_latency", field="seconds.total", value=10.0,
+          for_windows=3),
         R("no_data", kind="absence", stale_seconds=600.0),
     )
 

@@ -38,6 +38,8 @@ import sys
 from .aggregate import (
     bucket_percentile,
     collect,
+    critical_path_digest,
+    daemon_digest,
     dedup_windows,
     final_counters,
     fmt_bytes,
@@ -304,6 +306,39 @@ def _render_audit(audits: list[dict], out) -> None:
         print("  no anomalies flagged", file=out)
 
 
+def _render_daemon(digest: dict, out) -> None:
+    """Streaming-daemon + critical-path digest lines (traced streams
+    only — obs/trace.py; untraced streams render unchanged)."""
+    dd = daemon_digest(digest.get("decisions") or [],
+                       digest.get("epoch_pins") or [])
+    if dd is None:
+        return
+    line = (f"\nDaemon: {dd['decisions']} traced decisions, "
+            f"{dd['epochs_published']} epochs published, "
+            f"{dd['epochs_pinned']} pinned; event-to-decision "
+            f"p50 {dd['event_to_decision_p50_seconds']:.4g}s / "
+            f"p99 {dd['event_to_decision_p99_seconds']:.4g}s")
+    if dd.get("publish_to_pin_p50_seconds") is not None:
+        line += (f"; publish-to-pin p50 "
+                 f"{dd['publish_to_pin_p50_seconds']:.4g}s")
+    print(line, file=out)
+    cp = critical_path_digest(digest.get("decisions") or [],
+                              digest.get("windows") or [])
+    if cp is None:
+        return
+    shares = " / ".join(f"{k} {v:.0%}"
+                        for k, v in cp["stage_shares"].items()
+                        if v >= 0.005)
+    recon = ("reconciled" if cp["reconciled"] else
+             f"RECONCILIATION BROKEN x{cp['reconcile_mismatches']}")
+    print(f"Critical path: decision p99 {cp['total_p99_seconds']:.4g}s "
+          f"= {shares} ({recon})", file=out)
+    if cp["exemplars"]:
+        ex = ", ".join(f"{e['trace']} {e['total_seconds']:.4g}s"
+                       for e in cp["exemplars"][:4])
+        print(f"  exemplars (full span trees kept): {ex}", file=out)
+
+
 def summarize_events(events: list[dict], out=None, peak_flops=None,
                      peak_gbps=None) -> None:
     out = out or sys.stdout
@@ -366,6 +401,7 @@ def summarize_events(events: list[dict], out=None, peak_flops=None,
     _render_alerts(digest["windows"], out)
     _render_cells(digest.get("cells") or [], out)
     _render_checkpoint(digest, out)
+    _render_daemon(digest, out)
     _render_serving(digest["windows"], out)
     _render_storage(digest["windows"], out)
     _render_durability(digest["windows"], out)
@@ -504,6 +540,17 @@ def _tail_line(e: dict) -> str:
     if kind == "lineage":
         return (f"lineage window={e.get('window')} cause={e.get('cause')} "
                 f"files={e.get('files')} bytes={e.get('bytes')}")
+    if kind == "decision_trace":
+        return (f"decision {e.get('trace')} window={e.get('window')} "
+                f"total={int(e.get('total_ns', 0)) / 1e9:.4g}s "
+                f"epoch={e.get('epoch_id')}"
+                + (" exemplar" if e.get("exemplar") else ""))
+    if kind == "epoch_pin":
+        p2p = e.get("publish_to_pin_ns")
+        return (f"epoch_pin epoch={e.get('epoch_id')} "
+                f"trace={e.get('trace')}"
+                + (f" publish_to_pin={p2p / 1e9:.4g}s"
+                   if p2p is not None else ""))
     if kind == "audit":
         sil = e.get("silhouette")
         sil = "" if sil is None else f" silhouette={sil:.3f}"

@@ -536,6 +536,52 @@ def _serve_section(digest: dict) -> str:
             "</tr>" + "".join(rows) + "</table>")
 
 
+def _critical_path_section(digest: dict) -> str:
+    """Decision critical-path attribution (``decision_trace`` records
+    from a traced daemon run — obs/trace.py): event-to-decision tail,
+    time-weighted stage shares, exemplar decisions.  Absent for
+    untraced streams — older reports render unchanged."""
+    from .aggregate import critical_path_digest, daemon_digest
+
+    cp = critical_path_digest(digest.get("decisions") or [],
+                              digest.get("windows") or [])
+    if cp is None:
+        return ""
+    dd = daemon_digest(digest.get("decisions") or [],
+                       digest.get("epoch_pins") or []) or {}
+    recon = ("reconciled" if cp["reconciled"]
+             else f"RECONCILIATION BROKEN ×{cp['reconcile_mismatches']}")
+    tiles = "".join(
+        f'<div class="tile"><div class="v">{v}</div>'
+        f'<div class="l">{_esc(label)}</div></div>'
+        for label, v in (
+            ("traced decisions", _fmt(cp["decisions"])),
+            ("epochs published", _fmt(dd.get("epochs_published"))),
+            ("epochs pinned", _fmt(dd.get("epochs_pinned"))),
+            ("decision p50", f"{cp['total_p50_seconds']:.4g}s"),
+            ("decision p99", f"{cp['total_p99_seconds']:.4g}s"),
+            ("segments", _esc(recon)),
+        ))
+    rows = "".join(
+        f"<tr><td><code>{_esc(k)}</code></td>"
+        f'<td class="num">{v:.1%}</td></tr>'
+        for k, v in cp["stage_shares"].items() if v >= 0.001)
+    ex = "".join(
+        f"<tr><td><code>{_esc(e['trace'])}</code></td>"
+        f'<td class="num">{_esc(e["window"])}</td>'
+        f'<td class="num">{e["total_seconds"]:.4g}s</td></tr>'
+        for e in cp["exemplars"][:8])
+    ex_tbl = ("<h3>Exemplars (full span trees kept)</h3>"
+              "<table><tr><th>trace</th><th class=num>window</th>"
+              "<th class=num>total</th></tr>" + ex + "</table>"
+              if ex else "")
+    return ("<h2>Decision critical path</h2>"
+            f'<div class="tiles">{tiles}</div>'
+            "<table><tr><th>stage</th><th class=num>share of "
+            "event-to-decision time</th></tr>" + rows + "</table>"
+            + ex_tbl)
+
+
 def _trace_section(digest: dict) -> str:
     traces = digest["traces"]
     if not traces:
@@ -584,6 +630,7 @@ def render_html(events: list[dict], title: str = "cdrs telemetry report"
         + _storage_section(digest)
         + _durability_section(digest)
         + _integrity_section(digest)
+        + _critical_path_section(digest)
         + _window_section(digest)
         + _trace_section(digest)
         + _gauge_section(digest)

@@ -280,6 +280,31 @@ class Telemetry:
     def span(self, name: str, **attrs) -> Span:
         return Span(self, name, attrs)
 
+    def emit_span(self, name: str, dur: float, *, parent: int | None
+                  = None, **attrs) -> int:
+        """Emit one ALREADY-MEASURED region as a span event (same schema
+        as :class:`Span`, same id allocator, same sink) and return its
+        id.  The decision tracer uses this to land retrospective spans —
+        a stage whose duration was measured elsewhere (the controller's
+        per-stage clock, the daemon's reconciled segments) still joins
+        the span forest under the caller's chosen parent (default: the
+        currently open span)."""
+        sid = self._next_id()
+        if parent is None:
+            parent = self.current_span_id()
+        event = {
+            "kind": "span",
+            "name": name,
+            "id": sid,
+            "parent": parent,
+            "t": time.time(),
+            "dur": float(dur),
+        }
+        if attrs:
+            event["attrs"] = attrs
+        self._emit(event)
+        return sid
+
     def counter_inc(self, name: str, delta: float = 1.0) -> float:
         with self._agg_lock:
             value = self.counters.get(name, 0.0) + float(delta)

@@ -1,0 +1,407 @@
+"""End-to-end decision tracing: the daemon's causal flight recorder.
+
+Aggregate histograms explain averages; only a per-decision causal trace
+explains a p99 (Sigelman et al., "Dapper", and Dean & Barroso, "The
+Tail at Scale" — PAPERS.md).  This module gives every streaming-daemon
+decision exactly that story:
+
+* A :class:`TraceContext` is minted for every ingested event batch at
+  the tailer boundary (``daemon/core.StreamDaemon._batches`` — the
+  ingest timestamp is taken by the tailer itself, as close to the read
+  as possible) and carried through the window carve into the decision.
+* Each processed window emits ONE compact ``decision_trace`` event into
+  the same JSONL sink as the window records: the trace id, the exact
+  per-stage segment durations, the published epoch, and the ingest
+  cursor — the stage sums every decision keeps.
+* **Reconciliation is exact by construction**: segments are integer
+  nanoseconds measured as consecutive deltas of ONE monotonic clock
+  (``time.perf_counter_ns``), so they telescope — their sum equals the
+  measured event-to-decision total bit-for-bit, the same discipline as
+  the PR-15 ``causes`` digest reconciling migrated bytes.  Consumers
+  (:func:`cdrs_tpu.obs.aggregate.critical_path_digest`, the scenario
+  harness, CI) *assert* it rather than trust it.
+* **Tail-sampled exemplars**: only the ``trace_exemplars`` slowest
+  decisions seen so far keep a FULL span tree (the coarse segments plus
+  the controller's per-stage breakdown, embedded in the event); the
+  rest keep the stage sums alone, so steady-state overhead stays inside
+  the repo's 1.05x telemetry budget (data/telemetry_overhead_r17.json).
+
+The ``cdrs trace`` CLI (:func:`main`) reads the stream back:
+``list`` tabulates decisions slowest-first, ``show`` renders one
+decision's span tree with the epoch/lineage it produced (composing
+with ``cdrs explain window``), and ``export`` emits deterministic
+Chrome/Perfetto ``trace_event`` JSON — ``--canonical`` zeroes the
+wall-clock fields so double runs are byte-identical (the CI check).
+
+Span/segment schema of one ``decision_trace`` event::
+
+    {"kind": "decision_trace", "trace": "d000007", "window": 7,
+     "total_ns": 41823992,
+     "segments_ns": {"tail": 92, "decide": 41_0.., "observe": ...,
+                     "publish": ...},          # sum == total_ns, exact
+     "ref_ns": <perf_counter_ns at segment origin>,
+     "n_events": 1204, "epoch_id": 8, "map_epoch_id": 8,
+     "plan_hash": "…", "batch": {"offset": 16384, "skip": 0},
+     "exemplar": true,                         # only the N slowest
+     "spans": [{"name": "decision", "parent": null, "dur_ns": …}, …]}
+
+``tail`` is the carve/queue wait the daemon itself can control: the
+delta from the later of (closing batch ingested, previous decision
+finished) to decision start — a backlog replay does not double-charge
+earlier decisions' service time to later windows.  The trace id is the
+window index (``d%06d``): deterministic, and a SIGTERM/checkpoint/
+resume stitch mints the SAME lineage for a re-decided window, so
+consumers dedup last-wins exactly like window records.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from dataclasses import dataclass
+
+__all__ = ["TraceContext", "mint_batch", "decision_trace_id",
+           "build_span_tree", "chrome_trace", "main"]
+
+#: Fixed segment order for rendering/export (deterministic output).
+SEGMENT_ORDER = ("tail", "decide", "observe", "publish", "minibatch")
+
+#: Controller stage order inside the ``decide`` segment (the
+#: ``rec["seconds"]`` keys, pipeline order; "total" is the phase sum and
+#: never a stage).
+STAGE_ORDER = ("fold", "hotspot", "drift", "recluster", "faults",
+               "repair", "rebalance", "scrub", "schedule", "serve",
+               "evaluate", "plan")
+
+
+@dataclass
+class TraceContext:
+    """Span context of one ingested event batch, minted at the tailer.
+
+    ``offset``/``skip`` name the batch's resumable cursor position (byte
+    offset of its block for binary logs, global event index for feeds);
+    ``ingest_ns`` is ``time.perf_counter_ns()`` taken when the batch was
+    read — the causal origin of every decision the batch closes."""
+
+    offset: int
+    skip: int
+    ingest_ns: int
+
+
+def mint_batch(offset: int, skip: int,
+               ingest_ns: int | None = None) -> TraceContext:
+    """Mint the per-batch context (``ingest_ns`` defaults to *now*; the
+    tailer passes its own stamp, taken before any slicing work)."""
+    return TraceContext(int(offset), int(skip),
+                        int(ingest_ns if ingest_ns is not None
+                            else time.perf_counter_ns()))
+
+
+def decision_trace_id(window: int) -> str:
+    """The decision's trace id.  Window indices identify decisions
+    one-to-one (the carver's grid), so the id is deterministic across
+    double runs AND across a checkpoint/resume stitch — a resumed
+    decision references the same trace lineage, never an orphan."""
+    return f"d{int(window):06d}"
+
+
+def build_span_tree(decision: dict, window_rec: dict | None = None
+                    ) -> list[dict]:
+    """The decision's full span tree as a flat parent-indexed list.
+
+    Row 0 is the root; each row is ``{"name", "parent": index|None,
+    "dur_ns": int}``.  Coarse segments come from the reconciled
+    ``segments_ns``; when the stream also carries the decision's window
+    record, its ``rec["seconds"]`` stage breakdown nests under the
+    ``decide`` segment (durations scaled to the decide segment so the
+    tree's levels each sum to their parent).  Exemplar events embed
+    exactly this tree at emit time; for the rest it is rebuilt here —
+    same shape, same math."""
+    if decision.get("spans"):
+        return list(decision["spans"])
+    segs = decision.get("segments_ns") or {}
+    rows = [{"name": "decision", "parent": None,
+             "dur_ns": int(decision.get("total_ns", 0))}]
+    decide_idx = None
+    for name in SEGMENT_ORDER:
+        if name not in segs:
+            continue
+        rows.append({"name": name, "parent": 0,
+                     "dur_ns": int(segs[name])})
+        if name == "decide":
+            decide_idx = len(rows) - 1
+    secs = (window_rec or {}).get("seconds")
+    if decide_idx is not None and isinstance(secs, dict):
+        stage_sum = sum(float(secs[k]) for k in STAGE_ORDER if k in secs)
+        decide_ns = int(segs.get("decide", 0))
+        if stage_sum > 0 and decide_ns > 0:
+            for k in STAGE_ORDER:
+                if k in secs:
+                    rows.append({
+                        "name": f"controller.{k}", "parent": decide_idx,
+                        "dur_ns": int(round(float(secs[k]) / stage_sum
+                                            * decide_ns))})
+    return rows
+
+
+# -- readers ------------------------------------------------------------------
+
+
+def _load_events(path: str):
+    from .sink import read_events
+
+    try:
+        events = read_events(path)
+    except OSError as e:
+        raise SystemExit(f"error: cannot read metrics stream {path!r}: "
+                         f"{e.strerror or e}")
+    if not events:
+        raise SystemExit(f"error: no telemetry events in {path!r} "
+                         f"(empty or not a metrics JSONL stream)")
+    return events
+
+
+def _decisions_and_windows(events):
+    from .aggregate import dedup_windows
+
+    decisions = dedup_windows(events, "decision_trace")
+    if not decisions:
+        raise SystemExit(
+            "error: stream carries no decision_trace events — produce "
+            "one with `cdrs daemon ... --metrics FILE` (tracing rides "
+            "the metrics sink)")
+    windows = {w.get("window"): w for w in dedup_windows(events)}
+    return decisions, windows
+
+
+def _fmt_ns(ns: int) -> str:
+    s = ns / 1e9
+    if s >= 1.0:
+        return f"{s:.3f}s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.2f}ms"
+    return f"{s * 1e6:.1f}us"
+
+
+def _reconcile(d: dict) -> bool:
+    segs = d.get("segments_ns") or {}
+    return sum(int(v) for v in segs.values()) == int(d.get("total_ns", -1))
+
+
+# -- subcommands --------------------------------------------------------------
+
+
+def list_decisions(events, out=None, limit: int | None = None) -> None:
+    """Slowest-first table of every traced decision (stage attribution
+    at a glance; the reconciliation column is asserted, not assumed)."""
+    out = out or sys.stdout
+    decisions, _ = _decisions_and_windows(events)
+    rows = sorted(decisions,
+                  key=lambda d: -int(d.get("total_ns", 0)))
+    if limit:
+        rows = rows[:limit]
+    print(f"{'trace':<10} {'window':>6} {'total':>10} {'top stage':>18} "
+          f"{'epoch':>6} {'ok':>3} {'ex':>3}", file=out)
+    for d in rows:
+        segs = d.get("segments_ns") or {}
+        top = max(segs, key=segs.get) if segs else "?"
+        print(f"{d.get('trace', '?'):<10} {d.get('window'):>6} "
+              f"{_fmt_ns(int(d.get('total_ns', 0))):>10} "
+              f"{top + ' ' + _fmt_ns(int(segs.get(top, 0))):>18} "
+              f"{d.get('epoch_id', '—'):>6} "
+              f"{'y' if _reconcile(d) else 'N':>3} "
+              f"{'*' if d.get('exemplar') else '':>3}", file=out)
+
+
+def show_decision(events, which: str | None = None, out=None) -> None:
+    """One decision's span tree, stage durations, and the epoch/lineage
+    ids it produced.  ``which`` is a window index or a trace id
+    (``d000007``); omitted, the SLOWEST decision is shown (the one
+    ``trace list`` ranks first).  Composes with ``cdrs explain
+    window``: the footer names the command that reconstructs the full
+    decision story."""
+    out = out or sys.stdout
+    decisions, windows = _decisions_and_windows(events)
+    if which is None:
+        slowest = max(decisions, key=lambda d: int(d.get("total_ns", 0)))
+        w = int(slowest.get("window", -1))
+    else:
+        key = which.lstrip("d").lstrip("0") or "0"
+        try:
+            w = int(key)
+        except ValueError:
+            raise SystemExit(f"error: {which!r} is not a window index "
+                             f"or trace id (want e.g. 7 or d000007)")
+    match = [d for d in decisions if int(d.get("window", -1)) == w]
+    if not match:
+        have = [int(d.get("window", -1)) for d in decisions]
+        raise SystemExit(f"error: no traced decision for window {w} "
+                         f"(stream has windows "
+                         f"{min(have)}..{max(have)})")
+    d = match[0]
+    rec = windows.get(w)
+    ok = _reconcile(d)
+    print(f"decision {d.get('trace')}  window {w}  "
+          f"total {_fmt_ns(int(d.get('total_ns', 0)))}  "
+          f"events {d.get('n_events')}  "
+          f"{'reconciled' if ok else 'RECONCILIATION BROKEN'}"
+          f"{'  [exemplar]' if d.get('exemplar') else ''}", file=out)
+    tree = build_span_tree(d, rec)
+    total = max(1, int(d.get("total_ns", 1)))
+    children: dict = {}
+    for i, row in enumerate(tree):
+        children.setdefault(row.get("parent"), []).append(i)
+
+    def render(idx: int, depth: int) -> None:
+        row = tree[idx]
+        dur = int(row.get("dur_ns", 0))
+        print(f"  {'  ' * depth}{row['name']:<{28 - 2 * depth}} "
+              f"{_fmt_ns(dur):>10}  {dur / total:>6.1%}", file=out)
+        for c in children.get(idx, ()):
+            render(c, depth + 1)
+
+    for root in children.get(None, ()):
+        render(root, 0)
+    if d.get("epoch_id") is not None:
+        print(f"  -> published epoch {d['epoch_id']} "
+              f"(map revision {d.get('map_epoch_id')}, "
+              f"plan {str(d.get('plan_hash', ''))[:16]})", file=out)
+    causes = (rec or {}).get("causes") or {}
+    for name in sorted(causes):
+        c = causes[name]
+        print(f"  -> lineage {name}: {c.get('files', 0)} files / "
+              f"{c.get('bytes', 0)} bytes", file=out)
+    batch = d.get("batch") or {}
+    if batch:
+        print(f"  ingested from cursor offset={batch.get('offset')} "
+              f"skip={batch.get('skip')}", file=out)
+    print(f"  (full story: cdrs explain window {w} --metrics <stream>)",
+          file=out)
+
+
+def chrome_trace(events, canonical: bool = False) -> dict:
+    """Deterministic Chrome/Perfetto ``trace_event`` JSON.
+
+    One complete (``ph: "X"``) event per decision and per stage, ordered
+    by (window, fixed stage order) with fixed pid/tid — the only run-
+    varying fields are the wall-clock ``ts``/``dur`` microseconds.
+    ``canonical=True`` zeroes those, making double runs byte-identical
+    (the CI byte-stability check runs ``cmp`` on two canonical
+    exports)."""
+    decisions, windows = _decisions_and_windows(events)
+    decisions = sorted(decisions, key=lambda d: int(d.get("window", 0)))
+    base = min((int(d.get("ref_ns", 0)) for d in decisions), default=0)
+    out = [{"ph": "M", "pid": 1, "tid": 1, "name": "process_name",
+            "args": {"name": "cdrs daemon"}}]
+    for d in decisions:
+        w = int(d.get("window", 0))
+        t0 = (int(d.get("ref_ns", 0)) - base) / 1e3
+        total = int(d.get("total_ns", 0)) / 1e3
+        args = {"trace": d.get("trace"), "window": w,
+                "n_events": d.get("n_events"),
+                "epoch_id": d.get("epoch_id"),
+                "reconciled": _reconcile(d)}
+        out.append({"ph": "X", "pid": 1, "tid": 1, "cat": "decision",
+                    "name": f"decision w{w}", "ts": t0, "dur": total,
+                    "args": args})
+        cursor = t0
+        segs = d.get("segments_ns") or {}
+        for name in SEGMENT_ORDER:
+            if name not in segs:
+                continue
+            dur = int(segs[name]) / 1e3
+            out.append({"ph": "X", "pid": 1, "tid": 1, "cat": "segment",
+                        "name": name, "ts": cursor, "dur": dur,
+                        "args": {"window": w}})
+            if name == "decide":
+                tree = build_span_tree(d, windows.get(w))
+                sub = cursor
+                for row in tree:
+                    if not str(row["name"]).startswith("controller."):
+                        continue
+                    sdur = int(row.get("dur_ns", 0)) / 1e3
+                    out.append({"ph": "X", "pid": 1, "tid": 1,
+                                "cat": "stage", "name": row["name"],
+                                "ts": sub, "dur": sdur,
+                                "args": {"window": w}})
+                    sub += sdur
+            cursor += dur
+    if canonical:
+        for ev in out:
+            if "ts" in ev:
+                ev["ts"] = 0.0
+                ev["dur"] = 0.0
+    return {"displayTimeUnit": "ms", "traceEvents": out}
+
+
+def export_trace(events, out_path: str | None, out=None,
+                 canonical: bool = False) -> None:
+    out = out or sys.stdout
+    doc = chrome_trace(events, canonical=canonical)
+    text = json.dumps(doc, sort_keys=True,
+                      separators=(",", ":")) + "\n"
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text)
+        print(f"wrote {len(doc['traceEvents'])} trace events to "
+              f"{out_path}", file=out)
+    else:
+        out.write(text)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cdrs trace",
+        description="per-decision causal traces of the streaming daemon "
+                    "(read back from the metrics JSONL stream)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("list", help="every traced decision, slowest "
+                                    "first, with stage attribution")
+    p.add_argument("file", help="metrics JSONL stream")
+    p.add_argument("--limit", type=int, default=None,
+                   help="show only the N slowest")
+
+    p = sub.add_parser("show", help="one decision's span tree, stage "
+                                    "durations and epoch/lineage ids")
+    p.add_argument("file", help="metrics JSONL stream")
+    p.add_argument("which", nargs="?", default=None,
+                   help="window index or trace id (d000007); default = "
+                        "the slowest decision")
+
+    p = sub.add_parser("export", help="Chrome/Perfetto trace_event JSON "
+                                      "(chrome://tracing, ui.perfetto."
+                                      "dev)")
+    p.add_argument("file", help="metrics JSONL stream")
+    p.add_argument("--out", default=None, help="output path (default "
+                                               "stdout)")
+    p.add_argument("--canonical", action="store_true",
+                   help="zero the wall-clock ts/dur fields: double runs "
+                        "become byte-identical (the CI stability check)")
+
+    args = parser.parse_args(argv)
+    events = _load_events(args.file)
+    try:
+        if args.cmd == "list":
+            list_decisions(events, limit=args.limit)
+        elif args.cmd == "show":
+            show_decision(events, args.which)
+        elif args.cmd == "export":
+            export_trace(events, args.out, canonical=args.canonical)
+    except BrokenPipeError:
+        # `cdrs trace ... | head` closing the pipe is a clean exit, not
+        # a traceback (the metrics_cli idiom).
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

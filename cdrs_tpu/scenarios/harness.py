@@ -469,8 +469,15 @@ def _daemon_invariants(spec: ScenarioSpec, manifest: Manifest,
     * ``daemon_resume_bit_identical`` — stop mid-run via the SIGTERM
       flag path (``request_stop`` is exactly what the signal handler
       raises), checkpoint, resume: the stitched record stream and the
-      final epoch must equal the uninterrupted daemon run's.
+      final epoch must equal the uninterrupted daemon run's,
+    * ``trace_engaged`` — the full run carried a metrics sink, so every
+      processed window must have emitted exactly one ``decision_trace``
+      event (obs/trace.py),
+    * ``trace_reconciled`` — every decision's integer-ns segments sum
+      to its measured total EXACTLY (the one-clock telescoping
+      contract; any mismatch is an emitter bug, not noise).
     """
+    import json as _json
     import os
     import tempfile
 
@@ -481,11 +488,25 @@ def _daemon_invariants(spec: ScenarioSpec, manifest: Manifest,
         log = os.path.join(td, "events.cdrsb")
         events.write_binary(log, manifest)
 
+        metrics = os.path.join(td, "daemon.jsonl")
         full = StreamDaemon(_controller(spec, manifest, schedule))
-        dig = full.run(log)
+        dig = full.run(log, metrics_path=metrics)
         inv["daemon_engaged"] = dig["epochs_published"] >= 2
         inv["daemon_decisions_identical"] = \
             _strip(full.records) == _strip(batch_records)
+
+        # Decision tracing rides the metrics sink (telemetry is
+        # observe-only, so the decision-identity gate above already ran
+        # WITH tracing engaged — the trace cannot have changed a plan).
+        with open(metrics) as f:
+            traces = [e for e in map(_json.loads, f)
+                      if e.get("kind") == "decision_trace"]
+        inv["trace_engaged"] = (
+            len(traces) == dig["windows_processed"]
+            and dig["windows_processed"] >= 2)
+        inv["trace_reconciled"] = bool(traces) and all(
+            sum(int(v) for v in t["segments_ns"].values())
+            == int(t["total_ns"]) for t in traces)
 
         ep = full.publisher.pin()
         ctl = full.controller

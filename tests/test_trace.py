@@ -1,0 +1,428 @@
+"""End-to-end decision tracing (obs/trace.py + daemon wiring): exact
+segment reconciliation, deterministic trace ids across double runs and
+SIGTERM/checkpoint/resume stitches, byte-stable canonical exports,
+tail-sampled exemplars, the bounded decision-latency reservoir, and the
+`cdrs trace` CLI surfaces."""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.config import (
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from cdrs_tpu.control import ControllerConfig, ReplicationController
+from cdrs_tpu.daemon import DaemonConfig, StreamDaemon
+from cdrs_tpu.obs import trace as trace_mod
+from cdrs_tpu.obs.aggregate import (
+    collect,
+    critical_path_digest,
+    daemon_digest,
+)
+from cdrs_tpu.obs.trace import (
+    build_span_tree,
+    chrome_trace,
+    decision_trace_id,
+    mint_batch,
+)
+from cdrs_tpu.sim.access import simulate_access
+from cdrs_tpu.sim.generator import generate_population
+
+
+@pytest.fixture(scope="module")
+def workload():
+    manifest = generate_population(GeneratorConfig(n_files=150, seed=31))
+    events = simulate_access(manifest,
+                             SimulatorConfig(duration_seconds=600.0, seed=32))
+    return manifest, events
+
+
+def _cfg(**kw):
+    base = dict(window_seconds=120.0, backend="numpy",
+                kmeans=KMeansConfig(k=8, seed=42),
+                scoring=validated_scoring_config())
+    base.update(kw)
+    return ControllerConfig(**base)
+
+
+def _run_traced(workload, tmp_path, name="m.jsonl", dcfg=None, **run_kw):
+    manifest, events = workload
+    ctl = ReplicationController(manifest, _cfg())
+    d = StreamDaemon(ctl, dcfg or DaemonConfig())
+    log = tmp_path / "events.cdrsb"
+    if not log.exists():
+        events.write_binary(str(log), manifest)
+    metrics = tmp_path / name
+    dig = d.run(str(log), metrics_path=str(metrics), **run_kw)
+    with open(metrics, encoding="utf-8") as f:
+        evs = [json.loads(line) for line in f]
+    return d, dig, evs, metrics
+
+
+def _decisions(evs):
+    return [e for e in evs if e.get("kind") == "decision_trace"]
+
+
+# -- context + ids ----------------------------------------------------------
+
+def test_trace_id_is_window_deterministic():
+    assert decision_trace_id(0) == "d000000"
+    assert decision_trace_id(7) == "d000007"
+    assert decision_trace_id(123456) == "d123456"
+
+
+def test_mint_batch_carries_cursor_and_stamp():
+    tc = mint_batch(4096, 17)
+    assert (tc.offset, tc.skip) == (4096, 17)
+    assert tc.ingest_ns > 0
+    assert mint_batch(0, 0, ingest_ns=42).ingest_ns == 42
+
+
+# -- emission + reconciliation ----------------------------------------------
+
+def test_every_decision_reconciles_exactly(workload, tmp_path):
+    d, dig, evs, _ = _run_traced(workload, tmp_path)
+    decisions = _decisions(evs)
+    assert len(decisions) == dig["windows_processed"] > 0
+    assert dig["traced_decisions"] == len(decisions)
+    for dec in decisions:
+        # Integer equality, not approximation: the segments are deltas
+        # of one perf_counter_ns clock and MUST telescope to the total.
+        assert sum(dec["segments_ns"].values()) == dec["total_ns"]
+        assert set(dec["segments_ns"]) == {"tail", "decide", "observe",
+                                           "publish"}
+        assert dec["trace"] == decision_trace_id(dec["window"])
+        assert dec["epoch_id"] >= 1
+        assert dec["plan_hash"]
+
+
+def test_untraced_run_emits_no_trace_events(workload, tmp_path):
+    manifest, events = workload
+    ctl = ReplicationController(manifest, _cfg())
+    d = StreamDaemon(ctl)
+    log = tmp_path / "events.cdrsb"
+    events.write_binary(str(log), manifest)
+    dig = d.run(str(log))
+    assert dig["traced_decisions"] == 0
+    assert d.publisher.record_pins is False
+
+
+def test_published_epoch_carries_trace_id(workload, tmp_path):
+    d, _, evs, _ = _run_traced(workload, tmp_path)
+    ep = d.publisher.pin()
+    last = _decisions(evs)[-1]
+    assert ep.trace_id == last["trace"]
+    assert ep.epoch_id == last["epoch_id"]
+
+
+def test_pin_recording_and_digest_neutrality(workload, tmp_path):
+    d, _, _, _ = _run_traced(workload, tmp_path)
+    assert d.publisher.record_pins is True
+    before = dict(d.publisher.first_pins)
+    d.digest()   # reporting must never register a serve-path pin
+    assert d.publisher.first_pins == before
+    ep = d.publisher.pin()
+    assert ep.epoch_id in d.publisher.first_pins
+
+
+def test_exemplar_cap_limits_embedded_span_trees(workload, tmp_path):
+    d, _, evs, _ = _run_traced(
+        workload, tmp_path, dcfg=DaemonConfig(trace_exemplars=2))
+    decisions = _decisions(evs)
+    with_spans = [dec for dec in decisions if dec.get("spans")]
+    flagged = [dec for dec in decisions if dec.get("exemplar")]
+    assert with_spans == flagged
+    # The heap admits a decision when it beats the N-th slowest SO FAR,
+    # so >= cap events may carry trees; the final top-N is what an
+    # analyzer keeps.  At least the cap's worth must be present.
+    assert len(flagged) >= 2
+    tots = sorted((dec["total_ns"] for dec in decisions), reverse=True)
+    kept = {dec["total_ns"] for dec in flagged}
+    assert set(tots[:2]) <= kept
+
+
+def test_exemplars_disabled(workload, tmp_path):
+    _, _, evs, _ = _run_traced(
+        workload, tmp_path, dcfg=DaemonConfig(trace_exemplars=0))
+    assert all(not dec.get("exemplar") and "spans" not in dec
+               for dec in _decisions(evs))
+
+
+def test_trace_exemplars_validation():
+    with pytest.raises(ValueError, match="trace_exemplars"):
+        DaemonConfig(trace_exemplars=-1)
+
+
+# -- span trees --------------------------------------------------------------
+
+def test_build_span_tree_nests_and_sums(workload, tmp_path):
+    _, _, evs, _ = _run_traced(workload, tmp_path)
+    windows = {e["window"]: e for e in evs if e.get("kind") == "window"}
+    dec = _decisions(evs)[0]
+    tree = build_span_tree(dict(dec, spans=None), windows[dec["window"]])
+    assert tree[0]["name"] == "decision"
+    assert tree[0]["parent"] is None
+    assert tree[0]["dur_ns"] == dec["total_ns"]
+    seg_rows = [r for r in tree if r["parent"] == 0]
+    assert sum(r["dur_ns"] for r in seg_rows) == dec["total_ns"]
+    stages = [r for r in tree
+              if str(r["name"]).startswith("controller.")]
+    assert stages, "window record present -> decide must expand"
+    decide_idx = next(i for i, r in enumerate(tree)
+                      if r["name"] == "decide")
+    assert all(r["parent"] == decide_idx for r in stages)
+
+
+def test_embedded_exemplar_tree_matches_rebuild(workload, tmp_path):
+    _, _, evs, _ = _run_traced(workload, tmp_path)
+    windows = {e["window"]: e for e in evs if e.get("kind") == "window"}
+    dec = next(d for d in _decisions(evs) if d.get("exemplar"))
+    rebuilt = build_span_tree(dict(dec, spans=None),
+                              windows[dec["window"]])
+    assert dec["spans"] == rebuilt
+
+
+# -- determinism: double run + kill/resume ----------------------------------
+
+def test_canonical_export_byte_stable_across_double_run(workload,
+                                                        tmp_path):
+    _, _, evs1, _ = _run_traced(workload, tmp_path, name="m1.jsonl")
+    _, _, evs2, _ = _run_traced(workload, tmp_path, name="m2.jsonl")
+    t1 = json.dumps(chrome_trace(evs1, canonical=True), sort_keys=True)
+    t2 = json.dumps(chrome_trace(evs2, canonical=True), sort_keys=True)
+    assert t1 == t2
+    # And the wall-clock fields really were the only difference.
+    raw1 = chrome_trace(evs1)["traceEvents"]
+    raw2 = chrome_trace(evs2)["traceEvents"]
+    assert [(e.get("name"), e.get("cat")) for e in raw1] \
+        == [(e.get("name"), e.get("cat")) for e in raw2]
+
+
+def test_resume_keeps_trace_lineage(workload, tmp_path):
+    manifest, events = workload
+    log = tmp_path / "events.cdrsb"
+    events.write_binary(str(log), manifest)
+    metrics = tmp_path / "stitched.jsonl"
+    ck = tmp_path / "daemon.npz"
+
+    a = StreamDaemon(ReplicationController(manifest, _cfg()),
+                     DaemonConfig(max_windows=2))
+    a.run(str(log), metrics_path=str(metrics), checkpoint_path=str(ck))
+    b = StreamDaemon(ReplicationController(manifest, _cfg()))
+    b.run(str(log), metrics_path=str(metrics), checkpoint_path=str(ck))
+
+    full = StreamDaemon(ReplicationController(manifest, _cfg()))
+    fm = tmp_path / "full.jsonl"
+    dig = full.run(str(log), metrics_path=str(fm))
+
+    with open(metrics, encoding="utf-8") as f:
+        stitched = [json.loads(line) for line in f]
+    with open(fm, encoding="utf-8") as f:
+        uncut = [json.loads(line) for line in f]
+    s_dec = collect(stitched)["decisions"]
+    f_dec = collect(uncut)["decisions"]
+    # Same lineage: identical trace ids per window, full coverage, and
+    # every decision still reconciles — no orphan spans from the kill.
+    assert [d["trace"] for d in s_dec] == [d["trace"] for d in f_dec]
+    assert len(s_dec) == dig["windows_processed"]
+    assert all(sum(d["segments_ns"].values()) == d["total_ns"]
+               for d in s_dec)
+    # The stitched epoch ids continue the daemon-lifetime sequence.
+    assert [d["epoch_id"] for d in s_dec] \
+        == [d["epoch_id"] for d in f_dec]
+
+
+# -- reservoir ---------------------------------------------------------------
+
+def test_decision_reservoir_is_bounded():
+    from cdrs_tpu.obs.telemetry import HIST_RAW_CAP
+
+    d = StreamDaemon.__new__(StreamDaemon)
+    d.decision_seconds = []
+    d._dec_seen = 0
+    d._dec_stride = 1
+    n = HIST_RAW_CAP * 4 + 123
+    for i in range(n):
+        d._record_decision(float(i))
+    assert len(d.decision_seconds) < HIST_RAW_CAP
+    assert d._dec_seen == n
+    assert d._dec_stride > 1
+    # Uniform decimation: kept samples are every stride-th observation.
+    assert d.decision_seconds == [float(i) for i in range(n)
+                                  if i % d._dec_stride == 0]
+
+
+# -- aggregation -------------------------------------------------------------
+
+def test_critical_path_digest(workload, tmp_path):
+    _, _, evs, _ = _run_traced(workload, tmp_path)
+    agg = collect(evs)
+    cp = critical_path_digest(agg["decisions"], agg["windows"])
+    assert cp["reconciled"] and cp["reconcile_mismatches"] == 0
+    assert cp["decisions"] == len(agg["decisions"])
+    assert cp["total_p99_seconds"] >= cp["total_p50_seconds"] > 0
+    # decide expands into controller stages when windows join; shares
+    # are a partition of the total event-to-decision time.
+    assert "decide" not in cp["stage_shares"]
+    assert "fold" in cp["stage_shares"]
+    assert abs(sum(cp["stage_shares"].values()) - 1.0) < 1e-9
+    assert cp["exemplars"] and cp["exemplars"][0]["total_seconds"] \
+        == max(e["total_seconds"] for e in cp["exemplars"])
+
+
+def test_critical_path_digest_flags_mismatch():
+    bad = [{"kind": "decision_trace", "window": 0, "trace": "d000000",
+            "total_ns": 100, "segments_ns": {"tail": 10, "decide": 80},
+            "epoch_id": 1}]
+    cp = critical_path_digest(bad, [])
+    assert not cp["reconciled"]
+    assert cp["reconcile_mismatches"] == 1
+
+
+def test_daemon_digest(workload, tmp_path):
+    _, dig, evs, _ = _run_traced(workload, tmp_path)
+    agg = collect(evs)
+    dd = daemon_digest(agg["decisions"], agg["epoch_pins"])
+    assert dd["decisions"] == dig["windows_processed"]
+    assert dd["epochs_published"] == dig["epochs_published"]
+    assert dd["event_to_decision_p99_seconds"] \
+        >= dd["event_to_decision_p50_seconds"] > 0
+    assert critical_path_digest([], []) is None
+    assert daemon_digest([], []) is None
+
+
+def test_epoch_pin_events_join_publish_provenance(workload, tmp_path):
+    manifest, events = workload
+    ctl = ReplicationController(manifest, _cfg())
+    d = StreamDaemon(ctl)
+    log = tmp_path / "events.cdrsb"
+    events.write_binary(str(log), manifest)
+    metrics = tmp_path / "pins.jsonl"
+
+    # A serve-path reader pinning between decisions: observe the pin
+    # through the follow-mode alert hook by pinning inside the loop via
+    # record hook — simplest honest route: run once, pin, run a second
+    # daemon resuming the SAME publisher is not supported, so instead
+    # drive the loop manually with a feed and pin between windows.
+    batches = [events]
+    d.publisher.record_pins = True  # what a traced run() sets
+
+    orig_publish = d._publish
+    pinned = []
+
+    def publish_and_pin(w, rec, trace_id=None):
+        ep = orig_publish(w, rec, trace_id=trace_id)
+        pinned.append(d.publisher.pin().epoch_id)   # reader pins
+        return ep
+
+    d._publish = publish_and_pin
+    d.run(batches, metrics_path=str(metrics))
+    with open(metrics, encoding="utf-8") as f:
+        evs = [json.loads(line) for line in f]
+    pins = [e for e in evs if e.get("kind") == "epoch_pin"]
+    assert pins, "pinned epochs must surface as epoch_pin events"
+    for p in pins:
+        assert p["epoch_id"] in pinned
+        assert p["trace"] == decision_trace_id(p["window"])
+        assert p["publish_to_pin_ns"] >= 0
+    dd = daemon_digest(collect(evs)["decisions"],
+                       collect(evs)["epoch_pins"])
+    assert dd["epochs_pinned"] == len(pins)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_trace_cli_list_show_export(workload, tmp_path, capsys):
+    _, _, evs, metrics = _run_traced(workload, tmp_path)
+    assert trace_mod.main(["list", str(metrics)]) == 0
+    out = capsys.readouterr().out
+    assert "trace" in out and "d0000" in out
+
+    w = _decisions(evs)[0]["window"]
+    assert trace_mod.main(["show", str(metrics), str(w)]) == 0
+    out = capsys.readouterr().out
+    assert "reconciled" in out
+    assert "published epoch" in out
+    assert "cdrs explain window" in out
+    # trace-id addressing resolves to the same decision
+    assert trace_mod.main(
+        ["show", str(metrics), decision_trace_id(w)]) == 0
+
+    dst = tmp_path / "chrome.json"
+    assert trace_mod.main(["export", str(metrics),
+                           "--out", str(dst)]) == 0
+    doc = json.loads(dst.read_text())
+    assert doc["displayTimeUnit"] == "ms"
+    kinds = {e.get("cat") for e in doc["traceEvents"] if "cat" in e}
+    assert {"decision", "segment"} <= kinds
+
+
+def test_trace_cli_errors(tmp_path, workload):
+    with pytest.raises(SystemExit):
+        trace_mod.main(["list", str(tmp_path / "missing.jsonl")])
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    with pytest.raises(SystemExit):
+        trace_mod.main(["list", str(empty)])
+    # A stream with telemetry but no decisions names the daemon command.
+    nodec = tmp_path / "nodec.jsonl"
+    nodec.write_text('{"kind": "window", "window": 0}\n')
+    with pytest.raises(SystemExit, match="cdrs daemon"):
+        trace_mod.main(["list", str(nodec)])
+    _, _, _, metrics = _run_traced(workload, tmp_path)
+    with pytest.raises(SystemExit, match="no traced decision"):
+        trace_mod.main(["show", str(metrics), "999"])
+
+
+def test_cdrs_trace_subcommand_wired(workload, tmp_path, capsys):
+    from cdrs_tpu.cli import main as cli_main
+
+    _, _, _, metrics = _run_traced(workload, tmp_path)
+    assert cli_main(["trace", "list", str(metrics)]) == 0
+    assert "d0000" in capsys.readouterr().out
+
+
+def test_summarize_renders_daemon_and_critical_path(workload, tmp_path):
+    from cdrs_tpu.obs.metrics_cli import summarize_events
+
+    _, _, evs, _ = _run_traced(workload, tmp_path)
+    buf = io.StringIO()
+    summarize_events(evs, out=buf)
+    text = buf.getvalue()
+    assert "Daemon:" in text
+    assert "Critical path: decision p99" in text
+    assert "reconciled" in text
+
+
+def test_report_renders_critical_path_section(workload, tmp_path):
+    from cdrs_tpu.obs.report import render_html
+
+    _, _, evs, _ = _run_traced(workload, tmp_path)
+    html = render_html(evs)
+    assert "Decision critical path" in html
+    assert "traced decisions" in html
+
+
+def test_stage_latency_rules_present_and_silent_on_healthy_stream(
+        workload, tmp_path):
+    from cdrs_tpu.obs.alerts import (
+        DEFAULT_RULE_NAMES,
+        AlertEngine,
+        default_rules,
+    )
+
+    assert {"stage_plan_latency", "decision_latency"} \
+        <= set(DEFAULT_RULE_NAMES)
+    _, _, evs, _ = _run_traced(workload, tmp_path)
+    eng = AlertEngine(default_rules())
+    for e in evs:
+        if e.get("kind") == "window":
+            eng.observe(e)
+    fired = {r["name"] for r in eng.results() if r["fired"]}
+    assert not ({"stage_plan_latency", "decision_latency"} & fired)
