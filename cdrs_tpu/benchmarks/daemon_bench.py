@@ -30,10 +30,14 @@ claim is about the decayed code path, not about it being skipped.
 Decision latency runs WITH tracing on (obs/trace.py): the artifact's
 ``stage_attribution`` columns are the critical-path shares and the
 ``trace_reconciled`` criterion asserts the exact integer-ns segment
-telescoping on every traced decision.
+telescoping on every traced decision.  Round 18 additionally attaches
+the live operational plane (obs/httpz.py) with a scraper polling
+``/metrics`` + ``/statusz`` for the whole run — the reported latency
+numbers carry the endpoint cost they claim to, and the final scrape is
+format-linted (``live_endpoint`` block).
 
 ``python -m cdrs_tpu.benchmarks.daemon_bench`` writes the artifact and
-appends round-17 rows to ``data/bench_history.jsonl``
+appends round-18 rows to ``data/bench_history.jsonl``
 (regress.append_history, deduped); ``--quick`` shrinks scales for the
 CI smoke step and never appends.
 """
@@ -90,9 +94,15 @@ def run_decision_latency(n_files: int = 20_000, n_windows: int = 20,
     """p99 window-close-to-admitted-decision latency through the full
     daemon path (binary-log tail -> carve -> fold -> decide -> epoch
     publish), at the control-overhead scale — WITH decision tracing on
-    (obs/trace.py rides the metrics sink), so the reported numbers carry
-    the tracing cost they claim to and each decision's critical path is
-    attributed per stage."""
+    (obs/trace.py rides the metrics sink) AND the live operational
+    plane attached under an active scraper (obs/httpz.py), so the
+    reported numbers carry the full observability cost they claim to
+    and each decision's critical path is attributed per stage."""
+    import urllib.request
+
+    from ..obs import prom
+    from ..obs.httpz import ObsServer
+
     manifest, events = _population(n_files, n_windows * window_seconds,
                                    seed)
     with tempfile.TemporaryDirectory() as td:
@@ -100,7 +110,40 @@ def run_decision_latency(n_files: int = 20_000, n_windows: int = 20,
         metrics = os.path.join(td, "metrics.jsonl")
         events.write_binary(log, manifest)
         daemon = StreamDaemon(_controller(manifest, window_seconds, k))
-        dig = daemon.run(log, metrics_path=metrics)
+        with ObsServer() as srv:
+            daemon.attach_http(srv)
+            stop = threading.Event()
+            counter = {"n": 0}
+
+            def scrape():
+                while not stop.is_set():
+                    for path in ("/metrics", "/statusz"):
+                        try:
+                            with urllib.request.urlopen(
+                                    srv.url + path, timeout=2) as r:
+                                r.read()
+                            counter["n"] += 1
+                        except OSError:
+                            pass
+                    stop.wait(0.1)
+
+            th = threading.Thread(target=scrape, daemon=True)
+            th.start()
+            dig = daemon.run(log, metrics_path=metrics)
+            stop.set()
+            th.join(timeout=5.0)
+            with urllib.request.urlopen(srv.url + "/metrics",
+                                        timeout=5) as r:
+                final_scrape = r.read().decode("utf-8")
+            snap = srv.snapshot
+        live_endpoint = {
+            "scrapes": int(counter["n"]),
+            "snapshot_seq": int(snap.seq),
+            "snapshot_consistent": bool(
+                snap.seq == snap.windows_processed
+                == snap.epochs_published),
+            "metrics_lint_clean": prom.lint(final_scrape) == [],
+        }
         with open(metrics, encoding="utf-8") as f:
             evs = [json.loads(line) for line in f]
     lat = np.asarray(daemon.decision_seconds, dtype=np.float64)
@@ -124,6 +167,7 @@ def run_decision_latency(n_files: int = 20_000, n_windows: int = 20,
             for name, share in (cp.get("stage_shares") or {}).items()},
         "event_to_decision_p99_seconds": round(
             float(cp.get("total_p99_seconds", 0.0)), 6),
+        "live_endpoint": live_endpoint,
     }
 
 
@@ -241,7 +285,7 @@ def run_decay_identity(n_files: int = 2_000, n_windows: int = 12,
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--out", default="data/daemon_bench.json")
-    p.add_argument("--round", type=int, default=17, dest="round_no",
+    p.add_argument("--round", type=int, default=18, dest="round_no",
                    help="PR-round stamp for the regress history")
     p.add_argument("--quick", action="store_true",
                    help="small sizes for smoke runs (CI); never appends "
@@ -272,6 +316,10 @@ def main(argv=None) -> int:
     out["criteria"] = {
         "decision_p99_sub_second": latency["sub_second_p99"],
         "trace_reconciled": latency["trace_reconciled"],
+        "endpoint_scraped_during_run":
+            latency["live_endpoint"]["scrapes"] > 0
+            and latency["live_endpoint"]["snapshot_consistent"]
+            and latency["live_endpoint"]["metrics_lint_clean"],
         "routed_1m_reads_per_sec_during_recluster":
             serve["sustained_1m_reads_per_sec"]
             and serve["reclustered_underneath"],

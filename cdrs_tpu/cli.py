@@ -583,6 +583,23 @@ def _cmd_daemon(args) -> int:
         recluster=args.recluster, minibatch_rows=args.minibatch_rows))
     daemon.install_signal_handlers()
     with contextlib.ExitStack() as stack:
+        if args.http:
+            from .obs.httpz import ObsServer
+
+            host, _, port = args.http.rpartition(":")
+            try:
+                server = ObsServer(host or "127.0.0.1", int(port))
+            except (OSError, ValueError) as e:
+                print(f"error: cannot bind --http {args.http}: {e}",
+                      file=sys.stderr)
+                return 2
+            stack.callback(server.close)
+            server.start()
+            daemon.attach_http(server)
+            # The bound address, for port 0 (and for probes/scrapers to
+            # copy): the one operational line the daemon prints.
+            print(f"http: serving /metrics /healthz /readyz /statusz "
+                  f"/debug/trace on {server.url}", file=sys.stderr)
         _open_telemetry(args, stack, "daemon_cmd")
         with StageTimer("daemon_cmd") as t:
             digest = daemon.run(
@@ -1084,6 +1101,41 @@ def _cmd_trace(args) -> int:
     return trace_main(args.rest)
 
 
+def _cmd_status(args) -> int:
+    """One-shot consumer of a live daemon's operational plane
+    (obs/httpz.py): fetch /statusz (+probe verdicts) from a daemon
+    started with --http and render the compact status block."""
+    from .obs.metrics_cli import base_url, fetch_statusz, statusz_lines
+
+    base = base_url(args.url)
+    try:
+        doc = fetch_statusz(base)
+    except (OSError, ValueError) as e:
+        print(f"error: {base} unreachable: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(doc, sort_keys=True, indent=1))
+        return 0
+    for line in statusz_lines(base, doc):
+        print(line)
+    # Probe verdicts ride along: the two bits a balancer would read.
+    import urllib.error
+    import urllib.request
+
+    for probe in ("/readyz", "/healthz"):
+        try:
+            with urllib.request.urlopen(base + probe, timeout=5) as r:
+                body = r.read().decode("utf-8").strip()
+                code = r.status
+        except urllib.error.HTTPError as e:
+            body = e.read().decode("utf-8").strip()
+            code = e.code
+        except OSError as e:
+            body, code = str(e), None
+        print(f"{probe}:  {code} {body}")
+    return 0
+
+
 def _cmd_explain(args) -> int:
     """Decision provenance (obs/explain.py): reconstruct why a file
     lives where it does, why a category scored what it did, or what a
@@ -1345,6 +1397,12 @@ def main(argv: list[str] | None = None) -> int:
                    metavar="ROWS")
     p.add_argument("--digest_out", default=None, metavar="JSON",
                    help="additionally write the final digest here")
+    p.add_argument("--http", default=None, metavar="HOST:PORT",
+                   help="serve the live operational plane while running "
+                        "(obs/httpz.py): /metrics (Prometheus), "
+                        "/healthz, /readyz, /statusz, /debug/trace — "
+                        "off the decision path; port 0 binds an "
+                        "ephemeral port (printed to stderr)")
     p.set_defaults(fn=_cmd_daemon)
 
     p = sub.add_parser("chaos", help="fault-injected controller run: node "
@@ -1601,6 +1659,16 @@ def main(argv: list[str] | None = None) -> int:
                    help="list FILE [--limit N] | show FILE WINDOW | "
                         "export FILE [--out JSON] [--canonical]")
     p.set_defaults(fn=_cmd_trace)
+
+    p = sub.add_parser("status", help="one-shot status of a live daemon "
+                       "started with --http: /statusz digest plus the "
+                       "/readyz and /healthz probe verdicts")
+    p.add_argument("url", metavar="HOST:PORT|URL",
+                   help="the daemon's --http address (scheme optional)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw /statusz JSON instead of the "
+                        "human block")
+    p.set_defaults(fn=_cmd_status)
 
     p = sub.add_parser("explain", help="decision provenance: why a file "
                        "lives where it does (slot-by-slot chooser "

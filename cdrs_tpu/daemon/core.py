@@ -58,7 +58,7 @@ from ..control.windows import _slice, iter_windows
 from ..io.events import EventLog, is_binary_log
 from ..obs.alerts import SEVERE_ALERTS, AlertEngine, default_rules
 from ..obs.telemetry import HIST_RAW_CAP
-from ..obs.trace import build_span_tree, decision_trace_id
+from ..obs.trace import STAGE_ORDER, build_span_tree, decision_trace_id
 from .epochs import EpochPublisher, PlacementEpoch
 from .tailer import tail_binary_log
 
@@ -165,18 +165,45 @@ class StreamDaemon:
         self._ingest_box: dict = {"ns": 0}
         self._batch_cursor = (0, 0)   # (offset, skip) of the last mint
         self._prev_end_ns = 0
-        self._exemplar_heap: list[int] = []
+        # Exemplar retention: a min-heap of (total_ns, window, event) —
+        # the event dicts of the ``trace_exemplars`` slowest decisions
+        # stay resident so the live /debug/trace endpoint can serve them
+        # without re-reading the sink.  (total_ns, window) orders the
+        # heap; window indices are unique within a run, so the dict is
+        # never compared.
+        self._exemplar_heap: list[tuple[int, int, dict]] = []
         self._publish_info: dict[int, tuple[int, int, str]] = {}
         self._pins_seen: set[int] = set()
         self._last_epoch_id = 0
+        # Live operational plane (obs/httpz.py), attached via
+        # ``attach_http``: one immutable snapshot published per
+        # processed window + readiness/health bits.
+        self._obs = None
+        self._reclusters = 0
+        self._bytes_migrated = 0
+        self._stage_ns: dict[str, int] = {}
+        self._source_path: str | None = None
 
     # -- lifecycle ---------------------------------------------------------
+    def attach_http(self, server) -> None:
+        """Attach the live operational plane (obs/httpz.ObsServer):
+        the daemon publishes one immutable :class:`ObsSnapshot` per
+        processed window and drives the readiness/health bits.  Call
+        before :meth:`run`; the caller owns the server's lifecycle."""
+        self._obs = server
+        server.heartbeat()
+
     def request_stop(self, reason: str = "requested") -> None:
         """Ask the loop to stop after the window in flight (thread- and
         signal-safe)."""
         if self._stop_reason is None:
             self._stop_reason = reason
         self._stop.set()
+        obs = self._obs
+        if obs is not None:
+            # Drain begins NOW: readiness drops before the in-flight
+            # window finishes (attribute stores only — signal-safe).
+            obs.set_draining(True)
 
     def install_signal_handlers(self,
                                 signals=(signal.SIGTERM,
@@ -189,6 +216,16 @@ class StreamDaemon:
                     signal.Signals(signum).name))
 
     # -- ingest ------------------------------------------------------------
+    def _ingest_stop(self) -> bool:
+        """The tailer's stop probe doubles as the liveness heartbeat:
+        it runs at every poll/batch boundary — exactly when ingest is
+        making progress (or actively waiting on an empty log, which is
+        healthy idling, not a wedge)."""
+        obs = self._obs
+        if obs is not None:
+            obs.heartbeat()
+        return self._stop.is_set()
+
     def _batches(self, source, batch_size: int):
         """Normalize any source into EventLog batches WITH cursor
         bookkeeping: every yielded batch is registered in
@@ -205,7 +242,7 @@ class StreamDaemon:
             stream = tail_binary_log(
                 str(source), self.controller.manifest,
                 follow=self.cfg.follow, poll=self.cfg.poll,
-                stop=self._stop.is_set,
+                stop=self._ingest_stop,
                 start_offset=int(self._cursor["offset"]),
                 ingest_box=self._ingest_box)
             for ev, off, nxt in stream:
@@ -235,7 +272,7 @@ class StreamDaemon:
             else iter(source)
         gidx = 0
         for ev in feed:
-            if self._stop.is_set():
+            if self._ingest_stop():
                 return
             n = len(ev)
             if skip:
@@ -325,6 +362,10 @@ class StreamDaemon:
             stale = epoch.epoch_id - 256
             for eid in [e for e in self._publish_info if e < stale]:
                 del self._publish_info[eid]
+        if self._obs is not None and not self._stop.is_set():
+            # The epoch-pinned serving contract as a probe: an epoch
+            # exists to pin, so the daemon is ready for traffic.
+            self._obs.set_ready(True)
         return epoch
 
     def _observe_alerts(self, rec: dict, sink,
@@ -401,19 +442,23 @@ class StreamDaemon:
         cap = int(self.cfg.trace_exemplars)
         exemplar = False
         if cap > 0:
-            import heapq
-
             if len(self._exemplar_heap) < cap:
-                heapq.heappush(self._exemplar_heap, int(total_ns))
                 exemplar = True
-            elif int(total_ns) > self._exemplar_heap[0]:
-                heapq.heapreplace(self._exemplar_heap, int(total_ns))
+            elif (int(total_ns), int(w)) > self._exemplar_heap[0][:2]:
                 exemplar = True
         ev["exemplar"] = exemplar
         if exemplar:
+            import heapq
+
             ev["spans"] = build_span_tree(ev, rec)
-        sink.emit(ev)
-        self.traced_decisions += 1
+            item = (int(total_ns), int(w), ev)
+            if len(self._exemplar_heap) < cap:
+                heapq.heappush(self._exemplar_heap, item)
+            else:
+                heapq.heapreplace(self._exemplar_heap, item)
+        if sink is not None:
+            sink.emit(ev)
+            self.traced_decisions += 1
 
     def _drain_pins(self, sink) -> None:
         """Surface first serve-path pins as ``epoch_pin`` events closing
@@ -438,6 +483,92 @@ class StreamDaemon:
                 self._pins_seen.discard(eid)
                 self._publish_info.pop(eid, None)
 
+    def _publish_snapshot(self, w: int, rec: dict, segments_ns: dict,
+                          total_ns: int) -> None:
+        """Build ONE immutable ObsSnapshot and install it with a single
+        reference swap (obs/httpz.py snapshot-swap contract).  Runs
+        after the decision's segment clocks close — the endpoint is
+        strictly off the decision path; this method is the only
+        daemon->server data flow."""
+        from ..obs.httpz import ObsSnapshot
+
+        # Critical-path stage attribution, incrementally: the
+        # aggregate.critical_path_digest math — the decide segment
+        # expands into the controller's per-stage breakdown scaled to
+        # the segment's integer-ns span.
+        secs = rec.get("seconds") or {}
+        decide_ns = int(segments_ns.get("decide", 0))
+        stage_sum = sum(float(secs[k]) for k in STAGE_ORDER if k in secs)
+        for name, ns in segments_ns.items():
+            if name == "decide" and decide_ns > 0 and stage_sum > 0:
+                for k in STAGE_ORDER:
+                    if k in secs:
+                        self._stage_ns[k] = self._stage_ns.get(k, 0) \
+                            + int(round(float(secs[k]) / stage_sum
+                                        * decide_ns))
+                continue
+            self._stage_ns[name] = self._stage_ns.get(name, 0) + int(ns)
+        total_stage = sum(self._stage_ns.values()) or 1
+        order = ("tail",) + STAGE_ORDER + ("decide", "observe",
+                                           "publish", "minibatch")
+        stages = tuple(
+            (name, self._stage_ns[name] / 1e9,
+             self._stage_ns[name] / total_stage)
+            for name in order if name in self._stage_ns)
+        self._reclusters += 1 if rec.get("recluster") else 0
+        self._bytes_migrated += int(rec.get("bytes_migrated", 0) or 0)
+        backlog_bytes = 0
+        if self._source_path is not None:
+            try:
+                # Block-granular: bytes of log at/after the resume
+                # cursor — what a restart would re-read.
+                backlog_bytes = max(
+                    0, os.path.getsize(self._source_path)
+                    - int(self._cursor["offset"]))
+            except OSError:
+                pass
+        # Buffered-but-unprocessed events: inflight batches keep their
+        # FULL ts arrays (a batch can span many windows), so count only
+        # events past the just-closed window's end.
+        w_end = self.controller._t0 \
+            + (w + 1) * float(self.controller.cfg.window_seconds)
+        backlog_events = int(sum(
+            len(fl.ts) - int(np.searchsorted(fl.ts, w_end, side="left"))
+            for fl in self._inflight))
+        lat = self.decision_seconds
+        arr = np.asarray(lat, dtype=np.float64)
+        alerts = tuple(
+            {"name": r["name"], "severity": r["severity"],
+             "kind": r["kind"], "firing": r["firing"],
+             "fired": r["fired"], "since": r["since"],
+             "streak": r["streak"]}
+            for r in self.engine.results())
+        self._obs.publish(ObsSnapshot(
+            seq=int(self.windows_processed),
+            epoch_id=int(self._last_epoch_id) or None,
+            window=int(w),
+            windows_processed=int(self.windows_processed),
+            events_ingested=int(self.events_ingested),
+            epochs_published=int(self.publisher.published_total),
+            checkpoints_written=int(self.checkpoint_count),
+            reclusters=int(self._reclusters),
+            bytes_migrated=int(self._bytes_migrated),
+            traced_decisions=int(self.traced_decisions),
+            backlog_events=backlog_events,
+            backlog_bytes=int(backlog_bytes),
+            decision_seconds=tuple(lat),
+            decision_p50_seconds=(
+                None if arr.size == 0
+                else round(float(np.quantile(arr, 0.5)), 6)),
+            decision_p99_seconds=(
+                None if arr.size == 0
+                else round(float(np.quantile(arr, 0.99)), 6)),
+            stages=stages,
+            alerts=alerts,
+            exemplars=tuple(ev for _t, _w, ev in sorted(
+                self._exemplar_heap, key=lambda it: it[1])),
+        ))
+
     def _save(self, path: str) -> None:
         self.controller.save_checkpoint(path, extra_meta={"daemon": {
             "offset": int(self._cursor["offset"]),
@@ -456,6 +587,8 @@ class StreamDaemon:
         the digest (:meth:`digest`)."""
         ctl = self.controller
         cfg = self.cfg
+        if isinstance(source, (str, bytes, os.PathLike)):
+            self._source_path = os.fspath(source)  # backlog accounting
         if checkpoint_path:
             ctl._load_checkpoint_with_fallback(checkpoint_path)
             dmeta = (getattr(ctl, "last_checkpoint_meta", None)
@@ -565,15 +698,22 @@ class StreamDaemon:
                 if cfg.recluster == "minibatch":
                     segments["minibatch"] = t4 - t3
                 self._record_decision((t4 - t_start) / 1e9)
-                if trace_on:
+                if trace_on or self._obs is not None:
+                    # Exemplar retention also feeds the live
+                    # /debug/trace endpoint, so it runs whenever the
+                    # operational plane is attached — sink-less runs
+                    # build the events without emitting them.
                     self._emit_decision_trace(
-                        sink, w, tid, rec, epoch, segments,
-                        t4 - ref, ref, len(events))
+                        sink if trace_on else None, w, tid, rec, epoch,
+                        segments, t4 - ref, ref, len(events))
+                if trace_on:
                     self._drain_pins(sink)
                 self._prev_end_ns = t4
                 self.windows_processed += 1
                 since_ckpt += 1
                 self._advance_cursor(w)
+                if self._obs is not None:
+                    self._publish_snapshot(w, rec, segments, t4 - ref)
                 if checkpoint_path and since_ckpt >= every:
                     self._save(checkpoint_path)
                     since_ckpt = 0
@@ -589,6 +729,11 @@ class StreamDaemon:
         finally:
             if sink is not None and own_sink:
                 sink.close()
+            if self._obs is not None:
+                # The loop is over (drain, cap, or end of stream):
+                # whatever epoch is pinned stays served by its holders,
+                # but no new work should be routed here.
+                self._obs.set_ready(False)
         if checkpoint_path and since_ckpt:
             self._save(checkpoint_path)
         return self.digest()
@@ -600,7 +745,7 @@ class StreamDaemon:
         lat = np.asarray(self.decision_seconds, dtype=np.float64)
         # NOT ``pin()``: a digest is reporting, not serving — it must
         # never register as an epoch's first serve-path pin.
-        cur = self.publisher._current
+        cur = self.publisher.peek()
         out = {
             "windows_processed": int(self.windows_processed),
             "window_index": int(self.controller.window_index),

@@ -108,6 +108,12 @@ class EpochPublisher:
             self.published_total += 1
         return epoch
 
+    def peek(self) -> PlacementEpoch | None:
+        """The current epoch WITHOUT pin semantics: reporting surfaces
+        (``digest()``, the /statusz snapshot) read state but must never
+        register as an epoch's first serve-path pin."""
+        return self._current
+
     def pin(self) -> PlacementEpoch | None:
         """The current epoch, pinned: callers hold the returned object
         for their WHOLE request batch and never re-read mid-batch.

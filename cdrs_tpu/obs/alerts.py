@@ -355,7 +355,7 @@ class AlertEngine:
 
     def results(self) -> list[dict]:
         """Per-rule verdicts, rule order: ``{name, severity, kind,
-        firing, fired, since, transitions}``."""
+        firing, fired, since, streak, transitions}``."""
         out = []
         for rule in self.rules:
             st = self._st[rule.name]
@@ -366,6 +366,7 @@ class AlertEngine:
                 "firing": st.firing,
                 "fired": st.fired,
                 "since": st.since,
+                "streak": st.streak,
                 "transitions": list(st.transitions),
             })
         return out

@@ -1,0 +1,360 @@
+"""Live operational plane: the daemon's in-process HTTP endpoint.
+
+Every observability surface before this PR was post-hoc file
+inspection — ``cdrs metrics summarize|watch|alerts`` and ``cdrs trace``
+re-read the JSONL sink after (or while) the daemon writes it.  A daemon
+serving reads must be scrapeable and probeable *while it runs* (Dapper /
+Tail-at-Scale: production latency debugging happens against live
+systems, not log archives), so ``cdrs daemon --http HOST:PORT`` runs
+this server in a daemon-owned thread, strictly OFF the decision path:
+
+====================  =======================================================
+endpoint              serves
+====================  =======================================================
+``/metrics``          Prometheus text format (obs/prom.py — the SAME
+                      renderer as ``cdrs metrics export``), plus
+                      ``cdrs_process_start_time_seconds`` and
+                      ``cdrs_build_info``
+``/healthz``          200 iff the tailer is making progress (fresh
+                      heartbeat) and no page-severity alert is firing
+``/readyz``           200 iff a ``PlacementEpoch`` has been published and
+                      the daemon is not draining — the epoch-pinned
+                      serving contract as a probe
+``/statusz``          JSON introspection: epoch id, window index, backlog,
+                      firing alerts with streaks, decision p50/p99 from
+                      the PR-17 reservoir, per-stage critical-path shares
+``/debug/trace``      the tail-sampled slowest-decision exemplars as the
+                      same Chrome/Perfetto JSON ``cdrs trace export``
+                      emits
+====================  =======================================================
+
+**Snapshot-swap contract (no torn reads).**  The daemon never exposes
+live mutable state to the server.  Once per processed window it builds
+one immutable :class:`ObsSnapshot` and installs it with a single
+reference assignment (:meth:`ObsServer.publish`); a request handler
+reads ``self.snapshot`` exactly once and renders everything from that
+object.  Same discipline as ``EpochPublisher.pin`` — a scrape landing
+mid-republication sees either the whole previous snapshot or the whole
+next one, never a mixture.  The invariant the concurrency test hammers:
+within any one response, ``epochs_published == windows_processed ==
+seq`` (a fresh daemon publishes exactly one epoch per processed
+window), and ``seq`` is monotone across responses.
+
+Probe semantics: readiness is about *traffic* (an epoch exists to pin;
+flips false the moment SIGTERM drain begins so a balancer stops sending
+work the daemon will not finish), health is about *liveness + paging*
+(the tailer heartbeat goes stale when ingest wedges; a page-severity
+alert means the data the daemon serves is in jeopardy).  Both recover
+without restart.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import prom
+
+__all__ = ["ObsSnapshot", "ObsServer", "EMPTY_SNAPSHOT"]
+
+#: /statusz keys whose values move with the wall clock (or host timing)
+#: on every run — the CI double-run stability check strips exactly these
+#: before comparing bytes.  Everything else in /statusz is deterministic
+#: for a seeded run.
+STATUSZ_WALL_KEYS = ("captured_unix", "uptime_seconds", "decision",
+                     "stages")
+
+
+@dataclass(frozen=True)
+class ObsSnapshot:
+    """One immutable cut of daemon state, built once per processed
+    window (module docstring: the snapshot-swap contract).
+
+    ``decision_seconds`` carries the PR-17 bounded reservoir verbatim so
+    ``/metrics`` renders the same summary convention as the textfile
+    surface; ``stages`` is the critical-path share table
+    ``((stage, seconds, share), ...)``; ``exemplars`` are the retained
+    slowest-decision ``decision_trace`` events (span trees embedded)."""
+
+    seq: int = 0
+    epoch_id: int | None = None
+    window: int | None = None
+    windows_processed: int = 0
+    events_ingested: int = 0
+    epochs_published: int = 0
+    checkpoints_written: int = 0
+    reclusters: int = 0
+    bytes_migrated: int = 0
+    traced_decisions: int = 0
+    backlog_events: int = 0
+    backlog_bytes: int = 0
+    decision_seconds: tuple = ()
+    decision_p50_seconds: float | None = None
+    decision_p99_seconds: float | None = None
+    stages: tuple = ()
+    alerts: tuple = ()
+    exemplars: tuple = ()
+    captured_unix: float = field(default_factory=time.time)
+
+    def severe_firing(self) -> tuple:
+        """Firing page-severity alert rows — the /healthz trip wire."""
+        return tuple(a for a in self.alerts
+                     if a.get("firing") and a.get("severity") == "page")
+
+
+EMPTY_SNAPSHOT = ObsSnapshot(captured_unix=0.0)
+
+
+def _metrics_text(snap: ObsSnapshot) -> str:
+    """The live Prometheus exposition, rendered entirely from one
+    snapshot via the shared obs/prom.py primitives."""
+    lines: list[str] = []
+    counters = {
+        "daemon.windows_processed": snap.windows_processed,
+        "daemon.events_ingested": snap.events_ingested,
+        "daemon.epochs_published": snap.epochs_published,
+        "daemon.checkpoints_written": snap.checkpoints_written,
+        "daemon.reclusters": snap.reclusters,
+        "daemon.bytes_migrated": snap.bytes_migrated,
+        "daemon.traced_decisions": snap.traced_decisions,
+    }
+    for name in sorted(counters):
+        lines += prom.counter_lines(name, counters[name])
+    gauges = {
+        "daemon.backlog_bytes": snap.backlog_bytes,
+        "daemon.backlog_events": snap.backlog_events,
+        "daemon.epoch_id": snap.epoch_id or 0,
+        "daemon.window": snap.window if snap.window is not None else -1,
+        "obs.snapshot_seq": snap.seq,
+    }
+    for name in sorted(gauges):
+        lines += prom.gauge_lines(name, gauges[name])
+    if snap.decision_seconds:
+        lines += prom.summary_lines("daemon.decision.seconds",
+                                    list(snap.decision_seconds))
+    for stage, _seconds, share in snap.stages:
+        lines += prom.gauge_lines(f"daemon.stage.{stage}.share", share)
+    firing = [a for a in snap.alerts if a.get("firing")]
+    lines += prom.alerts_lines(firing)
+    lines += prom.meta_lines()
+    return "\n".join(lines) + "\n"
+
+
+def _statusz_json(snap: ObsSnapshot, *, ready: bool, draining: bool,
+                  started_unix: float) -> str:
+    doc = {
+        "seq": snap.seq,
+        "captured_unix": snap.captured_unix,
+        "uptime_seconds": max(0.0, time.time() - started_unix),
+        "ready": ready,
+        "draining": draining,
+        "epoch_id": snap.epoch_id,
+        "window": snap.window,
+        "windows_processed": snap.windows_processed,
+        "events_ingested": snap.events_ingested,
+        "epochs_published": snap.epochs_published,
+        "checkpoints_written": snap.checkpoints_written,
+        "reclusters": snap.reclusters,
+        "bytes_migrated": snap.bytes_migrated,
+        "traced_decisions": snap.traced_decisions,
+        "backlog": {"events": snap.backlog_events,
+                    "bytes": snap.backlog_bytes},
+        "decision": {
+            "count": len(snap.decision_seconds),
+            "p50_seconds": snap.decision_p50_seconds,
+            "p99_seconds": snap.decision_p99_seconds,
+        },
+        "stages": [{"stage": s, "seconds": sec, "share": share}
+                   for s, sec, share in snap.stages],
+        "alerts": [dict(a) for a in snap.alerts if a.get("fired")],
+    }
+    return json.dumps(doc, sort_keys=True, indent=1) + "\n"
+
+
+def _trace_json(snap: ObsSnapshot) -> str:
+    """``/debug/trace``: the exemplar decisions in the exact JSON shape
+    ``cdrs trace export`` emits.  Exemplar events embed their span trees,
+    so no window-record join is needed; an empty exemplar set is a valid
+    empty trace document, not an error."""
+    from .trace import chrome_trace
+
+    if not snap.exemplars:
+        doc = {"displayTimeUnit": "ms", "traceEvents": []}
+    else:
+        doc = chrome_trace(list(snap.exemplars))
+    return json.dumps(doc, sort_keys=True, separators=(",", ":")) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # The server thread must never write to the daemon's stderr per
+    # request (scrapes are periodic; the log would drown the digest).
+    def log_message(self, fmt, *args):  # noqa: ARG002
+        pass
+
+    def _send(self, status: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(data)
+
+    def do_HEAD(self):  # noqa: N802
+        self.do_GET()
+
+    def do_GET(self):  # noqa: N802
+        obs: ObsServer = self.server.obs  # type: ignore[attr-defined]
+        # ONE read of the snapshot reference; everything below renders
+        # from this object only (the no-torn-reads contract).
+        snap = obs.snapshot
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self._send(200, _metrics_text(snap),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                ok, reason = obs.health(snap)
+                self._send(200 if ok else 503,
+                           ("ok\n" if ok else f"unhealthy: {reason}\n"),
+                           "text/plain; charset=utf-8")
+            elif path == "/readyz":
+                ready, reason = obs.readiness()
+                self._send(200 if ready else 503,
+                           ("ready\n" if ready
+                            else f"unready: {reason}\n"),
+                           "text/plain; charset=utf-8")
+            elif path == "/statusz":
+                self._send(200, _statusz_json(
+                    snap, ready=obs.ready, draining=obs.draining,
+                    started_unix=obs.started_unix),
+                    "application/json; charset=utf-8")
+            elif path == "/debug/trace":
+                self._send(200, _trace_json(snap),
+                           "application/json; charset=utf-8")
+            elif path == "/":
+                self._send(200, "cdrs daemon: /metrics /healthz /readyz "
+                                "/statusz /debug/trace\n",
+                           "text/plain; charset=utf-8")
+            else:
+                self._send(404, f"no such endpoint {path}\n",
+                           "text/plain; charset=utf-8")
+        except BrokenPipeError:
+            pass  # scraper hung up mid-response; nothing to salvage
+        except Exception as e:  # pragma: no cover - defensive
+            try:
+                self._send(500, f"internal error: {e}\n",
+                           "text/plain; charset=utf-8")
+            except Exception:
+                pass
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    obs: ObsServer
+
+
+class ObsServer:
+    """The daemon-owned observability server (module docstring).
+
+    Lifecycle: construct (binds the socket, so a bad address fails fast
+    in the foreground, before the daemon loop starts), :meth:`start`
+    (serving thread), :meth:`publish` once per processed window,
+    :meth:`set_ready` / :meth:`set_draining` at the epoch/drain
+    transitions, :meth:`heartbeat` from the tailer's poll loop,
+    :meth:`close` on the way out.  ``port=0`` binds an ephemeral port
+    (tests); :attr:`url` reports the bound address either way.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 stale_after: float = 30.0):
+        self._httpd = _Server((host, port), _Handler)
+        self._httpd.obs = self
+        self._thread: threading.Thread | None = None
+        self.snapshot: ObsSnapshot = EMPTY_SNAPSHOT
+        self.ready: bool = False
+        self.draining: bool = False
+        self.started_unix: float = time.time()
+        self.stale_after = float(stale_after)
+        self._heartbeat_mono = time.monotonic()
+
+    # -- address ----------------------------------------------------------
+
+    @property
+    def address(self) -> tuple[str, int]:
+        host, port = self._httpd.server_address[:2]
+        return str(host), int(port)
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> ObsServer:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="cdrs-obs-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> ObsServer:
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- daemon-side publication ------------------------------------------
+
+    def publish(self, snapshot: ObsSnapshot) -> None:
+        """Install a new immutable snapshot: ONE reference assignment,
+        atomic under the GIL — the whole no-torn-reads contract."""
+        self.snapshot = snapshot
+
+    def set_ready(self, ready: bool) -> None:
+        self.ready = bool(ready)
+
+    def set_draining(self, draining: bool) -> None:
+        """Drain begins: readiness drops IMMEDIATELY (single attribute
+        stores — safe from a signal handler), before the daemon finishes
+        the in-flight window."""
+        self.draining = bool(draining)
+        if draining:
+            self.ready = False
+
+    def heartbeat(self) -> None:
+        """Tailer progress stamp, called from the ingest poll loop."""
+        self._heartbeat_mono = time.monotonic()
+
+    # -- probe verdicts ----------------------------------------------------
+
+    def readiness(self) -> tuple[bool, str]:
+        if self.ready:
+            return True, ""
+        if self.draining:
+            return False, "draining"
+        return False, "no placement epoch published yet"
+
+    def health(self, snap: ObsSnapshot | None = None) -> tuple[bool, str]:
+        snap = self.snapshot if snap is None else snap
+        severe = snap.severe_firing()
+        if severe:
+            names = ",".join(a.get("name", "?") for a in severe)
+            return False, f"severe alert firing: {names}"
+        age = time.monotonic() - self._heartbeat_mono
+        if age > self.stale_after:
+            return False, (f"tailer stalled: no ingest progress for "
+                           f"{age:.1f}s (bound {self.stale_after:g}s)")
+        return True, ""

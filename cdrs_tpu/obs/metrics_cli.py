@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import re
 import sys
 
 from .aggregate import (
@@ -43,7 +42,6 @@ from .aggregate import (
     dedup_windows,
     final_counters,
     fmt_bytes,
-    merge_hist_buckets,
     ordered_span_paths,
     pacing_digest,
     percentile,
@@ -433,86 +431,12 @@ def summarize_events(events: list[dict], out=None, peak_flops=None,
 
 # -- export ------------------------------------------------------------------
 
-
-def _prom_name(name: str, prefix: str = "cdrs_") -> str:
-    """Sanitize an event name into a valid Prometheus metric name.
-
-    Valid names match ``[a-zA-Z_:][a-zA-Z0-9_:]*``: every other character
-    maps to ``_``, and a digit-leading result is escaped with ``_`` so the
-    name stays valid even with an empty prefix (exporters that strip or
-    configure away the ``cdrs_`` namespace)."""
-    s = re.sub(r"[^a-zA-Z0-9_]", "_", name)
-    full = prefix + s
-    if full and full[0].isdigit():
-        full = "_" + full
-    return full
-
-
-def prometheus_lines(events: list[dict]) -> list[str]:
-    """Prometheus textfile exposition of the stream's final aggregates."""
-    lines: list[str] = []
-    counters = final_counters(events)
-    gauges: dict[str, float] = {}
-    hists: dict[str, list[float]] = {}
-    bulk: dict[str, dict] = {}
-    for e in events:
-        kind = e.get("kind")
-        if kind == "gauge":
-            gauges[e["name"]] = e["value"]
-        elif kind == "hist":
-            hists.setdefault(e["name"], []).append(float(e["value"]))
-        elif kind == "hist_bulk":
-            merge_hist_buckets(bulk.setdefault(e["name"], {}), e)
-        elif kind == "span":
-            hists.setdefault(f"span.{e['name']}.seconds", []).append(
-                float(e.get("dur", 0.0)))
-    for name in sorted(counters):
-        m = _prom_name(name)
-        lines += [f"# TYPE {m} counter", f"{m} {counters[name]:g}"]
-    for name in sorted(gauges):
-        m = _prom_name(name)
-        lines += [f"# TYPE {m} gauge", f"{m} {gauges[name]:g}"]
-    for name in sorted(hists):
-        vs = hists[name]
-        m = _prom_name(name)
-        lines += [
-            f"# TYPE {m} summary",
-            f'{m}{{quantile="0.5"}} {percentile(vs, 0.5):g}',
-            f'{m}{{quantile="0.95"}} {percentile(vs, 0.95):g}',
-            f"{m}_sum {sum(vs):g}",
-            f"{m}_count {len(vs)}",
-        ]
-    # Bucketed (hist_bulk) names export as native Prometheus histograms:
-    # cumulative le buckets over the fixed ladder, closed by +Inf.
-    for name in sorted(bulk):
-        agg = bulk[name]
-        m = _prom_name(name)
-        lines.append(f"# TYPE {m} histogram")
-        cum = 0
-        for le in sorted(k for k in agg["buckets"] if k != float("inf")):
-            cum += agg["buckets"][le]
-            lines.append(f'{m}_bucket{{le="{le:g}"}} {cum}')
-        lines += [
-            f'{m}_bucket{{le="+Inf"}} {agg["count"]}',
-            f"{m}_sum {agg['sum']:g}",
-            f"{m}_count {agg['count']}",
-        ]
-    # Prometheus-convention ALERTS gauges (what Alertmanager-side rules
-    # export): one series per alert still firing at end of stream.
-    from .aggregate import dedup_windows as _dw
-    from .alerts import evaluate_records as _ev
-
-    windows = _dw(events)
-    if windows:
-        firing = [r for r in _ev(windows) if r["firing"]]
-        if firing:
-            lines.append("# TYPE ALERTS gauge")
-            for r in firing:
-                lines.append(
-                    f'ALERTS{{alertname="{r["name"]}",'
-                    f'alertstate="firing",'
-                    f'severity="{r["severity"]}"}} 1')
-    return lines
+# The exposition renderer lives in obs/prom.py now (ONE renderer shared
+# with the daemon's live /metrics endpoint, obs/httpz.py); these aliases
+# keep the long-standing import surface of this module working.
+from .prom import meta_lines  # noqa: E402
+from .prom import prom_name as _prom_name  # noqa: E402,F401
+from .prom import prometheus_lines  # noqa: E402,F401
 
 
 # -- tail --------------------------------------------------------------------
@@ -678,6 +602,117 @@ def watch(path: str, *, interval: float = 1.0, poll: float | None = None,
     return 0
 
 
+# -- watch --url (live daemon endpoint) --------------------------------------
+
+
+def base_url(spec: str) -> str:
+    """Normalize ``HOST:PORT`` / ``http://host:port[/]`` into a scheme'd
+    base URL with no trailing slash (the ``cdrs status`` / ``watch
+    --url`` address argument)."""
+    u = spec.strip().rstrip("/")
+    if not u.startswith(("http://", "https://")):
+        u = "http://" + u
+    return u
+
+
+def fetch_statusz(base: str, timeout: float = 5.0) -> dict:
+    """One GET of a live daemon's ``/statusz`` (obs/httpz.py), parsed.
+    Raises OSError/ValueError on unreachable or malformed endpoints —
+    callers render the one-line error."""
+    import urllib.request
+
+    with urllib.request.urlopen(base + "/statusz",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def statusz_lines(base: str, doc: dict) -> list[str]:
+    """Human rendering of one /statusz document — shared by ``cdrs
+    status`` and ``cdrs metrics watch --url``."""
+    lines = [f"cdrs daemon @ {base}  (snapshot seq {doc.get('seq')}, "
+             f"up {doc.get('uptime_seconds', 0):.0f}s)"]
+    state = "ready" if doc.get("ready") else (
+        "draining" if doc.get("draining") else "not ready")
+    lines.append(f"state:    {state}")
+    lines.append(
+        f"epoch:    {doc.get('epoch_id')} "
+        f"(window {doc.get('window')}, "
+        f"{doc.get('epochs_published')} published)")
+    lines.append(
+        f"ingest:   {doc.get('events_ingested')} events, "
+        f"{doc.get('windows_processed')} windows, backlog "
+        f"{(doc.get('backlog') or {}).get('events', 0)} events / "
+        f"{_fmt_bytes((doc.get('backlog') or {}).get('bytes', 0))}")
+    dec = doc.get("decision") or {}
+    if dec.get("count"):
+        p50 = dec.get("p50_seconds")
+        p99 = dec.get("p99_seconds")
+        lines.append(
+            f"decide:   n={dec['count']} p50="
+            f"{'-' if p50 is None else f'{p50 * 1e3:.2f}ms'} p99="
+            f"{'-' if p99 is None else f'{p99 * 1e3:.2f}ms'}")
+    stages = doc.get("stages") or []
+    if stages:
+        top = sorted(stages, key=lambda s: -s.get("share", 0))[:4]
+        lines.append("stages:   " + "  ".join(
+            f"{s['stage']} {s.get('share', 0):.1%}" for s in top))
+    lines.append(
+        f"moves:    {doc.get('reclusters')} reclusters, "
+        f"{_fmt_bytes(doc.get('bytes_migrated', 0))} migrated, "
+        f"{doc.get('checkpoints_written')} checkpoints")
+    for a in doc.get("alerts") or []:
+        if a.get("firing"):
+            lines.append(f"ALERT FIRING: {a['name']} [{a['severity']}] "
+                         f"since window {a.get('since')} "
+                         f"(streak {a.get('streak')})")
+    return lines
+
+
+def watch_url(url: str, *, interval: float = 1.0,
+              max_seconds: float | None = None, once: bool = False,
+              out=None) -> int:
+    """``watch`` against a live daemon's /statusz endpoint instead of a
+    sink file: no shared filesystem needed, and the view is the daemon's
+    own atomic snapshot rather than a re-aggregated tail."""
+    import time as _time
+
+    out = out or sys.stdout
+    base = base_url(url)
+    t0 = _time.monotonic()
+    interactive = (not once) and getattr(out, "isatty", lambda: False)()
+    code = 0
+    try:
+        while True:
+            try:
+                lines = statusz_lines(base, fetch_statusz(base))
+                code = 0
+            except (OSError, ValueError) as e:
+                lines = [f"cdrs metrics watch — {base} unreachable: "
+                         f"{e}"]
+                code = 1
+            if interactive:
+                print("\x1b[2J\x1b[H" + "\n".join(lines), file=out,
+                      flush=True)
+            else:
+                print("\n".join(lines) + "\n", file=out, flush=True)
+            if once:
+                return code
+            if max_seconds is not None \
+                    and _time.monotonic() - t0 >= max_seconds:
+                return code
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return code
+    except BrokenPipeError:
+        # The downstream pipe reader hung up (``| grep -q``, ``| head``):
+        # end of session, not an error.  Point stdout at devnull so the
+        # interpreter's exit flush does not raise the same error again.
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return code
+
+
 # -- alerts ------------------------------------------------------------------
 
 
@@ -811,8 +846,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--title", default=None)
 
     p = sub.add_parser("watch", help="live terminal view tailing a running "
-                                     "producer's stream")
-    p.add_argument("file")
+                                     "producer's stream (or polling a "
+                                     "daemon's --http endpoint via --url)")
+    p.add_argument("file", nargs="?", default=None)
+    p.add_argument("--url", default=None, metavar="HOST:PORT|URL",
+                   help="poll a live daemon's /statusz endpoint "
+                        "(cdrs daemon --http) instead of tailing a "
+                        "sink file")
     p.add_argument("--interval", type=float, default=1.0)
     p.add_argument("--poll", type=float, default=None, metavar="SECONDS",
                    help="file-poll cadence, decoupled from the redraw "
@@ -861,6 +901,14 @@ def main(argv: list[str] | None = None) -> int:
 
     args = parser.parse_args(argv)
     if args.action == "watch":
+        if args.url:
+            return watch_url(args.url, interval=args.interval,
+                             max_seconds=args.max_seconds,
+                             once=args.once)
+        if not args.file:
+            print("error: watch needs a stream FILE or --url",
+                  file=sys.stderr)
+            return 2
         return watch(args.file, interval=args.interval, poll=args.poll,
                      max_seconds=args.max_seconds, once=args.once)
     if args.action == "alerts":
@@ -907,8 +955,10 @@ def main(argv: list[str] | None = None) -> int:
                 f.write(html)
             print(f"wrote {out_path}", file=sys.stderr)
             return 0
-        # export
-        text = "\n".join(prometheus_lines(events)) + "\n"
+        # export — aggregate exposition plus the meta series every
+        # Prometheus surface carries (start-time gauge for rate() over
+        # resume-reset counters, build info; obs/prom.py).
+        text = "\n".join(prometheus_lines(events) + meta_lines()) + "\n"
         if args.out:
             with open(args.out, "w", encoding="utf-8") as f:
                 f.write(text)

@@ -1,0 +1,298 @@
+"""Prometheus text-format rendering — ONE renderer for every surface.
+
+The exposition logic used to live inside ``metrics_cli`` (the
+``cdrs metrics export --format prometheus`` textfile path).  The live
+operational plane (obs/httpz.py: the daemon's in-process ``/metrics``
+endpoint) must emit the SAME format with the SAME name sanitization and
+the SAME type/sample line shapes, so the renderer moved here and both
+surfaces consume it — the textfile export is now a thin wrapper
+(``metrics_cli`` re-exports :func:`prometheus_lines` unchanged, golden-
+tested byte-for-byte in tests/test_httpz.py).
+
+Every exposition additionally carries two meta series
+(:func:`meta_lines`):
+
+* ``cdrs_process_start_time_seconds`` — the standard Prometheus
+  process-start gauge.  The repo's counters are process-lifetime
+  cumulative and **reset on daemon restart/resume** (a resumed daemon's
+  ``windows_processed`` restarts at zero even though ``epoch_id``
+  continues); ``rate()``/``increase()`` handle that reset correctly
+  *only* when the scraper can see the restart, which is exactly what
+  this gauge publishes.  Documented in ARCHITECTURE "Live operational
+  plane".
+* ``cdrs_build_info`` — the conventional constant-``1`` info gauge
+  (version label), so dashboards can join metrics to the code that
+  produced them.
+
+:func:`lint` is the promtool-style format check CI and the tests run
+against live scrapes: TYPE-before-samples, valid metric/label syntax,
+parseable values, no duplicate TYPE declarations.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+
+from .aggregate import final_counters, merge_hist_buckets, percentile
+
+__all__ = ["prom_name", "prometheus_lines", "meta_lines", "lint",
+           "counter_lines", "gauge_lines", "summary_lines",
+           "histogram_lines", "alerts_lines", "PROCESS_START_TIME"]
+
+#: Wall-clock (unix) seconds this process started observing — stamped at
+#: first import of the telemetry layer, which every producing surface
+#: (daemon, CLI exporter) does during startup.  The honest value for
+#: ``cdrs_process_start_time_seconds`` at exposition resolution.
+PROCESS_START_TIME = time.time()
+
+_VERSION = None
+
+
+def _build_version() -> str:
+    global _VERSION
+    if _VERSION is None:
+        try:
+            from importlib.metadata import version
+
+            _VERSION = version("cdrs-tpu")
+        except Exception:
+            _VERSION = "unknown"
+    return _VERSION
+
+
+def prom_name(name: str, prefix: str = "cdrs_") -> str:
+    """Sanitize an event name into a valid Prometheus metric name.
+
+    Valid names match ``[a-zA-Z_:][a-zA-Z0-9_:]*``: every other character
+    maps to ``_``, and a digit-leading result is escaped with ``_`` so the
+    name stays valid even with an empty prefix (exporters that strip or
+    configure away the ``cdrs_`` namespace)."""
+    s = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    full = prefix + s
+    if full and full[0].isdigit():
+        full = "_" + full
+    return full
+
+
+def _labels(labels: dict | None) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+# -- primitive renderers (shared by every surface) ---------------------------
+
+
+def counter_lines(name: str, value: float,
+                  labels: dict | None = None) -> list[str]:
+    m = prom_name(name)
+    return [f"# TYPE {m} counter", f"{m}{_labels(labels)} {value:g}"]
+
+
+def gauge_lines(name: str, value: float,
+                labels: dict | None = None) -> list[str]:
+    m = prom_name(name)
+    return [f"# TYPE {m} gauge", f"{m}{_labels(labels)} {value:g}"]
+
+
+def summary_lines(name: str, values: list[float]) -> list[str]:
+    """Prometheus summary over raw samples: the textfile export's p50/p95
+    quantile convention, shared verbatim by the live endpoint."""
+    m = prom_name(name)
+    return [
+        f"# TYPE {m} summary",
+        f'{m}{{quantile="0.5"}} {percentile(values, 0.5):g}',
+        f'{m}{{quantile="0.95"}} {percentile(values, 0.95):g}',
+        f"{m}_sum {sum(values):g}",
+        f"{m}_count {len(values)}",
+    ]
+
+
+def histogram_lines(name: str, agg: dict) -> list[str]:
+    """Native Prometheus histogram from a merged ``hist_bulk`` aggregate
+    (cumulative le buckets over the fixed ladder, closed by +Inf)."""
+    m = prom_name(name)
+    lines = [f"# TYPE {m} histogram"]
+    cum = 0
+    for le in sorted(k for k in agg["buckets"] if k != float("inf")):
+        cum += agg["buckets"][le]
+        lines.append(f'{m}_bucket{{le="{le:g}"}} {cum}')
+    lines += [
+        f'{m}_bucket{{le="+Inf"}} {agg["count"]}',
+        f"{m}_sum {agg['sum']:g}",
+        f"{m}_count {agg['count']}",
+    ]
+    return lines
+
+
+def alerts_lines(firing: list[dict]) -> list[str]:
+    """Prometheus-convention ``ALERTS`` gauges (what Alertmanager-side
+    rules export): one series per currently-firing alert.  ``firing``
+    rows need ``name`` and ``severity`` (the alert-engine result /
+    transition shape)."""
+    if not firing:
+        return []
+    lines = ["# TYPE ALERTS gauge"]
+    for r in firing:
+        lines.append(
+            f'ALERTS{{alertname="{r["name"]}",'
+            f'alertstate="firing",'
+            f'severity="{r["severity"]}"}} 1')
+    return lines
+
+
+def meta_lines(start_time: float | None = None,
+               version: str | None = None) -> list[str]:
+    """The two meta series every exposition carries (module docstring:
+    restart visibility for ``rate()`` + build provenance).  ``start_time``
+    defaults to this process's observed start; tests inject a fixed value
+    for byte-stable assertions."""
+    st = PROCESS_START_TIME if start_time is None else float(start_time)
+    ver = _build_version() if version is None else version
+    return [
+        "# TYPE cdrs_process_start_time_seconds gauge",
+        f"cdrs_process_start_time_seconds {st:.3f}",
+        "# TYPE cdrs_build_info gauge",
+        f'cdrs_build_info{{version="{ver}"}} 1',
+    ]
+
+
+# -- the stream renderer (the historical textfile exposition) ----------------
+
+
+def prometheus_lines(events: list[dict]) -> list[str]:
+    """Prometheus textfile exposition of the stream's final aggregates.
+
+    Byte-for-byte the exposition ``cdrs metrics export`` has always
+    produced (golden-tested); surfaces append :func:`meta_lines` on top."""
+    lines: list[str] = []
+    counters = final_counters(events)
+    gauges: dict[str, float] = {}
+    hists: dict[str, list[float]] = {}
+    bulk: dict[str, dict] = {}
+    for e in events:
+        kind = e.get("kind")
+        if kind == "gauge":
+            gauges[e["name"]] = e["value"]
+        elif kind == "hist":
+            hists.setdefault(e["name"], []).append(float(e["value"]))
+        elif kind == "hist_bulk":
+            merge_hist_buckets(bulk.setdefault(e["name"], {}), e)
+        elif kind == "span":
+            hists.setdefault(f"span.{e['name']}.seconds", []).append(
+                float(e.get("dur", 0.0)))
+    for name in sorted(counters):
+        lines += counter_lines(name, counters[name])
+    for name in sorted(gauges):
+        lines += gauge_lines(name, gauges[name])
+    for name in sorted(hists):
+        lines += summary_lines(name, hists[name])
+    for name in sorted(bulk):
+        lines += histogram_lines(name, bulk[name])
+    from .aggregate import dedup_windows
+    from .alerts import evaluate_records
+
+    windows = dedup_windows(events)
+    if windows:
+        firing = [r for r in evaluate_records(windows) if r["firing"]]
+        lines += alerts_lines(firing)
+    return lines
+
+
+# -- format lint (promtool-style) --------------------------------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^{}]*\})?"
+    r" (?P<value>\S+)$")
+_LABEL_RE = re.compile(
+    r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+
+
+def _base_name(name: str) -> str:
+    """A sample's family name: summary/histogram component suffixes map
+    back to the declared metric."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def lint(text: str) -> list[str]:
+    """Promtool-style format check of one exposition.
+
+    Returns a list of error strings (empty = clean): every sample line
+    must parse (name, optional well-formed labels, float value), every
+    sample's family must have a TYPE declared BEFORE it, no family may
+    declare TYPE twice, and the exposition must end with a newline.
+    """
+    errors: list[str] = []
+    if text and not text.endswith("\n"):
+        errors.append("exposition must end with a newline")
+    typed: dict[str, str] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) != 4:
+                    errors.append(f"line {i}: malformed TYPE comment")
+                    continue
+                _, _, name, mtype = parts
+                if not _NAME_RE.match(name):
+                    errors.append(f"line {i}: invalid metric name "
+                                  f"{name!r}")
+                if mtype not in ("counter", "gauge", "summary",
+                                 "histogram", "untyped"):
+                    errors.append(f"line {i}: unknown type {mtype!r}")
+                if name in typed:
+                    errors.append(f"line {i}: duplicate TYPE for {name}")
+                typed[name] = mtype
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {i}: unparseable sample {line!r}")
+            continue
+        labels = m.group("labels")
+        if labels:
+            for pair in _split_labels(labels[1:-1]):
+                if pair and not _LABEL_RE.match(pair):
+                    errors.append(f"line {i}: bad label {pair!r}")
+        try:
+            float(m.group("value"))
+        except ValueError:
+            errors.append(f"line {i}: non-numeric value "
+                          f"{m.group('value')!r}")
+        fam = _base_name(m.group("name"))
+        if fam not in typed and m.group("name") not in typed:
+            errors.append(f"line {i}: sample {m.group('name')} has no "
+                          f"preceding TYPE")
+    return errors
+
+
+def _split_labels(inner: str) -> list[str]:
+    """Split ``k="v",k2="v2"`` on commas outside quotes."""
+    out, buf, in_q, esc = [], [], False, False
+    for ch in inner:
+        if esc:
+            buf.append(ch)
+            esc = False
+            continue
+        if ch == "\\":
+            buf.append(ch)
+            esc = True
+            continue
+        if ch == '"':
+            in_q = not in_q
+        if ch == "," and not in_q:
+            out.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    if buf:
+        out.append("".join(buf))
+    return out

@@ -475,13 +475,21 @@ def _daemon_invariants(spec: ScenarioSpec, manifest: Manifest,
       event (obs/trace.py),
     * ``trace_reconciled`` — every decision's integer-ns segments sum
       to its measured total EXACTLY (the one-clock telescoping
-      contract; any mismatch is an emitter bug, not noise).
+      contract; any mismatch is an emitter bug, not noise),
+    * ``endpoint_engaged`` — the live operational plane (obs/httpz.py)
+      rode the SAME full run: the scraped ``/metrics`` exposition is
+      format-clean with one snapshot per processed window, ``/statusz``
+      agrees with the run digest, and ``/debug/trace`` serves exemplar
+      decisions — all over real HTTP against the in-process endpoint.
     """
     import json as _json
     import os
     import tempfile
+    import urllib.request
 
     from ..daemon import DaemonConfig, StreamDaemon
+    from ..obs import prom
+    from ..obs.httpz import ObsServer
 
     inv: dict[str, bool] = {}
     with tempfile.TemporaryDirectory() as td:
@@ -490,7 +498,27 @@ def _daemon_invariants(spec: ScenarioSpec, manifest: Manifest,
 
         metrics = os.path.join(td, "daemon.jsonl")
         full = StreamDaemon(_controller(spec, manifest, schedule))
-        dig = full.run(log, metrics_path=metrics)
+        with ObsServer() as srv:
+            full.attach_http(srv)
+            dig = full.run(log, metrics_path=metrics)
+
+            def _scrape(path: str) -> str:
+                with urllib.request.urlopen(srv.url + path,
+                                            timeout=5) as r:
+                    return r.read().decode("utf-8")
+
+            snap = srv.snapshot
+            text = _scrape("/metrics")
+            statusz = _json.loads(_scrape("/statusz"))
+            trace = _json.loads(_scrape("/debug/trace"))
+            inv["endpoint_engaged"] = bool(
+                prom.lint(text) == []
+                and snap.seq == snap.windows_processed
+                == snap.epochs_published
+                == dig["windows_processed"] >= 2
+                and statusz["seq"] == snap.seq
+                and statusz["events_ingested"] == dig["events_ingested"]
+                and trace["traceEvents"])
         inv["daemon_engaged"] = dig["epochs_published"] >= 2
         inv["daemon_decisions_identical"] = \
             _strip(full.records) == _strip(batch_records)

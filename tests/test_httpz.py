@@ -1,0 +1,487 @@
+"""Live operational plane (obs/httpz.py + obs/prom.py).
+
+Covers the PR-18 surfaces end to end:
+
+* the shared Prometheus renderer extracted into obs/prom.py — golden
+  byte-for-byte against the historical ``cdrs metrics export``
+  exposition, meta-series determinism, and the promtool-style lint;
+* ObsServer unit lifecycle — readiness/health probe semantics, the
+  snapshot-swap contract, 404s, the empty /debug/trace document;
+* StreamDaemon integration through the in-process feed — snapshot
+  invariant ``epochs_published == windows_processed == seq``, the
+  concurrency hammer (scrapes racing republication see no torn reads),
+  SIGTERM-drain readiness, and the /healthz flip on a page-severity
+  alert with recovery;
+* the consumer CLIs: ``cdrs status [--json]`` and
+  ``cdrs metrics watch --url``.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.cli import main as cdrs_main
+from cdrs_tpu.config import (
+    GeneratorConfig,
+    KMeansConfig,
+    SimulatorConfig,
+    validated_scoring_config,
+)
+from cdrs_tpu.control import ControllerConfig, ReplicationController
+from cdrs_tpu.daemon import StreamDaemon
+from cdrs_tpu.io.events import EventLog
+from cdrs_tpu.obs import metrics_cli, prom
+from cdrs_tpu.obs.alerts import AlertRule
+from cdrs_tpu.obs.httpz import (
+    EMPTY_SNAPSHOT,
+    STATUSZ_WALL_KEYS,
+    ObsServer,
+    ObsSnapshot,
+)
+from cdrs_tpu.sim.access import simulate_access
+from cdrs_tpu.sim.generator import generate_population
+
+
+@pytest.fixture(scope="module")
+def workload():
+    manifest = generate_population(GeneratorConfig(n_files=150, seed=31))
+    events = simulate_access(manifest,
+                             SimulatorConfig(duration_seconds=600.0, seed=32))
+    return manifest, events
+
+
+def _cfg(**kw):
+    base = dict(window_seconds=120.0, backend="numpy",
+                kmeans=KMeansConfig(k=8, seed=42),
+                scoring=validated_scoring_config())
+    base.update(kw)
+    return ControllerConfig(**base)
+
+
+def _get(url: str):
+    """(status_code, body) for one GET — 503s are data, not errors."""
+    try:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def _synthetic_batches(n_files: int, sizes, window_seconds: float,
+                       seed: int = 7):
+    """One EventLog batch per window, ``sizes[w]`` events inside window
+    ``w`` — the deterministic feed the lifecycle tests drive."""
+    rng = np.random.default_rng(seed)
+    batches = []
+    for w, size in enumerate(sizes):
+        ts = np.sort(rng.uniform(w * window_seconds,
+                                 (w + 1) * window_seconds, size))
+        batches.append(EventLog(
+            ts=ts.astype(np.float64),
+            path_id=rng.integers(0, n_files, size).astype(np.int32),
+            op=np.zeros(size, dtype=np.int8),
+            client_id=np.zeros(size, dtype=np.int32),
+            clients=["c0"]))
+    return batches
+
+
+# -- obs/prom.py: the shared renderer ---------------------------------------
+
+GOLDEN_EVENTS = [
+    {"kind": "counter", "name": "reads.routed", "value": 12345, "run": "r1"},
+    {"kind": "counter", "name": "jit.recompiles", "value": 3, "run": "r1"},
+    {"kind": "gauge", "name": "serve.p99_ms", "value": 41.5},
+    {"kind": "gauge", "name": "9weird name!", "value": 2.0},
+    {"kind": "hist", "name": "plan.seconds", "value": 0.25},
+    {"kind": "hist", "name": "plan.seconds", "value": 0.75},
+    {"kind": "span", "name": "window", "dur": 1.5, "id": 1, "run": "r1"},
+    {"kind": "hist_bulk", "name": "serve.latency_ms", "count": 4,
+     "sum": 10.0, "min": 1.0, "max": 4.0,
+     "buckets": [[1.0, 1], [3.0, 2], ["+Inf", 1]]},
+    {"kind": "window", "window": 0, "durability": {"lost": 1}},
+    {"kind": "window", "window": 1, "durability": {"lost": 1}},
+]
+
+# The exposition ``cdrs metrics export --format prometheus`` produced
+# BEFORE the renderer moved to obs/prom.py — captured verbatim from the
+# pre-refactor metrics_cli.prometheus_lines.  The refactor must keep
+# every byte.
+GOLDEN_TEXT = """\
+# TYPE cdrs_jit_recompiles counter
+cdrs_jit_recompiles 3
+# TYPE cdrs_reads_routed counter
+cdrs_reads_routed 12345
+# TYPE cdrs_9weird_name_ gauge
+cdrs_9weird_name_ 2
+# TYPE cdrs_serve_p99_ms gauge
+cdrs_serve_p99_ms 41.5
+# TYPE cdrs_plan_seconds summary
+cdrs_plan_seconds{quantile="0.5"} 0.25
+cdrs_plan_seconds{quantile="0.95"} 0.75
+cdrs_plan_seconds_sum 1
+cdrs_plan_seconds_count 2
+# TYPE cdrs_span_window_seconds summary
+cdrs_span_window_seconds{quantile="0.5"} 1.5
+cdrs_span_window_seconds{quantile="0.95"} 1.5
+cdrs_span_window_seconds_sum 1.5
+cdrs_span_window_seconds_count 1
+# TYPE cdrs_serve_latency_ms histogram
+cdrs_serve_latency_ms_bucket{le="1"} 1
+cdrs_serve_latency_ms_bucket{le="3"} 3
+cdrs_serve_latency_ms_bucket{le="+Inf"} 4
+cdrs_serve_latency_ms_sum 10
+cdrs_serve_latency_ms_count 4
+# TYPE ALERTS gauge
+ALERTS{alertname="files_lost",alertstate="firing",severity="page"} 1
+ALERTS{alertname="durability_degraded",alertstate="firing",severity="ticket"} 1
+"""
+
+
+def test_prometheus_lines_golden_bytes():
+    text = "\n".join(prom.prometheus_lines(GOLDEN_EVENTS)) + "\n"
+    assert text == GOLDEN_TEXT
+
+
+def test_textfile_export_is_a_thin_wrapper():
+    # The CLI surface re-exports the SAME objects — not a parallel
+    # implementation that could drift.
+    assert metrics_cli.prometheus_lines is prom.prometheus_lines
+    assert metrics_cli._prom_name is prom.prom_name
+
+
+def test_export_cli_appends_meta_series(tmp_path, capsys):
+    f = tmp_path / "m.jsonl"
+    f.write_text("".join(json.dumps(e) + "\n" for e in GOLDEN_EVENTS))
+    assert metrics_cli.main(["export", str(f)]) == 0
+    text = capsys.readouterr().out
+    assert text.startswith(GOLDEN_TEXT.rstrip("\n"))
+    assert "# TYPE cdrs_process_start_time_seconds gauge" in text
+    assert 'cdrs_build_info{version="' in text
+    assert text.endswith("\n")
+    assert prom.lint(text) == []
+
+
+def test_meta_lines_deterministic_bytes():
+    assert prom.meta_lines(start_time=123.4564, version="1.2.3") == [
+        "# TYPE cdrs_process_start_time_seconds gauge",
+        "cdrs_process_start_time_seconds 123.456",
+        "# TYPE cdrs_build_info gauge",
+        'cdrs_build_info{version="1.2.3"} 1',
+    ]
+
+
+def test_prom_name_sanitization():
+    assert prom.prom_name("reads.routed") == "cdrs_reads_routed"
+    assert prom.prom_name("9weird name!") == "cdrs_9weird_name_"
+    assert prom.prom_name("9lead", prefix="") == "_9lead"
+
+
+def test_lint_accepts_golden_and_meta():
+    assert prom.lint(GOLDEN_TEXT) == []
+    assert prom.lint("\n".join(prom.meta_lines()) + "\n") == []
+
+
+@pytest.mark.parametrize("bad,needle", [
+    ("cdrs_x 1\n", "no preceding TYPE"),
+    ("# TYPE cdrs_x counter\ncdrs_x nope\n", "non-numeric"),
+    ("# TYPE cdrs_x counter\n# TYPE cdrs_x gauge\ncdrs_x 1\n",
+     "duplicate TYPE"),
+    ("# TYPE cdrs_x counter\ncdrs_x 1", "end with a newline"),
+    ('# TYPE cdrs_x counter\ncdrs_x{9bad="v"} 1\n', "bad label"),
+    ("# TYPE cdrs_x counter\nnot a sample at all !\n", "unparseable"),
+])
+def test_lint_rejects_malformed(bad, needle):
+    errs = prom.lint(bad)
+    assert any(needle in e for e in errs), errs
+
+
+# -- ObsServer unit lifecycle ------------------------------------------------
+
+def test_server_probe_lifecycle():
+    with ObsServer() as srv:
+        code, body = _get(srv.url + "/")
+        assert code == 200 and "/metrics" in body
+        # Fresh server: no epoch yet -> unready, but alive -> healthy.
+        code, body = _get(srv.url + "/readyz")
+        assert code == 503 and "no placement epoch" in body
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200 and body == "ok\n"
+        srv.set_ready(True)
+        assert _get(srv.url + "/readyz") == (200, "ready\n")
+        # Drain wins over ready, immediately.
+        srv.set_draining(True)
+        code, body = _get(srv.url + "/readyz")
+        assert code == 503 and "draining" in body
+        assert srv.readiness() == (False, "draining")
+        code, _ = _get(srv.url + "/nope")
+        assert code == 404
+
+
+def test_server_health_trips_on_severe_alert_and_recovers():
+    page = {"name": "files_lost", "severity": "page", "kind": "threshold",
+            "firing": True, "fired": True, "since": 3, "streak": 2}
+    ticket = dict(page, name="durability_degraded", severity="ticket")
+    with ObsServer() as srv:
+        srv.publish(ObsSnapshot(seq=1, alerts=(ticket,)))
+        assert _get(srv.url + "/healthz")[0] == 200  # ticket never pages
+        srv.publish(ObsSnapshot(seq=2, alerts=(page, ticket)))
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503 and "files_lost" in body
+        # /metrics exposes BOTH firing alerts while health trips.
+        _, text = _get(srv.url + "/metrics")
+        assert 'ALERTS{alertname="files_lost"' in text
+        assert 'ALERTS{alertname="durability_degraded"' in text
+        # Recovery without restart: next snapshot clears the page.
+        srv.publish(ObsSnapshot(seq=3, alerts=(ticket,)))
+        assert _get(srv.url + "/healthz")[0] == 200
+
+
+def test_server_health_trips_on_stale_heartbeat():
+    with ObsServer(stale_after=0.0) as srv:
+        code, body = _get(srv.url + "/healthz")
+        assert code == 503 and "tailer stalled" in body
+        srv.stale_after = 60.0
+        srv.heartbeat()
+        assert _get(srv.url + "/healthz")[0] == 200
+
+
+def test_empty_snapshot_surfaces_lint_clean():
+    with ObsServer() as srv:
+        code, text = _get(srv.url + "/metrics")
+        assert code == 200
+        assert prom.lint(text) == []
+        assert "cdrs_obs_snapshot_seq 0" in text
+        code, body = _get(srv.url + "/statusz")
+        doc = json.loads(body)
+        assert code == 200 and doc["seq"] == 0
+        assert set(STATUSZ_WALL_KEYS) <= set(doc)
+        code, body = _get(srv.url + "/debug/trace")
+        assert code == 200
+        assert json.loads(body) == {"displayTimeUnit": "ms",
+                                    "traceEvents": []}
+
+
+# -- daemon integration ------------------------------------------------------
+
+def test_daemon_publishes_consistent_snapshots(workload):
+    manifest, events = workload
+    d = StreamDaemon(ReplicationController(manifest, _cfg()))
+    with ObsServer() as srv:
+        d.attach_http(srv)
+        dig = d.run(events)
+        snap = srv.snapshot
+        # The no-torn-reads invariant, at rest: one snapshot per window,
+        # one epoch per window.
+        assert (snap.seq == snap.windows_processed
+                == snap.epochs_published == dig["windows_processed"]
+                == len(d.records) >= 2)
+        assert snap.epoch_id == d.publisher.peek().epoch_id
+        assert snap.events_ingested == len(events)
+        assert snap.backlog_events == 0 and snap.backlog_bytes == 0
+        # End of stream: no more epochs will publish -> not ready.
+        assert srv.readiness()[0] is False
+
+        _, text = _get(srv.url + "/metrics")
+        assert prom.lint(text) == []
+        assert f"cdrs_daemon_windows_processed {snap.seq}" in text
+        assert f"cdrs_daemon_epochs_published {snap.seq}" in text
+        assert "cdrs_daemon_decision_seconds_count" in text
+        assert "cdrs_process_start_time_seconds" in text
+
+        _, body = _get(srv.url + "/statusz")
+        doc = json.loads(body)
+        assert doc["seq"] == snap.seq
+        assert doc["decision"]["count"] == len(d.decision_seconds)
+        assert doc["stages"], "critical-path shares missing"
+        share = sum(s["share"] for s in doc["stages"])
+        assert share == pytest.approx(1.0, abs=1e-6)
+
+        # Exemplars serve without a trace sink attached (retained heap).
+        assert d.traced_decisions == 0
+        _, body = _get(srv.url + "/debug/trace")
+        trace = json.loads(body)
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert any(n.startswith("decision w") for n in names)
+
+
+def test_daemon_snapshot_attach_does_not_change_decisions(workload):
+    manifest, events = workload
+    ref = StreamDaemon(ReplicationController(manifest, _cfg()))
+    ref.run(events)
+    d = StreamDaemon(ReplicationController(manifest, _cfg()))
+    with ObsServer() as srv:
+        d.attach_http(srv)
+        d.run(events)
+    def strip(rs):
+        return [{k: v for k, v in r.items() if k != "seconds"} for r in rs]
+
+    assert strip(d.records) == strip(ref.records)
+
+
+def test_concurrent_scrapes_never_tear(workload):
+    manifest, _ = workload
+    batches = _synthetic_batches(len(manifest), [250] * 20,
+                                 window_seconds=60.0, seed=11)
+    d = StreamDaemon(ReplicationController(manifest,
+                                           _cfg(window_seconds=60.0)))
+    done = threading.Event()
+    errors: list[str] = []
+    last_seq = {}
+
+    def hammer(tid: int, path: str):
+        while not done.is_set():
+            code, body = _get(srv.url + path)
+            if code != 200:
+                errors.append(f"{path} -> {code}")
+                return
+            if path == "/statusz":
+                doc = json.loads(body)
+                seq, wp, ep = (doc["seq"], doc["windows_processed"],
+                               doc["epochs_published"])
+            else:
+                vals = dict(
+                    line.split(" ", 1) for line in body.splitlines()
+                    if line and not line.startswith("#")
+                    and "{" not in line)
+                seq = float(vals["cdrs_obs_snapshot_seq"])
+                wp = float(vals["cdrs_daemon_windows_processed"])
+                ep = float(vals["cdrs_daemon_epochs_published"])
+            if not (seq == wp == ep):
+                errors.append(
+                    f"torn {path}: seq={seq} windows={wp} epochs={ep}")
+                return
+            if seq < last_seq.get(tid, 0):
+                errors.append(f"seq went backwards on {path}")
+                return
+            last_seq[tid] = seq
+
+    with ObsServer() as srv:
+        d.attach_http(srv)
+        threads = [threading.Thread(target=hammer, args=(i, p))
+                   for i, p in enumerate(["/metrics", "/statusz"] * 2)]
+        for t in threads:
+            t.start()
+        d.run(iter(batches))
+        done.set()
+        for t in threads:
+            t.join(timeout=10.0)
+    assert errors == []
+    assert max(last_seq.values()) >= 2  # scrapes actually saw progress
+
+
+def test_readiness_flips_false_at_drain_request(workload):
+    manifest, _ = workload
+    batches = _synthetic_batches(len(manifest), [200] * 8,
+                                 window_seconds=60.0, seed=13)
+    d = StreamDaemon(ReplicationController(manifest,
+                                           _cfg(window_seconds=60.0)))
+    seen: list[tuple[str, int]] = []
+
+    def feed():
+        for k, b in enumerate(batches):
+            if k == 4:
+                # Ready by now: windows 0..k-2 processed, epochs live.
+                seen.append(("pre", _get(srv.url + "/readyz")[0]))
+                d.request_stop("SIGTERM")
+                # Drain drops readiness IMMEDIATELY — before the daemon
+                # finishes (or even starts) the in-flight window.
+                seen.append(("drain", _get(srv.url + "/readyz")[0]))
+                assert d._obs.readiness() == (False, "draining")
+            yield b
+
+    with ObsServer() as srv:
+        d.attach_http(srv)
+        dig = d.run(feed())
+    assert dig["stop_reason"] == "SIGTERM"
+    assert seen == [("pre", 200), ("drain", 503)]
+    assert srv.readiness()[0] is False
+
+
+def test_healthz_flips_on_page_alert_and_recovers(workload):
+    manifest, _ = workload
+    sizes = [120, 120, 500, 500, 120, 120]
+    batches = _synthetic_batches(len(manifest), sizes,
+                                 window_seconds=60.0, seed=17)
+    rules = [AlertRule("hot_window", kind="threshold", field="n_events",
+                       op=">", value=300, for_windows=1, severity="page")]
+    d = StreamDaemon(ReplicationController(manifest,
+                                           _cfg(window_seconds=60.0)),
+                     rules=rules)
+    health: list[tuple[int, int]] = []
+
+    def feed():
+        for k, b in enumerate(batches):
+            if k >= 2:
+                # Windows 0..k-2 are processed before batch k is pulled.
+                health.append((k - 2, _get(srv.url + "/healthz")[0]))
+            yield b
+
+    with ObsServer() as srv:
+        d.attach_http(srv)
+        d.run(feed())
+        # Trailing window 5 (120 events) processed at end of stream:
+        # the alert resolved, health recovers without restart.
+        code, _ = _get(srv.url + "/healthz")
+        final = code
+        snap = srv.snapshot
+    assert health == [(0, 200), (1, 200), (2, 503), (3, 503)]
+    assert final == 200
+    assert snap.severe_firing() == ()
+    rows = {a["name"]: a for a in snap.alerts}
+    assert rows["hot_window"]["fired"] and not rows["hot_window"]["firing"]
+
+
+# -- consumer CLIs -----------------------------------------------------------
+
+@pytest.fixture()
+def live_server(workload):
+    manifest, events = workload
+    d = StreamDaemon(ReplicationController(manifest, _cfg()))
+    with ObsServer() as srv:
+        d.attach_http(srv)
+        d.run(events)
+        srv.set_ready(True)  # frozen end state, presented as live
+        yield srv
+
+
+def test_cdrs_status_renders_block(live_server, capsys):
+    assert cdrs_main(["status", live_server.url]) == 0
+    out = capsys.readouterr().out
+    assert f"cdrs daemon @ {live_server.url}" in out
+    assert "state:    ready" in out
+    assert "/readyz:  200 ready" in out
+    assert "/healthz:  200 ok" in out
+
+
+def test_cdrs_status_json_is_raw_statusz(live_server, capsys):
+    assert cdrs_main(["status", live_server.url, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["seq"] == doc["windows_processed"] == doc["epochs_published"]
+
+
+def test_cdrs_status_unreachable_is_exit_1(capsys):
+    assert cdrs_main(["status", "127.0.0.1:1"]) == 1
+    assert "unreachable" in capsys.readouterr().err
+
+
+def test_metrics_watch_url_once(live_server, capsys):
+    host_port = "{}:{}".format(*live_server.address)
+    assert metrics_cli.main(["watch", "--url", host_port, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "cdrs daemon @ http://" + host_port in out
+    assert "ingest:" in out and "decide:" in out
+
+
+def test_metrics_watch_url_unreachable_is_exit_1(capsys):
+    code = metrics_cli.main(["watch", "--url", "127.0.0.1:1", "--once"])
+    assert code == 1
+    assert "unreachable" in capsys.readouterr().out
+
+
+def test_metrics_watch_requires_file_or_url(capsys):
+    assert metrics_cli.main(["watch"]) == 2
+    assert "--url" in capsys.readouterr().err
