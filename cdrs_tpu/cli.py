@@ -997,10 +997,28 @@ def _cmd_scenarios(args) -> int:
             spec = cells[args.cell]
         elif args.spec:
             text = args.spec
-            if not text.lstrip().startswith("{"):
-                with open(text, encoding="utf-8") as f:
-                    text = f.read()
-            spec = ScenarioSpec.from_dict(json.loads(text))
+            inline = text.lstrip().startswith("{")
+            if not inline:
+                try:
+                    with open(text, encoding="utf-8") as f:
+                        text = f.read()
+                except OSError as e:
+                    print(f"error: cannot read spec file {args.spec}: "
+                          f"{e.strerror or e}", file=sys.stderr)
+                    return 2
+            try:
+                doc = json.loads(text)
+                if not isinstance(doc, dict):
+                    raise ValueError("spec must be a JSON object")
+                if "spec" in doc and isinstance(doc["spec"], dict):
+                    # A banked search-corpus entry wraps the spec.
+                    doc = doc["spec"]
+                spec = ScenarioSpec.from_dict(doc)
+            except (TypeError, ValueError) as e:
+                src = "--spec" if inline else args.spec
+                print(f"error: invalid scenario spec in {src}: {e}",
+                      file=sys.stderr)
+                return 2
         else:
             print("error: scenarios run needs --preset NAME, --cell NAME "
                   "(with --suite), or --spec JSON|FILE", file=sys.stderr)
@@ -1011,6 +1029,61 @@ def _cmd_scenarios(args) -> int:
         if not cell["ok"]:
             print(f"FAILED; repro: {cell['repro']}", file=sys.stderr)
             return 1
+        return 0
+
+    if args.action == "search":
+        from .scenarios.search import (
+            SEARCH_BASE,
+            distill_corpus,
+            load_corpus,
+            run_search,
+        )
+
+        base = tuple(s for s in (args.base or "").split(",") if s) \
+            or SEARCH_BASE
+        try:
+            out = run_search(
+                seed=args.seed, budget_cells=args.budget_cells,
+                budget_seconds=args.budget_seconds,
+                corpus_dir=args.corpus, base=base,
+                progress=lambda line: print(line, file=sys.stderr,
+                                            flush=True))
+        except (KeyError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        if args.distill:
+            d = distill_corpus(load_corpus(args.corpus))
+            os.makedirs(args.corpus, exist_ok=True)
+            path = os.path.join(args.corpus, "distilled.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(d, f, indent=2, sort_keys=True)
+                f.write("\n")
+            out["distilled"] = {"path": path, "names": d["names"],
+                                "coverage_bits": d["coverage_bits"],
+                                "fingerprint": d["fingerprint"]}
+        if args.out:
+            parent = os.path.dirname(args.out)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(out, f, indent=2)
+                f.write("\n")
+        digest = {k: out[k] for k in (
+            "seed", "budget_cells", "iterations", "cells_run",
+            "baseline_bits", "coverage_bits", "new_coverage_cells",
+            "fingerprint", "seconds")}
+        digest["violations"] = len(out["violations"])
+        if "distilled" in out:
+            digest["distilled"] = out["distilled"]
+        print(json.dumps(digest, indent=2))
+        # Violations are banked FINDINGS (with shrunk repro lines), not
+        # sweep regressions: the search exits green so a nightly soak
+        # keeps accumulating corpus instead of aborting at first blood.
+        for v in out["violations"]:
+            sh = v.get("shrunk") or {}
+            print(f"finding: {','.join(v.get('failed') or ()) or v.get('error')}"
+                  f" — repro: {sh.get('repro') or v['repro']}",
+                  file=sys.stderr)
         return 0
 
     # sweep
@@ -1585,11 +1658,14 @@ def main(argv: list[str] | None = None) -> int:
                        "suite gated on invariants (zero silent loss, "
                        "churn budget, domain diversity, SLO, sampled "
                        "kill/resume bit-identity)")
-    p.add_argument("action", choices=["list", "run", "sweep"],
+    p.add_argument("action", choices=["list", "run", "sweep", "search"],
                    help="list = named presets + suites; run = one cell "
                         "(--preset / --suite+--cell / --spec); sweep = "
                         "every cell of --suite, nonzero exit on any "
-                        "invariant failure")
+                        "invariant failure; search = seeded coverage-"
+                        "guided failure-space search (mutate corpus "
+                        "cells, keep new-coverage ones, shrink "
+                        "violations to minimal repros)")
     p.add_argument("--suite", default="ci-smoke",
                    help="cell suite (default ci-smoke; see 'scenarios "
                         "list')")
@@ -1620,6 +1696,28 @@ def main(argv: list[str] | None = None) -> int:
                    help="(sweep) emit per-cell records as 'cell' events "
                         "here; 'cdrs metrics summarize' renders a "
                         "Scenarios digest")
+    p.add_argument("--budget-cells", type=int, default=50,
+                   dest="budget_cells",
+                   help="(search) mutation iterations to attempt "
+                        "(deterministic in --seed; default 50)")
+    p.add_argument("--budget-seconds", type=float, default=None,
+                   dest="budget_seconds",
+                   help="(search) wall-clock cap: truncates the same "
+                        "seeded sequence (the nightly-soak bound)")
+    p.add_argument("--corpus", default="data/search_corpus",
+                   metavar="DIR",
+                   help="(search) corpus directory: banked cells seed "
+                        "the next run's frontier; violations land under "
+                        "violations/ with shrunk repro lines")
+    p.add_argument("--base", default=None, metavar="P1,P2,...",
+                   help="(search) comma-separated preset names seeding "
+                        "the corpus (default: the cheap cross-domain "
+                        "SEARCH_BASE set)")
+    p.add_argument("--distill", action="store_true",
+                   help="(search) after the run, greedily distill the "
+                        "banked corpus to a minimal cell set covering "
+                        "the whole discovered frontier "
+                        "(<corpus>/distilled.json, deterministic)")
     p.set_defaults(fn=_cmd_scenarios)
 
     p = sub.add_parser("bench", help="benchmark harness (BASELINE.md configs)")

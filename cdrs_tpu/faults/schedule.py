@@ -52,7 +52,7 @@ Schedules come from three places: explicit specs (``crash:dn2@3``,
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as _dc_replace
 
 import numpy as np
 
@@ -140,6 +140,20 @@ class FaultEvent:
         return s
 
 
+class _EventsView(tuple):
+    """The schedule's window-sorted event tuple, callable for edit flows.
+
+    ``sched.events`` keeps its historical meaning (an immutable tuple
+    attribute, iterable/indexable/comparable like any tuple), while
+    ``sched.events()`` returns a fresh MUTABLE list of the same
+    ``FaultEvent``s — the decomposition half of the edit contract whose
+    recomposition half is ``FaultSchedule.from_events``.
+    """
+
+    def __call__(self) -> list:
+        return list(self)
+
+
 class FaultSchedule:
     """Immutable, window-sorted event list (see module docstring)."""
 
@@ -147,7 +161,7 @@ class FaultSchedule:
         evs = tuple(sorted(events,
                            key=lambda e: (e.window, _KIND_ORDER[e.kind],
                                           e.node)))
-        self.events: tuple[FaultEvent, ...] = evs
+        self.events: tuple[FaultEvent, ...] = _EventsView(evs)
 
     def __len__(self) -> int:
         return len(self.events)
@@ -214,6 +228,41 @@ class FaultSchedule:
                 events.extend(FaultEvent(e.window, e.kind, n, **kw)
                               for n in resolved)
         return FaultSchedule(events)
+
+    # -- event-level editing (mutate / splice / drop) ------------------------
+    @classmethod
+    def from_events(cls, events) -> "FaultSchedule":
+        """Recompose a schedule from an edited event list — the inverse of
+        ``events()``.  Accepts ``FaultEvent``s or ``to_json``-style dicts,
+        so both ``from_events(s.events())`` and ``from_events(s.to_json())``
+        are lossless identities (order is renormalized, duplicates kept)."""
+        rows = list(events)
+        if rows and isinstance(rows[0], dict):
+            return cls.from_json(rows)
+        return cls(rows)
+
+    def drop(self, index: int) -> "FaultSchedule":
+        """New schedule without ``events()[index]`` (negative indices OK)."""
+        rows = self.events()
+        del rows[index]
+        return FaultSchedule(rows)
+
+    def splice(self, event: FaultEvent) -> "FaultSchedule":
+        """New schedule with ``event`` added (window order renormalized)."""
+        return FaultSchedule((*self.events, event))
+
+    def retime(self, index: int, window: int) -> "FaultSchedule":
+        """New schedule with ``events()[index]`` moved to ``window``."""
+        return self.mutate(index, window=int(window))
+
+    def mutate(self, index: int, **changes) -> "FaultSchedule":
+        """New schedule with ``events()[index]`` field-replaced (any
+        ``FaultEvent`` field: window/kind/node/fail_prob/factor/file);
+        validation reruns, so an edit that breaks an event invariant
+        raises the same ``ValueError`` construction would."""
+        rows = self.events()
+        rows[index] = _dc_replace(rows[index], **changes)
+        return FaultSchedule(rows)
 
     # -- constructors --------------------------------------------------------
     @classmethod

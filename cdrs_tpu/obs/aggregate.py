@@ -17,7 +17,8 @@ __all__ = ["collect", "span_forest", "ordered_span_paths", "percentile",
            "bucket_percentile", "merge_hist_buckets", "dedup_windows",
            "final_counters", "roofline_rows", "fmt_bytes", "serve_digest",
            "storage_digest", "pacing_digest", "integrity_digest",
-           "cells_digest", "critical_path_digest", "daemon_digest"]
+           "cells_digest", "coverage_fingerprint", "critical_path_digest",
+           "daemon_digest"]
 
 
 def fmt_bytes(b, sep: str = " ") -> str:
@@ -246,6 +247,19 @@ def collect(events: list[dict]) -> dict:
     }
 
 
+def coverage_fingerprint(bits) -> str:
+    """The canonical digest of a coverage-bit set (scenario cells'
+    ``coverage`` lists — scenarios/harness.py ``coverage_bits``): sha256
+    over the sorted newline-joined bits, so any two runs exhibiting the
+    same behaviour set hash identically regardless of discovery order.
+    The failure-space search keys its corpus (and the
+    ``search-s<seed>-<prefix>`` cell names) on this digest."""
+    import hashlib
+
+    return hashlib.sha256(
+        "\n".join(sorted(set(map(str, bits)))).encode()).hexdigest()
+
+
 def cells_digest(cells: list[dict]) -> dict | None:
     """Scenario-matrix digest over sweep cell records (``kind: cell`` —
     scenarios/sweep.py).  None when the stream has no cells, so
@@ -253,7 +267,8 @@ def cells_digest(cells: list[dict]) -> dict | None:
     if not cells:
         return None
     failed = [c for c in cells if not c.get("ok")]
-    return {
+    union = {b for c in cells for b in c.get("coverage") or ()}
+    digest = {
         "cells": len(cells),
         "invariants_checked": sum(len(c.get("invariants") or {})
                                   for c in cells),
@@ -265,6 +280,10 @@ def cells_digest(cells: list[dict]) -> dict | None:
         "seconds_total": round(sum(float(c.get("seconds", 0.0))
                                    for c in cells), 3),
     }
+    if union:
+        digest["coverage_bits"] = len(union)
+        digest["fingerprint"] = coverage_fingerprint(union)
+    return digest
 
 
 def serve_digest(windows: list[dict]) -> dict | None:

@@ -254,6 +254,9 @@ def _render_cells(cells: list[dict], out) -> None:
     print(f"\nScenarios: {d['cells']} cells, "
           f"{d['invariants_checked']} invariants checked — {verdict} "
           f"({d['seconds_total']:.1f}s)", file=out)
+    if d.get("coverage_bits"):
+        print(f"  coverage: {d['coverage_bits']} fingerprint bits "
+              f"(fp {d['fingerprint'][:12]})", file=out)
     if d["failed_invariants"]:
         print(f"  failed invariants: "
               f"{', '.join(d['failed_invariants'])}", file=out)

@@ -9,7 +9,10 @@ harness (harness.py) that runs any cell end to end and checks
 invariants — zero silent loss, churn-budget conservation, placement
 domain diversity, SLO bounds, sampled kill/resume bit-identity — and
 named presets + seeded random cells (presets.py) swept by ``cdrs
-scenarios sweep``.
+scenarios sweep``.  On top of the matrix, search.py grows the cell set
+itself: a seeded coverage-guided mutator (``cdrs scenarios search``)
+that keeps mutants lighting up new coverage-fingerprint bits and
+delta-debugs any invariant violation down to a minimal-event repro.
 
 Why a matrix and not more hand-picked configs: CRUSH (Weil et al., SC
 2006 — PAPERS.md) argues placement properties must hold across the
@@ -22,8 +25,14 @@ invariant-gated sweep provides.  Every cell is seeded and every failing
 cell prints a one-line repro command.
 """
 
-from .harness import run_cell
+from .harness import coverage_bits, run_cell
 from .presets import PRESETS, SUITES, preset, random_cell, suite_cells
+from .search import (
+    distill_corpus,
+    mutate_spec,
+    run_search,
+    shrink_cell,
+)
 from .spec import ScenarioSpec
 from .sweep import run_sweep
 
@@ -31,9 +40,14 @@ __all__ = [
     "PRESETS",
     "SUITES",
     "ScenarioSpec",
+    "coverage_bits",
+    "distill_corpus",
+    "mutate_spec",
     "preset",
     "random_cell",
     "run_cell",
+    "run_search",
     "run_sweep",
+    "shrink_cell",
     "suite_cells",
 ]

@@ -65,7 +65,8 @@ from ..sim.access import (
 from ..sim.generator import generate_population
 from .spec import ScenarioSpec
 
-__all__ = ["build_events", "build_schedule", "run_cell", "repro_line"]
+__all__ = ["build_events", "build_schedule", "coverage_bits", "run_cell",
+           "repro_line"]
 
 _DEFAULT_FLIP = {"hot": "archival", "archival": "hot"}
 
@@ -570,6 +571,83 @@ def _daemon_invariants(spec: ScenarioSpec, manifest: Manifest,
     return inv
 
 
+#: Durability tiers a cell can ENTER (any window with the tally > 0) —
+#: one coverage bit each.  The blind tiers plus the integrity layer's
+#: ``true_lost`` (clean copies below the survivable minimum).
+_COVERAGE_TIERS = ("lost", "at_risk", "under_replicated", "unreachable",
+                   "correlated_risk")
+#: Repair-outcome branches (window-record counters > 0): each is an
+#: error-handling path Yuan et al.'s catastrophic failures hide in.
+_COVERAGE_REPAIR = ("repair_failed", "repair_rebalanced",
+                    "repair_corrupt_sources", "repair_deferred_budget",
+                    "repair_deferred_backoff", "repair_deferred_no_source",
+                    "repair_deferred_no_target",
+                    "repair_deferred_partition")
+
+
+def coverage_bits(records: list[dict], inv: dict,
+                  alerts_fired: set) -> list[str]:
+    """The cell's coverage fingerprint bits — the behaviour the run
+    actually exhibited, extracted from what the window records, alert
+    evaluation and invariant gating already capture (nothing new is
+    instrumented):
+
+    * ``fault:<kind>``   — a fault event of that kind APPLIED in-window,
+    * ``tier:<name>``    — a durability tier entered (incl. true_lost),
+    * ``repair:<branch>``— a repair outcome/deferral branch taken,
+    * ``degraded:*`` / ``scrub:*`` / ``integrity:detected_*`` — degraded
+      modes and detection paths hit,
+    * ``serve:*`` / ``recluster:<trigger>`` — read-path and re-plan
+      behaviour observed,
+    * ``cause:<name>``   — a lineage cause consumed churn budget,
+    * ``alert:<name>``   — an alert rule fired,
+    * ``inv:<name>``     — an invariant branch evaluated non-vacuously
+      (the conditional gates only materialize when their machinery ran).
+
+    Sorted and deterministic; the search (scenarios/search.py) unions
+    these across a corpus and chases cells that light up new bits.
+    """
+    bits: set[str] = set()
+    for r in records:
+        for ev in r.get("fault_events") or ():
+            bits.add("fault:" + str(ev).split(":", 1)[0])
+        d = r.get("durability")
+        if d:
+            for tier in _COVERAGE_TIERS:
+                if d.get(tier, 0):
+                    bits.add("tier:" + tier)
+        integ = r.get("integrity")
+        if integ:
+            if integ.get("true_lost", 0):
+                bits.add("tier:true_lost")
+            for k in ("detected_scrub", "detected_read",
+                      "detected_repair"):
+                if integ.get(k, 0):
+                    bits.add("integrity:" + k)
+        for k in _COVERAGE_REPAIR:
+            if r.get(k, 0):
+                bits.add("repair:" + k[len("repair_"):])
+        if r.get("degraded_kernel"):
+            bits.add("degraded:kernel_fallback")
+        sc = r.get("scrub")
+        if sc:
+            if sc.get("corrupt_found", 0):
+                bits.add("scrub:detected")
+            if sc.get("starved"):
+                bits.add("scrub:starved")
+        if r.get("recluster"):
+            bits.add("recluster:" + str(r.get("recluster_trigger")))
+        if int(r.get("reads_unavailable", 0) or 0):
+            bits.add("serve:unavailable")
+        if r.get("hotspot_files"):
+            bits.add("serve:hotspot")
+        for cause in r.get("causes") or ():
+            bits.add("cause:" + cause)
+    bits.update("alert:" + a for a in alerts_fired)
+    bits.update("inv:" + k for k in inv)
+    return sorted(bits)
+
+
 def repro_line(spec: ScenarioSpec, suite: str | None = None,
                suite_seed: int = 0) -> str:
     """One line that reruns exactly this cell.  The suite form carries
@@ -687,6 +765,9 @@ def run_cell(spec: ScenarioSpec, *, suite: str | None = None,
             "value": float(metrics["latency_p99_ms_final"]), "unit": "ms",
             "backend": "numpy",
         })
+    from ..obs.aggregate import coverage_fingerprint
+
+    coverage = coverage_bits(records, inv, alerts_fired)
     return {
         "cell": spec.name,
         "seed": spec.seed,
@@ -694,6 +775,8 @@ def run_cell(spec: ScenarioSpec, *, suite: str | None = None,
         "invariants": inv,
         "ok": all(inv.values()),
         "metrics": metrics,
+        "coverage": coverage,
+        "fingerprint": coverage_fingerprint(coverage),
         "bench_records": bench_records,
         "seconds": round(time.perf_counter() - t0, 3),
         "repro": repro_line(spec, suite, suite_seed),

@@ -289,6 +289,13 @@ def _presets() -> dict[str, ScenarioSpec]:
         alerts=_alerts([], "others"))
 
     for name, spec in p.items():
+        # Generated cells own these namespaces (random_cell,
+        # scenarios/search.py): a preset named into them would alias
+        # the generated cells' scenario_<name>_* history/regress keys.
+        if name.startswith(("random-", "search-")):
+            raise ValueError(
+                f"preset {name!r} uses a reserved generated-cell "
+                f"name prefix")
         spec._preset = name
     return p
 

@@ -1,0 +1,243 @@
+"""Coverage-guided failure-space search (cdrs_tpu/scenarios/search.py):
+fault-schedule edit API round-trips, mutation determinism, coverage
+fingerprints, the ddmin shrinker oracle (designed-bad cell with a known
+2-event minimal cause), search-loop smoke + corpus banking, distill
+determinism, and the CLI surfaces (``scenarios search``, ``run --spec``
+file paths)."""
+
+import contextlib
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cdrs_tpu.cli import main as cli_main
+from cdrs_tpu.faults import FaultEvent, FaultSchedule
+from cdrs_tpu.obs.aggregate import cells_digest, coverage_fingerprint
+from cdrs_tpu.scenarios import (
+    PRESETS,
+    ScenarioSpec,
+    distill_corpus,
+    mutate_spec,
+    preset,
+    run_cell,
+    run_search,
+    shrink_cell,
+)
+from cdrs_tpu.scenarios.search import (
+    RESERVED_NAME_PREFIXES,
+    load_corpus,
+    planted_violation_spec,
+    search_cell_name,
+)
+
+SEED = int(os.environ.get("CDRS_CHAOS_SEED", "0"))
+
+
+# -- fault-schedule edit API (events / from_events) --------------------------
+
+def test_events_roundtrip_property():
+    """Lossless decomposition/recomposition on seeds 0/1/2: events() ->
+    from_events is identity, and the JSON dict form round-trips too."""
+    nodes = [f"dn{i}" for i in range(1, 6)]
+    for seed in (0, 1, 2):
+        s = FaultSchedule.random(nodes, n_windows=12, seed=seed)
+        specs = [e.spec() for e in s]
+        assert specs, "random schedule should not be empty"
+        back = FaultSchedule.from_events(s.events())
+        assert [e.spec() for e in back] == specs
+        via_json = FaultSchedule.from_events(s.to_json())
+        assert [e.spec() for e in via_json] == specs
+        assert via_json.to_json() == s.to_json()
+
+
+def test_events_view_is_tuple_and_callable():
+    s = FaultSchedule.from_specs(["crash:dn1@2", "recover:dn1@4"])
+    # Back-compat: .events still behaves as the tuple attribute it was.
+    assert isinstance(s.events, tuple)
+    assert len(s.events) == 2
+    # New surface: calling it yields an independent mutable list.
+    rows = s.events()
+    assert isinstance(rows, list)
+    assert rows == list(s.events)
+    rows.pop()
+    assert len(s.events) == 2
+
+
+def test_schedule_edit_constructors():
+    s = FaultSchedule.from_specs(["crash:dn1@2", "crash:dn2@5"])
+    assert [e.spec() for e in s.drop(0)] == ["crash:dn2@5"]
+    assert [e.spec() for e in s.retime(1, 7)] == ["crash:dn1@2",
+                                                  "crash:dn2@7"]
+    spliced = s.splice(FaultEvent(window=3, kind="crash", node="dn3"))
+    assert "crash:dn3@3" in [e.spec() for e in spliced]
+    assert [e.spec() for e in s.mutate(0, node="dn4")] == \
+        ["crash:dn4@2", "crash:dn2@5"]
+
+
+# -- mutation ----------------------------------------------------------------
+
+def test_mutate_spec_deterministic_and_valid():
+    parent = preset("chaos-kill")
+    a = mutate_spec(parent, np.random.default_rng([SEED, 7]), n_ops=2)
+    b = mutate_spec(parent, np.random.default_rng([SEED, 7]), n_ops=2)
+    assert a is not None and b is not None
+    assert a[0].to_dict() == b[0].to_dict()
+    assert a[1] == b[1] and len(a[1]) >= 1
+    # Every mutant revalidates through the spec constructor.
+    ScenarioSpec.from_dict(a[0].to_dict())
+    assert a[0].to_dict() != parent.to_dict()
+
+
+# -- coverage fingerprints ---------------------------------------------------
+
+def test_run_cell_coverage_and_fingerprint():
+    res = run_cell(preset("chaos-kill"))
+    cov = res["coverage"]
+    assert cov == sorted(set(cov)) and cov
+    assert "fault:crash" in cov
+    assert any(b.startswith("inv:") for b in cov)
+    assert res["fingerprint"] == coverage_fingerprint(cov)
+    # Order/duplication-insensitive digest.
+    assert coverage_fingerprint(reversed(cov + cov[:2])) == \
+        res["fingerprint"]
+    digest = cells_digest([res])
+    assert digest["coverage_bits"] == len(cov)
+    assert digest["fingerprint"] == res["fingerprint"]
+
+
+# -- the shrinker oracle (designed-bad cell, known 2-event cause) ------------
+
+def test_shrinker_reduces_planted_cell_to_known_minimal_cause():
+    """The planted cell carries 5 events; only {corrupt dn2's copies,
+    decommission the last clean holder} is the real cause.  ddmin must
+    strip the noise spans and land on exactly those 2 events,
+    deterministically, and the emitted repro line must rerun RED
+    verbatim through the real CLI."""
+    spec = planted_violation_spec(SEED)
+    planted = run_cell(spec)
+    assert not planted["ok"]
+    assert [k for k, v in planted["invariants"].items() if not v] == \
+        ["zero_silent_loss"]
+
+    sh = shrink_cell(spec)
+    assert sh["n_events"] == 2
+    assert set(sh["events"]) == {"corrupt:dn2@3:1", "decommission:dn1@5"}
+    assert sh["failed"] == ["zero_silent_loss"]
+    again = shrink_cell(spec)
+    assert again["events"] == sh["events"]
+
+    payload = sh["repro"].split("--spec ", 1)[1].strip().strip("'")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["scenarios", "run", "--spec", payload])
+    rerun = json.loads(buf.getvalue())
+    assert rc == 1 and not rerun["ok"]
+
+
+# -- the search loop ---------------------------------------------------------
+
+@pytest.mark.slow
+def test_search_finds_new_coverage_and_banks_corpus(tmp_path):
+    corpus = str(tmp_path / "corpus")
+    got = run_search(seed=SEED, budget_cells=12, corpus_dir=corpus,
+                     shrink=False)
+    assert got["new_coverage_cells"] >= 1
+    assert got["coverage_bits"] > got["baseline_bits"]
+    for entry in got["kept"]:
+        assert entry["name"] == search_cell_name(SEED,
+                                                 entry["fingerprint"])
+        assert entry["new_bits"]
+    banked = load_corpus(corpus)
+    assert [e["name"] for e in banked] == \
+        sorted(e["name"] for e in got["kept"])
+    # Deterministic: the unbanked A/B mode replays the same trajectory.
+    again = run_search(seed=SEED, budget_cells=12, corpus_dir="",
+                       bank=False, shrink=False)
+    assert [e["name"] for e in again["kept"]] == \
+        [e["name"] for e in got["kept"]]
+    assert again["fingerprint"] == got["fingerprint"]
+
+
+def test_distill_is_deterministic_greedy_cover():
+    entries = [
+        {"name": "c", "spec": {"name": "c"}, "coverage": ["a", "b"],
+         "seconds": 2.0},
+        {"name": "a", "spec": {"name": "a"}, "coverage": ["a", "b", "x"],
+         "seconds": 1.0},
+        {"name": "b", "spec": {"name": "b"}, "coverage": ["y"],
+         "seconds": 0.5},
+        {"name": "d", "spec": {"name": "d"}, "coverage": ["y"],
+         "seconds": 0.5},
+    ]
+    d = distill_corpus(entries)
+    assert d["names"] == ["a", "b"]  # greedy gain, then seconds, then name
+    assert d["coverage_bits"] == 4
+    assert d == distill_corpus(list(reversed(entries)))
+    assert d["fingerprint"] == coverage_fingerprint(["a", "b", "x", "y"])
+
+
+# -- namespaces (search cells can never alias presets) -----------------------
+
+def test_generated_cell_name_prefixes_are_reserved():
+    assert not any(n.startswith(RESERVED_NAME_PREFIXES) for n in PRESETS)
+    name = search_cell_name(SEED, "deadbeefcafe")
+    assert name == f"search-s{SEED}-deadbeef"
+    assert name.startswith("search-")
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def _cli(argv):
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        rc = cli_main(argv)
+    return rc, out.getvalue(), err.getvalue()
+
+
+def test_cli_run_spec_accepts_file_and_banked_entry(tmp_path):
+    path = tmp_path / "cell.json"
+    path.write_text(json.dumps(preset("chaos-kill").to_dict()))
+    rc, out, _ = _cli(["scenarios", "run", "--spec", str(path)])
+    assert rc == 0
+    assert json.loads(out)["cell"] == "chaos-kill"
+    # A banked corpus entry (spec wrapped under "spec") runs as-is.
+    wrapped = tmp_path / "entry.json"
+    wrapped.write_text(json.dumps(
+        {"name": "w", "coverage": [], "spec":
+         preset("chaos-kill").to_dict()}))
+    rc, out, _ = _cli(["scenarios", "run", "--spec", str(wrapped)])
+    assert rc == 0 and json.loads(out)["ok"]
+
+
+def test_cli_run_spec_file_errors_name_the_path(tmp_path):
+    missing = str(tmp_path / "nope.json")
+    rc, _, err = _cli(["scenarios", "run", "--spec", missing])
+    assert rc == 2
+    assert "cannot read spec file" in err and "nope.json" in err
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"name": "x", "wat": 1}')
+    rc, _, err = _cli(["scenarios", "run", "--spec", str(bad)])
+    assert rc == 2
+    assert "invalid scenario spec" in err and "bad.json" in err
+
+
+@pytest.mark.slow
+def test_cli_search_smoke_writes_corpus_and_distills(tmp_path):
+    corpus = str(tmp_path / "corpus")
+    rc, out, err = _cli(["scenarios", "search", "--seed", str(SEED),
+                         "--budget-cells", "12", "--corpus", corpus,
+                         "--distill"])
+    assert rc == 0
+    digest = json.loads(out)
+    assert digest["new_coverage_cells"] >= 1
+    assert digest["coverage_bits"] > digest["baseline_bits"]
+    dist = json.load(open(os.path.join(corpus, "distilled.json")))
+    assert dist["names"] and dist["coverage_bits"] > 0
+    # Every distilled cell must rerun green straight from the bank.
+    first = os.path.join(corpus, f"{dist['names'][0]}.json")
+    if os.path.exists(first):
+        rc, out, _ = _cli(["scenarios", "run", "--spec", first])
+        assert rc == 0
