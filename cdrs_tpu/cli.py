@@ -571,8 +571,42 @@ def _cmd_daemon(args) -> int:
     import contextlib
 
     from .control import ReplicationController
-    from .daemon import DaemonConfig, StreamDaemon
+    from .daemon import BrownoutConfig, DaemonConfig, StreamDaemon
     from .io.events import Manifest
+
+    if args.supervise:
+        # Re-exec ourselves as the supervised child, minus the
+        # supervision flags (the child must not recurse into a
+        # supervisor of its own).
+        from .daemon import supervise as _supervise
+
+        drop = ("--supervise", "--max_restarts")
+        child, skip = [], False
+        for tok in sys.argv[1:]:
+            if skip:
+                skip = False
+                continue
+            if tok in drop:
+                skip = (tok == "--max_restarts")
+                continue
+            if tok.startswith("--max_restarts="):
+                continue
+            child.append(tok)
+        return _supervise([sys.executable, "-m", "cdrs_tpu"] + child,
+                          max_restarts=args.max_restarts)
+
+    brownout = None
+    if args.brownout:
+        kw = {}
+        if args.brownout_engage:
+            kw["engage"] = tuple(
+                float(x) for x in args.brownout_engage.split(","))
+        if args.brownout_release:
+            kw["release"] = tuple(
+                float(x) for x in args.brownout_release.split(","))
+        if args.shed_fraction is not None:
+            kw["shed_fraction"] = args.shed_fraction
+        brownout = BrownoutConfig(**kw)
 
     manifest = Manifest.read_csv(args.manifest)
     controller = ReplicationController(manifest, _controller_cfg(args))
@@ -580,7 +614,8 @@ def _cmd_daemon(args) -> int:
         follow=args.follow, poll=args.poll,
         checkpoint_every=args.checkpoint_every,
         max_windows=args.max_windows, max_seconds=args.max_seconds,
-        recluster=args.recluster, minibatch_rows=args.minibatch_rows))
+        recluster=args.recluster, minibatch_rows=args.minibatch_rows,
+        brownout=brownout))
     daemon.install_signal_handlers()
     with contextlib.ExitStack() as stack:
         if args.http:
@@ -1086,6 +1121,33 @@ def _cmd_scenarios(args) -> int:
                   file=sys.stderr)
         return 0
 
+    if args.action == "triage":
+        from .scenarios.search import triage_corpus
+
+        out = triage_corpus(
+            args.corpus,
+            progress=lambda line: print(line, file=sys.stderr,
+                                        flush=True))
+        if args.out:
+            parent = os.path.dirname(args.out)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(args.out, "w", encoding="utf-8") as f:
+                json.dump(out, f, indent=2, sort_keys=True)
+                f.write("\n")
+        print(json.dumps({k: out[k] for k in (
+            "names", "n_violations", "ok", "seconds")}, indent=2))
+        if not out["ok"]:
+            # A still-red violation means the bug it banked is NOT
+            # fixed: do not promote, do fail the build.
+            for r in out["results"]:
+                if not r["ok"]:
+                    print(f"STILL RED: {r['name']} "
+                          f"({','.join(r['failed'])})\n"
+                          f"  repro: {r['repro']}", file=sys.stderr)
+            return 1
+        return 0
+
     # sweep
     from .scenarios.sweep import format_cell_line, run_sweep
 
@@ -1093,6 +1155,7 @@ def _cmd_scenarios(args) -> int:
         out = run_sweep(
             args.suite, seed=args.seed, round_no=args.round_no,
             history=args.history or None,
+            extra=args.extra_cells or None,
             progress=lambda line: print(line, file=sys.stderr,
                                         flush=True))
     except ValueError as e:
@@ -1476,6 +1539,32 @@ def main(argv: list[str] | None = None) -> int:
                         "/healthz, /readyz, /statusz, /debug/trace — "
                         "off the decision path; port 0 binds an "
                         "ephemeral port (printed to stderr)")
+    p.add_argument("--brownout", action="store_true",
+                   help="engage the overload brownout ladder "
+                        "(daemon/brownout.py): as decision lag crosses "
+                        "each rung's threshold, shed optional work in "
+                        "fixed order (skip minibatch -> defer scrub -> "
+                        "cap trace exemplars -> coalesce windows -> "
+                        "shed a bounded fraction of reads), recovering "
+                        "hysteretically in reverse")
+    p.add_argument("--brownout_engage", default=None, metavar="CSV",
+                   help="5 comma-separated lag-window thresholds, one "
+                        "per rung (default 2,3,4,6,8)")
+    p.add_argument("--brownout_release", default=None, metavar="CSV",
+                   help="5 release thresholds, each strictly below its "
+                        "engage threshold (default 1,1.5,2,3,4)")
+    p.add_argument("--shed_fraction", type=float, default=None,
+                   metavar="F",
+                   help="fraction of reads rejected while the shed_reads "
+                        "rung is engaged (default 0.2)")
+    p.add_argument("--supervise", action="store_true",
+                   help="run under the crash supervisor "
+                        "(daemon/supervise.py): restart on abnormal "
+                        "exit with capped exponential backoff — safe "
+                        "because a killed daemon resumes bit-identically "
+                        "from its last durable cursor")
+    p.add_argument("--max_restarts", type=int, default=5, metavar="N",
+                   help="give up after N consecutive crash-restarts")
     p.set_defaults(fn=_cmd_daemon)
 
     p = sub.add_parser("chaos", help="fault-injected controller run: node "
@@ -1658,14 +1747,18 @@ def main(argv: list[str] | None = None) -> int:
                        "suite gated on invariants (zero silent loss, "
                        "churn budget, domain diversity, SLO, sampled "
                        "kill/resume bit-identity)")
-    p.add_argument("action", choices=["list", "run", "sweep", "search"],
+    p.add_argument("action",
+                   choices=["list", "run", "sweep", "search", "triage"],
                    help="list = named presets + suites; run = one cell "
                         "(--preset / --suite+--cell / --spec); sweep = "
                         "every cell of --suite, nonzero exit on any "
                         "invariant failure; search = seeded coverage-"
                         "guided failure-space search (mutate corpus "
                         "cells, keep new-coverage ones, shrink "
-                        "violations to minimal repros)")
+                        "violations to minimal repros); triage = rerun "
+                        "every banked violation and promote the green "
+                        "ones into regression-locked triage-* cells "
+                        "(nonzero exit while any still reproduces)")
     p.add_argument("--suite", default="ci-smoke",
                    help="cell suite (default ci-smoke; see 'scenarios "
                         "list')")
@@ -1681,8 +1774,16 @@ def main(argv: list[str] | None = None) -> int:
                    help="(run) an inline spec JSON object or a path to "
                         "one")
     p.add_argument("--out", default=None, metavar="JSON",
-                   help="(sweep) write the full sweep artifact here "
-                        "(per-cell invariants, metrics, bench_records)")
+                   help="(sweep/triage) write the full artifact here "
+                        "(sweep: per-cell invariants, metrics, "
+                        "bench_records; triage: the promoted cell file "
+                        "for --extra_cells)")
+    p.add_argument("--extra_cells", action="append", default=None,
+                   metavar="JSON",
+                   help="(sweep) corpus cell file(s) to ride along with "
+                        "the suite — distilled.json / triage.json "
+                        "({'cells': [...], 'names': [...]}); pinned "
+                        "repros, never seed-shifted; repeatable")
     p.add_argument("--round", type=int, default=None, dest="round_no",
                    help="(sweep) PR-round stamp: appends the per-cell "
                         "bench_records to --history (regress."
@@ -1706,9 +1807,9 @@ def main(argv: list[str] | None = None) -> int:
                         "seeded sequence (the nightly-soak bound)")
     p.add_argument("--corpus", default="data/search_corpus",
                    metavar="DIR",
-                   help="(search) corpus directory: banked cells seed "
-                        "the next run's frontier; violations land under "
-                        "violations/ with shrunk repro lines")
+                   help="(search/triage) corpus directory: banked cells "
+                        "seed the next run's frontier; violations land "
+                        "under violations/ with shrunk repro lines")
     p.add_argument("--base", default=None, metavar="P1,P2,...",
                    help="(search) comma-separated preset names seeding "
                         "the corpus (default: the cheap cross-domain "

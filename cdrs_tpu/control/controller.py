@@ -611,6 +611,18 @@ class ReplicationController:
         #: instead of silently dropping it.
         self._last_window_events = 0
         self._t0: float | None = None
+        #: Degraded-mode levers the streaming daemon's brownout ladder
+        #: (daemon/brownout.py) pulls: a subset of its rung names.  The
+        #: controller only honours the two that change ITS work —
+        #: ``defer_scrub`` (skip the window's verification pass; known
+        #: damage still heals) and ``shed_reads`` (via ``serve_shed``).
+        #: Always empty outside a brownout-enabled daemon, so batch
+        #: records stay byte-identical.
+        self.degraded_modes: frozenset = frozenset()
+        #: Serve-path load shedding, ``(fraction, seed)`` or None: a
+        #: seeded per-window draw drops that fraction of the window's
+        #: reads BEFORE routing — an explicit shed, not a timeout.
+        self.serve_shed: tuple | None = None
 
     def _make_model(self, warm: bool,
                     backend: str | None = None) -> ReplicationPolicyModel:
@@ -966,24 +978,39 @@ class ReplicationController:
         # churn budget, capped by its own bytes_per_window rate; its
         # quarantines surface in the NEXT window's repair sync.
         if self._scrub is not None:
-            t0 = time.perf_counter()
-            left = None
-            if cfg.max_bytes_per_window is not None:
-                left = max(int(cfg.max_bytes_per_window) - bytes_reserved, 0)
-            sr = self._scrub.run_window(w, self._cluster_state,
-                                        shared_left=left)
-            seconds["scrub"] = time.perf_counter() - t0
-            plan_seconds += seconds["scrub"]
-            rec["scrub"] = {
-                "bytes": int(sr.bytes_used),
-                "copies_verified": sr.copies_verified,
-                "files_verified": sr.files_verified,
-                "corrupt_found": sr.corrupt_found,
-                "hinted": sr.hinted,
-                "starved": bool(sr.starved),
-                "cursor": int(sr.cursor),
-            }
-            bytes_reserved += sr.bytes_used
+            if "defer_scrub" in self.degraded_modes:
+                # Brownout rung: the verification pass is optional work
+                # — skip it wholesale (cursor and hints hold, so the
+                # lap resumes exactly where it paused once the ladder
+                # releases).  Deferral is not starvation: the budget
+                # was never offered.
+                rec["scrub"] = {
+                    "bytes": 0, "copies_verified": 0,
+                    "files_verified": 0, "corrupt_found": 0,
+                    "hinted": 0, "starved": False,
+                    "cursor": int(self._scrub.cursor),
+                    "deferred": True,
+                }
+            else:
+                t0 = time.perf_counter()
+                left = None
+                if cfg.max_bytes_per_window is not None:
+                    left = max(int(cfg.max_bytes_per_window)
+                               - bytes_reserved, 0)
+                sr = self._scrub.run_window(w, self._cluster_state,
+                                            shared_left=left)
+                seconds["scrub"] = time.perf_counter() - t0
+                plan_seconds += seconds["scrub"]
+                rec["scrub"] = {
+                    "bytes": int(sr.bytes_used),
+                    "copies_verified": sr.copies_verified,
+                    "files_verified": sr.files_verified,
+                    "corrupt_found": sr.corrupt_found,
+                    "hinted": sr.hinted,
+                    "starved": bool(sr.starved),
+                    "cursor": int(sr.cursor),
+                }
+                bytes_reserved += sr.bytes_used
 
         t0 = time.perf_counter()
         applied = self.scheduler.schedule(w, bytes_reserved=bytes_reserved,
@@ -1081,6 +1108,23 @@ class ReplicationController:
             t0 = time.perf_counter()
             from ..serve import read_view
 
+            reads_shed = 0
+            if self.serve_shed is not None and read_pid.shape[0]:
+                # Brownout load shedding: reject a seeded, bounded
+                # fraction of the window's reads with an explicit shed
+                # status BEFORE they queue — the Tail-at-Scale move of
+                # bounding p99 by refusing work, made reproducible by
+                # drawing from ``[shed_seed, window]`` exactly like the
+                # router's own arrival jitter.
+                frac, shed_seed = self.serve_shed
+                srng = np.random.default_rng([int(shed_seed), int(w)])
+                keep_r = srng.random(read_pid.shape[0]) >= float(frac)
+                if keep_r.any() and not keep_r.all():
+                    reads_shed = int(read_pid.shape[0]
+                                     - int(keep_r.sum()))
+                    read_pid = read_pid[keep_r]
+                    read_ts = read_ts[keep_r]
+                    read_client = read_client[keep_r]
             if self._cluster_state is not None:
                 view = read_view(read_pid, state=self._cluster_state)
                 if not self._integrity_on:
@@ -1128,6 +1172,11 @@ class ReplicationController:
                 extra_ms=extra_ms, edge_ms=self._edge_ms,
                 slot_corrupt=view.slot_corrupt)
             rec.update(res.record_fields())
+            if self.serve_shed is not None:
+                # Conditional key: only brownout-enabled daemon runs
+                # carry it, so every pinned batch record stays
+                # byte-identical.
+                rec["reads_shed"] = reads_shed
             if res.corrupt_pairs is not None and len(res.corrupt_pairs):
                 # Detect-on-read feedback: quarantine the rotten copies
                 # the window's reads tripped over, and hint the scrubber

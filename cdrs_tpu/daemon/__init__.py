@@ -21,9 +21,12 @@ stops.  Three pieces:
   O(new data) instead of re-reading history.
 """
 
+from .brownout import RUNGS, BrownoutConfig, BrownoutLadder
 from .core import DaemonConfig, StreamDaemon
 from .epochs import EpochPublisher, PlacementEpoch
+from .supervise import supervise
 from .tailer import TailBatch, tail_binary_log
 
 __all__ = ["DaemonConfig", "StreamDaemon", "EpochPublisher",
-           "PlacementEpoch", "TailBatch", "tail_binary_log"]
+           "PlacementEpoch", "TailBatch", "tail_binary_log",
+           "RUNGS", "BrownoutConfig", "BrownoutLadder", "supervise"]

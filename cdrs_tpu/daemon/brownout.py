@@ -1,0 +1,164 @@
+"""Brownout ladder: declarative degraded-mode state machine under load.
+
+Dean & Barroso (PAPERS.md, *The Tail at Scale*) argue tail tolerance is
+*designed* degradation: a system that cannot keep up must shed optional
+work in a deliberate order, not let queues (and p99) run away.  The
+daemon's load signal is **decision lag** — how far the window loop has
+fallen behind the log head, measured in windows (daemon/core.py
+``_update_lag``) — and this module turns it into a five-rung ladder of
+progressively harsher sheds, engaged in fixed order as lag crosses each
+rung's threshold and released **hysteretically** in reverse order:
+
+====================  =====================================================
+rung                  what it sheds
+====================  =====================================================
+``skip_minibatch``    the observability-only mini-batch Lloyd step
+``defer_scrub``       background verification reads (known damage still
+                      heals: repair keeps its budget priority)
+``cap_trace``         span-tree exemplar retention (stage sums survive)
+``coalesce``          window granularity: pending blocks merge onto the
+                      grid, one decision per ``coalesce_max`` windows
+``shed_reads``        serve-path load shedding: a bounded, seeded
+                      fraction of reads rejected with an explicit
+                      ``shed`` status instead of queueing
+====================  =====================================================
+
+The ladder is deliberately boring: pure function of the lag series
+(plus the optional SLO-burn trip wire for the serve rung), no wall
+clock, no RNG beyond the seeded shed draw the controller makes — so the
+same log replays the same rung transitions, and the level/calm pair
+checkpoints in the daemon's meta blob for bit-identical resume.
+
+Hysteresis: rung *i* engages at ``engage[i]`` lag-windows and is only
+released after ``hold`` consecutive windows at/below ``release[i]``
+(strictly below the engage threshold), top rung first — the standard
+two-threshold + dwell-time guard against flapping at a boundary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["RUNGS", "BrownoutConfig", "BrownoutLadder"]
+
+#: Shed order, mildest first.  ``modes()`` returns the engaged prefix.
+RUNGS = ("skip_minibatch", "defer_scrub", "cap_trace", "coalesce",
+         "shed_reads")
+
+
+@dataclass(frozen=True)
+class BrownoutConfig:
+    """Thresholds of the ladder, in decision-lag windows per rung."""
+
+    #: Lag (windows behind the log head) at which each rung engages.
+    engage: tuple = (2.0, 3.0, 4.0, 6.0, 8.0)
+    #: Lag at/below which a rung counts a calm window toward release.
+    #: Must sit strictly below the matching engage threshold.
+    release: tuple = (1.0, 1.5, 2.0, 3.0, 4.0)
+    #: Consecutive calm windows before ONE rung releases (dwell time).
+    hold: int = 2
+    #: Serve-path shed: fraction of the window's reads rejected while
+    #: the ``shed_reads`` rung is engaged (seeded, bounded).
+    shed_fraction: float = 0.2
+    #: Seed of the per-window shed draw (controller's rng stream is
+    #: ``[shed_seed, window]`` — decision-reproducible).
+    shed_seed: int = 0
+    #: Max consecutive windows merged per decision under ``coalesce``.
+    coalesce_max: int = 4
+    #: Optional serve trip wire: SLO burn at/above this also engages
+    #: the ladder through ``shed_reads`` (None = lag-only).
+    burn_engage: float | None = None
+
+    def __post_init__(self):
+        n = len(RUNGS)
+        if len(self.engage) != n or len(self.release) != n:
+            raise ValueError(
+                f"brownout thresholds must cover all {n} rungs, got "
+                f"engage={len(self.engage)} release={len(self.release)}")
+        if any(e2 < e1 for e1, e2 in zip(self.engage, self.engage[1:])):
+            raise ValueError(
+                f"engage thresholds must be non-decreasing (the ladder "
+                f"engages in rung order), got {self.engage}")
+        if any(r >= e for r, e in zip(self.release, self.engage)):
+            raise ValueError(
+                f"each release threshold must sit strictly below its "
+                f"engage threshold (hysteresis), got "
+                f"release={self.release} engage={self.engage}")
+        if self.hold < 1:
+            raise ValueError(f"hold must be >= 1, got {self.hold}")
+        if not 0.0 < self.shed_fraction < 1.0:
+            raise ValueError(
+                f"shed_fraction must be in (0, 1), got "
+                f"{self.shed_fraction}")
+        if self.coalesce_max < 2:
+            raise ValueError(
+                f"coalesce_max must be >= 2 (1 is no coalescing), got "
+                f"{self.coalesce_max}")
+
+
+@dataclass
+class BrownoutLadder:
+    """The live state machine: one :meth:`step` per processed window."""
+
+    cfg: BrownoutConfig = field(default_factory=BrownoutConfig)
+    #: Engaged rung count (0 = fully healthy; modes() = RUNGS[:level]).
+    level: int = 0
+    #: Consecutive calm windows toward the next release.
+    calm: int = 0
+
+    def modes(self) -> frozenset:
+        """The engaged degraded modes (prefix of :data:`RUNGS`)."""
+        return frozenset(RUNGS[:self.level])
+
+    def step(self, window: int, lag_windows: float,
+             slo_burn: float = 0.0) -> list[dict]:
+        """Advance one window; returns the rung transitions it caused
+        (``{"rung", "level", "state": "engage"|"release", "window",
+        "lag_windows"}`` dicts, engage-order)."""
+        cfg = self.cfg
+        lag = float(lag_windows)
+        out: list[dict] = []
+        want = 0
+        for i, thr in enumerate(cfg.engage):
+            if lag >= thr:
+                want = i + 1
+        if cfg.burn_engage is not None \
+                and float(slo_burn) >= float(cfg.burn_engage):
+            # The serve trip wire engages the WHOLE ladder: if p99 is
+            # burning the error budget, every milder shed is already
+            # justified.
+            want = len(RUNGS)
+        if want > self.level:
+            # Engage upward, possibly several rungs in one window (a
+            # lag spike does not wait for one-rung-per-window manners).
+            for lv in range(self.level + 1, want + 1):
+                out.append({"rung": RUNGS[lv - 1], "level": lv,
+                            "state": "engage", "window": int(window),
+                            "lag_windows": round(lag, 3)})
+            self.level = want
+            self.calm = 0
+            return out
+        # Release path: hysteretic, ONE rung per dwell period, reverse
+        # order — recovery is deliberately slower than degradation.
+        if self.level and lag <= cfg.release[self.level - 1] \
+                and (cfg.burn_engage is None
+                     or float(slo_burn) < float(cfg.burn_engage)):
+            self.calm += 1
+            if self.calm >= cfg.hold:
+                self.level -= 1
+                self.calm = 0
+                out.append({"rung": RUNGS[self.level],
+                            "level": self.level, "state": "release",
+                            "window": int(window),
+                            "lag_windows": round(lag, 3)})
+        else:
+            self.calm = 0
+        return out
+
+    # -- checkpoint (rides the daemon's meta blob) --------------------------
+    def state_dict(self) -> dict:
+        return {"level": int(self.level), "calm": int(self.calm)}
+
+    def load_state_dict(self, d: dict) -> None:
+        self.level = min(max(int(d.get("level", 0)), 0), len(RUNGS))
+        self.calm = max(int(d.get("calm", 0)), 0)

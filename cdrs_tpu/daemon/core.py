@@ -54,11 +54,12 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ..control.windows import _slice, iter_windows
+from ..control.windows import _concat, _slice, iter_windows
 from ..io.events import EventLog, is_binary_log
 from ..obs.alerts import SEVERE_ALERTS, AlertEngine, default_rules
 from ..obs.telemetry import HIST_RAW_CAP
 from ..obs.trace import STAGE_ORDER, build_span_tree, decision_trace_id
+from .brownout import RUNGS, BrownoutConfig, BrownoutLadder
 from .epochs import EpochPublisher, PlacementEpoch
 from .tailer import tail_binary_log
 
@@ -96,6 +97,13 @@ class DaemonConfig:
     #: obs/trace.py).  0 disables exemplar trees; tracing itself rides
     #: the metrics sink, not this knob.
     trace_exemplars: int = 8
+    #: Overload brownout ladder (daemon/brownout.py): when set, decision
+    #: lag drives the degraded-mode state machine — minibatch skip,
+    #: scrub deferral, trace capping, deterministic window coalescing,
+    #: seeded serve-path shedding.  None (the default) keeps every
+    #: existing run bit-identical: lag is still measured and exposed,
+    #: but nothing is ever shed or coalesced.
+    brownout: BrownoutConfig | None = None
 
     def __post_init__(self):
         if self.recluster not in _RECLUSTER_MODES:
@@ -183,6 +191,28 @@ class StreamDaemon:
         self._bytes_migrated = 0
         self._stage_ns: dict[str, int] = {}
         self._source_path: str | None = None
+        # Lag accounting (first-class overload signal): how far the
+        # resume cursor trails the log head, re-measured after every
+        # processed window — bytes are exact, blocks/seconds are
+        # estimated from the consumed log's own block-size and
+        # timestamp-density averages, so the whole vector is a
+        # deterministic function of (log contents, cursor).
+        self._lag = {"bytes": 0, "blocks": 0.0, "seconds": 0.0,
+                     "windows": 0.0}
+        self._bytes_ingested = 0
+        self._blocks_ingested = 0
+        self._counted_upto = 0
+        self._ts_first: float | None = None
+        self._ts_last: float | None = None
+        # Brownout ladder (cfg.brownout): built here so its level/calm
+        # state can be restored from the checkpoint before run().
+        self._ladder = (None if self.cfg.brownout is None
+                        else BrownoutLadder(self.cfg.brownout))
+        self._degraded: frozenset = frozenset()
+        self._exemplar_cap = int(self.cfg.trace_exemplars)
+        self.brownout_log: list[dict] = []
+        self.windows_coalesced = 0
+        self.reads_shed_total = 0
 
     # -- lifecycle ---------------------------------------------------------
     def attach_http(self, server) -> None:
@@ -246,6 +276,22 @@ class StreamDaemon:
                 start_offset=int(self._cursor["offset"]),
                 ingest_box=self._ingest_box)
             for ev, off, nxt in stream:
+                # Lag calibration: block size and timestamp density of
+                # everything CONSUMED (skipped blocks included — they
+                # are log mass too), before any slicing below.  The
+                # high-water mark keeps a resumed daemon (which re-reads
+                # inflight blocks past the cursor) from double-counting
+                # calibration mass — the estimator must equal the
+                # uninterrupted run's at every decision point, or the
+                # brownout ladder would diverge on resume.
+                if int(off) >= self._counted_upto:
+                    self._bytes_ingested += int(nxt - off)
+                    self._blocks_ingested += 1
+                    self._counted_upto = int(nxt)
+                    if len(ev):
+                        if self._ts_first is None:
+                            self._ts_first = float(ev.ts[0])
+                        self._ts_last = float(ev.ts[-1])
                 base = 0
                 if skip:
                     take = min(skip, len(ev))
@@ -275,6 +321,10 @@ class StreamDaemon:
             if self._ingest_stop():
                 return
             n = len(ev)
+            if n:
+                if self._ts_first is None:
+                    self._ts_first = float(ev.ts[0])
+                self._ts_last = float(ev.ts[-1])
             if skip:
                 take = min(skip, n)
                 skip -= take
@@ -310,6 +360,121 @@ class StreamDaemon:
         self._inflight = keep
         off, sk = cursor if cursor is not None else self._tail
         self._cursor = {"offset": int(off), "skip": int(sk)}
+
+    # -- overload: lag + brownout ------------------------------------------
+    def _update_lag(self, w: int) -> None:
+        """Decision lag after window ``w`` closed: how far the log head
+        is ahead of the resume cursor — bytes (exact), blocks and
+        stream-seconds (estimated from the consumed log's own block
+        size / timestamp density averages), and windows (seconds over
+        the grid).  Every input is a function of (log contents, cursor),
+        never of wall clock: the determinism the coalescing contract
+        (same log + same lag profile => same merged windows) rests on.
+        Feed sources have no byte head; their lag comes from the
+        buffered-but-unprocessed timestamp span only."""
+        ctl = self.controller
+        W = float(ctl.cfg.window_seconds)
+        w_end = float(ctl._t0) + (w + 1) * W
+        lag_bytes = 0
+        if self._source_path is not None:
+            try:
+                lag_bytes = max(
+                    0, os.path.getsize(self._source_path)
+                    - int(self._cursor["offset"]))
+            except OSError:
+                pass
+        lag_blocks = 0.0
+        lag_seconds = 0.0
+        if lag_bytes and self._blocks_ingested and self._bytes_ingested:
+            lag_blocks = lag_bytes / max(
+                self._bytes_ingested / self._blocks_ingested, 1.0)
+            if self._ts_first is not None \
+                    and self._ts_last > self._ts_first:
+                lag_seconds = lag_bytes * (
+                    (self._ts_last - self._ts_first)
+                    / self._bytes_ingested)
+        # Buffered-but-unprocessed events trail the head too — on short
+        # logs their exact span beats the byte-rate estimate.
+        buf_last = None
+        for fl in self._inflight:
+            if len(fl.ts):
+                t = float(fl.ts[-1])
+                buf_last = t if buf_last is None else max(buf_last, t)
+        if buf_last is not None and buf_last > w_end:
+            lag_seconds = max(lag_seconds, buf_last - w_end)
+        self._lag = {"bytes": int(lag_bytes),
+                     "blocks": float(lag_blocks),
+                     "seconds": float(lag_seconds),
+                     "windows": max(0.0, lag_seconds / W)}
+
+    def _apply_brownout(self) -> None:
+        """Install the ladder's engaged modes into the levers they pull:
+        the controller's degraded set + serve shed, and the daemon's own
+        exemplar cap.  Idempotent — called after every ladder step and
+        after a checkpoint restore."""
+        modes = self._ladder.modes()
+        self._degraded = modes
+        ctl = self.controller
+        ctl.degraded_modes = modes
+        bcfg = self.cfg.brownout
+        ctl.serve_shed = ((float(bcfg.shed_fraction),
+                          int(bcfg.shed_seed))
+                          if "shed_reads" in modes else None)
+        self._exemplar_cap = 0 if "cap_trace" in modes \
+            else int(self.cfg.trace_exemplars)
+
+    def _step_ladder(self, w: int, rec: dict, sink) -> None:
+        """One ladder step per processed window (AFTER the decision, so
+        a rung engaged here first affects the NEXT window — the modes a
+        window ran under are the ones its record reports)."""
+        for t in self._ladder.step(w, self._lag["windows"],
+                                   slo_burn=float(
+                                       rec.get("slo_burn") or 0.0)):
+            ev = {"kind": f"degraded.brownout.{t['state']}", **t}
+            self.brownout_log.append(ev)
+            if sink is not None:
+                sink.emit(ev)
+        self._apply_brownout()
+
+    def _coalesce(self, win_iter, w: int, events):
+        """Backpressure coalescing: merge up to ``coalesce_max``
+        consecutive pending windows onto the LAST window of the group
+        and decide once over the union — mass-conserving (every event
+        is folded exactly once; ``_advance_cursor`` on the last window
+        keeps the resume contract) and deterministic (group size is a
+        function of the lag vector; the merge is ``_concat`` in grid
+        order).  A window carrying fault-schedule events is never
+        merged at all: the controller applies ``for_window`` at the
+        GROUP'S last index only, so every member must be fault-free —
+        faulted windows always run alone, at their own index.  Returns
+        ``(last_w, merged_events, n_merged, pending)`` where
+        ``pending`` is a pulled-but-unmerged window for the caller to
+        process next."""
+        ctl = self.controller
+        group = min(int(self.cfg.brownout.coalesce_max),
+                    1 + int(self._lag["windows"]))
+        sched = getattr(ctl, "_fault_schedule", None)
+        if group <= 1 or (sched is not None
+                          and len(sched.for_window(w))):
+            return w, events, 1, None
+        parts = [events]
+        last = w
+        pending = None
+        while last - w + 1 < group:
+            if sched is not None and len(sched.for_window(last + 1)):
+                break   # fault boundary: never merge across it
+            try:
+                nw, nev = next(win_iter)
+            except StopIteration:
+                break
+            if nw != last + 1:   # defensive: the carver is consecutive
+                pending = (nw, nev)
+                break
+            parts.append(nev)
+            last = nw
+        if last == w:
+            return w, events, 1, pending
+        return last, _concat(parts, ctl.manifest), last - w + 1, pending
 
     # -- per-window actions ------------------------------------------------
     def _publish(self, w: int, rec: dict,
@@ -439,7 +604,10 @@ class StreamDaemon:
             "batch": {"offset": int(self._batch_cursor[0]),
                       "skip": int(self._batch_cursor[1])},
         }
-        cap = int(self.cfg.trace_exemplars)
+        # The live cap, not the configured one: the brownout ladder's
+        # ``cap_trace`` rung zeroes it while engaged (span trees are
+        # optional work; stage sums survive).
+        cap = int(self._exemplar_cap)
         exemplar = False
         if cap > 0:
             if len(self._exemplar_heap) < cap:
@@ -556,6 +724,16 @@ class StreamDaemon:
             traced_decisions=int(self.traced_decisions),
             backlog_events=backlog_events,
             backlog_bytes=int(backlog_bytes),
+            lag_bytes=int(self._lag["bytes"]),
+            lag_blocks=round(float(self._lag["blocks"]), 3),
+            lag_seconds=round(float(self._lag["seconds"]), 3),
+            lag_windows=round(float(self._lag["windows"]), 3),
+            brownout_level=(0 if self._ladder is None
+                            else int(self._ladder.level)),
+            brownout_rungs=(() if self._ladder is None
+                            else tuple(RUNGS[:self._ladder.level])),
+            reads_shed=int(self.reads_shed_total),
+            windows_coalesced=int(self.windows_coalesced),
             decision_seconds=tuple(lat),
             decision_p50_seconds=(
                 None if arr.size == 0
@@ -570,11 +748,36 @@ class StreamDaemon:
         ))
 
     def _save(self, path: str) -> None:
-        self.controller.save_checkpoint(path, extra_meta={"daemon": {
+        dmeta = {
             "offset": int(self._cursor["offset"]),
             "skip": int(self._cursor["skip"]),
             "epochs_published": int(self.publisher.published_total),
-        }})
+            # Lag-estimator calibration (block size / timestamp density
+            # averages): decision-relevant under brownout — a resumed
+            # ladder stepping on a freshly-zeroed estimator would see
+            # different lag than the uninterrupted run did.
+            "lag_est": {
+                "bytes": int(self._bytes_ingested),
+                "blocks": int(self._blocks_ingested),
+                "upto": int(self._counted_upto),
+                "ts_first": self._ts_first,
+                "ts_last": self._ts_last,
+            },
+            # The last computed lag vector: the NEXT decision's coalesce
+            # group size reads it before any window closes, so a resume
+            # must see what the uninterrupted run saw.
+            "lag": dict(self._lag),
+        }
+        if self._ladder is not None:
+            # The ladder is decision-relevant state (it gates sheds and
+            # coalescing): its level/calm pair must survive restart, or
+            # a resumed daemon would re-climb from rung 0 and make
+            # different decisions than the uninterrupted run.
+            dmeta["brownout"] = self._ladder.state_dict()
+            dmeta["windows_coalesced"] = int(self.windows_coalesced)
+            dmeta["reads_shed"] = int(self.reads_shed_total)
+        self.controller.save_checkpoint(path,
+                                        extra_meta={"daemon": dmeta})
         self.checkpoint_count += 1
 
     # -- the loop ----------------------------------------------------------
@@ -598,6 +801,23 @@ class StreamDaemon:
             self._tail = (self._cursor["offset"], self._cursor["skip"])
             self.publisher.published_total = int(
                 dmeta.get("epochs_published", 0))
+            est = dmeta.get("lag_est") or {}
+            self._bytes_ingested = int(est.get("bytes", 0))
+            self._blocks_ingested = int(est.get("blocks", 0))
+            self._counted_upto = int(est.get("upto", 0))
+            self._ts_first = est.get("ts_first")
+            self._ts_last = est.get("ts_last")
+            if dmeta.get("lag"):
+                self._lag = {k: dmeta["lag"].get(k, 0)
+                             for k in ("bytes", "blocks", "seconds",
+                                       "windows")}
+            if self._ladder is not None:
+                self._ladder.load_state_dict(
+                    dmeta.get("brownout") or {})
+                self.windows_coalesced = int(
+                    dmeta.get("windows_coalesced", 0))
+                self.reads_shed_total = int(dmeta.get("reads_shed", 0))
+                self._apply_brownout()
         sink = None
         own_sink = False
         tel = None
@@ -628,11 +848,25 @@ class StreamDaemon:
         every = max(1, int(cfg.checkpoint_every))
         since_ckpt = 0
         t0_box: dict = {}
+        win_iter = iter_windows(
+            self._batches(source, batch_size), ctl.manifest,
+            ctl.cfg.window_seconds, batch_size=batch_size,
+            t0=ctl._t0, t0_out=t0_box)
+        #: A window the coalescer pulled but could not merge (fault
+        #: boundary / group full): processed on the next iteration.
+        pending: tuple | None = None
         try:
-            for w, events in iter_windows(
-                    self._batches(source, batch_size), ctl.manifest,
-                    ctl.cfg.window_seconds, batch_size=batch_size,
-                    t0=ctl._t0, t0_out=t0_box):
+            while True:
+                if pending is not None:
+                    w, events = pending
+                    pending = None
+                else:
+                    try:
+                        w, events = next(win_iter)
+                    except StopIteration:
+                        if self._stop_reason is None:
+                            self._stop_reason = "end_of_stream"
+                        break
                 if self._stop.is_set():
                     # Includes the carver's trailing partial-window
                     # flush after a stop-interrupted tail: those events
@@ -652,6 +886,11 @@ class StreamDaemon:
                         self._advance_cursor(w)
                         since_ckpt += 1
                     continue
+                coalesced = 1
+                if self._ladder is not None \
+                        and "coalesce" in self._degraded:
+                    w, events, coalesced, pending = self._coalesce(
+                        win_iter, w, events)
                 # Segment clocks: consecutive ``perf_counter_ns`` reads
                 # of ONE clock, so the per-stage deltas telescope to the
                 # measured total EXACTLY (integer equality — the
@@ -679,6 +918,33 @@ class StreamDaemon:
                 t1 = time.perf_counter_ns()
                 ctl.window_index = w + 1
                 ctl._last_window_events = len(events)
+                # Crash-anywhere contract: the cursor advances WITH the
+                # window index, before anything below can land a
+                # checkpoint (the alert path's protective save runs
+                # next).  A snapshot carrying window_index = w+1 with a
+                # cursor still parked on window w's first event would
+                # double-fold window w on resume — the exact torn state
+                # an uncoordinated kill -9 used to be able to persist.
+                self._advance_cursor(w)
+                self._update_lag(w)
+                if self._ladder is not None:
+                    # First-class overload signal in the record stream
+                    # (daemon_lagging alert + post-hoc analysis).  Keyed
+                    # into the record ONLY under a brownout config, so
+                    # the batch-equivalence oracle's records stay
+                    # byte-identical.
+                    rec["daemon"] = {
+                        "lag_bytes": int(self._lag["bytes"]),
+                        "lag_blocks": round(self._lag["blocks"], 3),
+                        "lag_seconds": round(self._lag["seconds"], 3),
+                        "lag_windows": round(self._lag["windows"], 3),
+                        "brownout_level": int(self._ladder.level),
+                        "coalesced": int(coalesced),
+                    }
+                    if coalesced > 1:
+                        self.windows_coalesced += coalesced - 1
+                    self.reads_shed_total += int(
+                        rec.get("reads_shed") or 0)
                 self.records.append(rec)
                 if sink is not None:
                     sink.emit({"kind": "window", **rec})
@@ -688,14 +954,17 @@ class StreamDaemon:
                     w, rec, trace_id=tid if trace_on else None)
                 t3 = time.perf_counter_ns()
                 t4 = t3
-                if cfg.recluster == "minibatch":
+                did_minibatch = (cfg.recluster == "minibatch"
+                                 and "skip_minibatch"
+                                 not in self._degraded)
+                if did_minibatch:
                     self._minibatch_step()
                     t4 = time.perf_counter_ns()
                 segments = {"tail": t_start - ref,
                             "decide": t1 - t_start,
                             "observe": t2 - t1,
                             "publish": t3 - t2}
-                if cfg.recluster == "minibatch":
+                if did_minibatch:
                     segments["minibatch"] = t4 - t3
                 self._record_decision((t4 - t_start) / 1e9)
                 if trace_on or self._obs is not None:
@@ -711,7 +980,11 @@ class StreamDaemon:
                 self._prev_end_ns = t4
                 self.windows_processed += 1
                 since_ckpt += 1
-                self._advance_cursor(w)
+                if self._ladder is not None:
+                    # Ladder steps AFTER the decision: the rung set a
+                    # window ran under is what its record reports; a
+                    # transition here first bites the NEXT window.
+                    self._step_ladder(w, rec, sink)
                 if self._obs is not None:
                     self._publish_snapshot(w, rec, segments, t4 - ref)
                 if checkpoint_path and since_ckpt >= every:
@@ -723,9 +996,6 @@ class StreamDaemon:
                     self.request_stop("max_windows")
                 if deadline is not None and time.monotonic() > deadline:
                     self.request_stop("max_seconds")
-            else:
-                if self._stop_reason is None:
-                    self._stop_reason = "end_of_stream"
         finally:
             if sink is not None and own_sink:
                 sink.close()
@@ -769,4 +1039,18 @@ class StreamDaemon:
         }
         if self.minibatch is not None:
             out["minibatch"] = dict(self.minibatch)
+        if self._ladder is not None:
+            out["lag"] = {
+                "bytes": int(self._lag["bytes"]),
+                "blocks": round(float(self._lag["blocks"]), 3),
+                "seconds": round(float(self._lag["seconds"]), 3),
+                "windows": round(float(self._lag["windows"]), 3),
+            }
+            out["brownout"] = {
+                "level": int(self._ladder.level),
+                "rungs": list(RUNGS[:self._ladder.level]),
+                "transitions": len(self.brownout_log),
+                "windows_coalesced": int(self.windows_coalesced),
+                "reads_shed": int(self.reads_shed_total),
+            }
         return out

@@ -110,17 +110,27 @@ class Scrubber:
         ``shared_left``: bytes remaining of the shared churn budget after
         repairs pre-charged it (None = unshared).  The effective allowance
         is ``min(bytes_per_window, shared_left)``; the first copy of the
-        window is always admitted when any allowance exists (the
-        largest-file-must-not-starve rule repair and migration use).
+        window is admitted past the configured ``bytes_per_window`` pacing
+        (the largest-file-must-not-starve rule repair and migration use)
+        but NEVER past ``shared_left`` — the shared remainder is a hard
+        conservation bound, not a pacing hint: breaching it over-charges
+        the window's churn budget (the ``budget_conserved`` violation the
+        failure-space search banked).  A first copy too large for the
+        remainder is deferred to a richer window and the pass reports
+        ``starved``.
         """
         cap = int(self.cfg.bytes_per_window)
-        if shared_left is not None:
-            cap = min(cap, max(int(shared_left), 0))
+        #: Hard conservation bound: the first-copy override may exceed the
+        #: scrubber's own rate, never the shared remainder.
+        hard = None if shared_left is None else max(int(shared_left), 0)
+        if hard is not None:
+            cap = min(cap, hard)
         rep = ScrubReport()
         if cap <= 0:
             rep.starved = True
             rep.cursor = self.cursor
             return rep
+        blocked_hard = False
         reach = state.node_reachable()
         thr = state.node_throughput
 
@@ -137,8 +147,16 @@ class Scrubber:
                     continue
                 charge = int(np.ceil(int(state.shard_bytes[fid])
                                      / max(float(thr[node]), 1e-9)))
-                if rep.bytes_used + charge > cap and rep.bytes_used > 0:
-                    return False
+                if rep.bytes_used + charge > cap:
+                    if rep.bytes_used > 0:
+                        return False
+                    if hard is not None and charge > hard:
+                        # First copy, but even the full shared remainder
+                        # cannot pay for it: conservation wins over the
+                        # no-starve override.
+                        nonlocal blocked_hard
+                        blocked_hard = True
+                        return False
                 rep.bytes_used += charge
                 rep.copies_verified += 1
                 checked += 1
@@ -186,7 +204,10 @@ class Scrubber:
             csum = rep.bytes_used + np.cumsum(charge)
             kpre = int(np.searchsorted(csum, cap, side="right"))
             if kpre == 0 and rep.bytes_used == 0 and charge.size:
-                kpre = 1
+                if hard is None or int(charge[0]) <= hard:
+                    kpre = 1
+                else:
+                    blocked_hard = True
             if kpre:
                 rep.bytes_used = int(csum[kpre - 1])
                 rep.copies_verified += kpre
@@ -210,8 +231,11 @@ class Scrubber:
             self.cursor = (self.cursor + n_done) % n
             halted = kpre < charge.size
         # Starvation is about the SHARED budget, not the configured rate:
-        # halting because bytes_per_window ran out is normal pacing.
-        rep.starved = halted and cap < int(self.cfg.bytes_per_window)
+        # halting because bytes_per_window ran out is normal pacing.  A
+        # first copy refused because the shared remainder cannot pay for
+        # it is starvation too, whatever the configured rate says.
+        rep.starved = (halted and cap < int(self.cfg.bytes_per_window)) \
+            or blocked_hard
         rep.cursor = self.cursor
         return rep
 

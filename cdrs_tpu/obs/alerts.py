@@ -155,6 +155,13 @@ def default_rules() -> tuple[AlertRule, ...]:
           for_windows=2),
         R("repair_backlog", field="repair_backlog", for_windows=3),
         R("budget_saturated", field="deferred_budget", for_windows=3),
+        # Decision lag: the daemon has fallen >= 2 windows behind the
+        # log head for 2 consecutive windows.  The field only exists on
+        # brownout-enabled daemon records, so batch streams and plain
+        # controller runs never match (rule not applicable, by the
+        # _resolve None contract).
+        R("daemon_lagging", field="daemon.lag_windows", value=2.0,
+          op=">=", for_windows=2),
         R("scrub_starved", field="scrub.starved", for_windows=2),
         R("corruption_detected",
           field=("integrity.detected_scrub", "integrity.detected_read",

@@ -91,6 +91,14 @@ class ObsSnapshot:
     traced_decisions: int = 0
     backlog_events: int = 0
     backlog_bytes: int = 0
+    lag_bytes: int = 0
+    lag_blocks: float = 0.0
+    lag_seconds: float = 0.0
+    lag_windows: float = 0.0
+    brownout_level: int = 0
+    brownout_rungs: tuple = ()
+    reads_shed: int = 0
+    windows_coalesced: int = 0
     decision_seconds: tuple = ()
     decision_p50_seconds: float | None = None
     decision_p99_seconds: float | None = None
@@ -120,13 +128,20 @@ def _metrics_text(snap: ObsSnapshot) -> str:
         "daemon.reclusters": snap.reclusters,
         "daemon.bytes_migrated": snap.bytes_migrated,
         "daemon.traced_decisions": snap.traced_decisions,
+        "daemon.reads_shed": snap.reads_shed,
+        "daemon.windows_coalesced": snap.windows_coalesced,
     }
     for name in sorted(counters):
         lines += prom.counter_lines(name, counters[name])
     gauges = {
         "daemon.backlog_bytes": snap.backlog_bytes,
         "daemon.backlog_events": snap.backlog_events,
+        "daemon.brownout_level": snap.brownout_level,
         "daemon.epoch_id": snap.epoch_id or 0,
+        "daemon.lag_blocks": snap.lag_blocks,
+        "daemon.lag_bytes": snap.lag_bytes,
+        "daemon.lag_seconds": snap.lag_seconds,
+        "daemon.lag_windows": snap.lag_windows,
         "daemon.window": snap.window if snap.window is not None else -1,
         "obs.snapshot_seq": snap.seq,
     }
@@ -162,6 +177,14 @@ def _statusz_json(snap: ObsSnapshot, *, ready: bool, draining: bool,
         "traced_decisions": snap.traced_decisions,
         "backlog": {"events": snap.backlog_events,
                     "bytes": snap.backlog_bytes},
+        "lag": {"bytes": snap.lag_bytes,
+                "blocks": snap.lag_blocks,
+                "seconds": snap.lag_seconds,
+                "windows": snap.lag_windows},
+        "brownout": {"level": snap.brownout_level,
+                     "rungs": list(snap.brownout_rungs),
+                     "reads_shed": snap.reads_shed,
+                     "windows_coalesced": snap.windows_coalesced},
         "decision": {
             "count": len(snap.decision_seconds),
             "p50_seconds": snap.decision_p50_seconds,
@@ -218,8 +241,18 @@ class _Handler(BaseHTTPRequestHandler):
                            "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/healthz":
                 ok, reason = obs.health(snap)
-                self._send(200 if ok else 503,
-                           ("ok\n" if ok else f"unhealthy: {reason}\n"),
+                if ok and snap.brownout_level:
+                    # Designed degradation is not unhealth: the ladder
+                    # shedding load is the daemon WORKING as specified,
+                    # so brownout stays 200 — but the body says so, for
+                    # humans and for probes that grep.
+                    body = (f"ok (degraded: rung {snap.brownout_level} — "
+                            f"{','.join(snap.brownout_rungs)})\n")
+                elif ok:
+                    body = "ok\n"
+                else:
+                    body = f"unhealthy: {reason}\n"
+                self._send(200 if ok else 503, body,
                            "text/plain; charset=utf-8")
             elif path == "/readyz":
                 ready, reason = obs.readiness()

@@ -390,11 +390,19 @@ def _check_invariants(spec: ScenarioSpec, records: list[dict],
                       "detected_repair"))
         inv["corruption_engaged"] = rotted or detected > 0
     if has_ec:
+        # Anti-vacuousness: the EC axis must actually FIRE — a stripe
+        # installed and accounted in SOME window.  Engagement is about
+        # the run, not its final frame: a drift cell that legitimately
+        # promotes planted-archival files to Hot after a workload flip
+        # (cumulative features, decay=1.0) ends with zero EC files while
+        # having exercised the whole encode/repair path mid-run — the
+        # PR-19 search banked exactly that as a false violation.  A run
+        # where no stripe ever lands still fails.
         st = [r for r in records if r.get("storage")]
-        inv["ec_engaged"] = bool(
-            st and st[-1]["storage"]["ec_files"] > 0
-            and st[-1]["storage"]["bytes_stored"]
-            > st[-1]["storage"]["bytes_raw"])
+        inv["ec_engaged"] = bool(st) and any(
+            r["storage"]["ec_files"] > 0
+            and r["storage"]["bytes_stored"] > r["storage"]["bytes_raw"]
+            for r in st)
     if max_bytes is not None:
         # Integrity runs are allowed ONE verified boundary task past the
         # line (``budget_slack``): verified repair (faults/repair.py,

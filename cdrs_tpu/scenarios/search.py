@@ -57,12 +57,12 @@ from .spec import ScenarioSpec
 
 __all__ = ["SEARCH_BASE", "distill_corpus", "load_corpus", "mutate_spec",
            "planted_violation_spec", "run_search", "search_cell_name",
-           "shrink_cell"]
+           "shrink_cell", "triage_corpus"]
 
 #: Cell-name prefixes reserved for generated cells; presets must never
 #: use them (regress/history keys are ``scenario_<name>_*`` — a preset
 #: named like a generated cell would alias its baselines).
-RESERVED_NAME_PREFIXES = ("random-", "search-")
+RESERVED_NAME_PREFIXES = ("random-", "search-", "triage-")
 
 #: Seed corpus of the search: cheap, numpy-only presets spanning the
 #: fault / partition / storage / integrity / serve / drift domains.
@@ -545,6 +545,65 @@ def _bank(corpus_dir: str, entry: dict, sub: str | None = None) -> str:
         json.dump(entry, f, indent=2, sort_keys=True)
         f.write("\n")
     return path
+
+
+def triage_corpus(corpus_dir: str, progress=None) -> dict:
+    """Promote banked violations into named regression-locked cells.
+
+    Every entry under ``<corpus_dir>/violations/`` is a bug the search
+    once found; after the fix lands it must rerun GREEN forever.  This
+    reruns each one (preferring the shrunk minimal spec) under a stable
+    ``triage-*`` name and returns the same ``{"cells", "names"}`` shape
+    ``distill_corpus`` emits — so the triage file plugs straight into
+    the sweep's extra-cells slot and CI regression-locks the whole
+    violation history.  ``ok`` is False while ANY violation still
+    reproduces (the fix has not actually landed)."""
+    vdir = os.path.join(corpus_dir, "violations")
+    entries = []
+    if os.path.isdir(vdir):
+        for fn in sorted(os.listdir(vdir)):
+            path = os.path.join(vdir, fn)
+            if not fn.endswith(".json") or not os.path.isfile(path):
+                continue
+            with open(path, encoding="utf-8") as f:
+                e = json.load(f)
+            if isinstance(e, dict) and "spec" in e:
+                entries.append(e)
+    t0 = time.perf_counter()
+    results, cells, names = [], [], []
+    ok = True
+    for e in entries:
+        src = str(e.get("name") or "unnamed")
+        name = "triage-" + (src[len("search-"):]
+                            if src.startswith("search-") else src)
+        doc = dict((e.get("shrunk") or {}).get("spec") or e["spec"])
+        doc["name"] = name
+        spec = ScenarioSpec.from_dict(doc)
+        cell = run_cell(spec)
+        green = bool(cell["ok"])
+        ok = ok and green
+        results.append({
+            "name": name,
+            "source": src,
+            "ok": green,
+            "failed": sorted(k for k, v in cell["invariants"].items()
+                             if not v),
+            "repro": cell["repro"],
+            "seconds": cell["seconds"],
+        })
+        if progress is not None:
+            progress(f"  [{'ok  ' if green else 'FAIL'}] {name} "
+                     f"(from {src})")
+        cells.append(spec.to_dict())
+        names.append(name)
+    return {
+        "cells": cells,
+        "names": names,
+        "results": results,
+        "n_violations": len(entries),
+        "ok": ok,
+        "seconds": round(time.perf_counter() - t0, 3),
+    }
 
 
 def distill_corpus(entries: list[dict]) -> dict:

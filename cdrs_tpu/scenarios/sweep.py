@@ -15,13 +15,14 @@ a sweep (or CI re-running it) never double-appends rows.
 
 from __future__ import annotations
 
+import json
 import time
 
 from .harness import run_cell
 from .presets import suite_cells
 from .spec import ScenarioSpec
 
-__all__ = ["run_sweep", "format_cell_line"]
+__all__ = ["run_sweep", "format_cell_line", "load_extra_cells"]
 
 
 def format_cell_line(cell: dict) -> str:
@@ -37,11 +38,49 @@ def format_cell_line(cell: dict) -> str:
             f"         repro: {cell['repro']}")
 
 
+def load_extra_cells(paths) -> list[ScenarioSpec]:
+    """Corpus cell files -> validated specs riding along with a suite.
+
+    Each path is a ``{"cells": [spec dicts], "names": [...]}`` document
+    — the exact shape ``distill_corpus`` (distilled.json) and
+    ``triage_corpus`` (triage.json) emit — so the search's curated
+    frontier and the regression-locked violation reruns plug into the
+    CI sweep without a second driver.  Stored names are re-applied so
+    regress/history keys stay stable (``search-*`` / ``triage-*``
+    prefixes are reserved and can never alias a preset)."""
+    specs: list[ScenarioSpec] = []
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except OSError as e:
+            raise ValueError(
+                f"cannot read extra-cells file {path}: "
+                f"{e.strerror or e}") from e
+        if not isinstance(doc, dict) or not isinstance(
+                doc.get("cells"), list):
+            raise ValueError(
+                f"extra-cells file {path} must be a JSON object with a "
+                f"'cells' list (distilled.json / triage.json shape)")
+        names = doc.get("names") or []
+        for i, c in enumerate(doc["cells"]):
+            d = dict(c)
+            if i < len(names):
+                d["name"] = str(names[i])
+            specs.append(ScenarioSpec.from_dict(d))
+    return specs
+
+
 def run_sweep(suite: str, *, seed: int = 0, round_no: int | None = None,
-              history: str | None = None,
+              history: str | None = None, extra=None,
               progress=None) -> dict:
-    """Run every cell of ``suite``; returns the sweep artifact dict."""
-    cells = suite_cells(suite, seed)
+    """Run every cell of ``suite`` (plus any ``extra`` corpus cell
+    files — see :func:`load_extra_cells`); returns the sweep artifact
+    dict.  Extra cells are PINNED repros: the suite seed shifts preset
+    workloads but never touches them."""
+    cells = list(suite_cells(suite, seed))
+    if extra:
+        cells += load_extra_cells(extra)
     return run_cells(cells, suite=suite, seed=seed, round_no=round_no,
                      history=history, progress=progress)
 

@@ -1,0 +1,113 @@
+"""Worker process for the crash-anywhere daemon fuzz.
+
+Launched by tests/test_overload.py as ``python tests/overload_worker.py
+--manifest M --log L.cdrsb --checkpoint C.npz --metrics OUT.jsonl
+--kill N:STAGE [--brownout]``.  It builds the EXACT daemon the parent
+builds (``make_daemon`` is imported by the test so the two can never
+drift), then SIGKILLs its own process at a seeded injection point:
+
+* ``pre``  — immediately before the N-th ``process_window`` call
+  (death mid-ingest, the window's events buffered but undecided)
+* ``post`` — immediately after the N-th ``process_window`` returns,
+  before ANY daemon bookkeeping (cursor advance, record append, epoch
+  publish, checkpoint) — the harshest spot: a whole decision computed
+  and then lost
+* ``save`` — immediately after the first checkpoint write at/after the
+  N-th decision lands (death with a fresh durable cursor)
+
+No cleanup handler runs (it is a real ``SIGKILL``); the crash-anywhere
+contract (daemon/core.py) says the resumed daemon must replay from the
+last durable cursor and produce the same decision stream the
+uninterrupted run did.
+"""
+
+import argparse
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def make_daemon(manifest_path, *, brownout=False, max_windows=None,
+                checkpoint_every=1):
+    """The one daemon-under-test constructor the worker AND the parent
+    test share: windowed controller with serve + scrub + a benign fault
+    schedule (all three brownout levers live), optional aggressive
+    brownout thresholds so lag crosses every rung on a pre-written log.
+    """
+    from cdrs_tpu.config import KMeansConfig, validated_scoring_config
+    from cdrs_tpu.control import ControllerConfig, ReplicationController
+    from cdrs_tpu.daemon import BrownoutConfig, DaemonConfig, StreamDaemon
+    from cdrs_tpu.faults import FaultSchedule, ScrubConfig
+    from cdrs_tpu.io.events import Manifest
+    from cdrs_tpu.serve import ServeConfig
+
+    manifest = Manifest.read_csv(manifest_path)
+    cfg = ControllerConfig(
+        window_seconds=120.0, backend="numpy",
+        kmeans=KMeansConfig(k=8, seed=42),
+        scoring=validated_scoring_config(),
+        serve=ServeConfig(policy="p2c", seed=3),
+        fault_schedule=FaultSchedule.from_specs(["crash:dn2@3-5"]),
+        scrub=ScrubConfig(bytes_per_window=10**9))
+    bc = None
+    if brownout:
+        bc = BrownoutConfig(engage=(0.5, 1.0, 1.5, 2.0, 3.0),
+                            release=(0.2, 0.4, 0.6, 0.8, 1.0), hold=1)
+    return StreamDaemon(
+        ReplicationController(manifest, cfg),
+        DaemonConfig(checkpoint_every=checkpoint_every,
+                     max_windows=max_windows, brownout=bc))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--manifest", required=True)
+    ap.add_argument("--log", required=True)
+    ap.add_argument("--checkpoint", required=True)
+    ap.add_argument("--metrics", required=True)
+    ap.add_argument("--kill", default=None, metavar="N:STAGE",
+                    help="SIGKILL self around the N-th decision: "
+                         "pre | post | save")
+    ap.add_argument("--brownout", action="store_true")
+    args = ap.parse_args()
+
+    daemon = make_daemon(args.manifest, brownout=args.brownout)
+    if args.kill:
+        n_s, stage = args.kill.split(":")
+        kill_n = int(n_s)
+        if stage not in ("pre", "post", "save"):
+            raise SystemExit(f"unknown kill stage {stage!r}")
+        calls = {"n": -1}
+        ctl = daemon.controller
+        orig_pw = ctl.process_window
+
+        def pw(w, events):
+            calls["n"] += 1
+            if stage == "pre" and calls["n"] == kill_n:
+                os.kill(os.getpid(), signal.SIGKILL)
+            rec = orig_pw(w, events)
+            if stage == "post" and calls["n"] == kill_n:
+                os.kill(os.getpid(), signal.SIGKILL)
+            return rec
+
+        ctl.process_window = pw
+        if stage == "save":
+            orig_save = daemon._save
+
+            def save(path):
+                orig_save(path)
+                if calls["n"] >= kill_n:
+                    os.kill(os.getpid(), signal.SIGKILL)
+
+            daemon._save = save
+    daemon.run(args.log, checkpoint_path=args.checkpoint,
+               metrics_path=args.metrics)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

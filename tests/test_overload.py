@@ -1,0 +1,440 @@
+"""Overload resilience (daemon/brownout.py + daemon/core.py backpressure
++ daemon/supervise.py + scenarios triage): ladder semantics, lag-driven
+coalescing determinism, brownout checkpoint/resume bit-equality,
+crash-ANYWHERE (kill -9) recovery via a SIGKILL-injecting subprocess
+worker, the crash supervisor, the daemon_lagging alert, and the
+violation-triage promotion path.
+
+``CDRS_CHAOS_SEED`` varies the workload seeds — CI's overload smoke
+sweeps 0/1/2 so the crash-anywhere contract is not a single-seed
+accident.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import overload_worker
+from cdrs_tpu.config import GeneratorConfig, SimulatorConfig
+from cdrs_tpu.daemon import RUNGS, BrownoutConfig, BrownoutLadder, supervise
+from cdrs_tpu.obs.alerts import evaluate_records
+from cdrs_tpu.sim.access import simulate_access
+from cdrs_tpu.sim.generator import generate_population
+
+SEED = int(os.environ.get("CDRS_CHAOS_SEED", "0"))
+
+
+def _strip(records):
+    return [{k: v for k, v in r.items() if k != "seconds"} for r in records]
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """One pre-written overfed binary log + manifest CSV shared by every
+    daemon run in this module (the log is never mutated)."""
+    d = tmp_path_factory.mktemp("overload")
+    manifest = generate_population(
+        GeneratorConfig(n_files=120, seed=31 + SEED))
+    events = simulate_access(
+        manifest, SimulatorConfig(duration_seconds=1800.0, seed=32 + SEED))
+    mpath = str(d / "m.csv")
+    manifest.write_csv(mpath)
+    lpath = str(d / "ev.cdrsb")
+    # Small blocks: fine-grained cursor positions, so kill points land
+    # mid-window rather than on batch boundaries.
+    events.write_binary(lpath, manifest, block_rows=256)
+    return str(d), mpath, lpath
+
+
+# -- brownout ladder (pure state machine) -----------------------------------
+
+def test_brownout_config_validation():
+    with pytest.raises(ValueError, match="cover all 5 rungs"):
+        BrownoutConfig(engage=(1.0, 2.0))
+    with pytest.raises(ValueError, match="non-decreasing"):
+        BrownoutConfig(engage=(2.0, 1.0, 3.0, 4.0, 5.0))
+    with pytest.raises(ValueError, match="strictly below"):
+        BrownoutConfig(release=(2.0, 1.5, 2.0, 3.0, 4.0))
+    with pytest.raises(ValueError, match="hold"):
+        BrownoutConfig(hold=0)
+    with pytest.raises(ValueError, match="shed_fraction"):
+        BrownoutConfig(shed_fraction=1.5)
+    with pytest.raises(ValueError, match="coalesce_max"):
+        BrownoutConfig(coalesce_max=1)
+
+
+def test_ladder_engages_in_order_and_releases_hysteretically():
+    lad = BrownoutLadder(BrownoutConfig(hold=2))
+    # A lag spike through rung 3's threshold engages three rungs AT ONCE.
+    ts = lad.step(0, 5.0)
+    assert [t["rung"] for t in ts] == list(RUNGS[:3])
+    assert all(t["state"] == "engage" for t in ts)
+    assert lad.modes() == frozenset(RUNGS[:3])
+    # Calm windows release ONE rung per `hold` dwell, top rung first.
+    assert lad.step(1, 0.5) == []          # calm 1/2
+    ts = lad.step(2, 0.5)                  # calm 2/2 -> release
+    assert [(t["rung"], t["state"]) for t in ts] == [("cap_trace",
+                                                      "release")]
+    # A relapse above the CURRENT rung's release bound resets the dwell.
+    assert lad.step(3, 1.9) == []
+    assert lad.calm == 0
+    assert lad.level == 2
+
+
+def test_ladder_burn_trip_wire_engages_whole_ladder():
+    lad = BrownoutLadder(BrownoutConfig(burn_engage=2.0))
+    ts = lad.step(0, 0.0, slo_burn=2.5)    # zero lag, burning budget
+    assert [t["rung"] for t in ts] == list(RUNGS)
+    assert lad.level == len(RUNGS)
+    # The burn holding high blocks release even at zero lag.
+    assert lad.step(1, 0.0, slo_burn=2.5) == []
+    assert lad.calm == 0
+
+
+def test_ladder_state_roundtrip():
+    lad = BrownoutLadder(BrownoutConfig())
+    lad.step(0, 4.5)
+    lad.step(1, 0.0)
+    fresh = BrownoutLadder(BrownoutConfig())
+    fresh.load_state_dict(lad.state_dict())
+    assert (fresh.level, fresh.calm) == (lad.level, lad.calm)
+    fresh.load_state_dict({"level": 99, "calm": -3})  # clamped, not trusted
+    assert (fresh.level, fresh.calm) == (len(RUNGS), 0)
+
+
+# -- overloaded daemon: coalescing + determinism ----------------------------
+
+def test_overfed_daemon_coalesces_deterministically(corpus):
+    """A pre-written (maximally overfed) log: the ladder must engage,
+    coalescing must merge windows mass-conservingly, lag must drain to
+    zero, and a double run must be bit-identical — the decision-
+    reproducibility contract of degraded operation."""
+    _d, mpath, lpath = corpus
+    runs = []
+    for _ in range(2):
+        dm = overload_worker.make_daemon(mpath, brownout=True,
+                                         checkpoint_every=10**6)
+        dig = dm.run(lpath)
+        runs.append((dm, dig))
+    d1, dig1 = runs[0]
+    d2, _dig2 = runs[1]
+    assert _strip(d1.records) == _strip(d2.records)
+    assert d1.brownout_log == d2.brownout_log
+
+    recs = d1.records
+    # The ladder engaged (overfed log => immediate lag spike) and the
+    # coalesce rung actually merged windows.
+    assert dig1["brownout"]["level"] >= 1
+    assert any(t["state"] == "engage" for t in d1.brownout_log)
+    assert any(r["daemon"]["coalesced"] > 1 for r in recs)
+    assert dig1["brownout"]["windows_coalesced"] > 0
+    # Mass conservation: merged decisions still fold every event once.
+    assert sum(r["n_events"] for r in recs) == d1.events_ingested
+    # One epoch per DECISION (the /statusz invariant, under coalescing).
+    assert dig1["epochs_published"] == dig1["windows_processed"] \
+        == len(recs)
+    # The cursor only advances, so lag over a static log is monotone
+    # non-increasing and fully drained at end of stream.
+    lags = [r["daemon"]["lag_bytes"] for r in recs]
+    assert lags == sorted(lags, reverse=True)
+    assert dig1["lag"]["bytes"] == 0 and dig1["lag"]["windows"] == 0.0
+    # Degraded-mode levers actually pulled while engaged: deferred
+    # scrub windows and explicitly-shed reads are reported per record.
+    assert any(r.get("scrub", {}).get("deferred") for r in recs)
+    lvl5 = [r for r in recs if r["daemon"]["brownout_level"]
+            >= len(RUNGS)]
+    if lvl5:
+        assert all(r.get("reads_shed", 0) > 0 for r in lvl5
+                   if r["n_reads"] > 100)
+        # Bounded shed: ~shed_fraction of the window's reads, never all.
+        for r in lvl5:
+            assert r.get("reads_shed", 0) < r["n_reads"]
+
+
+def test_brownout_daemon_off_matches_no_daemon_key(corpus):
+    """brownout=None (the default) must not grow any record schema:
+    the conditional keys protect every pinned artifact."""
+    _d, mpath, lpath = corpus
+    dm = overload_worker.make_daemon(mpath, brownout=False,
+                                     checkpoint_every=10**6)
+    dig = dm.run(lpath)
+    assert all("daemon" not in r for r in dm.records)
+    assert all("deferred" not in r.get("scrub", {}) for r in dm.records)
+    assert "brownout" not in dig and "lag" not in dig
+
+
+def test_brownout_resume_bit_identical_at_every_stop(corpus, tmp_path):
+    """Graceful stop + resume under an ENGAGED ladder: the checkpointed
+    (ladder, lag, estimator) state must make the joined record stream
+    exactly the uninterrupted run's, at every stop point."""
+    _d, mpath, lpath = corpus
+    full = overload_worker.make_daemon(mpath, brownout=True)
+    full.run(lpath)
+    n = len(full.records)
+    assert n >= 3
+    for stop in (1, max(1, n // 2), n - 1):
+        ck = str(tmp_path / f"ck{stop}.npz")
+        d1 = overload_worker.make_daemon(mpath, brownout=True,
+                                         max_windows=stop)
+        d1.run(lpath, checkpoint_path=ck)
+        d2 = overload_worker.make_daemon(mpath, brownout=True)
+        dig2 = d2.run(lpath, checkpoint_path=ck)
+        assert _strip(d1.records) + _strip(d2.records) \
+            == _strip(full.records), f"stop={stop}"
+        assert dig2["epochs_published"] == n
+
+
+# -- crash-anywhere: kill -9 fuzz -------------------------------------------
+
+def _windows(path):
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue  # torn tail of a SIGKILLed writer
+            if e.get("kind") == "window":
+                out.append({k: v for k, v in e.items()
+                            if k != "seconds"})
+    return out
+
+
+@pytest.mark.parametrize("brownout", [False, True])
+def test_kill9_anywhere_resumes_decision_identical(corpus, tmp_path,
+                                                   brownout):
+    """SIGKILL at seeded (decision, stage) points — before a decision,
+    after the decision but before ANY bookkeeping, right after a
+    checkpoint lands — then resume: the deduplicated stitched window
+    stream must equal the uninterrupted run's exactly (which the
+    graceful-stop test above ties to the SIGTERM path), epoch ids must
+    never re-publish, and the final plan state must match."""
+    _d, mpath, lpath = corpus
+    refm = str(tmp_path / "ref.jsonl")
+    ref = overload_worker.make_daemon(mpath, brownout=brownout)
+    refdig = ref.run(lpath, metrics_path=refm)
+    n = len(ref.records)
+
+    rng = np.random.default_rng([SEED, 20, int(brownout)])
+    points = [("pre", int(rng.integers(1, n))),
+              ("post", int(rng.integers(1, n))),
+              ("save", int(rng.integers(0, n - 1)))]
+    for stage, kn in points:
+        tag = f"{stage}{kn}"
+        ck = str(tmp_path / f"{tag}.npz")
+        m1 = str(tmp_path / f"{tag}_kill.jsonl")
+        m2 = str(tmp_path / f"{tag}_resume.jsonl")
+        cmd = [sys.executable,
+               os.path.join(os.path.dirname(__file__),
+                            "overload_worker.py"),
+               "--manifest", mpath, "--log", lpath,
+               "--checkpoint", ck, "--metrics", m1,
+               "--kill", f"{kn}:{stage}"]
+        if brownout:
+            cmd.append("--brownout")
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=300)
+        assert proc.returncode == -signal.SIGKILL, \
+            (stage, kn, proc.returncode, proc.stderr[-2000:])
+
+        d2 = overload_worker.make_daemon(mpath, brownout=brownout)
+        dig2 = d2.run(lpath, checkpoint_path=ck, metrics_path=m2)
+        # Stitch + dedup (the killed run may have emitted records past
+        # its last durable checkpoint; the resume re-decides them — and
+        # both copies must be byte-equal, or the dedup would lie).
+        stitched = {}
+        for r in _windows(m1) + _windows(m2):
+            if r["window"] in stitched:
+                assert stitched[r["window"]] == r, \
+                    f"{tag}: window {r['window']} re-decided differently"
+            stitched[r["window"]] = r
+        want = {r["window"]: r for r in _windows(refm)}
+        assert stitched == want, f"{tag}: stitched stream != reference"
+        # No re-published epoch ids: the resumed publisher continues the
+        # uninterrupted sequence exactly.
+        assert dig2["epochs_published"] == refdig["epochs_published"]
+        np.testing.assert_array_equal(d2.controller.current_rf,
+                                      ref.controller.current_rf)
+        np.testing.assert_array_equal(d2.controller.current_cat,
+                                      ref.controller.current_cat)
+
+
+# -- supervisor --------------------------------------------------------------
+
+def test_supervisor_restarts_then_succeeds(tmp_path):
+    """A child that crashes twice then exits 0: the supervisor restarts
+    it (capped backoff) and reports the eventual clean exit."""
+    counter = tmp_path / "n.txt"
+    prog = ("import pathlib, sys; p = pathlib.Path(r'%s'); "
+            "n = int(p.read_text() or 0) if p.exists() else 0; "
+            "p.write_text(str(n + 1)); sys.exit(0 if n >= 2 else 7)"
+            % counter)
+    lines = []
+    rc = supervise([sys.executable, "-c", prog], max_restarts=5,
+                   backoff_base=0.01, backoff_cap=0.05,
+                   log=lines.append)
+    assert rc == 0
+    assert counter.read_text() == "3"
+    assert sum("restarting in" in ln for ln in lines) == 2
+
+
+def test_supervisor_gives_up_on_crash_loop():
+    lines = []
+    rc = supervise([sys.executable, "-c", "import sys; sys.exit(3)"],
+                   max_restarts=3, backoff_base=0.01, backoff_cap=0.02,
+                   log=lines.append)
+    assert rc == 3
+    assert any("giving up" in ln for ln in lines)
+
+
+def test_supervisor_validates_args():
+    with pytest.raises(ValueError, match="max_restarts"):
+        supervise(["true"], max_restarts=0)
+    with pytest.raises(ValueError, match="backoff"):
+        supervise(["true"], backoff_base=2.0, backoff_cap=1.0)
+
+
+def test_cli_supervise_strips_flags_and_reexecs(monkeypatch):
+    """`cdrs daemon --supervise` must re-exec itself WITHOUT the
+    supervision flags (child recursion would fork-bomb)."""
+    import cdrs_tpu.daemon as daemon_pkg
+    from cdrs_tpu import cli
+
+    seen = {}
+
+    def fake(child_argv, *, max_restarts):
+        seen["argv"] = child_argv
+        seen["max_restarts"] = max_restarts
+        return 0
+
+    # _cmd_daemon does `from .daemon import supervise` at call time,
+    # so patching the package attribute intercepts the re-exec.
+    monkeypatch.setattr(daemon_pkg, "supervise", fake)
+    argv = ["daemon", "--manifest", "m.csv", "--access_log", "a.cdrsb",
+            "--supervise", "--max_restarts", "7", "--brownout"]
+    monkeypatch.setattr(sys, "argv", ["cdrs"] + argv)
+    rc = cli.main(argv)
+    assert rc == 0
+    assert seen["max_restarts"] == 7
+    tail = seen["argv"][3:]  # python -m cdrs_tpu ...
+    assert "--supervise" not in tail and "--max_restarts" not in tail
+    assert "7" not in tail
+    assert "--brownout" in tail
+
+
+# -- daemon_lagging alert ----------------------------------------------------
+
+def test_daemon_lagging_alert_fires_on_sustained_lag():
+    base = {"kind": "window", "n_events": 1}
+    recs = [{**base, "window": w,
+             "daemon": {"lag_windows": lag}}
+            for w, lag in enumerate([0.5, 2.5, 3.0, 3.1, 1.0])]
+    res = {r["name"]: r for r in evaluate_records(recs)}
+    lagging = res["daemon_lagging"]
+    assert lagging["fired"] and not lagging["firing"]
+    assert lagging["since"] == 2  # 2 consecutive windows >= 2.0
+    # Records WITHOUT the daemon key (every batch run) never match.
+    silent = [{**base, "window": w} for w in range(5)]
+    res = {r["name"]: r for r in evaluate_records(silent)}
+    assert not res["daemon_lagging"]["fired"]
+
+
+# -- /healthz under brownout -------------------------------------------------
+
+def test_healthz_reports_degraded_but_stays_200():
+    from cdrs_tpu.obs.httpz import ObsServer, ObsSnapshot
+
+    with ObsServer() as srv:
+        srv.publish(ObsSnapshot(seq=1, brownout_level=2,
+                                brownout_rungs=RUNGS[:2]))
+        with urllib.request.urlopen(srv.url + "/healthz",
+                                    timeout=5) as r:
+            body = r.read().decode()
+            assert r.status == 200
+        assert "degraded: rung 2" in body
+        assert "defer_scrub" in body
+        with urllib.request.urlopen(srv.url + "/statusz",
+                                    timeout=5) as r:
+            doc = json.loads(r.read().decode())
+        assert doc["brownout"]["level"] == 2
+        assert doc["brownout"]["rungs"] == list(RUNGS[:2])
+        assert doc["lag"] == {"bytes": 0, "blocks": 0.0, "seconds": 0.0,
+                              "windows": 0.0}
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=5) as r:
+            text = r.read().decode()
+        assert "cdrs_daemon_brownout_level 2" in text
+        assert "cdrs_daemon_lag_windows 0" in text
+
+
+# -- triage + extra-cells ----------------------------------------------------
+
+def test_triage_promotes_green_violations(tmp_path):
+    from cdrs_tpu.scenarios import preset
+    from cdrs_tpu.scenarios.search import triage_corpus
+
+    corpus = tmp_path / "corpus"
+    vdir = corpus / "violations"
+    vdir.mkdir(parents=True)
+    spec = preset("chaos-kill").to_dict()
+    (vdir / "search-s0-deadbeef-bad.json").write_text(json.dumps({
+        "name": "search-s0-deadbeef-bad", "spec": spec,
+        "shrunk": {"spec": spec}}))
+    out = triage_corpus(str(corpus))
+    assert out["ok"] and out["n_violations"] == 1
+    assert out["names"] == ["triage-s0-deadbeef-bad"]
+    assert out["cells"][0]["name"] == "triage-s0-deadbeef-bad"
+    assert out["results"][0]["source"] == "search-s0-deadbeef-bad"
+
+
+def test_triage_flags_still_red_violations(tmp_path):
+    from cdrs_tpu.scenarios.search import (planted_violation_spec,
+                                           triage_corpus)
+
+    corpus = tmp_path / "corpus"
+    vdir = corpus / "violations"
+    vdir.mkdir(parents=True)
+    (vdir / "search-s0-00000000-bad.json").write_text(json.dumps({
+        "name": "search-s0-00000000-bad",
+        "spec": planted_violation_spec().to_dict()}))
+    out = triage_corpus(str(corpus))
+    assert not out["ok"]
+    assert out["results"][0]["failed"]  # names the violated invariants
+
+
+def test_load_extra_cells_applies_names_and_validates(tmp_path):
+    from cdrs_tpu.scenarios import preset
+    from cdrs_tpu.scenarios.sweep import load_extra_cells
+
+    doc = {"cells": [preset("chaos-kill").to_dict()],
+           "names": ["triage-s0-feedface"]}
+    p = tmp_path / "triage.json"
+    p.write_text(json.dumps(doc))
+    specs = load_extra_cells([str(p)])
+    assert [s.name for s in specs] == ["triage-s0-feedface"]
+    with pytest.raises(ValueError, match="cannot read"):
+        load_extra_cells([str(tmp_path / "missing.json")])
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"nope": 1}))
+    with pytest.raises(ValueError, match="'cells' list"):
+        load_extra_cells([str(bad)])
+
+
+def test_committed_corpus_files_load_as_extra_cells():
+    """The committed distilled.json + triage.json must stay loadable —
+    CI feeds them to every ci-smoke sweep."""
+    from cdrs_tpu.scenarios.sweep import load_extra_cells
+
+    paths = ["data/search_corpus/distilled.json",
+             "data/search_corpus/triage.json"]
+    specs = load_extra_cells([p for p in paths if os.path.exists(p)])
+    assert specs
+    assert all(s.name.startswith(("search-", "triage-")) for s in specs)
